@@ -1,0 +1,30 @@
+"""k8s_watcher_tpu — a TPU-native Kubernetes pod-slice watcher framework.
+
+A brand-new framework with the capabilities of ``highreso-gpu/k8s-watcher``
+(see SURVEY.md for the reference analysis), retargeted from GPU pods to GKE
+TPU pod-slices:
+
+- layered YAML/env config stack   (parity: reference pod_watcher.py:19-75)
+- resilient k8s watch loop        (reference pod_watcher.py:243-277 had none)
+- ``google.com/tpu`` resource filter + multi-host slice topology (net-new)
+- async HTTP notifier             (parity: reference clusterapi_client.py)
+- in-slice JAX/XLA health probe   (net-new: jax.devices() + timed ICI psum)
+
+Layout:
+
+- ``config``    layered config loader + typed schema
+- ``watch``     watch-source protocol + in-process fake source
+- ``k8s``       native k8s REST client (kubeconfig, list+watch, mock server)
+- ``pipeline``  event pipeline: filters -> phase-delta -> extract
+- ``slices``    TPU slice topology inference + slice-state aggregation
+- ``notify``    clusterapi HTTP client + async dispatcher
+- ``probe``     in-slice JAX health probe (device enum, ICI psum RTT, MXU)
+- ``parallel``  mesh / collective helpers shared by the probe plane
+- ``metrics``   latency histograms + counters
+- ``state``     checkpoint/resume (resourceVersion + slice cache)
+- ``faults``    fault-injection hooks for churn testing
+"""
+
+__version__ = "0.1.0"
+
+from k8s_watcher_tpu.config.loader import load_config, ConfigError  # noqa: F401

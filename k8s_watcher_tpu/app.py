@@ -1,0 +1,188 @@
+"""Application wiring: config -> source -> pipeline -> dispatcher.
+
+This replaces the reference's ``PodWatcher`` god-class (pod_watcher.py:10-277)
+with explicit composition. ``WatcherApp.run()`` is the steady-state loop the
+reference ran at pod_watcher.py:266-269, now over a pluggable source with
+the notifier fully async.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from k8s_watcher_tpu.config.schema import AppConfig
+from k8s_watcher_tpu.metrics import MetricsRegistry
+from k8s_watcher_tpu.notify.client import ClusterApiClient
+from k8s_watcher_tpu.notify.dispatcher import Dispatcher
+from k8s_watcher_tpu.pipeline.filters import CriticalEventGate, NamespaceFilter, TpuResourceFilter
+from k8s_watcher_tpu.pipeline.phase import PhaseTracker
+from k8s_watcher_tpu.pipeline.pipeline import EventPipeline
+from k8s_watcher_tpu.slices.tracker import SliceTracker
+from k8s_watcher_tpu.state.checkpoint import CheckpointStore
+from k8s_watcher_tpu.watch.source import WatchSource
+
+logger = logging.getLogger(__name__)
+
+
+def build_notifier(config: AppConfig) -> ClusterApiClient:
+    c = config.clusterapi
+    return ClusterApiClient(
+        c.base_url,
+        c.api_key,
+        c.timeout,
+        pod_update_endpoint=c.pod_update_endpoint,
+        health_endpoint=c.health_endpoint,
+        retry=c.retry,
+    )
+
+
+def build_source(config: AppConfig, checkpoint: Optional[CheckpointStore] = None) -> WatchSource:
+    """Pick the watch source for this environment.
+
+    ``kubernetes.use_mock`` (a dead key in the reference — SURVEY.md §2
+    defect #3) now has a real meaning: run against the in-process mock API
+    server/fake source instead of a live cluster.
+    """
+    if config.kubernetes.use_mock:
+        from k8s_watcher_tpu.watch.fake import FakeWatchSource, pod_lifecycle
+
+        logger.info("use_mock=true: replaying an in-process fake pod lifecycle")
+        return FakeWatchSource(
+            pod_lifecycle("mock-tpu-pod", "default", phases=("Pending", "Running"), tpu_chips=4),
+            hold_open=True,
+        )
+
+    from k8s_watcher_tpu.k8s.client import K8sClient
+    from k8s_watcher_tpu.k8s.kubeconfig import load_connection
+    from k8s_watcher_tpu.k8s.watch import KubernetesWatchSource
+
+    connection = load_connection(
+        use_incluster=config.kubernetes.use_incluster_config,
+        config_file=config.kubernetes.config_file,
+        verify_tls=config.kubernetes.verify_tls,
+    )
+    client = K8sClient(connection, request_timeout=config.kubernetes.request_timeout)
+    version = client.get_api_version()
+    logger.info("Successfully connected to Kubernetes API version: %s", version)
+    return KubernetesWatchSource(
+        client,
+        retry=config.watcher.retry,
+        watch_timeout_seconds=config.kubernetes.watch_timeout_seconds,
+        checkpoint=checkpoint,
+    )
+
+
+class WatcherApp:
+    def __init__(
+        self,
+        config: AppConfig,
+        *,
+        source: Optional[WatchSource] = None,
+        notifier: Optional[ClusterApiClient] = None,
+        metrics: Optional[MetricsRegistry] = None,
+    ):
+        self.config = config
+        self.metrics = metrics or MetricsRegistry()
+        self.checkpoint = (
+            CheckpointStore(config.state.checkpoint_path, interval_seconds=config.state.checkpoint_interval_seconds)
+            if config.state.checkpoint_path
+            else None
+        )
+        self.notifier = notifier or build_notifier(config)
+        self.dispatcher = Dispatcher(
+            self.notifier.update_pod_status,
+            capacity=config.clusterapi.queue_capacity,
+            workers=config.clusterapi.workers,
+            metrics=self.metrics,
+        )
+        self.source = source or build_source(config, self.checkpoint)
+        self.slice_tracker = SliceTracker(
+            config.environment,
+            resource_key=config.tpu.resource_key,
+            topology_label=config.tpu.topology_label,
+            accelerator_label=config.tpu.accelerator_label,
+        )
+        self.phase_tracker = PhaseTracker()
+        if self.checkpoint is not None:
+            self.phase_tracker.restore(self.checkpoint.get("phases", {}) or {})
+            self.slice_tracker.restore(self.checkpoint.get("slices", {}) or {})
+        self.pipeline = EventPipeline(
+            environment=config.environment,
+            sink=self.dispatcher.submit,
+            namespace_filter=NamespaceFilter(config.watcher.namespaces),
+            resource_filter=TpuResourceFilter(config.tpu.resource_key),
+            critical_gate=CriticalEventGate(config.environment, config.watcher.critical_events_only),
+            phase_tracker=self.phase_tracker,
+            slice_tracker=self.slice_tracker,
+            metrics=self.metrics,
+            resource_key=config.tpu.resource_key,
+            topology_label=config.tpu.topology_label,
+            accelerator_label=config.tpu.accelerator_label,
+        )
+        self._stop = threading.Event()
+        self._probe_agent = None
+        if config.tpu.probe_enabled:
+            from k8s_watcher_tpu.probe.agent import ProbeAgent
+
+            self._probe_agent = ProbeAgent(
+                config.tpu,
+                environment=config.environment,
+                sink=self.dispatcher.submit,
+                metrics=self.metrics,
+            )
+
+    def run(self) -> None:
+        """Blocking steady-state loop (parity: pod_watcher.py:243-277)."""
+        self.dispatcher.start()
+        if self.notifier.health_check():
+            logger.info("ClusterAPI health check passed")
+        else:
+            logger.warning("ClusterAPI health check failed, but continuing...")
+
+        namespaces = self.config.watcher.namespaces
+        logger.info(
+            "Monitoring %s", f"namespaces: {list(namespaces)}" if namespaces else "all namespaces"
+        )
+        if self._probe_agent is not None:
+            self._probe_agent.start()
+        try:
+            for event in self.source.events():
+                if self._stop.is_set():
+                    break
+                self.pipeline.process(event)
+                self._maybe_checkpoint()
+        except KeyboardInterrupt:
+            logger.info("Stopping Pod watcher...")
+        finally:
+            self.shutdown()
+
+    def _maybe_checkpoint(self, force: bool = False) -> None:
+        if self.checkpoint is None:
+            return
+        # snapshots are O(tracked pods); only build them when the throttled
+        # store will actually flush (or at shutdown)
+        if not (force or self.checkpoint.due()):
+            return
+        self.checkpoint.put("phases", self.phase_tracker.snapshot())
+        self.checkpoint.put("slices", self.slice_tracker.snapshot())
+        known = getattr(self.source, "known_pods", None)
+        if callable(known):
+            # persist the live-pod map so a post-restart relist can still
+            # synthesize DELETED events for pods that vanished while down
+            self.checkpoint.put("known_pods", known())
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.source.stop()
+
+    def shutdown(self) -> None:
+        self.source.stop()
+        if self._probe_agent is not None:
+            self._probe_agent.stop()
+        self.dispatcher.stop()
+        if self.checkpoint is not None:
+            self._maybe_checkpoint(force=True)
+            self.checkpoint.flush()
+        logger.info("Watcher metrics: %s", self.metrics.dump())

@@ -1,0 +1,41 @@
+"""CLI entrypoint (parity: reference main.py).
+
+Environment resolution order: CLI argument > ``ENVIRONMENT`` env var >
+``development`` default (main.py:7-10), validated against the supported set
+(main.py:13-17); exit code 1 on any startup error (main.py:25-27).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Optional, Sequence
+
+from k8s_watcher_tpu.config.loader import ConfigError, load_config, resolve_environment
+from k8s_watcher_tpu.logging_setup import setup_logging
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    try:
+        environment = resolve_environment(argv[:1])
+    except ConfigError as exc:
+        print(f"Error: {exc}")
+        return 1
+
+    print(f"Starting k8s-watcher-tpu in '{environment}' environment")
+    try:
+        config = load_config(environment)
+        setup_logging(environment, config.watcher.log_level)
+        from k8s_watcher_tpu.app import WatcherApp
+
+        WatcherApp(config).run()
+    except KeyboardInterrupt:
+        return 0
+    except Exception as exc:
+        print(f"Error starting watcher: {exc}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

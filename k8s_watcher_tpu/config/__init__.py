@@ -1,0 +1,27 @@
+"""Layered configuration subsystem.
+
+Contract parity with the reference config stack (pod_watcher.py:19-75,
+config/*.yaml): base + environment overlay with recursive merge, then
+``${VAR}`` / ``${VAR:-default}`` environment-variable substitution over the
+whole tree; missing files degrade to ``{}`` with a warning.
+
+Improvements over the reference (SURVEY.md §2 defect #3): every key is either
+consumed by the typed schema or rejected — no dead keys.
+"""
+
+from k8s_watcher_tpu.config.loader import (  # noqa: F401
+    ConfigError,
+    deep_merge,
+    load_config,
+    load_yaml_file,
+    resolve_environment,
+    substitute_env_vars,
+)
+from k8s_watcher_tpu.config.schema import (  # noqa: F401
+    AppConfig,
+    ClusterApiConfig,
+    KubernetesConfig,
+    RetryPolicy,
+    TpuConfig,
+    WatcherConfig,
+)

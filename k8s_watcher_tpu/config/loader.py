@@ -1,0 +1,135 @@
+"""Config loading: base + environment overlay + env-var substitution.
+
+Contract parity with the reference (pod_watcher.py:19-75):
+
+- ``config/base.yaml`` is loaded first, then ``config/{environment}.yaml``
+  is overlaid with a recursive dict merge where the overlay wins
+  (pod_watcher.py:47-57).
+- String values of the exact form ``${VAR}`` or ``${VAR:-default}`` are
+  replaced from the process environment (pod_watcher.py:59-75). Only
+  whole-string tokens are substituted, matching the reference contract.
+- A missing config file degrades to ``{}`` with a warning
+  (pod_watcher.py:39-41); a malformed file is an error (the reference
+  swallowed parse errors into ``{}`` — we consider that a defect and raise).
+
+Environment resolution order (main.py:7-10): ``ENVIRONMENT`` env var, then
+CLI argument, then the default ``development``; validated against the
+supported set (main.py:13-17).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from pathlib import Path
+from typing import Any, Dict, Mapping, Optional, Sequence
+
+import yaml
+
+from k8s_watcher_tpu.config.schema import AppConfig, SchemaError
+
+logger = logging.getLogger(__name__)
+
+SUPPORTED_ENVIRONMENTS = ("development", "staging", "production")
+DEFAULT_ENVIRONMENT = "development"
+
+
+class ConfigError(Exception):
+    """Raised for unreadable/malformed config files or schema violations."""
+
+
+def resolve_environment(
+    argv: Optional[Sequence[str]] = None,
+    env: Optional[Mapping[str, str]] = None,
+) -> str:
+    """Resolve the runtime environment name.
+
+    Order (reference main.py:7-10): CLI argument overrides the
+    ``ENVIRONMENT`` env var, which overrides the default. Raises
+    ``ConfigError`` for unsupported names (reference main.py:13-17 exits 1).
+    """
+    env = os.environ if env is None else env
+    name = env.get("ENVIRONMENT", DEFAULT_ENVIRONMENT)
+    if argv:
+        name = argv[0]
+    if name not in SUPPORTED_ENVIRONMENTS:
+        raise ConfigError(
+            f"Unsupported environment '{name}'. Supported environments: {list(SUPPORTED_ENVIRONMENTS)}"
+        )
+    return name
+
+
+def load_yaml_file(path: os.PathLike | str) -> Dict[str, Any]:
+    """Load one YAML file; missing -> {} with a warning; malformed -> error."""
+    path = Path(path)
+    try:
+        with open(path, "r") as fh:
+            data = yaml.safe_load(fh)
+    except FileNotFoundError:
+        logger.warning("Config file %s not found", path)
+        return {}
+    except yaml.YAMLError as exc:
+        raise ConfigError(f"Error loading config {path}: {exc}") from exc
+    if data is None:
+        return {}  # empty file (e.g. reference staging.yaml is 0 bytes)
+    if not isinstance(data, dict):
+        raise ConfigError(f"Config {path} must be a mapping, got {type(data).__name__}")
+    return data
+
+
+def deep_merge(base: Mapping[str, Any], override: Mapping[str, Any]) -> Dict[str, Any]:
+    """Recursive merge; override wins (parity: pod_watcher.py:47-57)."""
+    result: Dict[str, Any] = dict(base)
+    for key, value in override.items():
+        if key in result and isinstance(result[key], Mapping) and isinstance(value, Mapping):
+            result[key] = deep_merge(result[key], value)
+        else:
+            result[key] = value
+    return result
+
+
+def substitute_env_vars(obj: Any, env: Optional[Mapping[str, str]] = None) -> Any:
+    """Replace whole-string ``${VAR}`` / ``${VAR:-default}`` tokens.
+
+    Parity: pod_watcher.py:59-75 — substitution applies only when the entire
+    string starts with ``${`` and ends with ``}``; an unset variable with no
+    default becomes ``""``.
+    """
+    env = os.environ if env is None else env
+    if isinstance(obj, Mapping):
+        return {k: substitute_env_vars(v, env) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [substitute_env_vars(v, env) for v in obj]
+    if isinstance(obj, str) and obj.startswith("${") and obj.endswith("}"):
+        token = obj[2:-1]
+        default = ""
+        if ":-" in token:
+            token, default = token.split(":-", 1)
+        return env.get(token, default)
+    return obj
+
+
+def load_raw_config(
+    environment: str,
+    config_dir: os.PathLike | str = "config",
+    env: Optional[Mapping[str, str]] = None,
+) -> Dict[str, Any]:
+    """base.yaml + {environment}.yaml merge + env substitution, unvalidated."""
+    config_dir = Path(config_dir)
+    base = load_yaml_file(config_dir / "base.yaml")
+    overlay = load_yaml_file(config_dir / f"{environment}.yaml")
+    merged = deep_merge(base, overlay)
+    return substitute_env_vars(merged, env)
+
+
+def load_config(
+    environment: str,
+    config_dir: os.PathLike | str = "config",
+    env: Optional[Mapping[str, str]] = None,
+) -> AppConfig:
+    """Load, merge, substitute and validate the config for ``environment``."""
+    raw = load_raw_config(environment, config_dir, env)
+    try:
+        return AppConfig.from_raw(raw, environment)
+    except SchemaError as exc:
+        raise ConfigError(str(exc)) from exc

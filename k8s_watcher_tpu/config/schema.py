@@ -1,0 +1,356 @@
+"""Typed configuration schema.
+
+Every key that appears in ``config/*.yaml`` maps to a field here and is
+consumed somewhere in the framework; unknown keys are rejected by the loader.
+This fixes reference defect #3 (SURVEY.md §2: dead config keys —
+``watcher.watch_interval``, both ``retry`` blocks, ``clusterapi.endpoints``,
+``clusterapi.timeout`` and ``kubernetes.use_mock`` were never consumed by
+the reference).
+
+Schema parity map (reference file:line -> field):
+
+- base.yaml:4   watcher.watch_interval      -> WatcherConfig.watch_interval
+- base.yaml:7   watcher.log_level           -> WatcherConfig.log_level
+- base.yaml:10  watcher.retry               -> WatcherConfig.retry (now wired
+                                               into the resilient watch loop)
+- base.yaml:16  clusterapi.endpoints        -> ClusterApiConfig.endpoints (now
+                                               wired; reference hardcoded the
+                                               path at clusterapi_client.py:30)
+- base.yaml:21  clusterapi.timeout          -> ClusterApiConfig.timeout (now
+                                               actually passed to requests)
+- development.yaml:6  kubernetes.config_file -> KubernetesConfig.config_file
+- development.yaml:7  kubernetes.use_mock    -> KubernetesConfig.use_mock (now
+                                               selects the in-process fake
+                                               watch source)
+- production.yaml:6   kubernetes.use_incluster_config
+                                            -> KubernetesConfig.use_incluster_config
+- production.yaml:24  watcher.alerts.critical_events_only
+                                            -> WatcherConfig.critical_events_only
+
+The ``tpu:`` section is net-new (north star): backend selection, the
+accelerator resource key (``google.com/tpu``), slice-topology expectations,
+and probe cadence/thresholds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Optional, Sequence
+
+
+class SchemaError(ValueError):
+    """A config value failed schema validation."""
+
+
+def _type_name(value: Any) -> str:
+    return type(value).__name__
+
+
+def _expect(value: Any, types: tuple, path: str) -> Any:
+    if not isinstance(value, types):
+        wanted = "/".join(t.__name__ for t in types)
+        raise SchemaError(f"config key '{path}': expected {wanted}, got {_type_name(value)} ({value!r})")
+    # bool is a subclass of int — reject bools where ints are wanted.
+    if bool not in types and isinstance(value, bool) and int in types:
+        raise SchemaError(f"config key '{path}': expected int, got bool")
+    return value
+
+
+def _opt_str(raw: Mapping[str, Any], key: str, path: str, default: Optional[str] = None) -> Optional[str]:
+    if key not in raw or raw[key] is None:
+        return default
+    v = _expect(raw[key], (str,), f"{path}.{key}")
+    return v if v != "" else default
+
+
+def _opt_num(raw: Mapping[str, Any], key: str, path: str, default: float) -> float:
+    if key not in raw or raw[key] is None:
+        return default
+    v = raw[key]
+    if isinstance(v, str):  # env-substituted values arrive as strings
+        if v.strip() == "":
+            return default
+        try:
+            return float(v)
+        except ValueError:
+            raise SchemaError(f"config key '{path}.{key}': not a number: {v!r}")
+    return float(_expect(v, (int, float), f"{path}.{key}"))
+
+
+def _opt_int(raw: Mapping[str, Any], key: str, path: str, default: int) -> int:
+    if key not in raw or raw[key] is None:
+        return default
+    v = raw[key]
+    if isinstance(v, str):  # env-substituted values arrive as strings
+        if v.strip() == "":
+            return default
+        try:
+            return int(v)
+        except ValueError:
+            raise SchemaError(f"config key '{path}.{key}': not an integer: {v!r}")
+    return _expect(v, (int,), f"{path}.{key}")
+
+
+def _opt_bool(raw: Mapping[str, Any], key: str, path: str, default: bool) -> bool:
+    if key not in raw or raw[key] is None:
+        return default
+    v = raw[key]
+    # env-substituted values arrive as strings ("true"/"false")
+    if isinstance(v, str):
+        low = v.strip().lower()
+        if low in ("true", "1", "yes", "on"):
+            return True
+        if low in ("false", "0", "no", "off", ""):
+            return False
+        raise SchemaError(f"config key '{path}.{key}': not a boolean: {v!r}")
+    return _expect(v, (bool,), f"{path}.{key}")
+
+
+def _check_known(raw: Mapping[str, Any], known: Sequence[str], path: str) -> None:
+    unknown = sorted(set(raw) - set(known))
+    if unknown:
+        raise SchemaError(f"unknown config key(s) under '{path}': {', '.join(unknown)} (known: {', '.join(sorted(known))})")
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Retry/backoff policy (reference base.yaml:10-12,24-26 — dead there, wired here)."""
+
+    max_attempts: int = 3
+    delay_seconds: float = 5.0
+    # net-new: exponential backoff knobs for the resilient watch loop
+    max_delay_seconds: float = 60.0
+    backoff_multiplier: float = 2.0
+
+    @classmethod
+    def from_raw(cls, raw: Mapping[str, Any], path: str, *, delay_default: float = 5.0) -> "RetryPolicy":
+        _check_known(raw, ("max_attempts", "delay_seconds", "max_delay_seconds", "backoff_multiplier"), path)
+        return cls(
+            max_attempts=_opt_int(raw, "max_attempts", path, 3),
+            delay_seconds=_opt_num(raw, "delay_seconds", path, delay_default),
+            max_delay_seconds=_opt_num(raw, "max_delay_seconds", path, 60.0),
+            backoff_multiplier=_opt_num(raw, "backoff_multiplier", path, 2.0),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class WatcherConfig:
+    """The ``watcher:`` section (reference base.yaml:1-12, production.yaml:16-25)."""
+
+    watch_interval: float = 1.0
+    log_level: str = "INFO"
+    namespaces: tuple = ()
+    retry: RetryPolicy = dataclasses.field(default_factory=RetryPolicy)
+    critical_events_only: bool = False
+
+    @classmethod
+    def from_raw(cls, raw: Mapping[str, Any]) -> "WatcherConfig":
+        _check_known(raw, ("watch_interval", "log_level", "namespaces", "retry", "alerts"), "watcher")
+        namespaces = raw.get("namespaces") or ()
+        if namespaces:
+            _expect(namespaces, (list, tuple), "watcher.namespaces")
+            namespaces = tuple(_expect(ns, (str,), "watcher.namespaces[]") for ns in namespaces)
+        alerts = raw.get("alerts") or {}
+        _expect(alerts, (dict,), "watcher.alerts")
+        _check_known(alerts, ("critical_events_only",), "watcher.alerts")
+        level = _expect(raw.get("log_level", "INFO"), (str,), "watcher.log_level").upper()
+        if level not in ("DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"):
+            raise SchemaError(f"config key 'watcher.log_level': invalid level {level!r}")
+        return cls(
+            watch_interval=_opt_num(raw, "watch_interval", "watcher", 1.0),
+            log_level=level,
+            namespaces=namespaces,
+            retry=RetryPolicy.from_raw(raw.get("retry") or {}, "watcher.retry", delay_default=5.0),
+            critical_events_only=_opt_bool(alerts, "critical_events_only", "watcher.alerts", False),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterApiConfig:
+    """The ``clusterapi:`` section (reference base.yaml:14-26, clusterapi_client.py).
+
+    Unlike the reference, ``endpoints`` and ``timeout`` are actually consumed
+    (reference hardcoded ``/api/pods/update`` at clusterapi_client.py:30 and
+    never passed a timeout to requests.post at :36).
+    """
+
+    base_url: str = "http://localhost:3000"
+    api_key: Optional[str] = None
+    pod_update_endpoint: str = "/api/pods/update"
+    health_endpoint: str = "/health"
+    timeout: float = 30.0
+    retry: RetryPolicy = dataclasses.field(default_factory=lambda: RetryPolicy(delay_seconds=2.0))
+    # net-new: async dispatcher knobs (queue + worker so one slow POST can't
+    # stall the watch stream — prerequisite for the <1s p50 target)
+    queue_capacity: int = 1024
+    workers: int = 2
+
+    @classmethod
+    def from_raw(cls, raw: Mapping[str, Any]) -> "ClusterApiConfig":
+        _check_known(
+            raw,
+            ("base_url", "auth", "endpoints", "timeout", "retry", "queue_capacity", "workers"),
+            "clusterapi",
+        )
+        auth = raw.get("auth") or {}
+        _expect(auth, (dict,), "clusterapi.auth")
+        _check_known(auth, ("api_key",), "clusterapi.auth")
+        endpoints = raw.get("endpoints") or {}
+        _expect(endpoints, (dict,), "clusterapi.endpoints")
+        _check_known(endpoints, ("pod_update", "health"), "clusterapi.endpoints")
+        return cls(
+            base_url=_opt_str(raw, "base_url", "clusterapi", "http://localhost:3000").rstrip("/"),
+            api_key=_opt_str(auth, "api_key", "clusterapi.auth", None),
+            pod_update_endpoint=_opt_str(endpoints, "pod_update", "clusterapi.endpoints", "/api/pods/update"),
+            health_endpoint=_opt_str(endpoints, "health", "clusterapi.endpoints", "/health"),
+            timeout=_opt_num(raw, "timeout", "clusterapi", 30.0),
+            retry=RetryPolicy.from_raw(raw.get("retry") or {}, "clusterapi.retry", delay_default=2.0),
+            queue_capacity=_opt_int(raw, "queue_capacity", "clusterapi", 1024),
+            workers=_opt_int(raw, "workers", "clusterapi", 2),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class KubernetesConfig:
+    """The ``kubernetes:`` section (reference development.yaml:4-7, production.yaml:4-8)."""
+
+    use_incluster_config: bool = False
+    config_file: Optional[str] = None
+    use_mock: bool = False
+    # net-new: resilient-watch knobs (reference had no reconnect at all —
+    # SURVEY.md §2 defect #4)
+    request_timeout: float = 30.0
+    watch_timeout_seconds: int = 300
+    verify_tls: bool = True
+
+    @classmethod
+    def from_raw(cls, raw: Mapping[str, Any]) -> "KubernetesConfig":
+        _check_known(
+            raw,
+            ("use_incluster_config", "config_file", "use_mock", "request_timeout", "watch_timeout_seconds", "verify_tls"),
+            "kubernetes",
+        )
+        return cls(
+            use_incluster_config=_opt_bool(raw, "use_incluster_config", "kubernetes", False),
+            config_file=_opt_str(raw, "config_file", "kubernetes", None),
+            use_mock=_opt_bool(raw, "use_mock", "kubernetes", False),
+            request_timeout=_opt_num(raw, "request_timeout", "kubernetes", 30.0),
+            watch_timeout_seconds=_opt_int(raw, "watch_timeout_seconds", "kubernetes", 300),
+            verify_tls=_opt_bool(raw, "verify_tls", "kubernetes", True),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuConfig:
+    """The ``tpu:`` section — net-new (north star: BASELINE.json).
+
+    Selects the accelerator backend, the pod resource key used by the
+    resource filter, slice-topology expectations, and in-slice probe
+    cadence/thresholds.
+    """
+
+    backend: str = "tpu"  # "tpu" | "gpu" (gpu-compat mode filters nvidia.com/gpu)
+    resource_key: str = "google.com/tpu"
+    # GKE labels/annotations used for slice-topology inference
+    topology_label: str = "cloud.google.com/gke-tpu-topology"
+    accelerator_label: str = "cloud.google.com/gke-tpu-accelerator"
+    # probe plane
+    probe_enabled: bool = False
+    probe_interval_seconds: float = 30.0
+    probe_payload_bytes: int = 4 * 1024 * 1024
+    probe_rtt_warn_ms: float = 50.0
+    probe_matmul_size: int = 1024
+    expected_chips_per_host: int = 0  # 0 = don't enforce
+
+    @classmethod
+    def from_raw(cls, raw: Mapping[str, Any]) -> "TpuConfig":
+        _check_known(
+            raw,
+            (
+                "backend",
+                "resource_key",
+                "topology_label",
+                "accelerator_label",
+                "probe",
+            ),
+            "tpu",
+        )
+        backend = _opt_str(raw, "backend", "tpu", "tpu")
+        if backend not in ("tpu", "gpu"):
+            raise SchemaError(f"config key 'tpu.backend': must be 'tpu' or 'gpu', got {backend!r}")
+        default_key = "google.com/tpu" if backend == "tpu" else "nvidia.com/gpu"
+        probe = raw.get("probe") or {}
+        _expect(probe, (dict,), "tpu.probe")
+        _check_known(
+            probe,
+            ("enabled", "interval_seconds", "payload_bytes", "rtt_warn_ms", "matmul_size", "expected_chips_per_host"),
+            "tpu.probe",
+        )
+        return cls(
+            backend=backend,
+            resource_key=_opt_str(raw, "resource_key", "tpu", default_key),
+            topology_label=_opt_str(raw, "topology_label", "tpu", cls.topology_label),
+            accelerator_label=_opt_str(raw, "accelerator_label", "tpu", cls.accelerator_label),
+            probe_enabled=_opt_bool(probe, "enabled", "tpu.probe", False),
+            probe_interval_seconds=_opt_num(probe, "interval_seconds", "tpu.probe", 30.0),
+            probe_payload_bytes=_opt_int(probe, "payload_bytes", "tpu.probe", 4 * 1024 * 1024),
+            probe_rtt_warn_ms=_opt_num(probe, "rtt_warn_ms", "tpu.probe", 50.0),
+            probe_matmul_size=_opt_int(probe, "matmul_size", "tpu.probe", 1024),
+            expected_chips_per_host=_opt_int(probe, "expected_chips_per_host", "tpu.probe", 0),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class StateConfig:
+    """The ``state:`` section — net-new checkpoint/resume (SURVEY.md §5).
+
+    The reference lost all state on restart (no resourceVersion passed at
+    pod_watcher.py:264); we persist the last-seen resourceVersion and the
+    slice-state cache so a restart neither drops nor duplicates notifications.
+    """
+
+    checkpoint_path: Optional[str] = None
+    checkpoint_interval_seconds: float = 5.0
+
+    @classmethod
+    def from_raw(cls, raw: Mapping[str, Any]) -> "StateConfig":
+        _check_known(raw, ("checkpoint_path", "checkpoint_interval_seconds"), "state")
+        return cls(
+            checkpoint_path=_opt_str(raw, "checkpoint_path", "state", None),
+            checkpoint_interval_seconds=_opt_num(raw, "checkpoint_interval_seconds", "state", 5.0),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class AppConfig:
+    """Fully-validated application config (one per process)."""
+
+    environment: str
+    watcher: WatcherConfig
+    clusterapi: ClusterApiConfig
+    kubernetes: KubernetesConfig
+    tpu: TpuConfig
+    state: StateConfig
+
+    TOP_LEVEL_KEYS = ("environment", "watcher", "clusterapi", "kubernetes", "tpu", "state")
+
+    @classmethod
+    def from_raw(cls, raw: Mapping[str, Any], environment: str) -> "AppConfig":
+        _check_known(raw, cls.TOP_LEVEL_KEYS, "<root>")
+        for section in ("watcher", "clusterapi", "kubernetes", "tpu", "state"):
+            _expect(raw.get(section) or {}, (dict,), section)
+        # The reference's development.yaml declared `environment: local` while
+        # the CLI only accepted development|staging|production, leaving the
+        # "local" branch unreachable (SURVEY.md §2 defect #5). Here the
+        # declared name is advisory only; the CLI name wins and both are kept.
+        declared = raw.get("environment")
+        if declared is not None:
+            _expect(declared, (str,), "environment")
+        return cls(
+            environment=environment,
+            watcher=WatcherConfig.from_raw(raw.get("watcher") or {}),
+            clusterapi=ClusterApiConfig.from_raw(raw.get("clusterapi") or {}),
+            kubernetes=KubernetesConfig.from_raw(raw.get("kubernetes") or {}),
+            tpu=TpuConfig.from_raw(raw.get("tpu") or {}),
+            state=StateConfig.from_raw(raw.get("state") or {}),
+        )

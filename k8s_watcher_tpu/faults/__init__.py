@@ -1,0 +1,4 @@
+"""Fault injection for churn/resilience testing (SURVEY.md §5 — ABSENT in
+the reference; required for acceptance config #5)."""
+
+from k8s_watcher_tpu.faults.injection import ChurnGenerator, FaultyNotifier  # noqa: F401

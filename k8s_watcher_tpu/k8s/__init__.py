@@ -1,0 +1,15 @@
+"""Native Kubernetes API layer.
+
+The reference depended on the ``kubernetes`` Python SDK (requirements.txt:1)
+for kubeconfig loading, the CoreV1 client, and the watch stream
+(pod_watcher.py:110-157, 264). This framework implements that surface
+natively over HTTP (``requests``): a minimal kubeconfig/in-cluster loader,
+a REST client for the few endpoints the watcher needs, and a resilient
+list+watch source with resourceVersion resume, exponential backoff and
+410-Gone relist — the capability the reference's dead retry config promised
+but never delivered (SURVEY.md §2 defect #4).
+"""
+
+from k8s_watcher_tpu.k8s.kubeconfig import K8sConnection, load_connection  # noqa: F401
+from k8s_watcher_tpu.k8s.client import K8sApiError, K8sClient  # noqa: F401
+from k8s_watcher_tpu.k8s.watch import KubernetesWatchSource  # noqa: F401

@@ -1,0 +1,159 @@
+"""Minimal Kubernetes REST client.
+
+Implements exactly the API surface the watcher uses — the reference got this
+from the SDK's ``CoreV1Api`` (pod_watcher.py:137-148, 264):
+
+- ``get_api_version``        GET /version          (connection smoke test)
+- ``list_namespaces``        GET /api/v1/namespaces
+- ``list_pods``              GET /api/v1/pods  (all namespaces) or
+                             GET /api/v1/namespaces/{ns}/pods
+- ``watch_pods``             the same endpoints with ``watch=true``, streamed
+                             as JSON-lines over chunked HTTP
+
+Watch semantics follow the Kubernetes API contract: events resume from
+``resourceVersion``, bookmarks are requested so resume versions stay fresh,
+and a 410 Gone (either as HTTP status or as an in-stream ERROR event)
+raises ``K8sGoneError`` so the caller can relist.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Dict, Iterator, List, Optional
+
+import requests
+
+from k8s_watcher_tpu.k8s.kubeconfig import K8sConnection
+
+logger = logging.getLogger(__name__)
+
+
+class K8sApiError(Exception):
+    def __init__(self, message: str, status: Optional[int] = None):
+        super().__init__(message)
+        self.status = status
+
+
+class K8sGoneError(K8sApiError):
+    """resourceVersion too old (HTTP 410) — caller must relist."""
+
+
+class K8sClient:
+    def __init__(self, connection: K8sConnection, *, request_timeout: float = 30.0):
+        self.connection = connection
+        self.request_timeout = request_timeout
+        self.session = requests.Session()
+        if connection.token:
+            self.session.headers["Authorization"] = f"Bearer {connection.token}"
+        if connection.client_cert:
+            self.session.cert = connection.client_cert
+        self.session.verify = connection.verify
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _url(self, path: str) -> str:
+        return f"{self.connection.server}{path}"
+
+    def _get(self, path: str, params: Optional[Dict[str, Any]] = None, **kwargs) -> requests.Response:
+        try:
+            response = self.session.get(self._url(path), params=params, timeout=self.request_timeout, **kwargs)
+        except requests.RequestException as exc:
+            raise K8sApiError(f"GET {path} failed: {exc}") from exc
+        if response.status_code == 410:
+            raise K8sGoneError(f"GET {path}: resourceVersion expired (410 Gone)", status=410)
+        if response.status_code >= 400:
+            raise K8sApiError(f"GET {path}: HTTP {response.status_code}: {response.text[:300]}", status=response.status_code)
+        return response
+
+    # -- API surface -------------------------------------------------------
+
+    def get_api_version(self) -> str:
+        """Server version string, e.g. ``v1.31`` (smoke test; parity with
+        ``get_api_version`` at pod_watcher.py:140)."""
+        info = self._get("/version").json()
+        major, minor = info.get("major", "?"), info.get("minor", "?")
+        return f"v{major}.{minor}"
+
+    def list_namespaces(self, limit: Optional[int] = None) -> List[str]:
+        params: Dict[str, Any] = {}
+        if limit:
+            params["limit"] = limit
+        body = self._get("/api/v1/namespaces", params).json()
+        return [(item.get("metadata") or {}).get("name", "") for item in body.get("items", [])]
+
+    def _pods_path(self, namespace: Optional[str]) -> str:
+        return f"/api/v1/namespaces/{namespace}/pods" if namespace else "/api/v1/pods"
+
+    def list_pods(
+        self,
+        namespace: Optional[str] = None,
+        *,
+        limit: Optional[int] = None,
+        label_selector: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """One page of pods; returns the raw PodList body (items +
+        metadata.resourceVersion, the resume point for a subsequent watch)."""
+        params: Dict[str, Any] = {}
+        if limit:
+            params["limit"] = limit
+        if label_selector:
+            params["labelSelector"] = label_selector
+        return self._get(self._pods_path(namespace), params).json()
+
+    def watch_pods(
+        self,
+        namespace: Optional[str] = None,
+        *,
+        resource_version: Optional[str] = None,
+        timeout_seconds: int = 300,
+        allow_bookmarks: bool = True,
+        label_selector: Optional[str] = None,
+    ) -> Iterator[Dict[str, Any]]:
+        """Stream raw watch events (``{"type": ..., "object": ...}``) until
+        the server closes the bounded watch or an error occurs."""
+        params: Dict[str, Any] = {"watch": "true", "timeoutSeconds": timeout_seconds}
+        if resource_version:
+            params["resourceVersion"] = resource_version
+        if allow_bookmarks:
+            params["allowWatchBookmarks"] = "true"
+        if label_selector:
+            params["labelSelector"] = label_selector
+
+        # Read timeout must outlast the server-side watch window or we'd kill
+        # healthy idle watches; +30 s of slack over timeoutSeconds.
+        response = None
+        try:
+            try:
+                response = self.session.get(
+                    self._url(self._pods_path(namespace)),
+                    params=params,
+                    stream=True,
+                    timeout=(self.request_timeout, timeout_seconds + 30),
+                )
+            except requests.RequestException as exc:
+                raise K8sApiError(f"watch connect failed: {exc}") from exc
+            if response.status_code == 410:
+                raise K8sGoneError("watch: resourceVersion expired (410 Gone)", status=410)
+            if response.status_code >= 400:
+                raise K8sApiError(
+                    f"watch: HTTP {response.status_code}: {response.text[:300]}", status=response.status_code
+                )
+            for line in response.iter_lines():
+                if not line:
+                    continue
+                try:
+                    event = json.loads(line)
+                except json.JSONDecodeError as exc:
+                    raise K8sApiError(f"watch: malformed event frame: {line[:200]!r}") from exc
+                if event.get("type") == "ERROR":
+                    obj = event.get("object") or {}
+                    if obj.get("code") == 410:
+                        raise K8sGoneError(f"watch: {obj.get('message', '410 Gone')}", status=410)
+                    raise K8sApiError(f"watch: server error event: {obj}", status=obj.get("code"))
+                yield event
+        except requests.RequestException as exc:
+            raise K8sApiError(f"watch stream broken: {exc}") from exc
+        finally:
+            if response is not None:
+                response.close()

@@ -1,0 +1,170 @@
+"""Connection setup: kubeconfig parsing and in-cluster credentials.
+
+Covers the reference's three auth modes (pod_watcher.py:110-157):
+
+1. in-cluster service-account credentials (``use_incluster_config``),
+2. an explicit kubeconfig path (with existence check),
+3. the default kubeconfig (``~/.kube/config`` or ``$KUBECONFIG``).
+
+Implemented natively (no ``kubernetes`` SDK): the kubeconfig subset parsed is
+clusters (server, CA data/file, insecure-skip-tls-verify), users (token,
+client cert/key as data or file), contexts and current-context — everything
+the bundled mock kubeconfig (reference assets/config) and standard GKE
+kubeconfigs use, minus exec/auth-provider plugins which raise a clear error.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import logging
+import os
+import tempfile
+from pathlib import Path
+from typing import Any, Dict, Optional, Tuple, Union
+
+import yaml
+
+logger = logging.getLogger(__name__)
+
+SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class KubeconfigError(Exception):
+    """Unreadable/unsupported kubeconfig or in-cluster environment."""
+
+
+@dataclasses.dataclass
+class K8sConnection:
+    """Everything needed to open an authenticated session to an API server."""
+
+    server: str
+    token: Optional[str] = None
+    ca_file: Optional[str] = None
+    client_cert: Optional[Tuple[str, str]] = None  # (certfile, keyfile)
+    verify_tls: bool = True
+
+    @property
+    def verify(self) -> Union[bool, str]:
+        """The ``requests`` verify parameter."""
+        if not self.verify_tls:
+            return False
+        return self.ca_file if self.ca_file else True
+
+
+def _materialize(data_b64: Optional[str], file_path: Optional[str], label: str) -> Optional[str]:
+    """Return a filesystem path for cert material given either inline base64
+    data or a path; inline data is written to a private temp file."""
+    if file_path:
+        return file_path
+    if not data_b64:
+        return None
+    try:
+        raw = base64.b64decode(data_b64)
+    except Exception as exc:
+        raise KubeconfigError(f"invalid base64 in kubeconfig {label}") from exc
+    fd, path = tempfile.mkstemp(prefix=f"kwt-{label}-", suffix=".pem")
+    with os.fdopen(fd, "wb") as fh:
+        fh.write(raw)
+    return path
+
+
+def _index_by_name(items: Any, label: str) -> Dict[str, Dict[str, Any]]:
+    out: Dict[str, Dict[str, Any]] = {}
+    for item in items or []:
+        if isinstance(item, dict) and "name" in item:
+            out[item["name"]] = item
+    if not out:
+        raise KubeconfigError(f"kubeconfig has no {label}")
+    return out
+
+
+def load_kubeconfig(path: Union[str, os.PathLike], context: Optional[str] = None) -> K8sConnection:
+    """Parse a kubeconfig file into a ``K8sConnection``."""
+    path = Path(path)
+    if not path.exists():
+        raise KubeconfigError(f"Kubeconfig file not found: {path}")
+    try:
+        doc = yaml.safe_load(path.read_text()) or {}
+    except yaml.YAMLError as exc:
+        raise KubeconfigError(f"Malformed kubeconfig {path}: {exc}") from exc
+
+    contexts = _index_by_name(doc.get("contexts"), "contexts")
+    clusters = _index_by_name(doc.get("clusters"), "clusters")
+    users = _index_by_name(doc.get("users"), "users")
+
+    ctx_name = context or doc.get("current-context")
+    if not ctx_name or ctx_name not in contexts:
+        raise KubeconfigError(f"kubeconfig {path}: unknown context {ctx_name!r}")
+    ctx = contexts[ctx_name].get("context") or {}
+
+    cluster_entry = clusters.get(ctx.get("cluster", ""))
+    if cluster_entry is None:
+        raise KubeconfigError(f"kubeconfig {path}: context references unknown cluster {ctx.get('cluster')!r}")
+    cluster = cluster_entry.get("cluster") or {}
+    server = cluster.get("server")
+    if not server:
+        raise KubeconfigError(f"kubeconfig {path}: cluster has no server URL")
+
+    user_entry = users.get(ctx.get("user", "")) or {"user": {}}
+    user = user_entry.get("user") or {}
+    if "exec" in user or "auth-provider" in user:
+        raise KubeconfigError(
+            f"kubeconfig {path}: exec/auth-provider credential plugins are not supported; "
+            "use a token or client-certificate kubeconfig"
+        )
+
+    ca_file = _materialize(cluster.get("certificate-authority-data"), cluster.get("certificate-authority"), "ca")
+    cert_file = _materialize(user.get("client-certificate-data"), user.get("client-certificate"), "cert")
+    key_file = _materialize(user.get("client-key-data"), user.get("client-key"), "key")
+    client_cert = (cert_file, key_file) if cert_file and key_file else None
+
+    return K8sConnection(
+        server=server.rstrip("/"),
+        token=user.get("token"),
+        ca_file=ca_file,
+        client_cert=client_cert,
+        verify_tls=not cluster.get("insecure-skip-tls-verify", False),
+    )
+
+
+def load_incluster(sa_dir: Union[str, os.PathLike] = SERVICE_ACCOUNT_DIR) -> K8sConnection:
+    """Build a connection from the pod's mounted service-account credentials."""
+    sa_dir = Path(sa_dir)
+    host = os.environ.get("KUBERNETES_SERVICE_HOST")
+    port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+    token_path = sa_dir / "token"
+    if not host or not token_path.exists():
+        raise KubeconfigError(
+            "Not running in a cluster: KUBERNETES_SERVICE_HOST unset or service-account token missing"
+        )
+    ca_path = sa_dir / "ca.crt"
+    return K8sConnection(
+        server=f"https://{host}:{port}",
+        token=token_path.read_text().strip(),
+        ca_file=str(ca_path) if ca_path.exists() else None,
+    )
+
+
+def load_connection(
+    *,
+    use_incluster: bool = False,
+    config_file: Optional[str] = None,
+    verify_tls: bool = True,
+) -> K8sConnection:
+    """Resolve a connection with the reference's precedence
+    (pod_watcher.py:115-134): in-cluster, explicit kubeconfig, default
+    kubeconfig (``$KUBECONFIG`` or ``~/.kube/config``)."""
+    if use_incluster:
+        logger.info("Using in-cluster configuration")
+        conn = load_incluster()
+    elif config_file:
+        logger.info("Loading kubeconfig from: %s", config_file)
+        conn = load_kubeconfig(config_file)
+    else:
+        default = os.environ.get("KUBECONFIG", str(Path.home() / ".kube" / "config"))
+        logger.info("Using default kubeconfig: %s", default)
+        conn = load_kubeconfig(default)
+    if not verify_tls:
+        conn.verify_tls = False
+    return conn

@@ -1,0 +1,258 @@
+"""In-process mock Kubernetes API server.
+
+The reference's mock story (SURVEY.md §2.13, §4) pointed a bundled
+kubeconfig at "a mock k8s API server at http://localhost:9988" — but the
+server binary itself was never in the repo, so the mock tier could not
+actually run. This module ships that server: a small threaded HTTP server
+implementing the exact API subset ``K8sClient`` consumes:
+
+- ``GET /version``
+- ``GET /api/v1/namespaces``
+- ``GET /api/v1/pods`` and ``GET /api/v1/namespaces/{ns}/pods``
+  (list, and ``watch=true`` streaming with resourceVersion resume,
+  bookmarks, and 410-Gone on expired versions)
+
+Test hooks: ``MockCluster.add/modify/delete_pod`` drive the event stream;
+``compact()`` expires old resourceVersions to exercise the relist path;
+``fail_next(n)`` injects transient HTTP 500s to exercise backoff.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+
+class MockCluster:
+    """Shared cluster state + event journal."""
+
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._rv = 0
+        self._pods: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self._journal: List[Tuple[int, Dict[str, Any]]] = []  # (rv, raw watch event)
+        self._oldest_rv = 0  # journal entries <= this are compacted away
+        self._fail_next = 0
+        self.namespaces = ["default", "kube-system"]
+
+    # -- state mutation (test hooks) --------------------------------------
+
+    def _record(self, event_type: str, pod: Dict[str, Any]) -> int:
+        with self._lock:
+            self._rv += 1
+            pod.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
+            self._journal.append((self._rv, {"type": event_type, "object": json.loads(json.dumps(pod))}))
+            self._lock.notify_all()
+            return self._rv
+
+    def add_pod(self, pod: Dict[str, Any]) -> int:
+        meta = pod.get("metadata") or {}
+        key = (meta.get("namespace", "default"), meta.get("name", ""))
+        with self._lock:
+            self._pods[key] = pod
+        return self._record("ADDED", pod)
+
+    def modify_pod(self, pod: Dict[str, Any]) -> int:
+        meta = pod.get("metadata") or {}
+        key = (meta.get("namespace", "default"), meta.get("name", ""))
+        with self._lock:
+            self._pods[key] = pod
+        return self._record("MODIFIED", pod)
+
+    def delete_pod(self, namespace: str, name: str) -> Optional[int]:
+        key = (namespace, name)
+        with self._lock:
+            pod = self._pods.pop(key, None)
+        if pod is None:
+            return None
+        return self._record("DELETED", pod)
+
+    def set_phase(self, namespace: str, name: str, phase: str) -> Optional[int]:
+        with self._lock:
+            pod = self._pods.get((namespace, name))
+            if pod is None:
+                return None
+            pod.setdefault("status", {})["phase"] = phase
+        return self._record("MODIFIED", pod)
+
+    def compact(self) -> None:
+        """Forget journal history: any watch resuming below the current rv
+        gets 410 Gone (simulates apiserver etcd compaction)."""
+        with self._lock:
+            self._oldest_rv = self._rv
+            self._journal.clear()
+
+    def fail_next(self, n: int = 1) -> None:
+        """Make the next ``n`` HTTP requests fail with 500 (backoff tests)."""
+        with self._lock:
+            self._fail_next = n
+
+    def consume_failure(self) -> bool:
+        with self._lock:
+            if self._fail_next > 0:
+                self._fail_next -= 1
+                return True
+            return False
+
+    # -- reads -------------------------------------------------------------
+
+    def list_pods(self, namespace: Optional[str], limit: Optional[int]) -> Dict[str, Any]:
+        with self._lock:
+            items = [
+                json.loads(json.dumps(pod))
+                for (ns, _name), pod in sorted(self._pods.items())
+                if namespace is None or ns == namespace
+            ]
+            rv = str(self._rv)
+        if limit:
+            items = items[:limit]
+        return {
+            "kind": "PodList",
+            "apiVersion": "v1",
+            "metadata": {"resourceVersion": rv},
+            "items": items,
+        }
+
+    def events_since(self, rv: int, deadline: float) -> Optional[List[Dict[str, Any]]]:
+        """Block until there are journal events > rv or the deadline passes.
+        Returns None if rv has been compacted away (client must relist)."""
+        with self._lock:
+            while True:
+                if rv < self._oldest_rv:
+                    return None  # compacted (possibly while we were waiting)
+                batch = [ev for (erv, ev) in self._journal if erv > rv]
+                if batch:
+                    return batch
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return []
+                self._lock.wait(timeout=min(remaining, 0.25))
+
+    def latest_rv(self) -> int:
+        with self._lock:
+            return self._rv
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # HTTP/1.0: close-delimited bodies, so the watch stream needs no chunked
+    # framing and `requests` still consumes it incrementally.
+    protocol_version = "HTTP/1.0"
+    # Nagle + delayed-ACK would add ~40 ms to every streamed watch frame
+    disable_nagle_algorithm = True
+    cluster: MockCluster  # injected by make_server
+
+    def log_message(self, fmt, *args):  # silence default stderr spam
+        pass
+
+    def _json(self, status: int, body: Dict[str, Any]) -> None:
+        data = json.dumps(body).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 (stdlib naming)
+        if self.cluster.consume_failure():
+            self._json(500, {"kind": "Status", "code": 500, "message": "injected failure"})
+            return
+
+        parsed = urlparse(self.path)
+        params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        path = parsed.path
+
+        if path == "/version":
+            self._json(200, {"major": "1", "minor": "31", "gitVersion": "v1.31.0-mock"})
+            return
+        if path == "/api/v1/namespaces":
+            items = [{"metadata": {"name": ns}} for ns in self.cluster.namespaces]
+            self._json(200, {"kind": "NamespaceList", "items": items})
+            return
+
+        namespace: Optional[str] = None
+        if path == "/api/v1/pods":
+            pass
+        elif path.startswith("/api/v1/namespaces/") and path.endswith("/pods"):
+            namespace = path[len("/api/v1/namespaces/"):-len("/pods")]
+        else:
+            self._json(404, {"kind": "Status", "code": 404, "message": f"no route {path}"})
+            return
+
+        if params.get("watch") == "true":
+            self._serve_watch(namespace, params)
+        else:
+            limit = int(params["limit"]) if "limit" in params else None
+            self._json(200, self.cluster.list_pods(namespace, limit))
+
+    def _serve_watch(self, namespace: Optional[str], params: Dict[str, str]) -> None:
+        try:
+            rv = int(params.get("resourceVersion", "0") or "0")
+        except ValueError:
+            rv = 0
+        timeout_s = min(int(params.get("timeoutSeconds", "30") or "30"), 300)
+        deadline = time.monotonic() + timeout_s
+
+        first = self.cluster.events_since(rv, time.monotonic())  # non-blocking compaction check
+        if first is None:
+            self._json(410, {"kind": "Status", "code": 410, "message": "too old resource version"})
+            return
+
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.end_headers()
+        try:
+            while time.monotonic() < deadline:
+                batch = self.cluster.events_since(rv, min(deadline, time.monotonic() + 0.5))
+                if batch is None:
+                    # compacted mid-stream: emit the in-band 410 ERROR event
+                    err = {"type": "ERROR", "object": {"kind": "Status", "code": 410, "message": "too old resource version"}}
+                    self.wfile.write((json.dumps(err) + "\n").encode())
+                    self.wfile.flush()
+                    return
+                for event in batch:
+                    obj_ns = ((event.get("object") or {}).get("metadata") or {}).get("namespace")
+                    erv = int(((event.get("object") or {}).get("metadata") or {}).get("resourceVersion", "0"))
+                    rv = max(rv, erv)
+                    if namespace is not None and obj_ns != namespace:
+                        continue
+                    self.wfile.write((json.dumps(event) + "\n").encode())
+                    self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+
+class MockApiServer:
+    """Owns the HTTP server thread; use as a context manager in tests."""
+
+    def __init__(self, cluster: Optional[MockCluster] = None, host: str = "127.0.0.1", port: int = 0):
+        self.cluster = cluster or MockCluster()
+        handler = type("BoundHandler", (_Handler,), {"cluster": self.cluster})
+        self._server = ThreadingHTTPServer((host, port), handler)
+        self._server.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "MockApiServer":
+        self._thread = threading.Thread(target=self._server.serve_forever, name="mock-k8s-api", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+
+    def __enter__(self) -> "MockApiServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,175 @@
+"""Resilient list+watch source.
+
+The reference's loop died on any stream error (pod_watcher.py:273-275 —
+re-raise, no reconnect, no resume; SURVEY.md §2 defect #4). This source
+delivers the capability its dead retry config promised:
+
+- initial LIST synthesizes ADDED events for existing pods (the same
+  observable behavior as the SDK's list+watch at pod_watcher.py:264), then
+  WATCH resumes from the list's resourceVersion;
+- every event advances the resume version; BOOKMARK events keep it fresh
+  on quiet streams;
+- stream errors reconnect with exponential backoff (config-driven,
+  ``watcher.retry``);
+- 410 Gone triggers a full relist; the phase tracker downstream dedupes the
+  re-ADDED pods so subscribers see no spurious transitions;
+- an optional checkpoint store persists the resume version across restarts
+  (SURVEY.md §5 checkpoint/resume — ABSENT in the reference).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Iterator, Optional
+
+from k8s_watcher_tpu.config.schema import RetryPolicy
+from k8s_watcher_tpu.k8s.client import K8sApiError, K8sClient, K8sGoneError
+from k8s_watcher_tpu.watch.source import EventType, WatchEvent
+
+logger = logging.getLogger(__name__)
+
+
+class KubernetesWatchSource:
+    def __init__(
+        self,
+        client: K8sClient,
+        *,
+        namespace: Optional[str] = None,
+        label_selector: Optional[str] = None,
+        retry: Optional[RetryPolicy] = None,
+        watch_timeout_seconds: int = 300,
+        resource_version: Optional[str] = None,
+        checkpoint=None,  # state.checkpoint.CheckpointStore, optional
+        max_reconnects: Optional[int] = None,  # None = retry forever
+    ):
+        self.client = client
+        self.namespace = namespace
+        self.label_selector = label_selector
+        self.retry = retry or RetryPolicy()
+        self.watch_timeout_seconds = watch_timeout_seconds
+        self.resource_version = resource_version
+        self.checkpoint = checkpoint
+        self.max_reconnects = max_reconnects
+        self._stop = threading.Event()
+        # uid -> (name, namespace, phase) of live pods, so a relist can
+        # synthesize DELETED events for pods that vanished while the watch
+        # was disconnected (a plain relist only re-ADDs survivors, which
+        # would leak dead members in downstream phase/slice trackers).
+        # Restored from the checkpoint so the tombstones survive restarts
+        # that land past the apiserver's compaction window.
+        self._known: dict = {}
+        if checkpoint is not None:
+            for uid, entry in (checkpoint.get("known_pods") or {}).items():
+                self._known[uid] = tuple(entry)
+
+    def known_pods(self) -> dict:
+        """JSON-serializable live-pod map for the checkpoint subsystem."""
+        return {uid: list(entry) for uid, entry in self._known.items()}
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -- internals ---------------------------------------------------------
+
+    def _save_rv(self, rv: Optional[str]) -> None:
+        if rv:
+            self.resource_version = rv
+            if self.checkpoint is not None:
+                self.checkpoint.update_resource_version(rv)
+
+    def _track(self, event_type: str, pod: dict) -> None:
+        meta = pod.get("metadata") or {}
+        uid = meta.get("uid")
+        if not uid:
+            return
+        if event_type == EventType.DELETED:
+            self._known.pop(uid, None)
+        else:
+            self._known[uid] = (
+                meta.get("name", ""),
+                meta.get("namespace", "default"),
+                (pod.get("status") or {}).get("phase", "Unknown"),
+            )
+
+    def _relist(self) -> Iterator[WatchEvent]:
+        """LIST current pods: ADDED for each, synthetic DELETED for pods
+        that vanished during the disconnect gap, then set the resume version."""
+        body = self.client.list_pods(self.namespace, label_selector=self.label_selector)
+        rv = (body.get("metadata") or {}).get("resourceVersion")
+        listed_uids = set()
+        for pod in body.get("items", []):
+            listed_uids.add((pod.get("metadata") or {}).get("uid"))
+            self._track(EventType.ADDED, pod)
+            yield WatchEvent(type=EventType.ADDED, pod=pod, resource_version=rv)
+        for uid in [u for u in self._known if u not in listed_uids]:
+            name, namespace, phase = self._known.pop(uid)
+            logger.info("Relist: pod %s/%s vanished during disconnect; emitting DELETED", namespace, name)
+            tombstone = {
+                "metadata": {"name": name, "namespace": namespace, "uid": uid},
+                "status": {"phase": phase},
+                "spec": {},
+            }
+            yield WatchEvent(type=EventType.DELETED, pod=tombstone, resource_version=rv)
+        self._save_rv(rv)
+
+    def events(self) -> Iterator[WatchEvent]:
+        """Yield events forever (until ``stop()``), reconnecting as needed."""
+        backoff = self.retry.delay_seconds
+        reconnects = 0
+
+        if self.resource_version is None and self.checkpoint is not None:
+            self.resource_version = self.checkpoint.resource_version()
+            if self.resource_version:
+                logger.info("Resuming watch from checkpointed resourceVersion %s", self.resource_version)
+
+        need_list = self.resource_version is None
+        while not self._stop.is_set():
+            try:
+                if need_list:
+                    yield from self._relist()
+                    need_list = False
+
+                for raw in self.client.watch_pods(
+                    self.namespace,
+                    resource_version=self.resource_version,
+                    timeout_seconds=self.watch_timeout_seconds,
+                    label_selector=self.label_selector,
+                ):
+                    if self._stop.is_set():
+                        return
+                    obj = raw.get("object") or {}
+                    rv = (obj.get("metadata") or {}).get("resourceVersion")
+                    event_type = raw.get("type", "")
+                    if event_type == EventType.BOOKMARK:
+                        self._save_rv(rv)
+                        continue
+                    event = WatchEvent(type=event_type, pod=obj, resource_version=rv)
+                    self._track(event_type, obj)
+                    backoff = self.retry.delay_seconds  # healthy stream resets backoff
+                    reconnects = 0
+                    yield event
+                    # checkpoint only after the consumer processed the event
+                    # (generator resumes here on next()) — a crash mid-event
+                    # then replays it instead of silently skipping it
+                    self._save_rv(rv)
+                # bounded watch expired normally -> reconnect immediately
+                logger.debug("Watch window expired; reconnecting from rv=%s", self.resource_version)
+
+            except K8sGoneError:
+                logger.warning("resourceVersion %s expired (410 Gone); relisting", self.resource_version)
+                self.resource_version = None
+                need_list = True
+
+            except K8sApiError as exc:
+                reconnects += 1
+                if self.max_reconnects is not None and reconnects > self.max_reconnects:
+                    logger.error("Watch failed after %d reconnect attempts: %s", reconnects - 1, exc)
+                    raise
+                logger.warning(
+                    "Watch stream error (%s); reconnecting in %.1fs (attempt %d)", exc, backoff, reconnects
+                )
+                if self._stop.wait(backoff):
+                    return
+                backoff = min(backoff * self.retry.backoff_multiplier, self.retry.max_delay_seconds)

@@ -1,0 +1,62 @@
+"""Logging subsystem.
+
+Parity with the reference (pod_watcher.py:77-94): level comes from
+``watcher.log_level``; production gets structured JSON logs, other
+environments a human-readable ``[ENV] ts - name - level - msg`` format.
+
+Improvement: the reference built its "JSON" line by string concatenation
+(pod_watcher.py:84), which produces invalid JSON whenever a message contains
+a quote. We emit real ``json.dumps`` records.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Optional
+
+
+class JsonFormatter(logging.Formatter):
+    """Structured JSON log records for production."""
+
+    def __init__(self, environment: str):
+        super().__init__()
+        self.environment = environment
+
+    def format(self, record: logging.LogRecord) -> str:
+        payload = {
+            "timestamp": self.formatTime(record),
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+            "environment": self.environment,
+        }
+        if record.exc_info:
+            payload["exc_info"] = self.formatException(record.exc_info)
+        return json.dumps(payload, ensure_ascii=False)
+
+    def formatTime(self, record: logging.LogRecord, datefmt: Optional[str] = None) -> str:
+        ct = time.gmtime(record.created)
+        return time.strftime("%Y-%m-%dT%H:%M:%S", ct) + f".{int(record.msecs):03d}Z"
+
+
+def setup_logging(environment: str, log_level: str = "INFO", *, force: bool = True) -> logging.Logger:
+    """Configure root logging for ``environment`` and return this package's logger."""
+    level = getattr(logging, log_level.upper(), logging.INFO)
+    handler = logging.StreamHandler()
+    if environment == "production":
+        handler.setFormatter(JsonFormatter(environment))
+    else:
+        handler.setFormatter(
+            logging.Formatter(f"[{environment.upper()}] %(asctime)s - %(name)s - %(levelname)s - %(message)s")
+        )
+    root = logging.getLogger()
+    if force:
+        for h in list(root.handlers):
+            root.removeHandler(h)
+    root.addHandler(handler)
+    root.setLevel(level)
+    logger = logging.getLogger("k8s_watcher_tpu")
+    logger.info("Starting k8s-watcher-tpu in %s environment", environment)
+    return logger

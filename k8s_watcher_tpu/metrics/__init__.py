@@ -1,0 +1,4 @@
+"""Observability: latency histograms + counters (SURVEY.md §5 — ABSENT in
+the reference; the north-star metric is event→notify p50 latency)."""
+
+from k8s_watcher_tpu.metrics.metrics import Histogram, Counter, MetricsRegistry  # noqa: F401

@@ -1,0 +1,145 @@
+"""Lightweight in-process metrics.
+
+Thread-safe counters and reservoir-less streaming histograms good enough for
+p50/p90/p99 over bounded-latency distributions. No external metrics
+dependency (nothing may be installed; SURVEY.md §5 lists observability as a
+required net-new subsystem).
+
+The histogram uses fixed log-spaced buckets from 10 µs to 100 s, which gives
+<5 % relative quantile error across the whole range — plenty for a <1 s p50
+acceptance threshold — with O(1) record cost in the hot loop.
+"""
+
+from __future__ import annotations
+
+import bisect
+import collections
+import math
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+def _log_buckets(lo: float, hi: float, per_decade: int = 20) -> List[float]:
+    n = int(math.ceil(per_decade * math.log10(hi / lo))) + 1
+    return [lo * 10 ** (i / per_decade) for i in range(n)]
+
+
+class Counter:
+    """Monotonic counter with a windowed rate."""
+
+    # bound the rate window so unbounded churn can't grow memory
+    _WINDOW_MAX = 100_000
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._count = 0
+        self._window: collections.deque = collections.deque(maxlen=self._WINDOW_MAX)
+
+    def inc(self, n: int = 1) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._count += n
+            self._window.extend([now] * n)
+            cutoff = now - 60.0
+            while self._window and self._window[0] < cutoff:
+                self._window.popleft()
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._count
+
+    def rate_per_minute(self) -> float:
+        now = time.monotonic()
+        with self._lock:
+            return float(sum(1 for t in self._window if t > now - 60.0))
+
+
+class Histogram:
+    """Log-bucketed latency histogram (seconds)."""
+
+    def __init__(self, name: str, lo: float = 1e-5, hi: float = 100.0):
+        self.name = name
+        self._bounds = _log_buckets(lo, hi)
+        self._counts = [0] * (len(self._bounds) + 1)
+        self._lock = threading.Lock()
+        self._n = 0
+        self._sum = 0.0
+        self._max = 0.0
+
+    def record(self, seconds: float) -> None:
+        idx = bisect.bisect_left(self._bounds, seconds)
+        with self._lock:
+            self._counts[idx] += 1
+            self._n += 1
+            self._sum += seconds
+            if seconds > self._max:
+                self._max = seconds
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._n
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Approximate quantile in seconds (None if empty)."""
+        with self._lock:
+            if self._n == 0:
+                return None
+            target = q * self._n
+            seen = 0
+            for i, c in enumerate(self._counts):
+                seen += c
+                if seen >= target:
+                    if i >= len(self._bounds):
+                        return self._max
+                    return self._bounds[i]
+            return self._max
+
+    def summary(self) -> Dict[str, float]:
+        with self._lock:
+            n, total, mx = self._n, self._sum, self._max
+        if n == 0:
+            return {"count": 0}
+        return {
+            "count": n,
+            "mean_ms": 1e3 * total / n,
+            "p50_ms": 1e3 * (self.quantile(0.5) or 0.0),
+            "p90_ms": 1e3 * (self.quantile(0.9) or 0.0),
+            "p99_ms": 1e3 * (self.quantile(0.99) or 0.0),
+            "max_ms": 1e3 * mx,
+        }
+
+
+class MetricsRegistry:
+    """Named counters/histograms for one watcher process."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            if name not in self._counters:
+                self._counters[name] = Counter(name)
+            return self._counters[name]
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            if name not in self._histograms:
+                self._histograms[name] = Histogram(name)
+            return self._histograms[name]
+
+    def dump(self) -> Dict[str, Dict]:
+        with self._lock:
+            counters = dict(self._counters)
+            histograms = dict(self._histograms)
+        out: Dict[str, Dict] = {}
+        for name, c in counters.items():
+            out[name] = {"count": c.value, "per_minute": c.rate_per_minute()}
+        for name, h in histograms.items():
+            out[name] = h.summary()
+        return out

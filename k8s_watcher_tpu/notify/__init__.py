@@ -1,0 +1,4 @@
+"""Notification plane: clusterapi HTTP client + async dispatcher."""
+
+from k8s_watcher_tpu.notify.client import ClusterApiClient  # noqa: F401
+from k8s_watcher_tpu.notify.dispatcher import Dispatcher  # noqa: F401

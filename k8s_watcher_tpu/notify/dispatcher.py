@@ -1,0 +1,114 @@
+"""Async notification dispatcher: bounded queue + worker threads.
+
+The reference notified synchronously inside the watch loop (pod_watcher.py:236
+— disabled, but that was the design), so one slow POST would stall the whole
+stream. SURVEY.md §3.1 calls this the key hazard for the <1 s p50 target.
+Here the pipeline enqueues and returns; worker threads drain the queue and
+the event→notify latency histogram is recorded when the POST *completes* —
+the honest end-to-end number.
+
+Backpressure policy: when the bounded queue is full the oldest entry is
+dropped (and counted) rather than blocking the watch stream — under churn,
+fresh state supersedes stale state for the same pod anyway.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Callable, Optional
+
+from k8s_watcher_tpu.metrics import MetricsRegistry
+from k8s_watcher_tpu.pipeline.pipeline import Notification
+
+logger = logging.getLogger(__name__)
+
+
+class Dispatcher:
+    def __init__(
+        self,
+        send: Callable[[dict], bool],
+        *,
+        capacity: int = 1024,
+        workers: int = 2,
+        metrics: Optional[MetricsRegistry] = None,
+    ):
+        self._send = send
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, capacity))
+        self._workers = max(1, workers)
+        self._threads: list = []
+        self.metrics = metrics or MetricsRegistry()
+        self._started = False
+        self._stopping = threading.Event()
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        for i in range(self._workers):
+            t = threading.Thread(target=self._worker, name=f"notify-worker-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def submit(self, notification: Notification) -> bool:
+        """Enqueue without blocking; drop-oldest on overflow. Returns False
+        only if the notification was itself dropped (or we're shutting down)."""
+        if self._stopping.is_set():
+            self.metrics.counter("dispatch_dropped_stopping").inc()
+            return False
+        if not self._started:
+            self.start()
+        while True:
+            try:
+                self._queue.put_nowait(notification)
+                self.metrics.counter("dispatch_enqueued").inc()
+                return True
+            except queue.Full:
+                try:
+                    self._queue.get_nowait()
+                    self._queue.task_done()
+                    self.metrics.counter("dispatch_dropped_overflow").inc()
+                except queue.Empty:
+                    pass
+
+    def _worker(self) -> None:
+        hist = self.metrics.histogram("event_to_notify_latency")
+        while True:
+            try:
+                item = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                if self._stopping.is_set():
+                    return
+                continue
+            try:
+                ok = False
+                try:
+                    ok = self._send(item.payload)
+                except Exception as exc:  # send contract is boolean, but be safe
+                    logger.error("Notifier raised: %s", exc)
+                if ok:
+                    self.metrics.counter("dispatch_sent").inc()
+                    hist.record(time.monotonic() - item.received_monotonic)
+                else:
+                    self.metrics.counter("dispatch_failed").inc()
+            finally:
+                self._queue.task_done()
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Wait (bounded) for the queue to empty; True if fully drained."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._queue.unfinished_tasks == 0:
+                return True
+            time.sleep(0.01)
+        return self._queue.unfinished_tasks == 0
+
+    def stop(self, drain_timeout: float = 5.0) -> None:
+        if not self._started or self._stopping.is_set():
+            return
+        self.drain(drain_timeout)
+        self._stopping.set()  # workers exit once the queue runs dry
+        for t in self._threads:
+            t.join(timeout=2.0)

@@ -1,0 +1,13 @@
+"""Mesh/collective helpers for the probe plane (SURVEY.md §2.10-2.11).
+
+The reference had no parallelism or comm backend at all; the TPU build's
+SPMD surface is the in-slice health probe — JAX/XLA collectives over ICI
+(in-slice) and DCN (cross-slice), never NCCL/MPI.
+"""
+
+from k8s_watcher_tpu.parallel.mesh import (  # noqa: F401
+    host_chip_mesh,
+    flat_mesh,
+    initialize_multihost,
+)
+from k8s_watcher_tpu.parallel.collectives import make_psum_probe, make_allreduce_bandwidth_probe  # noqa: F401

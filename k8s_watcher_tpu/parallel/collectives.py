@@ -1,0 +1,99 @@
+"""Collective probe kernels.
+
+Two jitted SPMD programs, both built with ``jax.shard_map`` over a
+``(hosts, chips)`` mesh so XLA lowers them to ICI collectives:
+
+- ``make_psum_probe``: a minimal-latency ``lax.psum`` of a tiny vector over
+  every device — the round-trip time is the ICI *latency* health signal
+  (BASELINE.md: "ICI psum probe RTT" is a tracked metric).
+- ``make_allreduce_bandwidth_probe``: a large bf16 all-reduce; the achieved
+  bus bandwidth (2·(n-1)/n · bytes / t) is the ICI *bandwidth* health
+  signal, which catches degraded links that still pass the latency probe.
+
+Static shapes, no data-dependent control flow — each program is traced once
+and cached; steady-state probe iterations are pure device execution.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _mesh_axes(mesh: Mesh) -> Tuple[str, ...]:
+    return tuple(mesh.axis_names)
+
+
+def make_psum_probe(mesh: Mesh, inner_iters: int = 1) -> Callable[[jax.Array], jax.Array]:
+    """Jitted chained ``psum`` of a per-device scalar vector over the mesh.
+
+    One call runs ``inner_iters`` serialized psums (each feeds the next, so
+    XLA cannot overlap them) — amortizing host dispatch overhead out of the
+    RTT measurement; per-psum latency = call time / inner_iters. Each round
+    computes ``psum(x)/n``, so for any ``inner_iters >= 1`` the replicated
+    output equals ``sum(x)/n`` — a fixed point that doubles as the
+    correctness check.
+    """
+    axes = _mesh_axes(mesh)
+    n = mesh.size
+    if inner_iters < 1:
+        raise ValueError("inner_iters must be >= 1")
+
+    # jax>=0.8 renames pvary -> pcast(..., axis_name, to='varying')
+    _to_varying = (
+        (lambda v: jax.lax.pcast(v, axes, to="varying")) if hasattr(jax.lax, "pcast")
+        else (lambda v: jax.lax.pvary(v, axes))
+    )
+
+    def probe(x: jax.Array) -> jax.Array:
+        def body(_, carry):
+            # psum produces a device-invariant value; re-mark it as varying
+            # so the fori_loop carry type stays consistent
+            return _to_varying(jax.lax.psum(carry, axes) / n)
+
+        y = jax.lax.fori_loop(0, inner_iters - 1, body, x) if inner_iters > 1 else x
+        return jax.lax.psum(y, axes) / n  # final psum: invariant output
+
+    shard = jax.shard_map(probe, mesh=mesh, in_specs=P(axes), out_specs=P())
+    return jax.jit(shard)
+
+
+def make_allreduce_bandwidth_probe(mesh: Mesh, payload_bytes: int) -> Callable[[jax.Array], jax.Array]:
+    """Jitted large all-reduce; input is a ``(n_devices, chunk)`` bf16 array
+    sharded along the device axes, output the replicated reduced chunk."""
+    axes = _mesh_axes(mesh)
+
+    def probe(x: jax.Array) -> jax.Array:
+        # x arrives as this device's (1, chunk) shard; reduce across devices
+        return jax.lax.psum(x, axes)
+
+    shard = jax.shard_map(probe, mesh=mesh, in_specs=P(axes), out_specs=P())
+    return jax.jit(shard)
+
+
+def psum_probe_input(mesh: Mesh) -> jax.Array:
+    """A tiny per-device vector laid out for ``make_psum_probe``."""
+    n = mesh.size
+    axes = _mesh_axes(mesh)
+    x = jnp.arange(1.0, n + 1.0, dtype=jnp.float32)
+    return jax.device_put(x, NamedSharding(mesh, P(axes)))
+
+
+def bandwidth_probe_input(mesh: Mesh, payload_bytes: int) -> jax.Array:
+    """A bf16 payload of ~``payload_bytes`` per device for the bandwidth probe."""
+    n = mesh.size
+    axes = _mesh_axes(mesh)
+    chunk = max(128, payload_bytes // 2)  # bf16 = 2 bytes
+    x = jnp.ones((n, chunk), dtype=jnp.bfloat16)
+    return jax.device_put(x, NamedSharding(mesh, P(axes, None)))
+
+
+def allreduce_bus_bandwidth_gbps(payload_bytes: int, n_devices: int, seconds: float) -> float:
+    """Standard all-reduce bus-bandwidth formula: 2·(n-1)/n · S / t."""
+    if seconds <= 0 or n_devices <= 0:
+        return 0.0
+    moved = 2.0 * (n_devices - 1) / n_devices * payload_bytes
+    return moved / seconds / 1e9

@@ -1,0 +1,79 @@
+"""Device-mesh construction.
+
+The probe runs over a 2-D ``(hosts, chips)`` mesh so collectives can be
+scoped per axis: the ``chips`` axis rides intra-host ICI, the ``hosts`` axis
+rides inter-host ICI (same pod slice) or DCN (cross-slice). On a single
+host the mesh degenerates to ``(1, n)`` and everything still compiles — the
+same code path covers acceptance configs #3 (v4-8 single host) and #4
+(v5e-16, 4 hosts) from BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+logger = logging.getLogger(__name__)
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """``jax.distributed.initialize`` for multi-host probes.
+
+    Args default from the standard JAX env vars / GKE JobSet injection;
+    returns False (no-op) when running single-process. Safe to call twice.
+    """
+    if jax.process_count() > 1:
+        return True  # already initialized
+    coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if coordinator_address is None:
+        return False
+    num_processes = num_processes or int(os.environ.get("JAX_NUM_PROCESSES", "0")) or None
+    process_id = process_id if process_id is not None else int(os.environ.get("JAX_PROCESS_ID", "0"))
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        return True
+    except Exception as exc:  # already-initialized or misconfigured env
+        logger.warning("jax.distributed.initialize failed: %s", exc)
+        return False
+
+
+def host_chip_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """A ``(hosts, chips)`` mesh over ``devices`` (default: all devices).
+
+    Devices are grouped by ``process_index`` — JAX's unit of host locality —
+    so the ``chips`` axis only ever crosses intra-host links.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    by_host: dict = {}
+    for d in devices:
+        by_host.setdefault(d.process_index, []).append(d)
+    counts = {len(v) for v in by_host.values()}
+    if len(counts) != 1:
+        # ragged host sizes (unhealthy slice): fall back to a 1×N mesh so the
+        # probe can still run and report the asymmetry
+        logger.warning("Ragged devices-per-host %s; using flat mesh", sorted(counts))
+        return flat_mesh(devices)
+    per_host = counts.pop()
+    grid = np.array(
+        [dev for host in sorted(by_host) for dev in sorted(by_host[host], key=lambda d: d.id)]
+    ).reshape(len(by_host), per_host)
+    return Mesh(grid, ("hosts", "chips"))
+
+
+def flat_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """A 1-D ``(chips,)`` mesh (single-host or ragged fallback)."""
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.array(devices).reshape(1, len(devices)), ("hosts", "chips"))

@@ -1,0 +1,15 @@
+"""Event pipeline: filter -> phase-delta -> extract -> notify.
+
+Replaces the reference's single ``handle_pod_event`` method
+(pod_watcher.py:214-241) with small composable stages.
+"""
+
+from k8s_watcher_tpu.pipeline.filters import (  # noqa: F401
+    CriticalEventGate,
+    NamespaceFilter,
+    TpuResourceFilter,
+    pod_accelerator_chips,
+)
+from k8s_watcher_tpu.pipeline.phase import PhaseDelta, PhaseTracker  # noqa: F401
+from k8s_watcher_tpu.pipeline.extract import extract_pod_data  # noqa: F401
+from k8s_watcher_tpu.pipeline.pipeline import EventPipeline, PipelineResult  # noqa: F401

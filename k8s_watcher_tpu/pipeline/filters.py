@@ -1,0 +1,82 @@
+"""Event filters.
+
+- ``NamespaceFilter``: parity with the reference's client-side namespace
+  check (pod_watcher.py:226-229): empty list = watch everything.
+- ``CriticalEventGate``: parity with the production-only critical-events gate
+  (pod_watcher.py:204-212): when enabled, only DELETED events or pods in a
+  terminal phase pass.
+- ``TpuResourceFilter``: net-new (SURVEY.md §2 defect #6 — despite its name
+  the reference GPU watcher had no resource filter at all). Selects pods
+  that request the accelerator resource key (``google.com/tpu`` by default,
+  ``nvidia.com/gpu`` in gpu-compat mode) in any container's requests or
+  limits, including init containers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Sequence
+
+from k8s_watcher_tpu.watch.source import EventType, WatchEvent
+
+TERMINAL_PHASES = ("Failed", "Succeeded")
+
+
+def _containers(pod: Dict[str, Any]) -> Iterable[Dict[str, Any]]:
+    spec = pod.get("spec") or {}
+    yield from spec.get("containers") or []
+    yield from spec.get("initContainers") or []
+
+
+def pod_accelerator_chips(pod: Dict[str, Any], resource_key: str) -> int:
+    """Total accelerator chips requested by the pod (0 = not an accelerator pod)."""
+    total = 0
+    for container in _containers(pod):
+        resources = container.get("resources") or {}
+        for bucket in ("requests", "limits"):
+            value = (resources.get(bucket) or {}).get(resource_key)
+            if value is not None:
+                try:
+                    total = max(total, 0) + int(str(value))
+                except ValueError:
+                    total += 1  # present but unparsable still counts as accelerated
+                break  # count each container once (requests preferred)
+    return total
+
+
+class NamespaceFilter:
+    """Pass events whose namespace is in the target set (empty = all)."""
+
+    def __init__(self, namespaces: Sequence[str] = ()):
+        self.namespaces = frozenset(namespaces)
+
+    def __call__(self, event: WatchEvent) -> bool:
+        return not self.namespaces or event.namespace in self.namespaces
+
+
+class CriticalEventGate:
+    """In production with ``critical_events_only``, drop routine events.
+
+    Parity: pod_watcher.py:204-212 — DELETED always passes; otherwise only
+    pods whose phase is terminal (Failed/Succeeded) pass.
+    """
+
+    def __init__(self, environment: str, critical_events_only: bool):
+        self.enabled = environment == "production" and critical_events_only
+
+    def __call__(self, event: WatchEvent) -> bool:
+        if not self.enabled:
+            return True
+        return event.type == EventType.DELETED or event.phase in TERMINAL_PHASES
+
+
+class TpuResourceFilter:
+    """Pass pods that request the accelerator resource (google.com/tpu)."""
+
+    def __init__(self, resource_key: str = "google.com/tpu", *, enabled: bool = True):
+        self.resource_key = resource_key
+        self.enabled = enabled
+
+    def __call__(self, event: WatchEvent) -> bool:
+        if not self.enabled:
+            return True
+        return pod_accelerator_chips(event.pod, self.resource_key) > 0

@@ -1,0 +1,143 @@
+"""Pipeline composition.
+
+The reference's per-event path (pod_watcher.py:214-241) was: production
+critical gate → namespace filter → extract → (disabled) notify. This
+pipeline keeps that order and adds the net-new stages the north star needs:
+accelerator resource filter, phase-delta detection, and slice tracking.
+
+The pipeline never blocks on the network: its sink is a callable (normally
+``notify.Dispatcher.submit``) that enqueues and returns. One slow POST must
+not stall the watch stream (SURVEY.md §3.1 flags the reference's synchronous
+notify as the key <1 s p50 hazard).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Callable, Dict, Optional
+
+from k8s_watcher_tpu.metrics import MetricsRegistry
+from k8s_watcher_tpu.pipeline.extract import extract_pod_data
+from k8s_watcher_tpu.pipeline.filters import CriticalEventGate, NamespaceFilter, TpuResourceFilter
+from k8s_watcher_tpu.pipeline.phase import PhaseTracker
+from k8s_watcher_tpu.watch.source import EventType, WatchEvent
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class Notification:
+    """A payload bound for the notifier, carrying the receive stamp so the
+    event→notify latency (north-star metric) can be measured end to end."""
+
+    payload: Dict[str, Any]
+    received_monotonic: float
+    kind: str = "pod"  # "pod" | "slice" | "probe"
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineResult:
+    notified: bool
+    reason: str  # "notified" | drop reason
+    payload: Optional[Dict[str, Any]] = None
+
+
+Sink = Callable[[Notification], None]
+
+
+class EventPipeline:
+    def __init__(
+        self,
+        *,
+        environment: str,
+        sink: Sink,
+        namespace_filter: Optional[NamespaceFilter] = None,
+        resource_filter: Optional[TpuResourceFilter] = None,
+        critical_gate: Optional[CriticalEventGate] = None,
+        phase_tracker: Optional[PhaseTracker] = None,
+        slice_tracker: Optional[Any] = None,  # slices.SliceTracker (optional stage)
+        metrics: Optional[MetricsRegistry] = None,
+        notify_all: bool = False,
+        resource_key: str = "google.com/tpu",
+        topology_label: str = "cloud.google.com/gke-tpu-topology",
+        accelerator_label: str = "cloud.google.com/gke-tpu-accelerator",
+    ):
+        self.environment = environment
+        self.sink = sink
+        self.namespace_filter = namespace_filter or NamespaceFilter()
+        self.resource_filter = resource_filter or TpuResourceFilter(resource_key)
+        self.critical_gate = critical_gate or CriticalEventGate(environment, False)
+        # `or` would discard an *empty* tracker (PhaseTracker defines __len__,
+        # so a fresh one is falsy) and silently break checkpoint sharing
+        self.phase_tracker = phase_tracker if phase_tracker is not None else PhaseTracker()
+        self.slice_tracker = slice_tracker
+        self.metrics = metrics or MetricsRegistry()
+        self.notify_all = notify_all
+        self.resource_key = resource_key
+        self.topology_label = topology_label
+        self.accelerator_label = accelerator_label
+
+    def process(self, event: WatchEvent) -> PipelineResult:
+        m = self.metrics
+        m.counter("events_received").inc()
+
+        if event.type == EventType.BOOKMARK:
+            return PipelineResult(False, "bookmark")
+        if event.type == EventType.ERROR:
+            m.counter("events_error").inc()
+            return PipelineResult(False, "error_event")
+
+        if not self.namespace_filter(event):
+            m.counter("events_dropped_namespace").inc()
+            return PipelineResult(False, "namespace_filter")
+        if not self.resource_filter(event):
+            m.counter("events_dropped_resource").inc()
+            return PipelineResult(False, "resource_filter")
+
+        # State tracking sees every event; the critical gate (reference
+        # pod_watcher.py:204-212) only suppresses *pod notifications* below.
+        # Gating before tracking would starve the slice aggregate of
+        # Pending/Running observations in exactly the production environment
+        # that enables it — no slice could ever reach Ready.
+        delta = self.phase_tracker.observe(event)
+
+        slice_info = None
+        slice_notifications = []
+        if self.slice_tracker is not None:
+            slice_info, slice_notifications = self.slice_tracker.observe(event, delta)
+
+        critical_ok = self.critical_gate(event)
+        if not critical_ok:
+            m.counter("events_dropped_critical_gate").inc()
+            if not slice_notifications:
+                return PipelineResult(False, "critical_gate")
+
+        if not (self.notify_all or delta.significant or slice_notifications):
+            m.counter("events_dropped_insignificant").inc()
+            return PipelineResult(False, "no_significant_change")
+
+        payload = extract_pod_data(
+            event.pod,
+            self.environment,
+            resource_key=self.resource_key,
+            topology_label=self.topology_label,
+            accelerator_label=self.accelerator_label,
+            delta=delta,
+            slice_info=slice_info,
+        )
+        payload["event_type"] = event.type
+
+        if critical_ok and (self.notify_all or delta.significant):
+            self.sink(Notification(payload, event.received_monotonic, kind="pod"))
+            m.counter("notifications_enqueued").inc()
+        for slice_payload in slice_notifications:
+            self.sink(Notification(slice_payload, event.received_monotonic, kind="slice"))
+            m.counter("slice_notifications_enqueued").inc()
+
+        logger.debug(
+            "Pod event %s %s/%s phase=%s->%s",
+            event.type, event.namespace, event.name,
+            delta.old_phase, delta.new_phase,
+        )
+        return PipelineResult(True, "notified", payload)

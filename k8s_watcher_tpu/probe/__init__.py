@@ -1,0 +1,14 @@
+"""In-slice health probe plane (SURVEY.md §7 step 6 — the net-new TPU part).
+
+The probe runs *inside* the slice as an SPMD job (every host runs the same
+program; collectives ride ICI), while the watcher proper is a control-plane
+singleton — they meet at the notifier (``clusterapi``), exactly the split
+SURVEY.md §7 "hard parts (a)" calls for. ``ProbeAgent`` is the in-process
+form used when watcher and chips share a host (dev, single-host v4-8);
+``scripts/probe_agent.py`` is the standalone DaemonSet/JobSet form.
+"""
+
+from k8s_watcher_tpu.probe.device import enumerate_devices  # noqa: F401
+from k8s_watcher_tpu.probe.ici import IciProbeResult, run_ici_probe, run_mxu_probe  # noqa: F401
+from k8s_watcher_tpu.probe.report import ProbeReport  # noqa: F401
+from k8s_watcher_tpu.probe.agent import ProbeAgent  # noqa: F401

@@ -1,0 +1,102 @@
+"""Probe agent: run the health probe on a cadence, report via the sink.
+
+Process model (SURVEY.md §7 hard part (a)): the watcher is a cluster-external
+singleton; the probe must execute on the TPU hosts. ``ProbeAgent`` is that
+probe loop. In-process mode covers dev and single-host deployments; for
+multi-host slices the same agent runs standalone on every slice host
+(``scripts/probe_agent.py``, one process per host via DaemonSet/JobSet with
+``jax.distributed`` initialized) and reports to clusterapi directly —
+process 0 does the reporting, all processes join the collectives.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+import jax
+
+from k8s_watcher_tpu.config.schema import TpuConfig
+from k8s_watcher_tpu.metrics import MetricsRegistry
+from k8s_watcher_tpu.pipeline.pipeline import Notification
+from k8s_watcher_tpu.probe.device import enumerate_devices
+from k8s_watcher_tpu.probe.ici import run_ici_probe, run_mxu_probe
+from k8s_watcher_tpu.probe.report import ProbeReport
+
+logger = logging.getLogger(__name__)
+
+
+class ProbeAgent:
+    def __init__(
+        self,
+        tpu_config: TpuConfig,
+        *,
+        environment: str,
+        sink: Callable[[Notification], None],
+        metrics: Optional[MetricsRegistry] = None,
+        mesh=None,
+        expected_platform: Optional[str] = "auto",
+    ):
+        self.config = tpu_config
+        self.environment = environment
+        self.sink = sink
+        self.metrics = metrics or MetricsRegistry()
+        self.mesh = mesh
+        # "auto": the configured backend IS the platform contract — a tpu
+        # probe finding only CPU devices reports unhealthy, not healthy-CPU.
+        # Pass an explicit platform (or None to disable) for test meshes.
+        self.expected_platform = tpu_config.backend if expected_platform == "auto" else expected_platform
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def run_once(self) -> ProbeReport:
+        t0 = time.monotonic()
+        devices = enumerate_devices(
+            expected_per_host=self.config.expected_chips_per_host,
+            expected_platform=self.expected_platform,
+        )
+        ici = run_ici_probe(self.mesh, payload_bytes=self.config.probe_payload_bytes)
+        mxu = run_mxu_probe(self.config.probe_matmul_size)
+        report = ProbeReport(
+            environment=self.environment,
+            devices=devices,
+            ici=ici,
+            mxu=mxu,
+            rtt_warn_ms=self.config.probe_rtt_warn_ms,
+            duration_ms=1e3 * (time.monotonic() - t0),
+        )
+        self.metrics.counter("probe_runs").inc()
+        if ici.psum_rtt_ms >= 0:
+            self.metrics.histogram("probe_psum_rtt").record(ici.psum_rtt_ms / 1e3)
+        if not report.healthy:
+            self.metrics.counter("probe_unhealthy").inc()
+        return report
+
+    def _report(self, report: ProbeReport) -> None:
+        # only one process per slice reports (others just join collectives)
+        if jax.process_index() == 0:
+            self.sink(Notification(report.to_payload(), time.monotonic(), kind="probe"))
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._report(self.run_once())
+            except Exception as exc:
+                logger.error("Probe iteration failed: %s", exc)
+                self.metrics.counter("probe_errors").inc()
+            if self._stop.wait(self.config.probe_interval_seconds):
+                return
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop, name="tpu-probe-agent", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -1,0 +1,103 @@
+"""Chip enumeration (north star: the probe runs ``jax.devices()`` and
+reports chip status; BASELINE.json configs[2]).
+
+Each visible device is reported with identity, host locality, and — where
+the runtime exposes it — HBM usage. A per-device trivial computation
+isolates chips that enumerate but cannot execute (a failure mode a bare
+``jax.devices()`` call would miss).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
+
+
+def _device_entry(device: jax.Device) -> Dict[str, Any]:
+    entry: Dict[str, Any] = {
+        "id": device.id,
+        "platform": device.platform,
+        "device_kind": device.device_kind,
+        "process_index": device.process_index,
+    }
+    coords = getattr(device, "coords", None)
+    if coords is not None:
+        entry["coords"] = list(coords)
+    try:
+        stats = device.memory_stats()
+    except Exception:
+        stats = None
+    if stats:
+        entry["memory"] = {
+            "bytes_in_use": stats.get("bytes_in_use"),
+            "bytes_limit": stats.get("bytes_limit"),
+        }
+    return entry
+
+
+def _device_alive(device: jax.Device) -> bool:
+    """Run a one-element computation pinned to ``device``."""
+    try:
+        x = jax.device_put(jnp.float32(2.0), device)
+        return float(jax.block_until_ready(x * x)) == 4.0
+    except Exception as exc:
+        logger.error("Device %s failed liveness computation: %s", device, exc)
+        return False
+
+
+def enumerate_devices(
+    devices: Optional[Sequence[jax.Device]] = None,
+    *,
+    expected_per_host: int = 0,
+    check_liveness: bool = True,
+    expected_platform: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Inventory of visible chips + liveness verdicts.
+
+    ``expected_per_host > 0`` (from ``tpu.probe.expected_chips_per_host``)
+    flags hosts that enumerate fewer chips than the slice shape demands.
+    ``expected_platform`` (e.g. ``"tpu"``) flags devices on the wrong
+    backend — a probe that silently measures CPU "health" on a host with no
+    TPUs must not report the slice healthy.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    entries: List[Dict[str, Any]] = []
+    healthy = 0
+    for device in devices:
+        entry = _device_entry(device)
+        if check_liveness:
+            entry["alive"] = _device_alive(device)
+        else:
+            entry["alive"] = None
+        if entry["alive"] is not False:
+            healthy += 1
+        entries.append(entry)
+
+    local = [d for d in devices if d.process_index == jax.process_index()]
+    result: Dict[str, Any] = {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "visible_devices": len(devices),
+        "local_devices": len(local),
+        "healthy_devices": healthy,
+        "devices": entries,
+    }
+    if expected_per_host > 0:
+        result["expected_local_devices"] = expected_per_host
+        result["missing_local_devices"] = max(0, expected_per_host - len(local))
+    if expected_platform:
+        mismatched = sum(1 for d in devices if d.platform != expected_platform)
+        result["expected_platform"] = expected_platform
+        result["platform_mismatch"] = mismatched
+        if mismatched:
+            logger.warning(
+                "%d/%d devices are not %s (found: %s)",
+                mismatched, len(devices), expected_platform,
+                sorted({d.platform for d in devices}),
+            )
+    return result

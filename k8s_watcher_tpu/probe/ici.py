@@ -1,0 +1,171 @@
+"""Timed ICI + MXU probes.
+
+Measurement discipline: every program is jitted once (warmup call pays the
+compile), then timed over ``iters`` steady-state iterations with
+``block_until_ready`` fencing each one. The *minimum* is reported as the
+RTT (least-noise estimate of the hardware path) alongside mean/max for
+jitter visibility.
+
+North-star coverage (BASELINE.json): "ICI psum probe RTT" is
+``IciProbeResult.psum_rtt_ms``; the bandwidth probe and MXU matmul catch
+degraded-but-alive links/chips.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k8s_watcher_tpu.parallel.collectives import (
+    allreduce_bus_bandwidth_gbps,
+    bandwidth_probe_input,
+    make_allreduce_bandwidth_probe,
+    make_psum_probe,
+    psum_probe_input,
+)
+from k8s_watcher_tpu.parallel.mesh import host_chip_mesh
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class IciProbeResult:
+    ok: bool
+    n_devices: int
+    n_hosts: int
+    psum_rtt_ms: float  # min over iters
+    psum_rtt_mean_ms: float
+    psum_rtt_max_ms: float
+    psum_correct: bool
+    bandwidth_gbps: float
+    payload_bytes: int
+    compile_ms: float
+    error: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def _timed(fn, x, iters: int) -> tuple:
+    """(min, mean, max) seconds over ``iters`` fenced calls."""
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(x))
+        times.append(time.perf_counter() - t0)
+    return min(times), sum(times) / len(times), max(times)
+
+
+def run_ici_probe(
+    mesh=None,
+    *,
+    payload_bytes: int = 4 * 1024 * 1024,
+    iters: int = 10,
+    inner_iters: int = 10,
+) -> IciProbeResult:
+    """Latency (chained tiny psums) + bandwidth (large all-reduce).
+
+    ``inner_iters`` serialized psums run inside one jitted call so host
+    dispatch overhead (large under remote-tunnel setups) is amortized out of
+    the per-psum RTT.
+    """
+    try:
+        if mesh is None:
+            mesh = host_chip_mesh()
+        n = mesh.size
+        n_hosts = mesh.devices.shape[0]
+
+        t0 = time.perf_counter()
+        psum = make_psum_probe(mesh, inner_iters)
+        x = psum_probe_input(mesh)
+        result = jax.block_until_ready(psum(x))  # warmup = compile
+        compile_ms = 1e3 * (time.perf_counter() - t0)
+
+        expected = (n + 1) / 2.0  # fixed point of chained psum(x)/n
+        psum_correct = bool(np.allclose(np.asarray(result)[0], expected))
+
+        rtt_min, rtt_mean, rtt_max = _timed(psum, x, iters)
+        rtt_min, rtt_mean, rtt_max = (t / inner_iters for t in (rtt_min, rtt_mean, rtt_max))
+
+        bw_gbps = 0.0
+        if payload_bytes > 0 and n > 1:
+            bw_fn = make_allreduce_bandwidth_probe(mesh, payload_bytes)
+            payload = bandwidth_probe_input(mesh, payload_bytes)
+            jax.block_until_ready(bw_fn(payload))  # compile
+            bw_min, _, _ = _timed(bw_fn, payload, max(3, iters // 3))
+            bw_gbps = allreduce_bus_bandwidth_gbps(payload_bytes, n, bw_min)
+
+        return IciProbeResult(
+            ok=psum_correct,
+            n_devices=n,
+            n_hosts=n_hosts,
+            psum_rtt_ms=1e3 * rtt_min,
+            psum_rtt_mean_ms=1e3 * rtt_mean,
+            psum_rtt_max_ms=1e3 * rtt_max,
+            psum_correct=psum_correct,
+            bandwidth_gbps=bw_gbps,
+            payload_bytes=payload_bytes,
+            compile_ms=compile_ms,
+        )
+    except Exception as exc:
+        logger.error("ICI probe failed: %s", exc)
+        return IciProbeResult(
+            ok=False, n_devices=0, n_hosts=0,
+            psum_rtt_ms=-1.0, psum_rtt_mean_ms=-1.0, psum_rtt_max_ms=-1.0,
+            psum_correct=False, bandwidth_gbps=0.0, payload_bytes=payload_bytes,
+            compile_ms=0.0, error=str(exc),
+        )
+
+
+def run_mxu_probe(
+    size: int = 1024,
+    *,
+    iters: int = 5,
+    inner_iters: int = 8,
+    device: Optional[jax.Device] = None,
+) -> Dict[str, Any]:
+    """Chained bf16 matmuls on one device: MXU throughput + numeric sanity.
+
+    bf16 inputs with f32 accumulation is the MXU-native regime. The jitted
+    program chains ``inner_iters`` dependent matmuls (renormalized each step
+    so bf16 can't overflow), amortizing dispatch overhead; TFLOP/s =
+    2·size³·inner_iters / t. A health signal, not a benchmark.
+    """
+    try:
+        device = device or jax.devices()[0]
+
+        @jax.jit
+        def step(a, b):
+            def body(_, carry):
+                y = jnp.dot(carry, b, preferred_element_type=jnp.float32)
+                # renormalize to unit RMS so the chain stays in bf16 range
+                y = y * jax.lax.rsqrt(jnp.mean(y * y) + 1e-6)
+                return y.astype(jnp.bfloat16)
+
+            return jax.lax.fori_loop(0, inner_iters, body, a)
+
+        key = jax.random.PRNGKey(0)
+        a = jax.device_put(jax.random.normal(key, (size, size), dtype=jnp.bfloat16), device)
+        b = jax.device_put(jax.random.normal(jax.random.fold_in(key, 1), (size, size), dtype=jnp.bfloat16), device)
+        out = jax.block_until_ready(step(a, b))  # compile
+        finite = bool(jnp.isfinite(out.astype(jnp.float32)).all())
+        tmin, tmean, tmax = _timed(lambda ab: step(*ab), (a, b), iters)
+        tflops = 2.0 * size**3 * inner_iters / tmin / 1e12
+        return {
+            "ok": finite,
+            "size": size,
+            "inner_iters": inner_iters,
+            "device_id": device.id,
+            "time_ms": 1e3 * tmin,
+            "tflops": tflops,
+            "finite": finite,
+        }
+    except Exception as exc:
+        logger.error("MXU probe failed: %s", exc)
+        return {"ok": False, "size": size, "error": str(exc)}

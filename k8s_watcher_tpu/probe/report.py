@@ -1,0 +1,51 @@
+"""Probe report schema — the payload the probe plane sends through the
+notifier (north star: "reports chip/link status through clusterapi")."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from datetime import datetime, timezone
+from typing import Any, Dict, Optional
+
+from k8s_watcher_tpu.probe.ici import IciProbeResult
+
+
+@dataclasses.dataclass
+class ProbeReport:
+    environment: str
+    devices: Dict[str, Any]
+    ici: Optional[IciProbeResult] = None
+    mxu: Optional[Dict[str, Any]] = None
+    rtt_warn_ms: float = 50.0
+    duration_ms: float = 0.0
+
+    @property
+    def healthy(self) -> bool:
+        if self.devices.get("platform_mismatch", 0) > 0:
+            return False  # measuring the wrong hardware is never "healthy"
+        if self.devices.get("missing_local_devices", 0) > 0:
+            return False
+        if self.devices.get("healthy_devices", 0) < self.devices.get("visible_devices", 0):
+            return False
+        if self.ici is not None and not self.ici.ok:
+            return False
+        if self.ici is not None and self.ici.psum_rtt_ms > self.rtt_warn_ms:
+            return False
+        if self.mxu is not None and not self.mxu.get("ok", False):
+            return False
+        return True
+
+    def to_payload(self) -> Dict[str, Any]:
+        """Notification payload (event_type TPU_PROBE, like pod payloads
+        carry ADDED/MODIFIED/DELETED)."""
+        return {
+            "event_type": "TPU_PROBE",
+            "environment": self.environment,
+            "healthy": self.healthy,
+            "devices": self.devices,
+            "ici": self.ici.to_dict() if self.ici else None,
+            "mxu": self.mxu,
+            "duration_ms": self.duration_ms,
+            "event_timestamp": datetime.now(timezone.utc).isoformat(),
+        }

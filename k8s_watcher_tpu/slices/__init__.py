@@ -1,0 +1,9 @@
+"""TPU slice awareness: topology inference + slice-state aggregation.
+
+Net-new capability (north star; SURVEY.md §7 step 5): group pods into
+multi-host slices via GKE TPU labels/annotations and emit slice-level
+events, not just pod events.
+"""
+
+from k8s_watcher_tpu.slices.topology import SliceIdentity, chips_in_topology, infer_slice_identity  # noqa: F401
+from k8s_watcher_tpu.slices.tracker import SlicePhase, SliceState, SliceTracker  # noqa: F401

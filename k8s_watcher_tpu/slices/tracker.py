@@ -1,0 +1,219 @@
+"""Slice-state aggregation.
+
+A slice's health is an aggregate over its member pods (SURVEY.md §7 step 5:
+"a slice is Degraded if any member pod is"). The tracker folds pod-level
+phase deltas into a slice phase machine and emits a slice-level notification
+whenever the aggregate phase changes:
+
+- FORMING     members still scheduling/pending (or not all seen yet)
+- READY       every expected worker Running and ready
+- DEGRADED    any member Failed/Unknown, restarting, or missing after READY
+- COMPLETED   all members Succeeded
+- TERMINATED  all members deleted
+
+Pods are also attributed a ``slice_info`` block for their own notifications,
+so a consumer can always join a pod event back to its slice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+from k8s_watcher_tpu.pipeline.phase import PhaseDelta, pod_ready, pod_restarts
+from k8s_watcher_tpu.slices.topology import SliceIdentity, infer_slice_identity
+from k8s_watcher_tpu.watch.source import EventType, WatchEvent
+
+logger = logging.getLogger(__name__)
+
+
+class SlicePhase:
+    FORMING = "Forming"
+    READY = "Ready"
+    DEGRADED = "Degraded"
+    COMPLETED = "Completed"
+    TERMINATED = "Terminated"
+
+
+@dataclasses.dataclass
+class _Member:
+    uid: str
+    name: str
+    worker_index: Optional[int]
+    phase: str
+    ready: bool
+    restarts: int = 0
+
+
+@dataclasses.dataclass
+class SliceState:
+    identity: SliceIdentity
+    members: Dict[str, _Member] = dataclasses.field(default_factory=dict)
+    phase: str = SlicePhase.FORMING
+    ever_ready: bool = False
+    ever_had_members: bool = False
+
+    def aggregate_phase(self) -> str:
+        if not self.members:
+            # all members gone: terminal whether or not the slice ever got
+            # healthy (a quota-stuck JobSet deleted while Pending must still
+            # terminate, or its state would leak forever)
+            return SlicePhase.TERMINATED if self.ever_had_members else SlicePhase.FORMING
+        phases = [m.phase for m in self.members.values()]
+        if any(p in ("Failed", "Unknown") for p in phases):
+            return SlicePhase.DEGRADED
+        if all(p == "Succeeded" for p in phases):
+            return SlicePhase.COMPLETED
+        expected = self.identity.expected_workers
+        running_ready = sum(1 for m in self.members.values() if m.phase == "Running" and m.ready)
+        if expected is not None:
+            if len(self.members) < expected and self.ever_ready:
+                return SlicePhase.DEGRADED  # lost workers after being whole
+            if running_ready >= expected:
+                return SlicePhase.READY
+        elif running_ready == len(self.members) and running_ready > 0:
+            return SlicePhase.READY
+        return SlicePhase.DEGRADED if self.ever_ready else SlicePhase.FORMING
+
+    def summary(self) -> Dict[str, Any]:
+        ident = self.identity
+        return {
+            "slice": ident.key,
+            "namespace": ident.namespace,
+            "name": ident.name,
+            "topology": ident.topology,
+            "accelerator": ident.accelerator,
+            "chips_per_worker": ident.chips_per_worker,
+            "total_chips": ident.total_chips,
+            "expected_workers": ident.expected_workers,
+            "observed_workers": len(self.members),
+            "ready_workers": sum(1 for m in self.members.values() if m.phase == "Running" and m.ready),
+            "phase": self.phase,
+            "workers": [
+                {
+                    "name": m.name,
+                    "worker_index": m.worker_index,
+                    "phase": m.phase,
+                    "ready": m.ready,
+                    "restarts": m.restarts,
+                }
+                for m in sorted(self.members.values(), key=lambda m: (m.worker_index is None, m.worker_index, m.name))
+            ],
+        }
+
+
+class SliceTracker:
+    def __init__(
+        self,
+        environment: str,
+        *,
+        resource_key: str = "google.com/tpu",
+        topology_label: str = "cloud.google.com/gke-tpu-topology",
+        accelerator_label: str = "cloud.google.com/gke-tpu-accelerator",
+    ):
+        self.environment = environment
+        self.resource_key = resource_key
+        self.topology_label = topology_label
+        self.accelerator_label = accelerator_label
+        self._slices: Dict[str, SliceState] = {}
+        # checkpointed {key: {"phase", "ever_ready"}} applied lazily when the
+        # slice is first observed again after a restart
+        self._restored: Dict[str, Any] = {}
+
+    def __len__(self) -> int:
+        return len(self._slices)
+
+    def get(self, key: str) -> Optional[SliceState]:
+        return self._slices.get(key)
+
+    def states(self) -> Dict[str, SliceState]:
+        return dict(self._slices)
+
+    def observe(
+        self, event: WatchEvent, delta: PhaseDelta
+    ) -> Tuple[Optional[Dict[str, Any]], List[Dict[str, Any]]]:
+        """Fold one pod event into slice state.
+
+        Returns ``(slice_info for the pod payload, [slice notifications])``.
+        """
+        identity = infer_slice_identity(
+            event.pod,
+            resource_key=self.resource_key,
+            topology_label=self.topology_label,
+            accelerator_label=self.accelerator_label,
+        )
+        if identity is None:
+            return None, []
+
+        state = self._slices.get(identity.key)
+        if state is None:
+            state = SliceState(identity=identity)
+            restored = self._restored.pop(identity.key, None)
+            if restored:
+                # resume pre-restart aggregate so a slice that lost workers
+                # during watcher downtime reads Degraded, not Forming
+                state.phase = restored.get("phase", state.phase)
+                state.ever_ready = bool(restored.get("ever_ready"))
+                state.ever_had_members = True  # it existed before the restart
+            self._slices[identity.key] = state
+        elif identity.topology and not state.identity.topology:
+            state.identity = identity  # later pods may carry richer metadata
+
+        uid = event.uid
+        if event.type == EventType.DELETED:
+            state.members.pop(uid, None)
+            if not state.ever_had_members:
+                # DELETED for a slice we never saw alive: nothing to report
+                self._slices.pop(identity.key, None)
+                return None, []
+        else:
+            state.members[uid] = _Member(
+                uid=uid,
+                name=event.name,
+                worker_index=identity.worker_index,
+                phase=event.phase,
+                ready=pod_ready(event.pod),
+                restarts=pod_restarts(event.pod),
+            )
+
+        if state.members:
+            state.ever_had_members = True
+        old_phase = state.phase
+        new_phase = state.aggregate_phase()
+        state.phase = new_phase
+        if new_phase == SlicePhase.READY:
+            state.ever_ready = True
+
+        notifications: List[Dict[str, Any]] = []
+        if new_phase != old_phase:
+            logger.info("Slice %s: %s -> %s", identity.key, old_phase, new_phase)
+            summary = state.summary()
+            summary["environment"] = self.environment
+            summary["event_type"] = "SLICE_PHASE_CHANGE"
+            summary["phase_transition"] = {"from": old_phase, "to": new_phase}
+            notifications.append(summary)
+            if new_phase == SlicePhase.TERMINATED:
+                del self._slices[identity.key]
+
+        slice_info = {
+            "key": identity.key,
+            "worker_index": identity.worker_index,
+            "phase": new_phase,
+            "expected_workers": identity.expected_workers,
+            "observed_workers": len(state.members),
+        }
+        return slice_info, notifications
+
+    # -- checkpoint integration -------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            key: {"phase": st.phase, "ever_ready": st.ever_ready}
+            for key, st in self._slices.items()
+            if st.ever_had_members  # never-alive placeholder states aren't worth persisting
+        }
+
+    def restore(self, snapshot: Dict[str, Any]) -> None:
+        """Stash a checkpoint snapshot; applied as slices are re-observed."""
+        self._restored = dict(snapshot or {})

@@ -1,0 +1,4 @@
+"""Checkpoint/resume (SURVEY.md §5 — ABSENT in the reference: every restart
+re-watched from "now", dropping or duplicating notifications)."""
+
+from k8s_watcher_tpu.state.checkpoint import CheckpointStore  # noqa: F401

@@ -1,0 +1,101 @@
+"""Durable watcher state: last-seen resourceVersion + phase/slice snapshots.
+
+Atomic JSON file writes (write-temp + rename) with throttling so checkpoint
+I/O stays off the hot path even at 1 k events/min. A missing or corrupt
+checkpoint degrades to a cold start — never a crash.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+import threading
+import time
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+_SCHEMA_VERSION = 1
+
+
+class CheckpointStore:
+    def __init__(self, path: os.PathLike | str, *, interval_seconds: float = 5.0):
+        self.path = Path(path)
+        self.interval_seconds = interval_seconds
+        self._lock = threading.Lock()
+        self._state: Dict[str, Any] = {"version": _SCHEMA_VERSION}
+        self._dirty = False
+        self._last_flush = 0.0
+        self._load()
+
+    def _load(self) -> None:
+        try:
+            data = json.loads(self.path.read_text())
+        except FileNotFoundError:
+            return
+        except (json.JSONDecodeError, OSError) as exc:
+            logger.warning("Corrupt checkpoint %s (%s); starting cold", self.path, exc)
+            return
+        if isinstance(data, dict) and data.get("version") == _SCHEMA_VERSION:
+            self._state = data
+        else:
+            logger.warning("Checkpoint %s has unknown schema; starting cold", self.path)
+
+    # -- accessors ---------------------------------------------------------
+
+    def resource_version(self) -> Optional[str]:
+        with self._lock:
+            return self._state.get("resource_version")
+
+    def update_resource_version(self, rv: str) -> None:
+        with self._lock:
+            self._state["resource_version"] = rv
+            self._dirty = True
+        self.maybe_flush()
+
+    def get(self, key: str, default: Any = None) -> Any:
+        with self._lock:
+            return self._state.get(key, default)
+
+    def put(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._state[key] = value
+            self._dirty = True
+        self.maybe_flush()
+
+    # -- persistence -------------------------------------------------------
+
+    def due(self) -> bool:
+        """True when the throttle window has elapsed — callers with expensive
+        snapshots should skip building them entirely until this is True."""
+        with self._lock:
+            return time.monotonic() - self._last_flush >= self.interval_seconds
+
+    def maybe_flush(self) -> None:
+        """Flush if dirty and the throttle interval has elapsed."""
+        now = time.monotonic()
+        with self._lock:
+            if not self._dirty or now - self._last_flush < self.interval_seconds:
+                return
+        self.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            snapshot = json.dumps(self._state)
+            self._dirty = False
+            self._last_flush = time.monotonic()
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=str(self.path.parent), prefix=self.path.name, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as fh:
+                fh.write(snapshot)
+            os.replace(tmp, self.path)
+        except OSError as exc:
+            logger.error("Checkpoint flush to %s failed: %s", self.path, exc)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass

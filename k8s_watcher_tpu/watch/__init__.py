@@ -1,0 +1,13 @@
+"""Watch sources: the protocol, the in-process fake, and helpers.
+
+The reference consumed the kubernetes SDK's ``watch.Watch().stream(...)``
+directly inside its god-class (pod_watcher.py:264-269), making the loop
+untestable without a cluster. Here a ``WatchSource`` is a tiny protocol with
+interchangeable implementations:
+
+- ``FakeWatchSource``      in-process scripted replay (tests / acceptance #1)
+- ``k8s.watch.KubernetesWatchSource``  native REST list+watch with resume
+"""
+
+from k8s_watcher_tpu.watch.source import WatchEvent, WatchSource, EventType  # noqa: F401
+from k8s_watcher_tpu.watch.fake import FakeWatchSource, build_pod, pod_lifecycle  # noqa: F401

@@ -1,0 +1,8 @@
+"""Entrypoint shim (parity with reference main.py: ``python main.py [env]``)."""
+
+import sys
+
+from k8s_watcher_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

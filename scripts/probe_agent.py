@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Standalone in-slice probe agent.
+
+Deploy one per TPU host (DaemonSet on TPU node pools, or a sidecar in the
+training JobSet). Every process joins the collectives; process 0 reports to
+clusterapi. Multi-host initialization comes from the standard JAX env vars
+(JAX_COORDINATOR_ADDRESS, JAX_NUM_PROCESSES, JAX_PROCESS_ID) which GKE
+JobSets inject.
+
+Usage: python scripts/probe_agent.py [environment] [--once]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from k8s_watcher_tpu.config.loader import load_config, resolve_environment
+from k8s_watcher_tpu.logging_setup import setup_logging
+from k8s_watcher_tpu.notify.dispatcher import Dispatcher
+from k8s_watcher_tpu.parallel.mesh import initialize_multihost
+from k8s_watcher_tpu.probe.agent import ProbeAgent
+
+
+def main() -> int:
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    once = "--once" in sys.argv
+    environment = resolve_environment(args[:1])
+    config = load_config(environment)
+    setup_logging(environment, config.watcher.log_level)
+
+    initialize_multihost()  # no-op when single-process
+
+    from k8s_watcher_tpu.app import build_notifier
+
+    notifier = build_notifier(config)
+    dispatcher = Dispatcher(
+        notifier.update_pod_status,
+        capacity=config.clusterapi.queue_capacity,
+        workers=1,
+    )
+    dispatcher.start()
+    agent = ProbeAgent(config.tpu, environment=environment, sink=dispatcher.submit)
+
+    if once:
+        report = agent.run_once()
+        import json
+
+        print(json.dumps(report.to_payload(), indent=2, default=str))
+        dispatcher.stop()
+        return 0 if report.healthy else 1
+
+    agent.start()
+    try:
+        while True:
+            time.sleep(60)
+    except KeyboardInterrupt:
+        agent.stop()
+        dispatcher.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Run the mock Kubernetes API server standalone.
+
+The reference's mock tier pointed its kubeconfig at localhost:9988 but never
+shipped the server (SURVEY.md §2.13). This runs ours there, with a scripted
+TPU slice-pod lifecycle so a watcher pointed at it (development environment,
+``use_mock: false`` + ``config_file: ./assets/config``) sees realistic
+events.
+
+Usage: python scripts/run_mock_server.py [port] [--churn]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from k8s_watcher_tpu.k8s.mock_server import MockApiServer, MockCluster
+from k8s_watcher_tpu.watch.fake import build_pod
+
+
+def seed_slice(cluster: MockCluster, name: str = "train", workers: int = 4) -> None:
+    for w in range(workers):
+        cluster.add_pod(
+            build_pod(
+                f"{name}-{w}",
+                "default",
+                phase="Pending",
+                tpu_chips=4,
+                tpu_topology=f"2x2x{workers}",
+                tpu_accelerator="tpu-v5p-slice",
+                gke_slice_fields={
+                    "jobset.sigs.k8s.io/jobset-name": name,
+                    "batch.kubernetes.io/job-completion-index": w,
+                },
+            )
+        )
+
+
+def main() -> int:
+    port = int(sys.argv[1]) if len(sys.argv) > 1 and sys.argv[1].isdigit() else 9988
+    churn = "--churn" in sys.argv
+    cluster = MockCluster()
+    seed_slice(cluster)
+    server = MockApiServer(cluster, port=port).start()
+    print(f"mock k8s API server listening on {server.url} (Ctrl-C to stop)")
+    try:
+        phase_cycle = ["Running", "Failed", "Pending", "Running"]
+        i = 0
+        while True:
+            time.sleep(5.0)
+            if churn:
+                worker = i % 4
+                phase = phase_cycle[(i // 4) % len(phase_cycle)]
+                cluster.set_phase("default", f"train-{worker}", phase)
+                print(f"churn: train-{worker} -> {phase}")
+                i += 1
+    except KeyboardInterrupt:
+        print("stopping")
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,21 @@
+"""Test harness setup.
+
+JAX tests run on a virtual 8-device CPU mesh (multi-chip sharding validated
+without TPU hardware): XLA_FLAGS must be set before the first backend
+initialization, and the platform is forced to cpu because the environment
+may pin JAX_PLATFORMS to a hardware plugin.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,147 @@
+"""End-to-end app tests + churn/fault-injection load (acceptance #5 shape)."""
+
+import threading
+import time
+
+from k8s_watcher_tpu.config.loader import load_config
+from k8s_watcher_tpu.app import WatcherApp
+from k8s_watcher_tpu.faults.injection import ChurnGenerator, FaultyNotifier
+from k8s_watcher_tpu.metrics import MetricsRegistry
+from k8s_watcher_tpu.notify.dispatcher import Dispatcher
+from k8s_watcher_tpu.pipeline.filters import TpuResourceFilter
+from k8s_watcher_tpu.pipeline.pipeline import EventPipeline
+from k8s_watcher_tpu.slices.tracker import SliceTracker
+from k8s_watcher_tpu.watch.fake import FakeWatchSource, pod_lifecycle
+
+
+class RecordingNotifier:
+    """Stands in for ClusterApiClient (boolean contract)."""
+
+    def __init__(self):
+        self.payloads = []
+        self.lock = threading.Lock()
+
+    def update_pod_status(self, payload):
+        with self.lock:
+            self.payloads.append(payload)
+        return True
+
+    def health_check(self):
+        return True
+
+
+def dev_config(**overrides):
+    cfg = load_config("development", "/root/repo/config", env={})
+    return cfg
+
+
+class TestWatcherApp:
+    def test_end_to_end_fake_cycle(self):
+        config = dev_config()
+        notifier = RecordingNotifier()
+        source = FakeWatchSource(pod_lifecycle("w0", phases=("Pending", "Running"), tpu_chips=4))
+        app = WatcherApp(config, source=source, notifier=notifier)
+        app.run()  # source exhausts, run returns after shutdown
+        kinds = [p["event_type"] for p in notifier.payloads]
+        assert kinds == ["ADDED", "MODIFIED", "DELETED"]
+
+    def test_use_mock_source_built_from_config(self):
+        config = dev_config()
+        assert config.kubernetes.use_mock
+        notifier = RecordingNotifier()
+        app = WatcherApp(config, notifier=notifier)  # source from config (fake, hold_open)
+        t = threading.Thread(target=app.run, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 10
+        while len(notifier.payloads) < 3 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        app.stop()
+        t.join(timeout=5)
+        assert len(notifier.payloads) >= 3
+
+    def test_checkpoint_written_with_tracker_state(self, tmp_path):
+        import dataclasses
+        import json
+
+        config = dev_config()
+        state = dataclasses.replace(
+            config.state, checkpoint_path=str(tmp_path / "ck.json"), checkpoint_interval_seconds=0.0
+        )
+        config = dataclasses.replace(config, state=state)
+        notifier = RecordingNotifier()
+        # two ADDED pods, no deletes, so phase state persists at shutdown
+        from k8s_watcher_tpu.watch.fake import build_pod
+        from k8s_watcher_tpu.watch.source import EventType, WatchEvent
+
+        events = [
+            WatchEvent(type=EventType.ADDED, pod=build_pod(f"w{i}", phase="Running", tpu_chips=4))
+            for i in range(2)
+        ]
+        app = WatcherApp(config, source=FakeWatchSource(events), notifier=notifier)
+        # regression: `or` defaulting once replaced the app's (falsy-empty)
+        # trackers with private ones, so checkpoints were always empty
+        assert app.pipeline.phase_tracker is app.phase_tracker
+        app.run()
+        data = json.loads((tmp_path / "ck.json").read_text())
+        assert len(data["phases"]) == 2
+        assert set(data["phases"].values()) == {"Running"}
+
+
+class TestChurnLoad:
+    """1 k+ events through the full pipeline with faulty notifier — the
+    CPU-scale shape of acceptance config #5."""
+
+    def test_churn_1k_events_p50_under_target(self):
+        metrics = MetricsRegistry()
+        sent = []
+        inner = lambda p: (sent.append(None), True)[1]
+        notifier = FaultyNotifier(inner, fail_prob=0.05, seed=7)
+        dispatcher = Dispatcher(notifier, capacity=4096, workers=4, metrics=metrics)
+        dispatcher.start()
+        pipeline = EventPipeline(
+            environment="production",
+            sink=dispatcher.submit,
+            slice_tracker=SliceTracker("production"),
+            metrics=metrics,
+            resource_filter=TpuResourceFilter("google.com/tpu"),
+        )
+        churn = ChurnGenerator(n_slices=8, workers_per_slice=4, seed=3)
+        n = 1500
+        t0 = time.monotonic()
+        for event in churn.events(n):
+            pipeline.process(event)
+        ingest_seconds = time.monotonic() - t0
+        assert dispatcher.drain(30.0)
+        dispatcher.stop()
+
+        dump = metrics.dump()
+        assert dump["events_received"]["count"] == n
+        # sustained throughput far above 1k/min (≈17 events/s)
+        assert n / ingest_seconds > 100, f"ingest too slow: {n/ingest_seconds:.0f} ev/s"
+        latency = metrics.histogram("event_to_notify_latency")
+        assert latency.count > 0
+        p50 = latency.quantile(0.5)
+        assert p50 is not None and p50 < 1.0, f"p50 {p50*1000:.1f}ms breaches 1s target"
+        assert notifier.injected_failures > 0  # faults actually exercised
+
+    def test_slice_events_under_churn(self):
+        got = []
+        pipeline = EventPipeline(
+            environment="development",
+            sink=got.append,
+            slice_tracker=SliceTracker("development"),
+        )
+        churn = ChurnGenerator(n_slices=2, workers_per_slice=2, seed=1)
+        for event in churn.events(300):
+            pipeline.process(event)
+        slice_notes = [n for n in got if n.kind == "slice"]
+        assert slice_notes, "no slice-level notifications under churn"
+        assert all(n.payload["event_type"] == "SLICE_PHASE_CHANGE" for n in slice_notes)
+
+
+class TestCli:
+    def test_invalid_environment_exits_1(self, capsys):
+        from k8s_watcher_tpu.cli import main
+
+        assert main(["qa"]) == 1
+        assert "Unsupported environment" in capsys.readouterr().out

@@ -1,0 +1,176 @@
+"""Config stack tests — contract parity with reference pod_watcher.py:19-75
+plus the strict-schema behavior that fixes dead-key defect #3."""
+
+import pytest
+
+from k8s_watcher_tpu.config.loader import (
+    ConfigError,
+    deep_merge,
+    load_config,
+    load_yaml_file,
+    resolve_environment,
+    substitute_env_vars,
+)
+
+REPO_CONFIG_DIR = "config"
+
+
+class TestMerge:
+    def test_override_wins(self):
+        assert deep_merge({"a": 1}, {"a": 2}) == {"a": 2}
+
+    def test_recursive(self):
+        base = {"w": {"x": 1, "y": 2}, "keep": True}
+        over = {"w": {"y": 3, "z": 4}}
+        assert deep_merge(base, over) == {"w": {"x": 1, "y": 3, "z": 4}, "keep": True}
+
+    def test_dict_replaces_scalar(self):
+        assert deep_merge({"a": 1}, {"a": {"b": 2}}) == {"a": {"b": 2}}
+
+    def test_base_not_mutated(self):
+        base = {"w": {"x": 1}}
+        deep_merge(base, {"w": {"x": 9}})
+        assert base == {"w": {"x": 1}}
+
+
+class TestEnvSubstitution:
+    def test_whole_string_token(self):
+        out = substitute_env_vars({"k": "${FOO}"}, {"FOO": "bar"})
+        assert out == {"k": "bar"}
+
+    def test_default_used_when_unset(self):
+        out = substitute_env_vars({"k": "${FOO:-fallback}"}, {})
+        assert out == {"k": "fallback"}
+
+    def test_env_beats_default(self):
+        out = substitute_env_vars({"k": "${FOO:-fallback}"}, {"FOO": "real"})
+        assert out == {"k": "real"}
+
+    def test_unset_no_default_is_empty(self):
+        # parity: reference returns "" (pod_watcher.py:68-71)
+        assert substitute_env_vars({"k": "${NOPE}"}, {}) == {"k": ""}
+
+    def test_partial_string_not_substituted(self):
+        # parity: only whole-string tokens (pod_watcher.py:66)
+        assert substitute_env_vars({"k": "prefix-${FOO}"}, {"FOO": "x"}) == {"k": "prefix-${FOO}"}
+
+    def test_recurses_lists_and_dicts(self):
+        out = substitute_env_vars({"l": ["${A}", {"n": "${B}"}]}, {"A": "1", "B": "2"})
+        assert out == {"l": ["1", {"n": "2"}]}
+
+
+class TestEnvironmentResolution:
+    def test_default(self):
+        assert resolve_environment([], {}) == "development"
+
+    def test_env_var(self):
+        assert resolve_environment([], {"ENVIRONMENT": "staging"}) == "staging"
+
+    def test_argv_beats_env_var(self):
+        assert resolve_environment(["production"], {"ENVIRONMENT": "staging"}) == "production"
+
+    def test_unsupported_rejected(self):
+        with pytest.raises(ConfigError, match="Unsupported environment"):
+            resolve_environment(["qa"], {})
+
+
+class TestLoadYaml:
+    def test_missing_file_degrades_to_empty(self, tmp_path):
+        assert load_yaml_file(tmp_path / "nope.yaml") == {}
+
+    def test_empty_file(self, tmp_path):
+        p = tmp_path / "empty.yaml"
+        p.write_text("")
+        assert load_yaml_file(p) == {}
+
+    def test_malformed_raises(self, tmp_path):
+        p = tmp_path / "bad.yaml"
+        p.write_text("a: [unclosed")
+        with pytest.raises(ConfigError):
+            load_yaml_file(p)
+
+    def test_non_mapping_raises(self, tmp_path):
+        p = tmp_path / "list.yaml"
+        p.write_text("- a\n- b\n")
+        with pytest.raises(ConfigError):
+            load_yaml_file(p)
+
+
+class TestRepoConfigs:
+    """The shipped config/ tree must load cleanly for every environment."""
+
+    @pytest.mark.parametrize("env", ["development", "staging", "production"])
+    def test_environment_loads(self, env, monkeypatch):
+        monkeypatch.chdir("/root/repo")
+        cfg = load_config(env, REPO_CONFIG_DIR, env={})
+        assert cfg.environment == env
+        assert cfg.clusterapi.pod_update_endpoint == "/api/pods/update"
+        assert cfg.tpu.resource_key == "google.com/tpu"
+
+    def test_development_overlay(self, monkeypatch):
+        monkeypatch.chdir("/root/repo")
+        cfg = load_config("development", REPO_CONFIG_DIR, env={"CLUSTERAPI_API_KEY": "sekrit"})
+        assert cfg.kubernetes.use_mock is True
+        assert cfg.watcher.log_level == "DEBUG"
+        assert cfg.watcher.namespaces == ("default", "kube-system")
+        assert cfg.clusterapi.api_key == "sekrit"
+
+    def test_staging_inherits_base(self, monkeypatch):
+        monkeypatch.chdir("/root/repo")
+        cfg = load_config("staging", REPO_CONFIG_DIR, env={})
+        assert cfg.watcher.log_level == "INFO"
+        assert cfg.watcher.retry.max_attempts == 3
+
+    def test_production_overlay(self, monkeypatch):
+        monkeypatch.chdir("/root/repo")
+        cfg = load_config("production", REPO_CONFIG_DIR, env={})
+        assert cfg.kubernetes.use_incluster_config is True
+        assert cfg.watcher.critical_events_only is True
+        assert cfg.watcher.log_level == "WARNING"
+        assert cfg.tpu.probe_enabled is True
+        assert cfg.state.checkpoint_path == "/var/lib/k8s-watcher-tpu/checkpoint.json"
+
+
+class TestStrictSchema:
+    def _write(self, tmp_path, base: str, dev: str = "") -> str:
+        (tmp_path / "base.yaml").write_text(base)
+        (tmp_path / "development.yaml").write_text(dev)
+        return str(tmp_path)
+
+    def test_unknown_top_level_key_rejected(self, tmp_path):
+        d = self._write(tmp_path, "watcherr:\n  log_level: INFO\n")
+        with pytest.raises(ConfigError, match="unknown config key"):
+            load_config("development", d, env={})
+
+    def test_unknown_nested_key_rejected(self, tmp_path):
+        d = self._write(tmp_path, "watcher:\n  watch_intervall: 2\n")
+        with pytest.raises(ConfigError, match="watch_intervall"):
+            load_config("development", d, env={})
+
+    def test_bad_type_rejected(self, tmp_path):
+        d = self._write(tmp_path, "clusterapi:\n  timeout: fast\n")
+        with pytest.raises(ConfigError, match="timeout"):
+            load_config("development", d, env={})
+
+    def test_bad_log_level_rejected(self, tmp_path):
+        d = self._write(tmp_path, "watcher:\n  log_level: CHATTY\n")
+        with pytest.raises(ConfigError, match="log_level"):
+            load_config("development", d, env={})
+
+    def test_bool_from_env_string(self, tmp_path):
+        d = self._write(tmp_path, "kubernetes:\n  use_mock: ${USE_MOCK:-false}\n")
+        assert load_config("development", d, env={"USE_MOCK": "true"}).kubernetes.use_mock is True
+        assert load_config("development", d, env={}).kubernetes.use_mock is False
+
+    def test_numeric_from_env_string(self, tmp_path):
+        d = self._write(tmp_path, 'clusterapi:\n  timeout: "${T:-30}"\n  workers: "${W:-4}"\n')
+        cfg = load_config("development", d, env={"T": "7.5"})
+        assert cfg.clusterapi.timeout == 7.5
+        assert cfg.clusterapi.workers == 4  # default through unset var
+        with pytest.raises(ConfigError, match="not a number"):
+            load_config("development", d, env={"T": "fast"})
+
+    def test_gpu_compat_backend(self, tmp_path):
+        d = self._write(tmp_path, "tpu:\n  backend: gpu\n")
+        cfg = load_config("development", d, env={})
+        assert cfg.tpu.resource_key == "nvidia.com/gpu"

@@ -1,0 +1,173 @@
+"""Notifier tests: client contract (Bearer auth, endpoints, timeout, retry)
+and the async dispatcher (non-blocking, backpressure, latency metric)."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from k8s_watcher_tpu.config.schema import RetryPolicy
+from k8s_watcher_tpu.metrics import MetricsRegistry
+from k8s_watcher_tpu.notify.client import ClusterApiClient
+from k8s_watcher_tpu.notify.dispatcher import Dispatcher
+from k8s_watcher_tpu.pipeline.pipeline import Notification
+
+
+class _ApiSink(BaseHTTPRequestHandler):
+    """Records POSTs; scripted status codes via server.script list."""
+
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, status, body=b"{}"):
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        payload = json.loads(self.rfile.read(length) or b"{}")
+        with self.server.lock:
+            self.server.received.append(
+                {"path": self.path, "auth": self.headers.get("Authorization"), "payload": payload}
+            )
+            status = self.server.script.pop(0) if self.server.script else 200
+        if status == "hang":
+            time.sleep(5)
+            status = 200
+        self._reply(status)
+
+    def do_GET(self):
+        self._reply(200 if self.path == "/health" else 404)
+
+
+@pytest.fixture
+def api_server():
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _ApiSink)
+    server.received = []
+    server.script = []
+    server.lock = threading.Lock()
+    server.daemon_threads = True
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    yield server, url
+    server.shutdown()
+    server.server_close()
+
+
+class TestClusterApiClient:
+    def test_post_success_with_bearer_auth(self, api_server):
+        server, url = api_server
+        client = ClusterApiClient(url, api_key="tok123")
+        assert client.update_pod_status({"name": "w0"}) is True
+        req = server.received[0]
+        assert req["path"] == "/api/pods/update"  # parity: clusterapi_client.py:30
+        assert req["auth"] == "Bearer tok123"  # parity: clusterapi_client.py:14-18
+        assert req["payload"] == {"name": "w0"}
+
+    def test_custom_endpoint_from_config(self, api_server):
+        server, url = api_server
+        client = ClusterApiClient(url, pod_update_endpoint="/v2/pods")
+        client.update_pod_status({"name": "w0"})
+        assert server.received[0]["path"] == "/v2/pods"
+
+    def test_4xx_no_retry(self, api_server):
+        server, url = api_server
+        server.script = [403]
+        client = ClusterApiClient(url, retry=RetryPolicy(max_attempts=3, delay_seconds=0.0))
+        assert client.update_pod_status({}) is False
+        assert len(server.received) == 1
+
+    def test_5xx_retried_until_success(self, api_server):
+        server, url = api_server
+        server.script = [500, 502]
+        client = ClusterApiClient(url, retry=RetryPolicy(max_attempts=3, delay_seconds=0.0))
+        assert client.update_pod_status({}) is True
+        assert len(server.received) == 3
+
+    def test_5xx_exhausts_attempts(self, api_server):
+        server, url = api_server
+        server.script = [500, 500]
+        client = ClusterApiClient(url, retry=RetryPolicy(max_attempts=2, delay_seconds=0.0))
+        assert client.update_pod_status({}) is False
+        assert len(server.received) == 2
+
+    def test_connection_error_returns_false(self):
+        client = ClusterApiClient("http://127.0.0.1:1", retry=RetryPolicy(max_attempts=2, delay_seconds=0.0))
+        assert client.update_pod_status({}) is False
+
+    def test_timeout_enforced(self, api_server):
+        # reference defect: requests.post had NO timeout (clusterapi_client.py:36)
+        server, url = api_server
+        server.script = ["hang"]
+        client = ClusterApiClient(url, timeout=0.3, retry=RetryPolicy(max_attempts=1))
+        t0 = time.monotonic()
+        assert client.update_pod_status({}) is False
+        assert time.monotonic() - t0 < 2.0
+
+    def test_health_check(self, api_server):
+        _, url = api_server
+        assert ClusterApiClient(url).health_check() is True
+        assert ClusterApiClient("http://127.0.0.1:1").health_check() is False
+
+
+class TestDispatcher:
+    def _notification(self, i=0):
+        return Notification({"name": f"p{i}"}, time.monotonic())
+
+    def test_async_send_and_latency_metric(self):
+        sent = []
+        metrics = MetricsRegistry()
+        d = Dispatcher(lambda p: (sent.append(p), True)[1], metrics=metrics)
+        d.start()
+        for i in range(5):
+            d.submit(self._notification(i))
+        assert d.drain(5.0)
+        assert len(sent) == 5
+        hist = metrics.histogram("event_to_notify_latency")
+        assert hist.count == 5
+        d.stop()
+
+    def test_submit_never_blocks_on_slow_send(self):
+        release = threading.Event()
+        d = Dispatcher(lambda p: release.wait(5) or True, capacity=4, workers=1)
+        d.start()
+        t0 = time.monotonic()
+        for i in range(50):
+            d.submit(self._notification(i))
+        assert time.monotonic() - t0 < 1.0  # queue full -> drop-oldest, no block
+        release.set()
+        d.stop()
+        assert d.metrics.counter("dispatch_dropped_overflow").value > 0
+
+    def test_failed_sends_counted(self):
+        d = Dispatcher(lambda p: False)
+        d.start()
+        d.submit(self._notification())
+        d.drain(5.0)
+        assert d.metrics.counter("dispatch_failed").value == 1
+        d.stop()
+
+    def test_send_exception_does_not_kill_worker(self):
+        calls = []
+
+        def send(p):
+            calls.append(p)
+            if len(calls) == 1:
+                raise RuntimeError("boom")
+            return True
+
+        d = Dispatcher(send, workers=1)
+        d.start()
+        d.submit(self._notification(1))
+        d.submit(self._notification(2))
+        assert d.drain(5.0)
+        assert len(calls) == 2
+        d.stop()

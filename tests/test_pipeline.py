@@ -1,0 +1,297 @@
+"""Pipeline tests: filters, phase deltas, extraction, and the full
+acceptance-config-#1 cycle (ADDED→MODIFIED→DELETED on CPU, no cluster)."""
+
+import json
+
+from k8s_watcher_tpu.logging_setup import JsonFormatter, setup_logging
+from k8s_watcher_tpu.pipeline.extract import extract_pod_data
+from k8s_watcher_tpu.pipeline.filters import (
+    CriticalEventGate,
+    NamespaceFilter,
+    TpuResourceFilter,
+    pod_accelerator_chips,
+)
+from k8s_watcher_tpu.pipeline.phase import PhaseTracker
+from k8s_watcher_tpu.pipeline.pipeline import EventPipeline
+from k8s_watcher_tpu.watch.fake import FakeWatchSource, build_pod, pod_lifecycle
+from k8s_watcher_tpu.watch.source import EventType, WatchEvent
+
+
+def tpu_pod(name="w0", phase="Running", **kw):
+    return build_pod(name, phase=phase, tpu_chips=4, **kw)
+
+
+def ev(pod, etype=EventType.ADDED):
+    return WatchEvent(type=etype, pod=pod)
+
+
+class TestFilters:
+    def test_namespace_empty_passes_all(self):
+        assert NamespaceFilter(())(ev(build_pod("a", "anyns")))
+
+    def test_namespace_match(self):
+        f = NamespaceFilter(("default", "kube-system"))
+        assert f(ev(build_pod("a", "default")))
+        assert not f(ev(build_pod("a", "other")))
+
+    def test_resource_filter_requires_tpu(self):
+        f = TpuResourceFilter("google.com/tpu")
+        assert f(ev(tpu_pod()))
+        assert not f(ev(build_pod("plain")))
+
+    def test_resource_filter_limits_only(self):
+        pod = build_pod("lim", containers=[
+            {"name": "c", "image": "i", "resources": {"limits": {"google.com/tpu": "8"}}}
+        ])
+        assert pod_accelerator_chips(pod, "google.com/tpu") == 8
+
+    def test_resource_filter_init_container(self):
+        pod = build_pod("init")
+        pod["spec"]["initContainers"] = [
+            {"name": "warm", "resources": {"requests": {"google.com/tpu": "4"}}}
+        ]
+        assert TpuResourceFilter("google.com/tpu")(ev(pod))
+
+    def test_gpu_compat(self):
+        pod = build_pod("gpu", containers=[
+            {"name": "c", "image": "i", "resources": {"requests": {"nvidia.com/gpu": "2"}}}
+        ])
+        assert TpuResourceFilter("nvidia.com/gpu")(ev(pod))
+        assert not TpuResourceFilter("google.com/tpu")(ev(pod))
+
+    def test_critical_gate_parity(self):
+        # parity: pod_watcher.py:204-212 — only active in production w/ flag
+        gate = CriticalEventGate("production", True)
+        assert gate(ev(tpu_pod(phase="Failed"), EventType.MODIFIED))
+        assert gate(ev(tpu_pod(phase="Running"), EventType.DELETED))
+        assert not gate(ev(tpu_pod(phase="Running"), EventType.MODIFIED))
+        assert CriticalEventGate("development", True)(ev(tpu_pod(), EventType.MODIFIED))
+        assert CriticalEventGate("production", False)(ev(tpu_pod(), EventType.MODIFIED))
+
+
+class TestPhaseTracker:
+    def test_first_sighting_is_change(self):
+        t = PhaseTracker()
+        d = t.observe(ev(tpu_pod(phase="Pending")))
+        assert d.old_phase is None and d.new_phase == "Pending" and d.phase_changed
+
+    def test_same_phase_not_significant(self):
+        t = PhaseTracker()
+        pod = tpu_pod(phase="Running")
+        t.observe(ev(pod))
+        d = t.observe(ev(pod, EventType.MODIFIED))
+        assert not d.phase_changed and not d.significant
+
+    def test_phase_transition(self):
+        t = PhaseTracker()
+        pod1 = tpu_pod(phase="Pending")
+        t.observe(ev(pod1))
+        pod2 = build_pod("w0", uid=pod1["metadata"]["uid"], phase="Running", tpu_chips=4)
+        d = t.observe(ev(pod2, EventType.MODIFIED))
+        assert d.old_phase == "Pending" and d.new_phase == "Running" and d.phase_changed
+
+    def test_readiness_change_significant(self):
+        t = PhaseTracker()
+        uid = "u1"
+        p1 = build_pod("w0", uid=uid, phase="Running", tpu_chips=4,
+                       container_statuses=[{"name": "c", "ready": False, "restartCount": 0}])
+        p2 = build_pod("w0", uid=uid, phase="Running", tpu_chips=4,
+                       container_statuses=[{"name": "c", "ready": True, "restartCount": 0}])
+        t.observe(ev(p1))
+        d = t.observe(ev(p2, EventType.MODIFIED))
+        assert not d.phase_changed and d.readiness_changed and d.significant
+
+    def test_delete_clears_state(self):
+        t = PhaseTracker()
+        pod = tpu_pod()
+        t.observe(ev(pod))
+        d = t.observe(ev(pod, EventType.DELETED))
+        assert d.deleted and len(t) == 0
+
+    def test_snapshot_restore(self):
+        t = PhaseTracker()
+        t.observe(ev(tpu_pod()))
+        snap = t.snapshot()
+        t2 = PhaseTracker()
+        t2.restore(snap)
+        assert len(t2) == 1
+
+    def test_restore_does_not_fire_spurious_readiness_change(self):
+        # regression: restored (readiness-unknown) state compared against the
+        # first real heartbeat used to notify readiness_changed for every pod
+        t = PhaseTracker()
+        uid = "u-restored"
+        pod = build_pod("w0", uid=uid, phase="Running", tpu_chips=4,
+                        container_statuses=[{"name": "c", "ready": True, "restartCount": 0}])
+        t.observe(ev(pod))
+        t2 = PhaseTracker()
+        t2.restore(t.snapshot())
+        d = t2.observe(ev(pod, EventType.MODIFIED))
+        assert not d.phase_changed and not d.readiness_changed and not d.significant
+
+
+class TestExtract:
+    def test_schema_parity_fields(self):
+        # field parity with reference _extract_pod_data (pod_watcher.py:159-202)
+        pod = build_pod(
+            "w0", "prod-ns", phase="Running", node_name="node-1",
+            labels={"app": "train"}, annotations={"k": "v"},
+            conditions=[{"type": "Ready", "status": "True", "reason": None, "message": None}],
+            container_statuses=[{
+                "name": "main", "ready": True, "restartCount": 2,
+                "state": {"running": {"startedAt": "2026-01-01T00:00:00Z"}},
+            }],
+            tpu_chips=4, tpu_topology="2x2x1",
+        )
+        data = extract_pod_data(pod, "production")
+        assert data["name"] == "w0"
+        assert data["namespace"] == "prod-ns"
+        assert data["uid"].startswith("uid-w0")
+        assert data["environment"] == "production"
+        assert data["status"]["phase"] == "Running"
+        assert data["status"]["conditions"][0]["type"] == "Ready"
+        cs = data["status"]["container_statuses"][0]
+        assert cs == {"name": "main", "ready": True, "restart_count": 2,
+                      "state": "running(started_at=2026-01-01T00:00:00Z)"}
+        assert data["spec"]["node_name"] == "node-1"
+        assert data["spec"]["containers"][0]["image"] == "busybox:latest"
+        assert data["metadata"]["labels"] == {"app": "train"}
+        assert data["metadata"]["creation_timestamp"] == "2026-01-01T00:00:00Z"
+        assert "event_timestamp" in data
+
+    def test_tpu_block(self):
+        pod = tpu_pod(tpu_topology="2x2x4")
+        data = extract_pod_data(pod, "development")
+        assert data["tpu"]["chips"] == 4
+        assert data["tpu"]["topology"] == "2x2x4"
+        assert data["tpu"]["resource_key"] == "google.com/tpu"
+
+    def test_no_tpu_block_for_plain_pod(self):
+        assert "tpu" not in extract_pod_data(build_pod("p"), "development")
+
+    def test_terminated_state_rendering(self):
+        pod = build_pod("t", container_statuses=[{
+            "name": "c", "ready": False, "restartCount": 1,
+            "state": {"terminated": {"reason": "OOMKilled", "exitCode": 137}},
+        }])
+        s = extract_pod_data(pod, "dev")["status"]["container_statuses"][0]["state"]
+        assert s == "terminated(reason=OOMKilled, exit_code=137)"
+
+
+class RecordingSink:
+    def __init__(self):
+        self.items = []
+
+    def __call__(self, notification):
+        self.items.append(notification)
+
+
+class TestPipelineEndToEnd:
+    """Acceptance config #1: one pod cycled ADDED→MODIFIED→DELETED."""
+
+    def make_pipeline(self, sink, environment="development", **kw):
+        return EventPipeline(environment=environment, sink=sink, **kw)
+
+    def test_full_cycle_notifies_three_times(self):
+        sink = RecordingSink()
+        pipe = self.make_pipeline(sink)
+        events = pod_lifecycle("w0", phases=("Pending", "Running"), tpu_chips=4)
+        source = FakeWatchSource(events)
+        for event in source.events():
+            pipe.process(event)
+        kinds = [n.payload["event_type"] for n in sink.items]
+        assert kinds == ["ADDED", "MODIFIED", "DELETED"]
+        transitions = [n.payload["phase_transition"] for n in sink.items]
+        assert transitions[0]["to"] == "Pending"
+        assert transitions[1] == {"from": "Pending", "to": "Running", "phase_changed": True,
+                                  "readiness_changed": False, "deleted": False}
+        assert transitions[2]["deleted"] is True
+
+    def test_non_tpu_pod_dropped(self):
+        sink = RecordingSink()
+        pipe = self.make_pipeline(sink)
+        result = pipe.process(ev(build_pod("plain")))
+        assert not result.notified and result.reason == "resource_filter"
+        assert sink.items == []
+
+    def test_insignificant_modified_dropped(self):
+        sink = RecordingSink()
+        pipe = self.make_pipeline(sink)
+        pod = tpu_pod()
+        pipe.process(ev(pod))
+        result = pipe.process(ev(pod, EventType.MODIFIED))
+        assert result.reason == "no_significant_change"
+        assert len(sink.items) == 1
+
+    def test_notify_all_forwards_everything(self):
+        sink = RecordingSink()
+        pipe = self.make_pipeline(sink, notify_all=True)
+        pod = tpu_pod()
+        pipe.process(ev(pod))
+        pipe.process(ev(pod, EventType.MODIFIED))
+        assert len(sink.items) == 2
+
+    def test_critical_gate_suppresses_notify_but_feeds_trackers(self):
+        # regression: gating before tracking starved the slice aggregate in
+        # production (critical_events_only), so no slice could reach Ready
+        from k8s_watcher_tpu.pipeline.filters import CriticalEventGate
+        from k8s_watcher_tpu.slices.tracker import SlicePhase, SliceTracker
+        from k8s_watcher_tpu.watch.fake import build_pod as bp
+
+        sink = RecordingSink()
+        tracker = SliceTracker("production")
+        pipe = self.make_pipeline(
+            sink, environment="production",
+            critical_gate=CriticalEventGate("production", True),
+            slice_tracker=tracker,
+        )
+
+        def worker(w, phase="Running"):
+            return bp(
+                f"t-{w}", uid=f"uid-t-{w}", phase=phase, tpu_chips=4,
+                tpu_topology="2x2x2",
+                gke_slice_fields={
+                    "jobset.sigs.k8s.io/jobset-name": "t",
+                    "batch.kubernetes.io/job-completion-index": w,
+                },
+                container_statuses=[{"name": "c", "ready": phase == "Running", "restartCount": 0}],
+            )
+
+        for w in range(2):
+            pipe.process(ev(worker(w)))
+        # routine Running events: pod notifications suppressed by the gate...
+        assert [n.kind for n in sink.items].count("pod") == 0
+        # ...but the tracker still saw them and the slice reached Ready
+        assert tracker.get("default/t").phase == SlicePhase.READY
+        assert [n.payload["phase_transition"]["to"] for n in sink.items if n.kind == "slice"] == [SlicePhase.READY]
+        # a critical event (Failed) passes the gate as a pod notification too
+        pipe.process(ev(worker(0, phase="Failed"), EventType.MODIFIED))
+        assert [n.kind for n in sink.items].count("pod") == 1
+        assert tracker.get("default/t").phase == SlicePhase.DEGRADED
+
+    def test_metrics_counted(self):
+        sink = RecordingSink()
+        pipe = self.make_pipeline(sink)
+        pipe.process(ev(tpu_pod()))
+        pipe.process(ev(build_pod("plain")))
+        dump = pipe.metrics.dump()
+        assert dump["events_received"]["count"] == 2
+        assert dump["notifications_enqueued"]["count"] == 1
+        assert dump["events_dropped_resource"]["count"] == 1
+
+
+class TestLogging:
+    def test_json_formatter_valid_json_with_quotes(self):
+        import logging as _logging
+
+        fmt = JsonFormatter("production")
+        record = _logging.LogRecord("n", _logging.INFO, "p", 1, 'msg with "quotes"', None, None)
+        parsed = json.loads(fmt.format(record))
+        assert parsed["message"] == 'msg with "quotes"'
+        assert parsed["environment"] == "production"
+
+    def test_setup_logging_dev_format(self, capsys):
+        logger = setup_logging("development", "DEBUG")
+        logger.debug("hello")
+        err = capsys.readouterr().err
+        assert "[DEVELOPMENT]" in err and "hello" in err

@@ -1,0 +1,159 @@
+"""Probe-plane tests on the virtual 8-device CPU mesh (conftest forces
+JAX_PLATFORMS=cpu with xla_force_host_platform_device_count=8) — the same
+SPMD code paths that run over ICI on a real slice."""
+
+import time
+
+import jax
+import pytest
+
+from k8s_watcher_tpu.config.schema import TpuConfig
+from k8s_watcher_tpu.parallel.collectives import (
+    allreduce_bus_bandwidth_gbps,
+    make_psum_probe,
+    psum_probe_input,
+)
+from k8s_watcher_tpu.parallel.mesh import flat_mesh, host_chip_mesh
+from k8s_watcher_tpu.probe.agent import ProbeAgent
+from k8s_watcher_tpu.probe.device import enumerate_devices
+from k8s_watcher_tpu.probe.ici import run_ici_probe, run_mxu_probe
+from k8s_watcher_tpu.probe.report import ProbeReport
+
+
+def test_virtual_mesh_available():
+    assert len(jax.devices()) == 8
+    assert jax.devices()[0].platform == "cpu"
+
+
+class TestMesh:
+    def test_host_chip_mesh_shape(self):
+        mesh = host_chip_mesh()
+        assert mesh.axis_names == ("hosts", "chips")
+        assert mesh.size == 8
+
+    def test_flat_mesh(self):
+        mesh = flat_mesh()
+        assert mesh.devices.shape == (1, 8)
+
+    def test_subset_mesh(self):
+        mesh = host_chip_mesh(jax.devices()[:4])
+        assert mesh.size == 4
+
+
+class TestCollectives:
+    def test_psum_correct_over_8_devices(self):
+        mesh = host_chip_mesh()
+        probe = make_psum_probe(mesh)
+        x = psum_probe_input(mesh)
+        out = jax.block_until_ready(probe(x))
+        # chained psum(x)/n fixed point: sum(1..8)/8 = 4.5
+        assert float(out[0]) == 8 * 9 / 2.0 / 8
+
+    def test_psum_chain_amortized(self):
+        mesh = host_chip_mesh()
+        probe = make_psum_probe(mesh, inner_iters=5)
+        out = jax.block_until_ready(probe(psum_probe_input(mesh)))
+        assert float(out[0]) == 4.5  # same fixed point for any chain length
+
+    def test_bus_bandwidth_formula(self):
+        # 8 devices, 1 GiB, 1 s -> 2*(7/8) GiB/s
+        gbps = allreduce_bus_bandwidth_gbps(2**30, 8, 1.0)
+        assert abs(gbps - 2 * (7 / 8) * 2**30 / 1e9) < 1e-6
+        assert allreduce_bus_bandwidth_gbps(2**30, 8, 0.0) == 0.0
+
+
+class TestIciProbe:
+    def test_probe_reports_healthy(self):
+        result = run_ici_probe(payload_bytes=1 << 16, iters=3)
+        assert result.ok and result.psum_correct
+        assert result.n_devices == 8
+        assert result.psum_rtt_ms > 0
+        assert result.psum_rtt_ms <= result.psum_rtt_mean_ms <= result.psum_rtt_max_ms
+        assert result.bandwidth_gbps > 0
+        assert result.compile_ms > 0
+
+    def test_probe_single_device_mesh(self):
+        result = run_ici_probe(mesh=flat_mesh(jax.devices()[:1]), payload_bytes=0, iters=2)
+        assert result.ok and result.n_devices == 1
+
+    def test_mxu_probe(self):
+        out = run_mxu_probe(128, iters=2)
+        assert out["ok"] and out["finite"]
+        assert out["tflops"] > 0
+
+
+class TestDeviceEnumeration:
+    def test_enumerate(self):
+        inv = enumerate_devices()
+        assert inv["visible_devices"] == 8
+        assert inv["healthy_devices"] == 8
+        assert all(e["alive"] for e in inv["devices"])
+        assert inv["devices"][0]["platform"] == "cpu"
+
+    def test_expected_per_host_mismatch_flagged(self):
+        inv = enumerate_devices(expected_per_host=16)
+        assert inv["missing_local_devices"] == 8
+
+
+class TestProbeAgentAndReport:
+    def make_config(self, **kw):
+        defaults = dict(
+            probe_enabled=True, probe_interval_seconds=0.05,
+            probe_payload_bytes=1 << 14, probe_matmul_size=64,
+            probe_rtt_warn_ms=10_000.0,
+        )
+        defaults.update(kw)
+        return TpuConfig(**defaults)
+
+    def make_agent(self, config=None, sink=None, **agent_kw):
+        # test meshes are CPU: relax the platform contract explicitly
+        agent_kw.setdefault("expected_platform", "cpu")
+        return ProbeAgent(
+            config or self.make_config(),
+            environment="development",
+            sink=sink or (lambda n: None),
+            **agent_kw,
+        )
+
+    def test_run_once_healthy(self):
+        agent = self.make_agent()
+        report = agent.run_once()
+        assert report.healthy
+        payload = report.to_payload()
+        assert payload["event_type"] == "TPU_PROBE"
+        assert payload["ici"]["n_devices"] == 8
+        assert payload["mxu"]["ok"]
+        assert payload["devices"]["visible_devices"] == 8
+
+    def test_rtt_threshold_marks_unhealthy(self):
+        agent = self.make_agent(self.make_config(probe_rtt_warn_ms=1e-9))
+        assert agent.run_once().healthy is False
+
+    def test_missing_chips_mark_unhealthy(self):
+        agent = self.make_agent(self.make_config(expected_chips_per_host=16))
+        assert agent.run_once().healthy is False
+
+    def test_wrong_platform_marks_unhealthy(self):
+        # default contract: tpu backend demands tpu devices — a probe that
+        # can only see CPU must not report the slice healthy
+        agent = ProbeAgent(self.make_config(), environment="development", sink=lambda n: None)
+        assert agent.expected_platform == "tpu"
+        report = agent.run_once()
+        assert report.healthy is False
+        assert report.devices["platform_mismatch"] == 8
+
+    def test_agent_loop_reports_via_sink(self):
+        got = []
+        agent = self.make_agent(sink=got.append)
+        agent.start()
+        deadline = time.monotonic() + 10
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.05)
+        agent.stop()
+        assert got, "agent never reported"
+        assert got[0].kind == "probe"
+        assert got[0].payload["event_type"] == "TPU_PROBE"
+
+    def test_probe_failure_reported_not_raised(self):
+        result = run_ici_probe(mesh="not-a-mesh")
+        assert result.ok is False and result.error

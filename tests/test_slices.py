@@ -1,0 +1,187 @@
+"""Slice topology + aggregation tests (SURVEY.md §7 step 5)."""
+
+from k8s_watcher_tpu.pipeline.phase import PhaseTracker
+from k8s_watcher_tpu.slices.topology import chips_in_topology, infer_slice_identity
+from k8s_watcher_tpu.slices.tracker import SlicePhase, SliceTracker
+from k8s_watcher_tpu.watch.fake import build_pod
+from k8s_watcher_tpu.watch.source import EventType, WatchEvent
+
+
+def slice_pod(worker, phase="Running", ready=None, n_workers=4, name="train", uid=None):
+    ready = (phase == "Running") if ready is None else ready
+    return build_pod(
+        f"{name}-{worker}",
+        uid=uid or f"uid-{name}-{worker}",
+        phase=phase,
+        tpu_chips=4,
+        tpu_topology=f"2x2x{n_workers}",  # 4*n_workers chips => n_workers hosts
+        tpu_accelerator="tpu-v5p-slice",
+        gke_slice_fields={
+            "jobset.sigs.k8s.io/jobset-name": name,
+            "batch.kubernetes.io/job-completion-index": worker,
+        },
+        container_statuses=[{"name": "main", "ready": ready, "restartCount": 0}],
+    )
+
+
+def ev(pod, etype=EventType.ADDED):
+    return WatchEvent(type=etype, pod=pod)
+
+
+class TestTopology:
+    def test_chips_in_topology(self):
+        assert chips_in_topology("2x2x4") == 16
+        assert chips_in_topology("4x4") == 16
+        assert chips_in_topology("bogus") is None
+        assert chips_in_topology("0x2") is None
+
+    def test_identity_from_jobset(self):
+        ident = infer_slice_identity(slice_pod(0))
+        assert ident is not None
+        assert ident.key == "default/train"
+        assert ident.worker_index == 0
+        assert ident.topology == "2x2x4"
+        assert ident.chips_per_worker == 4
+        assert ident.expected_workers == 4
+        assert ident.total_chips == 16
+
+    def test_identity_from_bare_job(self):
+        pod = build_pod(
+            "j-0", phase="Running", tpu_chips=4,
+            gke_slice_fields={"job-name": "bare-job"},
+        )
+        ident = infer_slice_identity(pod)
+        assert ident.name == "bare-job"
+        assert ident.expected_workers is None  # no topology label
+
+    def test_non_tpu_pod_is_not_slice(self):
+        pod = build_pod("web", gke_slice_fields={"job-name": "web-job"})
+        assert infer_slice_identity(pod) is None
+
+    def test_standalone_tpu_pod_is_not_slice(self):
+        assert infer_slice_identity(build_pod("solo", tpu_chips=4)) is None
+
+
+class TestSliceTracker:
+    def drive(self, tracker, events):
+        phases = PhaseTracker()
+        out = []
+        for event in events:
+            delta = phases.observe(event)
+            out.append(tracker.observe(event, delta))
+        return out
+
+    def test_forming_to_ready(self):
+        tracker = SliceTracker("development")
+        notifications = []
+        for w in range(4):
+            _, notes = tracker.observe(ev(slice_pod(w, phase="Pending", ready=False)), None)
+            notifications += notes
+        state = tracker.get("default/train")
+        assert state.phase == SlicePhase.FORMING
+        for w in range(4):
+            _, notes = tracker.observe(
+                ev(slice_pod(w, phase="Running"), EventType.MODIFIED), None
+            )
+            notifications += notes
+        assert tracker.get("default/train").phase == SlicePhase.READY
+        # exactly one transition notification: Forming -> Ready
+        ready_notes = [n for n in notifications if n["phase_transition"]["to"] == SlicePhase.READY]
+        assert len(ready_notes) == 1
+        note = ready_notes[0]
+        assert note["event_type"] == "SLICE_PHASE_CHANGE"
+        assert note["expected_workers"] == 4
+        assert note["ready_workers"] == 4
+        assert note["total_chips"] == 16
+
+    def test_member_failure_degrades(self):
+        tracker = SliceTracker("development")
+        for w in range(4):
+            tracker.observe(ev(slice_pod(w)), None)
+        assert tracker.get("default/train").phase == SlicePhase.READY
+        _, notes = tracker.observe(
+            ev(slice_pod(1, phase="Failed", ready=False), EventType.MODIFIED), None
+        )
+        assert tracker.get("default/train").phase == SlicePhase.DEGRADED
+        assert notes and notes[0]["phase_transition"] == {"from": "Ready", "to": "Degraded"}
+
+    def test_preemption_degrades_after_ready(self):
+        tracker = SliceTracker("development")
+        for w in range(4):
+            tracker.observe(ev(slice_pod(w)), None)
+        _, notes = tracker.observe(ev(slice_pod(2), EventType.DELETED), None)
+        assert tracker.get("default/train").phase == SlicePhase.DEGRADED
+
+    def test_all_deleted_terminates_and_cleans_up(self):
+        tracker = SliceTracker("development")
+        for w in range(2):
+            tracker.observe(ev(slice_pod(w, n_workers=2)), None)
+        notes_all = []
+        for w in range(2):
+            _, notes = tracker.observe(ev(slice_pod(w, n_workers=2), EventType.DELETED), None)
+            notes_all += notes
+        assert [n["phase_transition"]["to"] for n in notes_all][-1] == SlicePhase.TERMINATED
+        assert len(tracker) == 0
+
+    def test_completed_when_all_succeed(self):
+        tracker = SliceTracker("development")
+        for w in range(2):
+            tracker.observe(ev(slice_pod(w, n_workers=2)), None)
+        for w in range(2):
+            tracker.observe(ev(slice_pod(w, phase="Succeeded", ready=False, n_workers=2), EventType.MODIFIED), None)
+        assert tracker.get("default/train").phase == SlicePhase.COMPLETED
+
+    def test_pod_payload_slice_info(self):
+        tracker = SliceTracker("development")
+        slice_info, _ = tracker.observe(ev(slice_pod(0)), None)
+        assert slice_info["key"] == "default/train"
+        assert slice_info["worker_index"] == 0
+        assert slice_info["expected_workers"] == 4
+
+    def test_non_slice_pod_passthrough(self):
+        tracker = SliceTracker("development")
+        slice_info, notes = tracker.observe(ev(build_pod("solo", tpu_chips=4)), None)
+        assert slice_info is None and notes == []
+
+    def test_never_ready_slice_still_terminates(self):
+        # regression: a quota-stuck slice (all Pending) whose pods are deleted
+        # got stuck Forming forever and leaked tracker/checkpoint state
+        tracker = SliceTracker("development")
+        for w in range(2):
+            tracker.observe(ev(slice_pod(w, phase="Pending", ready=False, n_workers=2)), None)
+        notes_all = []
+        for w in range(2):
+            _, notes = tracker.observe(
+                ev(slice_pod(w, phase="Pending", ready=False, n_workers=2), EventType.DELETED), None
+            )
+            notes_all += notes
+        assert [n["phase_transition"]["to"] for n in notes_all] == [SlicePhase.TERMINATED]
+        assert len(tracker) == 0
+
+    def test_deleted_event_for_unknown_slice_is_dropped(self):
+        tracker = SliceTracker("development")
+        _, notes = tracker.observe(ev(slice_pod(0), EventType.DELETED), None)
+        assert notes == [] and len(tracker) == 0
+
+    def test_restore_applies_on_first_observation(self):
+        # regression: restore() used to be a no-op on an empty tracker, so a
+        # restarted watcher forgot ever_ready and read lost workers as Forming
+        tracker = SliceTracker("development")
+        tracker.restore({"default/train": {"phase": SlicePhase.READY, "ever_ready": True}})
+        # after restart only 3 of 4 workers come back
+        for w in range(3):
+            tracker.observe(ev(slice_pod(w)), None)
+        state = tracker.get("default/train")
+        assert state.ever_ready is True
+        assert state.phase == SlicePhase.DEGRADED  # not Forming
+
+    def test_snapshot_restore_roundtrip(self):
+        tracker = SliceTracker("development")
+        for w in range(4):
+            tracker.observe(ev(slice_pod(w)), None)
+        snap = tracker.snapshot()
+        assert snap["default/train"]["ever_ready"] is True
+        t2 = SliceTracker("development")
+        t2.restore(snap)
+        t2.observe(ev(slice_pod(0, phase="Pending", ready=False)), None)
+        assert t2.get("default/train").ever_ready is True
