@@ -14,6 +14,7 @@ from typing import Optional
 
 from k8s_watcher_tpu.config.schema import AppConfig
 from k8s_watcher_tpu.metrics import MetricsRegistry
+from k8s_watcher_tpu.metrics.server import Liveness, StatusServer
 from k8s_watcher_tpu.notify.client import ClusterApiClient
 from k8s_watcher_tpu.notify.dispatcher import Dispatcher
 from k8s_watcher_tpu.pipeline.filters import CriticalEventGate, NamespaceFilter, TpuResourceFilter
@@ -38,7 +39,11 @@ def build_notifier(config: AppConfig) -> ClusterApiClient:
     )
 
 
-def build_source(config: AppConfig, checkpoint: Optional[CheckpointStore] = None) -> WatchSource:
+def build_source(
+    config: AppConfig,
+    checkpoint: Optional[CheckpointStore] = None,
+    heartbeat=None,
+) -> WatchSource:
     """Pick the watch source for this environment.
 
     ``kubernetes.use_mock`` (a dead key in the reference — SURVEY.md §2
@@ -68,9 +73,11 @@ def build_source(config: AppConfig, checkpoint: Optional[CheckpointStore] = None
     logger.info("Successfully connected to Kubernetes API version: %s", version)
     return KubernetesWatchSource(
         client,
+        label_selector=config.watcher.label_selector,
         retry=config.watcher.retry,
         watch_timeout_seconds=config.kubernetes.watch_timeout_seconds,
         checkpoint=checkpoint,
+        heartbeat=heartbeat,
     )
 
 
@@ -91,13 +98,15 @@ class WatcherApp:
             else None
         )
         self.notifier = notifier or build_notifier(config)
+        self.liveness = Liveness(config.watcher.liveness_stale_seconds)
+        self.status_server: Optional[StatusServer] = None
         self.dispatcher = Dispatcher(
             self.notifier.update_pod_status,
             capacity=config.clusterapi.queue_capacity,
             workers=config.clusterapi.workers,
             metrics=self.metrics,
         )
-        self.source = source or build_source(config, self.checkpoint)
+        self.source = source or build_source(config, self.checkpoint, self.liveness.beat)
         self.slice_tracker = SliceTracker(
             config.environment,
             resource_key=config.tpu.resource_key,
@@ -136,6 +145,11 @@ class WatcherApp:
     def run(self) -> None:
         """Blocking steady-state loop (parity: pod_watcher.py:243-277)."""
         self.dispatcher.start()
+        if self.config.watcher.status_port:
+            self.status_server = StatusServer(
+                self.metrics, self.liveness, port=self.config.watcher.status_port
+            ).start()
+            logger.info("Status endpoint on :%d (/metrics, /healthz)", self.status_server.port)
         if self.notifier.health_check():
             logger.info("ClusterAPI health check passed")
         else:
@@ -151,6 +165,7 @@ class WatcherApp:
             for event in self.source.events():
                 if self._stop.is_set():
                     break
+                self.liveness.beat()
                 self.pipeline.process(event)
                 self._maybe_checkpoint()
         except KeyboardInterrupt:
@@ -179,6 +194,9 @@ class WatcherApp:
 
     def shutdown(self) -> None:
         self.source.stop()
+        if self.status_server is not None:
+            self.status_server.stop()
+            self.status_server = None
         if self._probe_agent is not None:
             self._probe_agent.stop()
         self.dispatcher.stop()

@@ -142,10 +142,19 @@ class WatcherConfig:
     namespaces: tuple = ()
     retry: RetryPolicy = dataclasses.field(default_factory=RetryPolicy)
     critical_events_only: bool = False
+    # net-new observability + server-side filtering
+    status_port: int = 0  # 0 = no /metrics//healthz endpoint
+    liveness_stale_seconds: float = 900.0
+    label_selector: Optional[str] = None  # k8s labelSelector pushed to the API server
 
     @classmethod
     def from_raw(cls, raw: Mapping[str, Any]) -> "WatcherConfig":
-        _check_known(raw, ("watch_interval", "log_level", "namespaces", "retry", "alerts"), "watcher")
+        _check_known(
+            raw,
+            ("watch_interval", "log_level", "namespaces", "retry", "alerts",
+             "status_port", "liveness_stale_seconds", "label_selector"),
+            "watcher",
+        )
         namespaces = raw.get("namespaces") or ()
         if namespaces:
             _expect(namespaces, (list, tuple), "watcher.namespaces")
@@ -162,6 +171,9 @@ class WatcherConfig:
             namespaces=namespaces,
             retry=RetryPolicy.from_raw(raw.get("retry") or {}, "watcher.retry", delay_default=5.0),
             critical_events_only=_opt_bool(alerts, "critical_events_only", "watcher.alerts", False),
+            status_port=_opt_int(raw, "status_port", "watcher", 0),
+            liveness_stale_seconds=_opt_num(raw, "liveness_stale_seconds", "watcher", 900.0),
+            label_selector=_opt_str(raw, "label_selector", "watcher", None),
         )
 
 
@@ -260,6 +272,7 @@ class TpuConfig:
     probe_payload_bytes: int = 4 * 1024 * 1024
     probe_rtt_warn_ms: float = 50.0
     probe_matmul_size: int = 1024
+    probe_hbm_bytes: int = 256 * 1024 * 1024  # 0 disables the HBM sweep
     expected_chips_per_host: int = 0  # 0 = don't enforce
 
     @classmethod
@@ -283,7 +296,8 @@ class TpuConfig:
         _expect(probe, (dict,), "tpu.probe")
         _check_known(
             probe,
-            ("enabled", "interval_seconds", "payload_bytes", "rtt_warn_ms", "matmul_size", "expected_chips_per_host"),
+            ("enabled", "interval_seconds", "payload_bytes", "rtt_warn_ms", "matmul_size",
+             "hbm_bytes", "expected_chips_per_host"),
             "tpu.probe",
         )
         return cls(
@@ -296,6 +310,7 @@ class TpuConfig:
             probe_payload_bytes=_opt_int(probe, "payload_bytes", "tpu.probe", 4 * 1024 * 1024),
             probe_rtt_warn_ms=_opt_num(probe, "rtt_warn_ms", "tpu.probe", 50.0),
             probe_matmul_size=_opt_int(probe, "matmul_size", "tpu.probe", 1024),
+            probe_hbm_bytes=_opt_int(probe, "hbm_bytes", "tpu.probe", 256 * 1024 * 1024),
             expected_chips_per_host=_opt_int(probe, "expected_chips_per_host", "tpu.probe", 0),
         )
 

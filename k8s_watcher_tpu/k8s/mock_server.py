@@ -10,7 +10,8 @@ implementing the exact API subset ``K8sClient`` consumes:
 - ``GET /api/v1/namespaces``
 - ``GET /api/v1/pods`` and ``GET /api/v1/namespaces/{ns}/pods``
   (list, and ``watch=true`` streaming with resourceVersion resume,
-  bookmarks, and 410-Gone on expired versions)
+  equality-based ``labelSelector``, BOOKMARK frames on idle when
+  ``allowWatchBookmarks`` is set, and 410-Gone on expired versions)
 
 Test hooks: ``MockCluster.add/modify/delete_pod`` drive the event stream;
 ``compact()`` expires old resourceVersions to exercise the relist path;
@@ -25,6 +26,33 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
+
+
+def _parse_label_selector(selector: Optional[str]) -> List[Tuple[str, Optional[str]]]:
+    """Equality-based selector subset: ``k=v``, ``k==v``, bare ``k``."""
+    out: List[Tuple[str, Optional[str]]] = []
+    for part in (selector or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "==" in part:
+            k, v = part.split("==", 1)
+        elif "=" in part:
+            k, v = part.split("=", 1)
+        else:
+            k, v = part, None
+        out.append((k.strip(), v.strip() if v is not None else None))
+    return out
+
+
+def _matches_selector(pod: Dict[str, Any], selector: List[Tuple[str, Optional[str]]]) -> bool:
+    labels = (pod.get("metadata") or {}).get("labels") or {}
+    for key, value in selector:
+        if key not in labels:
+            return False
+        if value is not None and labels[key] != value:
+            return False
+    return True
 
 
 class MockCluster:
@@ -100,12 +128,18 @@ class MockCluster:
 
     # -- reads -------------------------------------------------------------
 
-    def list_pods(self, namespace: Optional[str], limit: Optional[int]) -> Dict[str, Any]:
+    def list_pods(
+        self,
+        namespace: Optional[str],
+        limit: Optional[int],
+        label_selector: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        selector = _parse_label_selector(label_selector)
         with self._lock:
             items = [
                 json.loads(json.dumps(pod))
                 for (ns, _name), pod in sorted(self._pods.items())
-                if namespace is None or ns == namespace
+                if (namespace is None or ns == namespace) and _matches_selector(pod, selector)
             ]
             rv = str(self._rv)
         if limit:
@@ -186,7 +220,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._serve_watch(namespace, params)
         else:
             limit = int(params["limit"]) if "limit" in params else None
-            self._json(200, self.cluster.list_pods(namespace, limit))
+            self._json(200, self.cluster.list_pods(namespace, limit, params.get("labelSelector")))
 
     def _serve_watch(self, namespace: Optional[str], params: Dict[str, str]) -> None:
         try:
@@ -195,6 +229,9 @@ class _Handler(BaseHTTPRequestHandler):
             rv = 0
         timeout_s = min(int(params.get("timeoutSeconds", "30") or "30"), 300)
         deadline = time.monotonic() + timeout_s
+        selector = _parse_label_selector(params.get("labelSelector"))
+        send_bookmarks = params.get("allowWatchBookmarks") == "true"
+        last_frame = time.monotonic()
 
         first = self.cluster.events_since(rv, time.monotonic())  # non-blocking compaction check
         if first is None:
@@ -213,14 +250,31 @@ class _Handler(BaseHTTPRequestHandler):
                     self.wfile.write((json.dumps(err) + "\n").encode())
                     self.wfile.flush()
                     return
+                if not batch and send_bookmarks and time.monotonic() - last_frame >= 1.0:
+                    # idle stream: k8s sends BOOKMARK frames so clients can
+                    # advance their resume version without real events. Use
+                    # the handler-local rv (not latest_rv()): an event
+                    # recorded in the race window must not be marked seen
+                    # before it is delivered.
+                    bookmark = {
+                        "type": "BOOKMARK",
+                        "object": {"kind": "Pod", "metadata": {"resourceVersion": str(rv)}},
+                    }
+                    self.wfile.write((json.dumps(bookmark) + "\n").encode())
+                    self.wfile.flush()
+                    last_frame = time.monotonic()
                 for event in batch:
-                    obj_ns = ((event.get("object") or {}).get("metadata") or {}).get("namespace")
-                    erv = int(((event.get("object") or {}).get("metadata") or {}).get("resourceVersion", "0"))
+                    obj = event.get("object") or {}
+                    obj_ns = (obj.get("metadata") or {}).get("namespace")
+                    erv = int((obj.get("metadata") or {}).get("resourceVersion", "0"))
                     rv = max(rv, erv)
                     if namespace is not None and obj_ns != namespace:
                         continue
+                    if selector and not _matches_selector(obj, selector):
+                        continue
                     self.wfile.write((json.dumps(event) + "\n").encode())
                     self.wfile.flush()
+                    last_frame = time.monotonic()
         except (BrokenPipeError, ConnectionResetError):
             pass
 

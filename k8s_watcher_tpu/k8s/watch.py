@@ -43,6 +43,7 @@ class KubernetesWatchSource:
         resource_version: Optional[str] = None,
         checkpoint=None,  # state.checkpoint.CheckpointStore, optional
         max_reconnects: Optional[int] = None,  # None = retry forever
+        heartbeat=None,  # Callable[[], None]: stamped on any apiserver contact
     ):
         self.client = client
         self.namespace = namespace
@@ -52,6 +53,7 @@ class KubernetesWatchSource:
         self.resource_version = resource_version
         self.checkpoint = checkpoint
         self.max_reconnects = max_reconnects
+        self.heartbeat = heartbeat or (lambda: None)
         self._stop = threading.Event()
         # uid -> (name, namespace, phase) of live pods, so a relist can
         # synthesize DELETED events for pods that vanished while the watch
@@ -130,6 +132,7 @@ class KubernetesWatchSource:
                 if need_list:
                     yield from self._relist()
                     need_list = False
+                    self.heartbeat()
 
                 for raw in self.client.watch_pods(
                     self.namespace,
@@ -139,6 +142,7 @@ class KubernetesWatchSource:
                 ):
                     if self._stop.is_set():
                         return
+                    self.heartbeat()  # any frame (incl. bookmarks) = live apiserver link
                     obj = raw.get("object") or {}
                     rv = (obj.get("metadata") or {}).get("resourceVersion")
                     event_type = raw.get("type", "")
@@ -155,6 +159,7 @@ class KubernetesWatchSource:
                     # then replays it instead of silently skipping it
                     self._save_rv(rv)
                 # bounded watch expired normally -> reconnect immediately
+                self.heartbeat()  # a clean window expiry is still a live link
                 logger.debug("Watch window expired; reconnecting from rv=%s", self.resource_version)
 
             except K8sGoneError:

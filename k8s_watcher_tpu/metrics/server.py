@@ -1,0 +1,103 @@
+"""Watcher status endpoint: /metrics and /healthz over HTTP.
+
+SURVEY.md §5 requires metrics as first-class (the reference only logged).
+This is the scrape surface: ``/metrics`` returns the full registry as JSON
+(counters with 1-minute rates, latency histograms with p50/p90/p99),
+``/healthz`` returns 200 while the watch loop is live — defined as having
+heard from the API server (event, bookmark, or successful reconnect) within
+``stale_after_seconds`` — and 503 otherwise, so a wedged watcher gets
+restarted by its liveness probe instead of silently going blind.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from k8s_watcher_tpu.metrics.metrics import MetricsRegistry
+
+
+class Liveness:
+    """Heartbeat stamped by the watch loop; consulted by /healthz."""
+
+    def __init__(self, stale_after_seconds: float = 900.0):
+        self.stale_after_seconds = stale_after_seconds
+        self._last_beat = time.monotonic()
+        self._lock = threading.Lock()
+
+    def beat(self) -> None:
+        with self._lock:
+            self._last_beat = time.monotonic()
+
+    def alive(self) -> bool:
+        with self._lock:
+            return time.monotonic() - self._last_beat < self.stale_after_seconds
+
+    def age_seconds(self) -> float:
+        with self._lock:
+            return time.monotonic() - self._last_beat
+
+
+class _StatusHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    disable_nagle_algorithm = True
+    metrics: MetricsRegistry
+    liveness: Liveness
+
+    def log_message(self, *a):
+        pass
+
+    def _json(self, status: int, body: dict) -> None:
+        data = json.dumps(body).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802
+        if self.path == "/metrics":
+            self._json(200, self.metrics.dump())
+        elif self.path == "/healthz":
+            alive = self.liveness.alive()
+            self._json(
+                200 if alive else 503,
+                {"alive": alive, "last_heartbeat_age_seconds": round(self.liveness.age_seconds(), 1)},
+            )
+        else:
+            self._json(404, {"error": f"no route {self.path}"})
+
+
+class StatusServer:
+    def __init__(
+        self,
+        metrics: MetricsRegistry,
+        liveness: Liveness,
+        *,
+        host: str = "0.0.0.0",
+        port: int = 0,
+    ):
+        handler = type(
+            "BoundStatusHandler", (_StatusHandler,), {"metrics": metrics, "liveness": liveness}
+        )
+        self._server = ThreadingHTTPServer((host, port), handler)
+        self._server.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> "StatusServer":
+        self._thread = threading.Thread(target=self._server.serve_forever, name="status-server", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=2.0)

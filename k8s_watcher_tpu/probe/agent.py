@@ -59,11 +59,17 @@ class ProbeAgent:
         )
         ici = run_ici_probe(self.mesh, payload_bytes=self.config.probe_payload_bytes)
         mxu = run_mxu_probe(self.config.probe_matmul_size)
+        hbm = None
+        if self.config.probe_hbm_bytes > 0:
+            from k8s_watcher_tpu.probe.hbm import run_hbm_probe
+
+            hbm = run_hbm_probe(self.config.probe_hbm_bytes)
         report = ProbeReport(
             environment=self.environment,
             devices=devices,
             ici=ici,
             mxu=mxu,
+            hbm=hbm,
             rtt_warn_ms=self.config.probe_rtt_warn_ms,
             duration_ms=1e3 * (time.monotonic() - t0),
         )

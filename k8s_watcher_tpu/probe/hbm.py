@@ -1,0 +1,119 @@
+"""HBM bandwidth probe — a Pallas streaming kernel.
+
+Degraded HBM is a real TPU failure mode that the psum (ICI) and matmul (MXU)
+probes can miss: a chip can compute and communicate correctly while its
+memory system runs far below spec. This probe streams a large HBM-resident
+buffer through VMEM and reports achieved read bandwidth.
+
+Kernel design (see the Pallas TPU guide): a 1-D grid over row-blocks of a
+``(rows, LANES*4)`` float32 buffer. The ``BlockSpec`` pipeline automatically
+double-buffers the HBM→VMEM DMAs while the VPU reduces each block, so the
+measurement is DMA-bound — exactly what we want to measure. Each grid step
+accumulates a partial sum into a (1, 1) SMEM-style output (init on step 0),
+which both defeats dead-code elimination and doubles as a data-integrity
+check (the buffer is all-ones, so the sum must equal the element count).
+
+On non-TPU backends the kernel runs in interpreter mode: numbers are
+meaningless there, but the code path stays testable on the CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+logger = logging.getLogger(__name__)
+
+LANES = 128
+BLOCK_ROWS = 1024  # 1024 x 512 f32 = 2 MiB per block: large enough to be
+WIDTH = 4 * LANES  # DMA-bound, small enough to double-buffer in ~16MB VMEM
+
+
+def _reduce_kernel(in_ref, out_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[0, 0] = 0.0
+
+    out_ref[0, 0] += jnp.sum(in_ref[:])
+
+
+@functools.lru_cache(maxsize=8)
+def make_hbm_read_probe(total_bytes: int, *, interpret: bool = False):
+    """Jitted fn streaming ~``total_bytes`` of f32 through VMEM; returns the
+    scalar sum. Also returns the actual byte count used (rounded to blocks).
+
+    Cached: jax's compilation cache is keyed on function identity, so a fresh
+    closure per probe cycle would force a full Pallas+XLA recompile every
+    ``probe_interval_seconds`` — the lru_cache keeps one jitted program per
+    (size, interpret) combination alive for the process lifetime.
+    """
+    bytes_per_block = BLOCK_ROWS * WIDTH * 4
+    num_blocks = max(1, total_bytes // bytes_per_block)
+    rows = num_blocks * BLOCK_ROWS
+
+    def probe(x: jax.Array) -> jax.Array:
+        return pl.pallas_call(
+            _reduce_kernel,
+            grid=(num_blocks,),
+            in_specs=[pl.BlockSpec((BLOCK_ROWS, WIDTH), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((1, 1), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            interpret=interpret,
+        )(x)
+
+    return jax.jit(probe), rows, num_blocks * bytes_per_block
+
+
+def run_hbm_probe(
+    total_bytes: int = 256 * 1024 * 1024,
+    *,
+    iters: int = 3,
+    device: Optional[jax.Device] = None,
+) -> Dict[str, Any]:
+    """Measure achieved HBM read bandwidth on one device."""
+    try:
+        device = device or jax.devices()[0]
+        interpret = device.platform != "tpu"
+        if interpret:
+            # interpreter mode is orders of magnitude slower: shrink the
+            # buffer so CPU tests stay fast; bandwidth number is meaningless
+            total_bytes = min(total_bytes, BLOCK_ROWS * WIDTH * 4 * 2)
+
+        probe, rows, actual_bytes = make_hbm_read_probe(total_bytes, interpret=interpret)
+        x = jax.device_put(jnp.ones((rows, WIDTH), dtype=jnp.float32), device)
+
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(probe(x))  # warmup = compile
+        compile_ms = 1e3 * (time.perf_counter() - t0)
+
+        expected = float(rows * WIDTH)
+        integrity_ok = abs(float(out[0, 0]) - expected) <= 1e-6 * expected
+
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(probe(x))
+            times.append(time.perf_counter() - t0)
+        best = min(times)
+
+        return {
+            "ok": integrity_ok,
+            "integrity_ok": integrity_ok,
+            "bytes": actual_bytes,
+            "time_ms": 1e3 * best,
+            "read_gbps": actual_bytes / best / 1e9,
+            "compile_ms": compile_ms,
+            "interpreted": interpret,
+            "device_id": device.id,
+        }
+    except Exception as exc:
+        logger.error("HBM probe failed: %s", exc)
+        return {"ok": False, "error": str(exc)}

@@ -17,6 +17,7 @@ class ProbeReport:
     devices: Dict[str, Any]
     ici: Optional[IciProbeResult] = None
     mxu: Optional[Dict[str, Any]] = None
+    hbm: Optional[Dict[str, Any]] = None
     rtt_warn_ms: float = 50.0
     duration_ms: float = 0.0
 
@@ -34,6 +35,8 @@ class ProbeReport:
             return False
         if self.mxu is not None and not self.mxu.get("ok", False):
             return False
+        if self.hbm is not None and not self.hbm.get("ok", False):
+            return False
         return True
 
     def to_payload(self) -> Dict[str, Any]:
@@ -46,6 +49,7 @@ class ProbeReport:
             "devices": self.devices,
             "ici": self.ici.to_dict() if self.ici else None,
             "mxu": self.mxu,
+            "hbm": self.hbm,
             "duration_ms": self.duration_ms,
             "event_timestamp": datetime.now(timezone.utc).isoformat(),
         }

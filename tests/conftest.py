@@ -8,13 +8,17 @@ may pin JAX_PLATFORMS to a hardware plugin.
 
 import os
 import sys
+from pathlib import Path
 
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO_ROOT = Path(__file__).resolve().parent.parent
+CONFIG_DIR = str(REPO_ROOT / "config")
+
+sys.path.insert(0, str(REPO_ROOT))
 
 import jax  # noqa: E402
 

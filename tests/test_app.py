@@ -1,6 +1,8 @@
 """End-to-end app tests + churn/fault-injection load (acceptance #5 shape)."""
 
 import threading
+
+from conftest import CONFIG_DIR
 import time
 
 from k8s_watcher_tpu.config.loader import load_config
@@ -31,7 +33,7 @@ class RecordingNotifier:
 
 
 def dev_config(**overrides):
-    cfg = load_config("development", "/root/repo/config", env={})
+    cfg = load_config("development", CONFIG_DIR, env={})
     return cfg
 
 

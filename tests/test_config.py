@@ -12,6 +12,8 @@ from k8s_watcher_tpu.config.loader import (
     substitute_env_vars,
 )
 
+from conftest import REPO_ROOT
+
 REPO_CONFIG_DIR = "config"
 
 
@@ -101,14 +103,14 @@ class TestRepoConfigs:
 
     @pytest.mark.parametrize("env", ["development", "staging", "production"])
     def test_environment_loads(self, env, monkeypatch):
-        monkeypatch.chdir("/root/repo")
+        monkeypatch.chdir(REPO_ROOT)
         cfg = load_config(env, REPO_CONFIG_DIR, env={})
         assert cfg.environment == env
         assert cfg.clusterapi.pod_update_endpoint == "/api/pods/update"
         assert cfg.tpu.resource_key == "google.com/tpu"
 
     def test_development_overlay(self, monkeypatch):
-        monkeypatch.chdir("/root/repo")
+        monkeypatch.chdir(REPO_ROOT)
         cfg = load_config("development", REPO_CONFIG_DIR, env={"CLUSTERAPI_API_KEY": "sekrit"})
         assert cfg.kubernetes.use_mock is True
         assert cfg.watcher.log_level == "DEBUG"
@@ -116,13 +118,13 @@ class TestRepoConfigs:
         assert cfg.clusterapi.api_key == "sekrit"
 
     def test_staging_inherits_base(self, monkeypatch):
-        monkeypatch.chdir("/root/repo")
+        monkeypatch.chdir(REPO_ROOT)
         cfg = load_config("staging", REPO_CONFIG_DIR, env={})
         assert cfg.watcher.log_level == "INFO"
         assert cfg.watcher.retry.max_attempts == 3
 
     def test_production_overlay(self, monkeypatch):
-        monkeypatch.chdir("/root/repo")
+        monkeypatch.chdir(REPO_ROOT)
         cfg = load_config("production", REPO_CONFIG_DIR, env={})
         assert cfg.kubernetes.use_incluster_config is True
         assert cfg.watcher.critical_events_only is True

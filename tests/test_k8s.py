@@ -135,6 +135,32 @@ class TestK8sClient:
         with pytest.raises(K8sApiError):
             make_client(mock_api).get_api_version()
 
+    def test_label_selector_list(self, mock_api):
+        mock_api.cluster.add_pod(build_pod("tpu-pod", labels={"app": "train", "tier": "tpu"}))
+        mock_api.cluster.add_pod(build_pod("web-pod", labels={"app": "web"}))
+        client = make_client(mock_api)
+        items = client.list_pods(label_selector="app=train")["items"]
+        assert [p["metadata"]["name"] for p in items] == ["tpu-pod"]
+        items = client.list_pods(label_selector="tier")["items"]  # existence
+        assert [p["metadata"]["name"] for p in items] == ["tpu-pod"]
+
+    def test_label_selector_watch(self, mock_api):
+        client = make_client(mock_api)
+        rv = client.list_pods()["metadata"]["resourceVersion"]
+        got = []
+
+        def consume():
+            for raw in client.watch_pods(resource_version=rv, timeout_seconds=5, label_selector="app=train"):
+                got.append(raw)
+                return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        mock_api.cluster.add_pod(build_pod("web", labels={"app": "web"}))
+        mock_api.cluster.add_pod(build_pod("trainer", labels={"app": "train"}))
+        t.join(timeout=6)
+        assert [e["object"]["metadata"]["name"] for e in got] == ["trainer"]
 
 class TestKubernetesWatchSource:
     def collect(self, source, n, timeout=10.0):
@@ -219,6 +245,32 @@ class TestKubernetesWatchSource:
         source.stop()
         deleted = [e for e in got if e.type == "DELETED"]
         assert any(e.name == "w1" for e in deleted), f"no synthetic DELETE: {[(e.type, e.name) for e in got]}"
+
+    def test_bookmarks_advance_resume_version(self, mock_api):
+        # a namespace-scoped watch never sees other-namespace events, but the
+        # idle-stream BOOKMARK frames must still advance its resume version
+        source = KubernetesWatchSource(
+            make_client(mock_api), namespace="default", watch_timeout_seconds=10
+        )
+        mock_api.cluster.add_pod(build_pod("seed", "default"))
+        # keep a consumer pulling the generator (bookmarks never yield, so the
+        # loop must stay blocked in next() for frames to be processed)
+        got, done, t = self.collect(source, 99)
+        deadline = time.monotonic() + 5
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert got, "seed event never arrived"
+        # events in a namespace this watch filters out: rv moves server-side
+        for i in range(3):
+            mock_api.cluster.add_pod(build_pod(f"other-{i}", "kube-system"))
+        deadline = time.monotonic() + 8
+        target = str(mock_api.cluster.latest_rv())
+        while source.resource_version != target and time.monotonic() < deadline:
+            time.sleep(0.2)
+        source.stop()
+        assert source.resource_version == target, (
+            f"bookmark never advanced rv: {source.resource_version} != {target}"
+        )
 
     def test_checkpoint_resume(self, mock_api, tmp_path):
         from k8s_watcher_tpu.state.checkpoint import CheckpointStore

@@ -1,0 +1,114 @@
+"""Status endpoint (/metrics, /healthz) + HBM probe kernel tests."""
+
+import threading
+
+from conftest import CONFIG_DIR
+import time
+
+import requests
+
+from k8s_watcher_tpu.metrics import MetricsRegistry
+from k8s_watcher_tpu.metrics.server import Liveness, StatusServer
+from k8s_watcher_tpu.probe.hbm import run_hbm_probe
+
+
+class TestStatusServer:
+    def setup_method(self):
+        self.metrics = MetricsRegistry()
+        self.liveness = Liveness(stale_after_seconds=1.0)
+        self.server = StatusServer(self.metrics, self.liveness, host="127.0.0.1").start()
+        self.url = f"http://127.0.0.1:{self.server.port}"
+
+    def teardown_method(self):
+        self.server.stop()
+
+    def test_metrics_dump(self):
+        self.metrics.counter("events_received").inc(5)
+        self.metrics.histogram("event_to_notify_latency").record(0.01)
+        body = requests.get(f"{self.url}/metrics", timeout=5).json()
+        assert body["events_received"]["count"] == 5
+        assert body["event_to_notify_latency"]["count"] == 1
+        assert body["event_to_notify_latency"]["p50_ms"] > 0
+
+    def test_healthz_alive_then_stale(self):
+        self.liveness.beat()
+        r = requests.get(f"{self.url}/healthz", timeout=5)
+        assert r.status_code == 200 and r.json()["alive"] is True
+        time.sleep(1.1)  # exceed stale_after_seconds
+        r = requests.get(f"{self.url}/healthz", timeout=5)
+        assert r.status_code == 503 and r.json()["alive"] is False
+        self.liveness.beat()
+        assert requests.get(f"{self.url}/healthz", timeout=5).status_code == 200
+
+    def test_unknown_route_404(self):
+        assert requests.get(f"{self.url}/nope", timeout=5).status_code == 404
+
+
+class TestWatcherAppStatusEndpoint:
+    def test_app_serves_metrics_while_running(self):
+        from k8s_watcher_tpu.app import WatcherApp
+        from k8s_watcher_tpu.config.loader import load_config
+        from k8s_watcher_tpu.watch.fake import FakeWatchSource, pod_lifecycle
+
+        class N:
+            def update_pod_status(self, p):
+                return True
+
+            def health_check(self):
+                return True
+
+        config = load_config("development", CONFIG_DIR, env={})
+        source = FakeWatchSource(pod_lifecycle("w0", tpu_chips=4), hold_open=True)
+        app = WatcherApp(config, source=source, notifier=N())
+        # status_port=0 disables the endpoint by config contract; start one
+        # manually wired to the app's registry to validate the integration
+        server = StatusServer(app.metrics, app.liveness, host="127.0.0.1").start()
+        t = threading.Thread(target=app.run, daemon=True)
+        t.start()
+        url = f"http://127.0.0.1:{server.port}"
+        deadline = time.monotonic() + 10
+        count = 0
+        while time.monotonic() < deadline:
+            count = requests.get(f"{url}/metrics", timeout=5).json().get("events_received", {}).get("count", 0)
+            if count >= 3:
+                break
+            time.sleep(0.05)
+        healthz_status = requests.get(f"{url}/healthz", timeout=5).status_code
+        app.stop()
+        t.join(timeout=5)
+        server.stop()
+        assert count >= 3
+        assert healthz_status == 200
+
+
+class TestHbmProbe:
+    def test_interpret_mode_integrity(self):
+        out = run_hbm_probe(1 << 22, iters=1)
+        assert out["ok"] and out["integrity_ok"]
+        assert out["interpreted"] is True  # CPU test mesh
+        assert out["bytes"] > 0 and out["read_gbps"] > 0
+
+    def test_agent_includes_hbm(self):
+        from k8s_watcher_tpu.config.schema import TpuConfig
+        from k8s_watcher_tpu.probe.agent import ProbeAgent
+
+        config = TpuConfig(
+            probe_enabled=True, probe_payload_bytes=1 << 14, probe_matmul_size=64,
+            probe_rtt_warn_ms=10_000.0, probe_hbm_bytes=1 << 22,
+        )
+        agent = ProbeAgent(config, environment="development", sink=lambda n: None, expected_platform="cpu")
+        report = agent.run_once()
+        assert report.hbm is not None and report.hbm["ok"]
+        assert report.healthy
+        assert report.to_payload()["hbm"]["integrity_ok"] is True
+
+    def test_agent_hbm_disabled(self):
+        from k8s_watcher_tpu.config.schema import TpuConfig
+        from k8s_watcher_tpu.probe.agent import ProbeAgent
+
+        config = TpuConfig(
+            probe_enabled=True, probe_payload_bytes=0, probe_matmul_size=64,
+            probe_rtt_warn_ms=10_000.0, probe_hbm_bytes=0,
+        )
+        agent = ProbeAgent(config, environment="development", sink=lambda n: None, expected_platform="cpu")
+        assert agent.run_once().hbm is None
