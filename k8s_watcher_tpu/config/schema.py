@@ -274,6 +274,10 @@ class TpuConfig:
     probe_matmul_size: int = 1024
     probe_hbm_bytes: int = 256 * 1024 * 1024  # 0 disables the HBM sweep
     expected_chips_per_host: int = 0  # 0 = don't enforce
+    # per-link localization probe (probe/links.py): O(links) small compiles,
+    # so off by default; turn on to get which-chip/which-link diagnostics
+    probe_links_enabled: bool = False
+    probe_link_rtt_factor: float = 3.0
 
     @classmethod
     def from_raw(cls, raw: Mapping[str, Any]) -> "TpuConfig":
@@ -297,7 +301,7 @@ class TpuConfig:
         _check_known(
             probe,
             ("enabled", "interval_seconds", "payload_bytes", "rtt_warn_ms", "matmul_size",
-             "hbm_bytes", "expected_chips_per_host"),
+             "hbm_bytes", "expected_chips_per_host", "links_enabled", "link_rtt_factor"),
             "tpu.probe",
         )
         return cls(
@@ -312,6 +316,8 @@ class TpuConfig:
             probe_matmul_size=_opt_int(probe, "matmul_size", "tpu.probe", 1024),
             probe_hbm_bytes=_opt_int(probe, "hbm_bytes", "tpu.probe", 256 * 1024 * 1024),
             expected_chips_per_host=_opt_int(probe, "expected_chips_per_host", "tpu.probe", 0),
+            probe_links_enabled=_opt_bool(probe, "links_enabled", "tpu.probe", False),
+            probe_link_rtt_factor=_opt_num(probe, "link_rtt_factor", "tpu.probe", 3.0),
         )
 
 

@@ -1,0 +1,96 @@
+"""ICI fault injection (SURVEY.md §5: "ICI fault injection hooks for the
+v5p-128 acceptance config"; §7 hard part (d): link faults testable below
+v5p scale).
+
+A real degraded chip/link cannot be conjured on demand, so faults are
+modeled *inside the probe programs themselves*, gated per-device with
+compiler-friendly control flow (``lax.cond`` on the device's mesh position
+— no data-dependent Python, SPMD-safe):
+
+- **slow chip**: one device runs a chained-matmul delay before joining the
+  collective, so every collective that waits on it stretches — exactly the
+  wall-clock signature of a thermally-throttled or driver-degraded chip.
+- **corrupt chip**: one device perturbs its contribution, so checksums
+  fail — the signature of bad HBM / a flaky lane.
+
+The probe kernels (parallel/collectives.py) accept an ``IciFaultSpec`` and
+the link prober (probe/links.py) must then *localize* the injected fault;
+tests assert it fingers the right device. The spec is test/chaos tooling:
+production probes pass ``fault=None`` and the gating code is never traced.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class IciFaultSpec:
+    """Which device misbehaves, and how.
+
+    ``slow_device_id`` / ``corrupt_device_id`` are ``jax.Device.id`` values
+    (global, stable across meshes — the same spec applies to the full-mesh
+    psum probe and every 2-device link probe, which is what lets the link
+    prober triangulate).
+    """
+
+    slow_device_id: Optional[int] = None
+    slow_matmul_size: int = 128
+    slow_iters: int = 100
+    corrupt_device_id: Optional[int] = None
+    corrupt_magnitude: float = 1e6
+
+    @property
+    def active(self) -> bool:
+        return self.slow_device_id is not None or self.corrupt_device_id is not None
+
+
+def apply_fault(
+    x: jax.Array,
+    fault: Optional[IciFaultSpec],
+    member_device_ids: Sequence[int],
+    linear_index: jax.Array,
+) -> jax.Array:
+    """Apply ``fault`` to this shard's value inside a shard_map'd program.
+
+    ``member_device_ids`` is the static tuple of ``Device.id`` in linear mesh
+    order; ``linear_index`` is this member's traced position in that order.
+    Devices not named by the spec are untouched (the heavy branch is a
+    ``lax.cond`` arm only the faulty device executes at runtime).
+    """
+    if fault is None or not fault.active:
+        return x
+    ids = tuple(member_device_ids)
+
+    if fault.slow_device_id in ids:
+        pos = ids.index(fault.slow_device_id)
+        size, iters = fault.slow_matmul_size, fault.slow_iters
+
+        def heavy() -> jax.Array:
+            m = jnp.full((size, size), 1e-3, dtype=jnp.bfloat16)
+
+            def body(_, c):
+                y = jnp.dot(c, c, preferred_element_type=jnp.float32)
+                # renormalize so the chain can't overflow bf16
+                y = y * jax.lax.rsqrt(jnp.mean(y * y) + 1e-6)
+                return y.astype(jnp.bfloat16)
+
+            r = jax.lax.fori_loop(0, iters, body, m)
+            # fold to a negligible-but-not-DCE-able scalar
+            return r.astype(jnp.float32).sum() * jnp.float32(1e-30)
+
+        extra = jax.lax.cond(linear_index == pos, heavy, lambda: jnp.float32(0.0))
+        x = x + extra.astype(x.dtype)
+
+    if fault.corrupt_device_id in ids:
+        pos_c = ids.index(fault.corrupt_device_id)
+        x = jnp.where(
+            linear_index == pos_c,
+            x + jnp.asarray(fault.corrupt_magnitude, dtype=x.dtype),
+            x,
+        )
+    return x

@@ -9,25 +9,49 @@ Two jitted SPMD programs, both built with ``jax.shard_map`` over a
 - ``make_allreduce_bandwidth_probe``: a large bf16 all-reduce; the achieved
   bus bandwidth (2·(n-1)/n · bytes / t) is the ICI *bandwidth* health
   signal, which catches degraded links that still pass the latency probe.
+- ``make_pair_probe``: a 2-device chained ``lax.ppermute`` exchange — the
+  per-*link* latency primitive the link prober (probe/links.py) runs over
+  every neighbor pair to localize a degraded link/chip.
 
 Static shapes, no data-dependent control flow — each program is traced once
-and cached; steady-state probe iterations are pure device execution.
+and cached; steady-state probe iterations are pure device execution. Every
+builder takes an optional ``IciFaultSpec`` (faults/ici.py) that gates
+injected slow/corrupt behavior onto one device for chaos testing.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Tuple
+import functools
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from k8s_watcher_tpu.faults.ici import IciFaultSpec, apply_fault
 
 
 def _mesh_axes(mesh: Mesh) -> Tuple[str, ...]:
     return tuple(mesh.axis_names)
 
 
-def make_psum_probe(mesh: Mesh, inner_iters: int = 1) -> Callable[[jax.Array], jax.Array]:
+def _linear_index(mesh: Mesh) -> jax.Array:
+    """This device's traced position in ``mesh.devices.flatten()`` order."""
+    idx = jax.lax.axis_index(mesh.axis_names[0])
+    for name in mesh.axis_names[1:]:
+        idx = idx * mesh.shape[name] + jax.lax.axis_index(name)
+    return idx
+
+
+def mesh_device_ids(mesh: Mesh) -> Tuple[int, ...]:
+    """Static ``Device.id`` tuple in the same linear order as ``_linear_index``."""
+    return tuple(d.id for d in mesh.devices.flatten())
+
+
+def make_psum_probe(
+    mesh: Mesh, inner_iters: int = 1, fault: Optional[IciFaultSpec] = None
+) -> Callable[[jax.Array], jax.Array]:
     """Jitted chained ``psum`` of a per-device scalar vector over the mesh.
 
     One call runs ``inner_iters`` serialized psums (each feeds the next, so
@@ -48,7 +72,11 @@ def make_psum_probe(mesh: Mesh, inner_iters: int = 1) -> Callable[[jax.Array], j
         else (lambda v: jax.lax.pvary(v, axes))
     )
 
+    device_ids = mesh_device_ids(mesh)
+
     def probe(x: jax.Array) -> jax.Array:
+        x = apply_fault(x, fault, device_ids, _linear_index(mesh))
+
         def body(_, carry):
             # psum produces a device-invariant value; re-mark it as varying
             # so the fori_loop carry type stays consistent
@@ -61,13 +89,17 @@ def make_psum_probe(mesh: Mesh, inner_iters: int = 1) -> Callable[[jax.Array], j
     return jax.jit(shard)
 
 
-def make_allreduce_bandwidth_probe(mesh: Mesh, payload_bytes: int) -> Callable[[jax.Array], jax.Array]:
+def make_allreduce_bandwidth_probe(
+    mesh: Mesh, payload_bytes: int, fault: Optional[IciFaultSpec] = None
+) -> Callable[[jax.Array], jax.Array]:
     """Jitted large all-reduce; input is a ``(n_devices, chunk)`` bf16 array
     sharded along the device axes, output the replicated reduced chunk."""
     axes = _mesh_axes(mesh)
+    device_ids = mesh_device_ids(mesh)
 
     def probe(x: jax.Array) -> jax.Array:
         # x arrives as this device's (1, chunk) shard; reduce across devices
+        x = apply_fault(x, fault, device_ids, _linear_index(mesh))
         return jax.lax.psum(x, axes)
 
     shard = jax.shard_map(probe, mesh=mesh, in_specs=P(axes), out_specs=P())
@@ -89,6 +121,53 @@ def bandwidth_probe_input(mesh: Mesh, payload_bytes: int) -> jax.Array:
     chunk = max(128, payload_bytes // 2)  # bf16 = 2 bytes
     x = jnp.ones((n, chunk), dtype=jnp.bfloat16)
     return jax.device_put(x, NamedSharding(mesh, P(axes, None)))
+
+
+@functools.lru_cache(maxsize=4096)
+def make_pair_probe(
+    dev_a: jax.Device,
+    dev_b: jax.Device,
+    inner_iters: int = 8,
+    fault: Optional[IciFaultSpec] = None,
+) -> Tuple[Callable[[jax.Array], jax.Array], Mesh, float]:
+    """A chained 2-device ``ppermute`` exchange over the (a, b) link.
+
+    Cached on ``(devices, inner_iters, fault)``: the link prober re-probes
+    every mesh edge each cycle, and a fresh closure per cycle would defeat
+    the jit cache (keyed on function identity) — O(links) recompiles per
+    probe interval. ``jax.Device`` and the frozen ``IciFaultSpec`` are both
+    hashable; after a backend restart new Device objects simply miss.
+
+    Returns ``(jitted_fn, pair_mesh, expected)``: the fn takes the pair
+    input from :func:`pair_probe_input`, runs ``inner_iters`` serialized
+    exchanges (each feeds the next — XLA cannot overlap them), and returns
+    the replicated psum of the final values. With an even ``inner_iters``
+    every value is back home, so the output equals ``expected`` (= 1+2);
+    any deviation means a member corrupted the payload in flight.
+    Per-hop latency = call time / inner_iters.
+    """
+    if inner_iters < 2 or inner_iters % 2:
+        raise ValueError("inner_iters must be an even integer >= 2")
+    mesh = Mesh(np.array([dev_a, dev_b]), ("pair",))
+    ids = (dev_a.id, dev_b.id)
+
+    def probe(x: jax.Array) -> jax.Array:
+        x = apply_fault(x, fault, ids, jax.lax.axis_index("pair"))
+
+        def body(_, carry):
+            return jax.lax.ppermute(carry, "pair", [(0, 1), (1, 0)])
+
+        y = jax.lax.fori_loop(0, inner_iters, body, x)
+        return jax.lax.psum(y, "pair")
+
+    shard = jax.shard_map(probe, mesh=mesh, in_specs=P("pair"), out_specs=P())
+    return jax.jit(shard), mesh, 3.0
+
+
+def pair_probe_input(mesh: Mesh) -> jax.Array:
+    """Per-member scalars (1.0, 2.0) laid out over the pair mesh."""
+    x = jnp.arange(1.0, 3.0, dtype=jnp.float32)
+    return jax.device_put(x, NamedSharding(mesh, P("pair")))
 
 
 def allreduce_bus_bandwidth_gbps(payload_bytes: int, n_devices: int, seconds: float) -> float:

@@ -59,6 +59,11 @@ class ProbeAgent:
         )
         ici = run_ici_probe(self.mesh, payload_bytes=self.config.probe_payload_bytes)
         mxu = run_mxu_probe(self.config.probe_matmul_size)
+        links = None
+        if self.config.probe_links_enabled:
+            from k8s_watcher_tpu.probe.links import run_link_probe
+
+            links = run_link_probe(self.mesh, rtt_factor=self.config.probe_link_rtt_factor)
         hbm = None
         if self.config.probe_hbm_bytes > 0:
             from k8s_watcher_tpu.probe.hbm import run_hbm_probe
@@ -70,6 +75,7 @@ class ProbeAgent:
             ici=ici,
             mxu=mxu,
             hbm=hbm,
+            links=links,
             rtt_warn_ms=self.config.probe_rtt_warn_ms,
             duration_ms=1e3 * (time.monotonic() - t0),
         )

@@ -68,6 +68,7 @@ def run_ici_probe(
     payload_bytes: int = 4 * 1024 * 1024,
     iters: int = 10,
     inner_iters: int = 10,
+    fault=None,  # faults.ici.IciFaultSpec — chaos testing only
 ) -> IciProbeResult:
     """Latency (chained tiny psums) + bandwidth (large all-reduce).
 
@@ -82,7 +83,7 @@ def run_ici_probe(
         n_hosts = mesh.devices.shape[0]
 
         t0 = time.perf_counter()
-        psum = make_psum_probe(mesh, inner_iters)
+        psum = make_psum_probe(mesh, inner_iters, fault)
         x = psum_probe_input(mesh)
         result = jax.block_until_ready(psum(x))  # warmup = compile
         compile_ms = 1e3 * (time.perf_counter() - t0)
@@ -95,7 +96,7 @@ def run_ici_probe(
 
         bw_gbps = 0.0
         if payload_bytes > 0 and n > 1:
-            bw_fn = make_allreduce_bandwidth_probe(mesh, payload_bytes)
+            bw_fn = make_allreduce_bandwidth_probe(mesh, payload_bytes, fault)
             payload = bandwidth_probe_input(mesh, payload_bytes)
             jax.block_until_ready(bw_fn(payload))  # compile
             bw_min, _, _ = _timed(bw_fn, payload, max(3, iters // 3))

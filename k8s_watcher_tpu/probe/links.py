@@ -1,0 +1,205 @@
+"""Per-link ICI probe: localize degraded links/chips, not just detect them.
+
+The aggregate psum probe (probe/ici.py) answers "is this slice healthy?";
+when it isn't, operators need to know *which* chip or link to drain. This
+prober walks every neighbor pair of the ``(hosts, chips)`` mesh — the ICI
+torus's physical edges — timing a chained 2-device ``ppermute`` exchange per
+link (parallel/collectives.py:make_pair_probe) and checksumming the payload
+round-trip:
+
+- a **slow chip** stretches every link probe it participates in → the
+  common endpoint of the slow links is the suspect chip;
+- a **degraded link** stretches only its own pair probe;
+- a **corrupt chip** fails the checksum of every link it touches.
+
+Outliers are flagged against the *median* link RTT (robust to global noise:
+on a healthy mesh all links are within a small factor of each other), with
+an absolute floor so microsecond-scale jitter can't trip it.
+
+Process model: single-controller probes every link. In multi-controller
+(DaemonSet) mode each host probes its own intra-host links — a 2-device
+program over a remote host's devices can't be launched locally — and
+inter-host paths stay covered by the aggregate psum/bandwidth probes, so
+localization granularity there is per-host, not per-link.
+
+Faults for chaos tests are injected via ``IciFaultSpec`` (faults/ici.py);
+tests assert the prober fingers exactly the injected device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from k8s_watcher_tpu.faults.ici import IciFaultSpec
+from k8s_watcher_tpu.parallel.collectives import make_pair_probe, pair_probe_input
+from k8s_watcher_tpu.parallel.mesh import host_chip_mesh
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class LinkResult:
+    axis: str  # "chips" (intra-host) | "hosts" (inter-host)
+    name: str  # e.g. "host0/chip1-chip2"
+    device_ids: Tuple[int, int]
+    rtt_ms: float  # min per-hop over iters
+    rtt_mean_ms: float
+    correct: bool
+
+
+@dataclasses.dataclass
+class LinkProbeResult:
+    ok: bool
+    n_links: int
+    median_rtt_ms: float
+    links: List[LinkResult]
+    suspect_links: List[Dict[str, Any]]  # {name, device_ids, reason, rtt_ms}
+    suspect_devices: List[int]  # device ids implicated by >1 suspect link
+    compile_ms: float
+    error: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)  # recursively converts nested LinkResults
+
+
+def enumerate_links(mesh) -> List[Tuple[str, str, jax.Device, jax.Device]]:
+    """Neighbor pairs along each mesh axis: ``(axis, name, dev_a, dev_b)``.
+
+    Rows of the device grid are chips within one host (intra-host ICI);
+    columns cross hosts (inter-host ICI / DCN). Rings longer than 2 get the
+    wraparound edge — matching the physical torus topology.
+    """
+    grid = np.asarray(mesh.devices)
+    if grid.ndim == 1:
+        grid = grid.reshape(1, -1)
+    hosts, chips = grid.shape
+    links: List[Tuple[str, str, jax.Device, jax.Device]] = []
+    for h in range(hosts):
+        for c in range(chips - 1):
+            links.append(("chips", f"host{h}/chip{c}-chip{c + 1}", grid[h, c], grid[h, c + 1]))
+        if chips > 2:
+            links.append(("chips", f"host{h}/chip{chips - 1}-chip0", grid[h, chips - 1], grid[h, 0]))
+    for c in range(chips):
+        for h in range(hosts - 1):
+            links.append(("hosts", f"chip{c}/host{h}-host{h + 1}", grid[h, c], grid[h + 1, c]))
+        if hosts > 2:
+            links.append(("hosts", f"chip{c}/host{hosts - 1}-host0", grid[hosts - 1, c], grid[0, c]))
+    return links
+
+
+def _timed_pair(fn, x, expected: float, iters: int, inner_iters: int) -> Tuple[float, float, bool]:
+    """(min_per_hop_s, mean_per_hop_s, correct) over ``iters`` fenced calls."""
+    times, correct = [], True
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(fn(x))
+        times.append(time.perf_counter() - t0)
+        if abs(float(np.asarray(out).ravel()[0]) - expected) > 1e-3 * max(1.0, abs(expected)):
+            correct = False
+    return min(times) / inner_iters, (sum(times) / len(times)) / inner_iters, correct
+
+
+def run_link_probe(
+    mesh=None,
+    *,
+    iters: int = 5,
+    inner_iters: int = 8,
+    rtt_factor: float = 3.0,
+    rtt_floor_ms: float = 0.05,
+    fault: Optional[IciFaultSpec] = None,
+) -> LinkProbeResult:
+    """Probe every mesh link; flag outliers and triangulate suspect devices.
+
+    A link is suspect when its payload checksum fails ("corrupt") or its
+    per-hop RTT exceeds ``max(rtt_floor_ms, rtt_factor * median)`` ("slow").
+    A device is suspect when it is an endpoint of at least two suspect links
+    (a single bad link implicates the link, not a chip).
+    """
+    try:
+        if mesh is None:
+            mesh = host_chip_mesh()
+        links = enumerate_links(mesh)
+        if jax.process_count() > 1:
+            # Multi-controller mode: a 2-device program over another host's
+            # devices cannot be launched from here (non-addressable shards),
+            # so each host probes its own intra-host links; inter-host paths
+            # are covered by the aggregate psum/bandwidth probes (detection
+            # at host granularity rather than per-link localization).
+            pid = jax.process_index()
+            local = [l for l in links if l[2].process_index == pid and l[3].process_index == pid]
+            if len(local) < len(links):
+                logger.info(
+                    "Multi-host link probe: probing %d/%d process-local links "
+                    "(inter-host links covered by the aggregate probes)",
+                    len(local), len(links),
+                )
+            links = local
+        if not links:
+            return LinkProbeResult(
+                ok=True, n_links=0, median_rtt_ms=0.0, links=[],
+                suspect_links=[], suspect_devices=[], compile_ms=0.0,
+            )
+
+        compile_s = 0.0
+        results: List[LinkResult] = []
+        for axis, name, dev_a, dev_b in links:
+            fn, pair_mesh, expected = make_pair_probe(dev_a, dev_b, inner_iters, fault)
+            x = pair_probe_input(pair_mesh)
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(x))  # warmup (compile on first cycle)
+            compile_s += time.perf_counter() - t0
+            rtt_min, rtt_mean, correct = _timed_pair(fn, x, expected, iters, inner_iters)
+            results.append(
+                LinkResult(
+                    axis=axis,
+                    name=name,
+                    device_ids=(dev_a.id, dev_b.id),
+                    rtt_ms=1e3 * rtt_min,
+                    rtt_mean_ms=1e3 * rtt_mean,
+                    correct=correct,
+                )
+            )
+        compile_ms = 1e3 * compile_s
+
+        median = float(np.median([r.rtt_ms for r in results]))
+        threshold = max(rtt_floor_ms, rtt_factor * median)
+        suspects: List[Dict[str, Any]] = []
+        for r in results:
+            if not r.correct:
+                suspects.append({"name": r.name, "device_ids": list(r.device_ids), "reason": "corrupt", "rtt_ms": r.rtt_ms})
+            elif r.rtt_ms > threshold:
+                suspects.append({"name": r.name, "device_ids": list(r.device_ids), "reason": "slow", "rtt_ms": r.rtt_ms})
+
+        endpoint_counts: Dict[int, int] = {}
+        for s in suspects:
+            for d in s["device_ids"]:
+                endpoint_counts[d] = endpoint_counts.get(d, 0) + 1
+        suspect_devices = sorted(d for d, c in endpoint_counts.items() if c >= 2)
+
+        if suspects:
+            logger.warning(
+                "Link probe: %d/%d suspect links (median %.3f ms): %s; suspect devices: %s",
+                len(suspects), len(results), median,
+                [s["name"] for s in suspects], suspect_devices,
+            )
+        return LinkProbeResult(
+            ok=not suspects,
+            n_links=len(results),
+            median_rtt_ms=median,
+            links=results,
+            suspect_links=suspects,
+            suspect_devices=suspect_devices,
+            compile_ms=compile_ms,
+        )
+    except Exception as exc:
+        logger.error("Link probe failed: %s", exc)
+        return LinkProbeResult(
+            ok=False, n_links=0, median_rtt_ms=-1.0, links=[],
+            suspect_links=[], suspect_devices=[], compile_ms=0.0, error=str(exc),
+        )

@@ -18,6 +18,7 @@ class ProbeReport:
     ici: Optional[IciProbeResult] = None
     mxu: Optional[Dict[str, Any]] = None
     hbm: Optional[Dict[str, Any]] = None
+    links: Optional[Any] = None  # probe.links.LinkProbeResult
     rtt_warn_ms: float = 50.0
     duration_ms: float = 0.0
 
@@ -37,6 +38,8 @@ class ProbeReport:
             return False
         if self.hbm is not None and not self.hbm.get("ok", False):
             return False
+        if self.links is not None and not self.links.ok:
+            return False
         return True
 
     def to_payload(self) -> Dict[str, Any]:
@@ -50,6 +53,7 @@ class ProbeReport:
             "ici": self.ici.to_dict() if self.ici else None,
             "mxu": self.mxu,
             "hbm": self.hbm,
+            "links": self.links.to_dict() if self.links is not None else None,
             "duration_ms": self.duration_ms,
             "event_timestamp": datetime.now(timezone.utc).isoformat(),
         }

@@ -1,0 +1,143 @@
+"""Per-link ICI probe + fault-injection tests on the virtual 8-device CPU
+mesh (conftest): the probe must not just detect an injected fault but
+localize it to the right chip — SURVEY.md §5 failure-detection substitute
+and §7 hard part (d) (link faults testable below v5p scale)."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from k8s_watcher_tpu.config.schema import TpuConfig
+from k8s_watcher_tpu.faults.ici import IciFaultSpec
+from k8s_watcher_tpu.parallel.collectives import make_pair_probe, pair_probe_input
+from k8s_watcher_tpu.probe.ici import run_ici_probe
+from k8s_watcher_tpu.probe.links import LinkProbeResult, enumerate_links, run_link_probe
+from k8s_watcher_tpu.probe.report import ProbeReport
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return Mesh(np.array(jax.devices()).reshape(2, 4), ("hosts", "chips"))
+
+
+# generous absolute floor: healthy CPU-mesh links are ~0.05 ms, the injected
+# delay is tens of ms — keeps the threshold far from scheduler jitter
+FLOOR_MS = 1.0
+SLOW = IciFaultSpec(slow_device_id=3, slow_matmul_size=128, slow_iters=200)
+
+
+class TestEnumerateLinks:
+    def test_2x4_torus(self, mesh):
+        links = enumerate_links(mesh)
+        # rows: 3 neighbor pairs + wrap = 4 per host x 2 hosts; cols: 1 pair
+        # per chip x 4 chips (no wrap for a 2-ring)
+        assert len(links) == 12
+        assert sum(1 for axis, *_ in links if axis == "chips") == 8
+        assert sum(1 for axis, *_ in links if axis == "hosts") == 4
+
+    def test_no_wrap_on_2ring(self, mesh):
+        names = [name for _, name, _, _ in enumerate_links(mesh)]
+        assert "chip0/host1-host0" not in names  # 2-ring has one edge only
+
+    def test_every_device_covered(self, mesh):
+        ids = {d.id for _, _, a, b in enumerate_links(mesh) for d in (a, b)}
+        assert ids == {d.id for d in jax.devices()}
+
+
+class TestPairProbe:
+    def test_roundtrip_correct(self):
+        a, b = jax.devices()[:2]
+        fn, pair_mesh, expected = make_pair_probe(a, b, inner_iters=4)
+        out = jax.block_until_ready(fn(pair_probe_input(pair_mesh)))
+        assert float(np.asarray(out).ravel()[0]) == pytest.approx(expected)
+
+    def test_odd_inner_iters_rejected(self):
+        a, b = jax.devices()[:2]
+        with pytest.raises(ValueError):
+            make_pair_probe(a, b, inner_iters=3)
+
+    def test_corrupt_member_breaks_checksum(self):
+        a, b = jax.devices()[:2]
+        fault = IciFaultSpec(corrupt_device_id=b.id)
+        fn, pair_mesh, expected = make_pair_probe(a, b, inner_iters=4, fault=fault)
+        out = jax.block_until_ready(fn(pair_probe_input(pair_mesh)))
+        assert abs(float(np.asarray(out).ravel()[0]) - expected) > 1.0
+
+
+class TestLinkProbe:
+    def test_healthy_mesh(self, mesh):
+        r = run_link_probe(mesh, iters=3, inner_iters=4, rtt_floor_ms=FLOOR_MS)
+        assert r.ok and r.error is None
+        assert r.n_links == 12
+        assert not r.suspect_links and not r.suspect_devices
+        assert r.median_rtt_ms > 0
+
+    def test_slow_chip_localized(self, mesh):
+        r = run_link_probe(mesh, iters=3, inner_iters=4, rtt_floor_ms=FLOOR_MS, fault=SLOW)
+        assert not r.ok
+        assert r.suspect_devices == [3]
+        # exactly the 3 torus edges touching device 3 (2 intra-host + 1 inter-host)
+        assert len(r.suspect_links) == 3
+        assert all(3 in s["device_ids"] for s in r.suspect_links)
+        assert all(s["reason"] == "slow" for s in r.suspect_links)
+
+    def test_corrupt_chip_localized(self, mesh):
+        fault = IciFaultSpec(corrupt_device_id=5)
+        r = run_link_probe(mesh, iters=3, inner_iters=4, rtt_floor_ms=FLOOR_MS, fault=fault)
+        assert not r.ok
+        assert r.suspect_devices == [5]
+        assert all(s["reason"] == "corrupt" for s in r.suspect_links)
+
+    def test_serializable(self, mesh):
+        import json
+
+        r = run_link_probe(mesh, iters=2, inner_iters=4, rtt_floor_ms=FLOOR_MS)
+        json.dumps(r.to_dict())
+
+    def test_multihost_probes_only_local_links(self, mesh, monkeypatch):
+        # simulate being one host of a 2-host slice that owns none of the
+        # mesh's devices: no launchable links, but the probe must degrade
+        # gracefully (inter-host paths belong to the aggregate probes)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "process_index", lambda: 1)
+        r = run_link_probe(mesh, iters=2, inner_iters=4, rtt_floor_ms=FLOOR_MS)
+        assert r.ok and r.error is None and r.n_links == 0
+
+
+class TestAggregateProbeUnderFault:
+    def test_psum_detects_corruption(self, mesh):
+        r = run_ici_probe(mesh, payload_bytes=0, iters=2, inner_iters=2,
+                          fault=IciFaultSpec(corrupt_device_id=2))
+        assert not r.ok and not r.psum_correct
+
+    def test_psum_still_ok_without_fault(self, mesh):
+        r = run_ici_probe(mesh, payload_bytes=0, iters=2, inner_iters=2)
+        assert r.ok and r.psum_correct
+
+
+class TestReportIntegration:
+    def _devices_ok(self):
+        return {"platform_mismatch": 0, "missing_local_devices": 0,
+                "healthy_devices": 8, "visible_devices": 8}
+
+    def test_suspect_links_make_report_unhealthy(self, mesh):
+        links = run_link_probe(mesh, iters=3, inner_iters=4, rtt_floor_ms=FLOOR_MS,
+                               fault=IciFaultSpec(corrupt_device_id=1))
+        report = ProbeReport(environment="test", devices=self._devices_ok(), links=links)
+        assert not report.healthy
+        assert report.to_payload()["links"]["suspect_devices"] == [1]
+
+    def test_healthy_links_keep_report_healthy(self, mesh):
+        links = run_link_probe(mesh, iters=2, inner_iters=4, rtt_floor_ms=FLOOR_MS)
+        report = ProbeReport(environment="test", devices=self._devices_ok(), links=links)
+        assert report.healthy
+
+
+def test_config_link_probe_keys():
+    cfg = TpuConfig.from_raw(
+        {"probe": {"enabled": True, "links_enabled": True, "link_rtt_factor": 5.0}}
+    )
+    assert cfg.probe_links_enabled is True
+    assert cfg.probe_link_rtt_factor == 5.0
+    assert TpuConfig.from_raw({}).probe_links_enabled is False
