@@ -278,6 +278,9 @@ class TpuConfig:
     # so off by default; turn on to get which-chip/which-link diagnostics
     probe_links_enabled: bool = False
     probe_link_rtt_factor: float = 3.0
+    # cross-slice DCN aggregation probe (probe/multislice.py)
+    probe_multislice_enabled: bool = False
+    probe_multislice_slices: int = 0  # 0 = infer from Device.slice_index
 
     @classmethod
     def from_raw(cls, raw: Mapping[str, Any]) -> "TpuConfig":
@@ -301,7 +304,8 @@ class TpuConfig:
         _check_known(
             probe,
             ("enabled", "interval_seconds", "payload_bytes", "rtt_warn_ms", "matmul_size",
-             "hbm_bytes", "expected_chips_per_host", "links_enabled", "link_rtt_factor"),
+             "hbm_bytes", "expected_chips_per_host", "links_enabled", "link_rtt_factor",
+             "multislice_enabled", "multislice_slices"),
             "tpu.probe",
         )
         return cls(
@@ -318,6 +322,8 @@ class TpuConfig:
             expected_chips_per_host=_opt_int(probe, "expected_chips_per_host", "tpu.probe", 0),
             probe_links_enabled=_opt_bool(probe, "links_enabled", "tpu.probe", False),
             probe_link_rtt_factor=_opt_num(probe, "link_rtt_factor", "tpu.probe", 3.0),
+            probe_multislice_enabled=_opt_bool(probe, "multislice_enabled", "tpu.probe", False),
+            probe_multislice_slices=_opt_int(probe, "multislice_slices", "tpu.probe", 0),
         )
 
 

@@ -59,34 +59,10 @@ def make_psum_probe(
     RTT measurement; per-psum latency = call time / inner_iters. Each round
     computes ``psum(x)/n``, so for any ``inner_iters >= 1`` the replicated
     output equals ``sum(x)/n`` — a fixed point that doubles as the
-    correctness check.
+    correctness check. The all-axes special case of
+    :func:`make_subaxis_psum_probe`.
     """
-    axes = _mesh_axes(mesh)
-    n = mesh.size
-    if inner_iters < 1:
-        raise ValueError("inner_iters must be >= 1")
-
-    # jax>=0.8 renames pvary -> pcast(..., axis_name, to='varying')
-    _to_varying = (
-        (lambda v: jax.lax.pcast(v, axes, to="varying")) if hasattr(jax.lax, "pcast")
-        else (lambda v: jax.lax.pvary(v, axes))
-    )
-
-    device_ids = mesh_device_ids(mesh)
-
-    def probe(x: jax.Array) -> jax.Array:
-        x = apply_fault(x, fault, device_ids, _linear_index(mesh))
-
-        def body(_, carry):
-            # psum produces a device-invariant value; re-mark it as varying
-            # so the fori_loop carry type stays consistent
-            return _to_varying(jax.lax.psum(carry, axes) / n)
-
-        y = jax.lax.fori_loop(0, inner_iters - 1, body, x) if inner_iters > 1 else x
-        return jax.lax.psum(y, axes) / n  # final psum: invariant output
-
-    shard = jax.shard_map(probe, mesh=mesh, in_specs=P(axes), out_specs=P())
-    return jax.jit(shard)
+    return make_subaxis_psum_probe(mesh, _mesh_axes(mesh), inner_iters, fault)
 
 
 def make_allreduce_bandwidth_probe(
@@ -121,6 +97,86 @@ def bandwidth_probe_input(mesh: Mesh, payload_bytes: int) -> jax.Array:
     chunk = max(128, payload_bytes // 2)  # bf16 = 2 bytes
     x = jnp.ones((n, chunk), dtype=jnp.bfloat16)
     return jax.device_put(x, NamedSharding(mesh, P(axes, None)))
+
+
+@functools.lru_cache(maxsize=1024)
+def make_subaxis_psum_probe(
+    mesh: Mesh,
+    reduce_axes: Tuple[str, ...],
+    inner_iters: int = 1,
+    fault: Optional[IciFaultSpec] = None,
+) -> Callable[[jax.Array], jax.Array]:
+    """Chained ``psum`` over a *subset* of mesh axes.
+
+    Cached (``Mesh`` hashes structurally, ``IciFaultSpec`` is frozen) so
+    per-cycle probe loops reuse one jitted program instead of re-tracing —
+    a fresh closure each cycle would defeat the jit cache.
+
+    On a hybrid ``(slices, hosts, chips)`` mesh this scopes the collective
+    to one fabric: ``("hosts", "chips")`` rides ICI only, all three axes
+    add the DCN hop — so ``t(all) - t(ici)`` isolates the cross-slice DCN
+    cost. Output is varying over the non-reduced axes (one value per
+    group); the fixed-point normalization matches ``make_psum_probe``.
+    """
+    all_axes = _mesh_axes(mesh)
+    if not reduce_axes or any(a not in all_axes for a in reduce_axes):
+        raise ValueError(f"reduce_axes {reduce_axes} not a subset of {all_axes}")
+    keep = tuple(a for a in all_axes if a not in reduce_axes)
+    k = 1
+    for a in reduce_axes:
+        k *= mesh.shape[a]
+    if inner_iters < 1:
+        raise ValueError("inner_iters must be >= 1")
+
+    _to_varying = (
+        (lambda v: jax.lax.pcast(v, reduce_axes, to="varying")) if hasattr(jax.lax, "pcast")
+        else (lambda v: jax.lax.pvary(v, reduce_axes))
+    )
+    device_ids = mesh_device_ids(mesh)
+
+    def probe(x: jax.Array) -> jax.Array:
+        x = apply_fault(x, fault, device_ids, _linear_index(mesh))
+
+        def body(_, carry):
+            return _to_varying(jax.lax.psum(carry, reduce_axes) / k)
+
+        y = jax.lax.fori_loop(0, inner_iters - 1, body, x) if inner_iters > 1 else x
+        return jax.lax.psum(y, reduce_axes) / k
+
+    shard = jax.shard_map(
+        probe, mesh=mesh, in_specs=P(all_axes), out_specs=P(keep) if keep else P()
+    )
+    return jax.jit(shard)
+
+
+@functools.lru_cache(maxsize=1024)
+def make_hierarchical_probe(
+    mesh: Mesh, fault: Optional[IciFaultSpec] = None
+) -> Callable[[jax.Array], Tuple[jax.Array, jax.Array]]:
+    """Per-slice psum over ICI, then cross-slice psum over DCN. Cached like
+    :func:`make_subaxis_psum_probe` — one jitted program per (mesh, fault).
+
+    For a ``(slices, hosts, chips)`` mesh (parallel/mesh.py:
+    hybrid_slice_mesh) returns ``(per_slice_sums, global_sum)`` of the
+    per-device inputs. Per-slice sums localize a deviating contribution to
+    its slice; the global sum is the DCN-aggregated health scalar.
+    """
+    all_axes = _mesh_axes(mesh)
+    if all_axes[0] != "slices" or len(all_axes) < 2:
+        raise ValueError(f"hierarchical probe wants ('slices', ...) axes, got {all_axes}")
+    ici_axes = all_axes[1:]
+    device_ids = mesh_device_ids(mesh)
+
+    def probe(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        x = apply_fault(x, fault, device_ids, _linear_index(mesh))
+        per_slice = jax.lax.psum(x, ici_axes)  # ICI: invariant within a slice
+        global_ = jax.lax.psum(per_slice, "slices")  # DCN hop
+        return per_slice, global_
+
+    shard = jax.shard_map(
+        probe, mesh=mesh, in_specs=P(all_axes), out_specs=(P("slices"), P())
+    )
+    return jax.jit(shard)
 
 
 @functools.lru_cache(maxsize=4096)

@@ -77,3 +77,51 @@ def flat_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """A 1-D ``(chips,)`` mesh (single-host or ragged fallback)."""
     devices = list(devices if devices is not None else jax.devices())
     return Mesh(np.array(devices).reshape(1, len(devices)), ("hosts", "chips"))
+
+
+def hybrid_slice_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    n_slices: Optional[int] = None,
+) -> Mesh:
+    """A 3-D ``(slices, hosts, chips)`` mesh for multi-slice deployments.
+
+    The ``slices`` axis crosses pod-slice boundaries and therefore rides
+    **DCN**; ``hosts``/``chips`` stay inside a slice on **ICI** — so
+    collectives scoped per axis measure exactly the fabric they name
+    (SURVEY.md §2.11: ICI for in-slice probes, DCN for cross-slice
+    aggregation). Slice membership comes from ``Device.slice_index`` where
+    the runtime exposes it (real multi-slice TPU); otherwise devices are
+    split into ``n_slices`` equal contiguous groups (virtual/test meshes).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    by_slice: dict = {}
+    for d in devices:
+        by_slice.setdefault(getattr(d, "slice_index", None), []).append(d)
+    if None in by_slice:
+        # no runtime slice info (CPU/virtual meshes): carve equal groups
+        n_slices = n_slices or 1
+        if len(devices) % n_slices:
+            raise ValueError(f"{len(devices)} devices do not split into {n_slices} slices")
+        per = len(devices) // n_slices
+        groups = [devices[i * per:(i + 1) * per] for i in range(n_slices)]
+    else:
+        # the runtime knows the real slice boundaries — config must agree,
+        # even for a single slice: carving one physical slice into fake
+        # "slices" would report DCN numbers measured over ICI links
+        if n_slices is not None and n_slices != len(by_slice):
+            raise ValueError(f"runtime reports {len(by_slice)} slices, config says {n_slices}")
+        groups = [by_slice[s] for s in sorted(by_slice)]
+        sizes = {len(g) for g in groups}
+        if len(sizes) != 1:
+            raise ValueError(f"ragged slice sizes {sorted(len(g) for g in groups)}")
+
+    # each slice group becomes a (hosts, chips) submesh, stacked on axis 0
+    subgrids = []
+    for group in groups:
+        sub = host_chip_mesh(group)
+        subgrids.append(np.asarray(sub.devices))
+    shapes = {g.shape for g in subgrids}
+    if len(shapes) != 1:
+        raise ValueError(f"slices have differing (hosts, chips) shapes: {sorted(shapes)}")
+    grid = np.stack(subgrids, axis=0)
+    return Mesh(grid, ("slices", "hosts", "chips"))

@@ -64,6 +64,15 @@ class ProbeAgent:
             from k8s_watcher_tpu.probe.links import run_link_probe
 
             links = run_link_probe(self.mesh, rtt_factor=self.config.probe_link_rtt_factor)
+        multislice = None
+        if self.config.probe_multislice_enabled:
+            from k8s_watcher_tpu.probe.multislice import run_multislice_probe
+
+            # the hybrid mesh has its own (slices, hosts, chips) shape —
+            # built from the runtime topology, not from self.mesh
+            multislice = run_multislice_probe(
+                n_slices=self.config.probe_multislice_slices or None
+            )
         hbm = None
         if self.config.probe_hbm_bytes > 0:
             from k8s_watcher_tpu.probe.hbm import run_hbm_probe
@@ -76,6 +85,7 @@ class ProbeAgent:
             mxu=mxu,
             hbm=hbm,
             links=links,
+            multislice=multislice,
             rtt_warn_ms=self.config.probe_rtt_warn_ms,
             duration_ms=1e3 * (time.monotonic() - t0),
         )

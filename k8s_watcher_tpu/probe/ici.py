@@ -52,7 +52,7 @@ class IciProbeResult:
         return dataclasses.asdict(self)
 
 
-def _timed(fn, x, iters: int) -> tuple:
+def timed(fn, x, iters: int) -> tuple:
     """(min, mean, max) seconds over ``iters`` fenced calls."""
     times = []
     for _ in range(iters):
@@ -91,7 +91,7 @@ def run_ici_probe(
         expected = (n + 1) / 2.0  # fixed point of chained psum(x)/n
         psum_correct = bool(np.allclose(np.asarray(result)[0], expected))
 
-        rtt_min, rtt_mean, rtt_max = _timed(psum, x, iters)
+        rtt_min, rtt_mean, rtt_max = timed(psum, x, iters)
         rtt_min, rtt_mean, rtt_max = (t / inner_iters for t in (rtt_min, rtt_mean, rtt_max))
 
         bw_gbps = 0.0
@@ -99,7 +99,7 @@ def run_ici_probe(
             bw_fn = make_allreduce_bandwidth_probe(mesh, payload_bytes, fault)
             payload = bandwidth_probe_input(mesh, payload_bytes)
             jax.block_until_ready(bw_fn(payload))  # compile
-            bw_min, _, _ = _timed(bw_fn, payload, max(3, iters // 3))
+            bw_min, _, _ = timed(bw_fn, payload, max(3, iters // 3))
             bw_gbps = allreduce_bus_bandwidth_gbps(payload_bytes, n, bw_min)
 
         return IciProbeResult(
@@ -156,7 +156,7 @@ def run_mxu_probe(
         b = jax.device_put(jax.random.normal(jax.random.fold_in(key, 1), (size, size), dtype=jnp.bfloat16), device)
         out = jax.block_until_ready(step(a, b))  # compile
         finite = bool(jnp.isfinite(out.astype(jnp.float32)).all())
-        tmin, tmean, tmax = _timed(lambda ab: step(*ab), (a, b), iters)
+        tmin, tmean, tmax = timed(lambda ab: step(*ab), (a, b), iters)
         tflops = 2.0 * size**3 * inner_iters / tmin / 1e12
         return {
             "ok": finite,

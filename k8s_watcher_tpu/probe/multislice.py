@@ -1,0 +1,126 @@
+"""Cross-slice (DCN) aggregation probe.
+
+SURVEY.md §2.11 / §5: the TPU substitute for the reference's absent
+distributed-communication backend is XLA collectives — **ICI** inside a pod
+slice, **DCN** across slices. This prober runs over the hybrid
+``(slices, hosts, chips)`` mesh (parallel/mesh.py:hybrid_slice_mesh):
+
+- a hierarchical psum (per-slice over ICI, then cross-slice over DCN)
+  whose per-slice partial sums localize a bad contribution to its slice;
+- chained subset-axis psums that time the ICI-only path and the full
+  ICI+DCN path separately, so ``dcn_overhead_ms = t(all) - t(ici)`` is the
+  cross-slice fabric's own cost — the number that blows up when DCN (not
+  ICI) is degraded.
+
+Single-slice deployments degenerate cleanly: one slice, no DCN hop,
+``dcn_overhead_ms`` ~ 0.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from k8s_watcher_tpu.faults.ici import IciFaultSpec
+from k8s_watcher_tpu.parallel.collectives import (
+    make_hierarchical_probe,
+    make_subaxis_psum_probe,
+    psum_probe_input,
+)
+from k8s_watcher_tpu.parallel.mesh import hybrid_slice_mesh
+from k8s_watcher_tpu.probe.ici import timed
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class MultiSliceProbeResult:
+    ok: bool
+    n_slices: int
+    devices_per_slice: int
+    per_slice_sums: List[float]
+    suspect_slices: List[int]  # slice indices whose partial sum deviates
+    ici_rtt_ms: float  # chained psum over (hosts, chips) only
+    total_rtt_ms: float  # chained psum over all axes (ICI + DCN)
+    dcn_overhead_ms: float  # total - ici, clamped at 0
+    compile_ms: float
+    error: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def run_multislice_probe(
+    mesh=None,
+    *,
+    n_slices: Optional[int] = None,
+    iters: int = 5,
+    inner_iters: int = 8,
+    fault: Optional[IciFaultSpec] = None,
+) -> MultiSliceProbeResult:
+    """Correctness + localization via the hierarchical psum, ICI vs DCN
+    latency via subset-axis chained psums. ``mesh`` defaults to
+    :func:`hybrid_slice_mesh` (slice membership from the runtime, or
+    ``n_slices`` equal groups on virtual meshes)."""
+    try:
+        if mesh is None:
+            mesh = hybrid_slice_mesh(n_slices=n_slices)
+        n_sl = mesh.shape["slices"]
+        per_slice_devices = mesh.size // n_sl
+
+        t0 = time.perf_counter()
+        hier = make_hierarchical_probe(mesh, fault)
+        ones = jax.device_put(
+            jnp.ones((mesh.size,), dtype=jnp.float32),
+            NamedSharding(mesh, P(tuple(mesh.axis_names))),
+        )
+        per_slice, global_sum = jax.block_until_ready(hier(ones))
+
+        ici_fn = make_subaxis_psum_probe(mesh, tuple(mesh.axis_names[1:]), inner_iters, fault)
+        all_fn = make_subaxis_psum_probe(mesh, tuple(mesh.axis_names), inner_iters, fault)
+        x = psum_probe_input(mesh)
+        jax.block_until_ready(ici_fn(x))
+        jax.block_until_ready(all_fn(x))
+        compile_ms = 1e3 * (time.perf_counter() - t0)
+
+        per_slice = [float(v) for v in np.asarray(per_slice).ravel()]
+        expected = float(per_slice_devices)
+        suspect = [
+            i for i, v in enumerate(per_slice)
+            if abs(v - expected) > 1e-3 * max(1.0, expected)
+        ]
+        global_ok = abs(float(np.asarray(global_sum).ravel()[0]) - mesh.size) <= 1e-3 * mesh.size
+
+        ici_s = timed(ici_fn, x, iters)[0] / inner_iters
+        total_s = timed(all_fn, x, iters)[0] / inner_iters
+
+        if suspect:
+            logger.warning(
+                "Multi-slice probe: per-slice sums %s deviate from %.1f in slices %s",
+                per_slice, expected, suspect,
+            )
+        return MultiSliceProbeResult(
+            ok=not suspect and global_ok,
+            n_slices=n_sl,
+            devices_per_slice=per_slice_devices,
+            per_slice_sums=per_slice,
+            suspect_slices=suspect,
+            ici_rtt_ms=1e3 * ici_s,
+            total_rtt_ms=1e3 * total_s,
+            dcn_overhead_ms=max(0.0, 1e3 * (total_s - ici_s)),
+            compile_ms=compile_ms,
+        )
+    except Exception as exc:
+        logger.error("Multi-slice probe failed: %s", exc)
+        return MultiSliceProbeResult(
+            ok=False, n_slices=0, devices_per_slice=0, per_slice_sums=[],
+            suspect_slices=[], ici_rtt_ms=-1.0, total_rtt_ms=-1.0,
+            dcn_overhead_ms=-1.0, compile_ms=0.0, error=str(exc),
+        )

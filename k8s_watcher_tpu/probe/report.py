@@ -19,6 +19,7 @@ class ProbeReport:
     mxu: Optional[Dict[str, Any]] = None
     hbm: Optional[Dict[str, Any]] = None
     links: Optional[Any] = None  # probe.links.LinkProbeResult
+    multislice: Optional[Any] = None  # probe.multislice.MultiSliceProbeResult
     rtt_warn_ms: float = 50.0
     duration_ms: float = 0.0
 
@@ -40,6 +41,8 @@ class ProbeReport:
             return False
         if self.links is not None and not self.links.ok:
             return False
+        if self.multislice is not None and not self.multislice.ok:
+            return False
         return True
 
     def to_payload(self) -> Dict[str, Any]:
@@ -54,6 +57,7 @@ class ProbeReport:
             "mxu": self.mxu,
             "hbm": self.hbm,
             "links": self.links.to_dict() if self.links is not None else None,
+            "multislice": self.multislice.to_dict() if self.multislice is not None else None,
             "duration_ms": self.duration_ms,
             "event_timestamp": datetime.now(timezone.utc).isoformat(),
         }

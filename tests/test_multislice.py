@@ -1,0 +1,147 @@
+"""Cross-slice DCN aggregation tests on the virtual 8-device CPU mesh:
+hybrid (slices, hosts, chips) mesh construction, hierarchical ICI-then-DCN
+psum, slice-granularity fault localization (SURVEY.md §2.11 — the TPU
+substitute for the reference's absent distributed backend)."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from k8s_watcher_tpu.config.schema import TpuConfig
+from k8s_watcher_tpu.faults.ici import IciFaultSpec
+from k8s_watcher_tpu.parallel.collectives import (
+    make_hierarchical_probe,
+    make_subaxis_psum_probe,
+    psum_probe_input,
+)
+from k8s_watcher_tpu.parallel.mesh import hybrid_slice_mesh
+from k8s_watcher_tpu.probe.multislice import run_multislice_probe
+from k8s_watcher_tpu.probe.report import ProbeReport
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return hybrid_slice_mesh(n_slices=2)
+
+
+class TestHybridMesh:
+    def test_axes_and_shape(self, mesh):
+        assert mesh.axis_names == ("slices", "hosts", "chips")
+        assert mesh.shape["slices"] == 2
+        assert mesh.size == 8
+
+    def test_single_slice_degenerate(self):
+        m = hybrid_slice_mesh(n_slices=1)
+        assert m.shape["slices"] == 1 and m.size == 8
+
+    def test_four_slices(self):
+        m = hybrid_slice_mesh(n_slices=4)
+        assert m.shape["slices"] == 4 and m.shape["chips"] == 2
+
+    def test_indivisible_rejected(self):
+        with pytest.raises(ValueError):
+            hybrid_slice_mesh(n_slices=3)
+
+    def test_runtime_slice_count_wins_over_config(self):
+        # a real runtime reporting ONE slice must not be carved into fake
+        # "slices" (DCN numbers would be measured over ICI links)
+        class FakeDev:
+            slice_index = 0
+            process_index = 0
+
+            def __init__(self, i):
+                self.id = i
+
+        with pytest.raises(ValueError, match="runtime reports 1 slices"):
+            hybrid_slice_mesh([FakeDev(i) for i in range(8)], n_slices=2)
+
+    def test_slices_partition_devices(self, mesh):
+        ids = sorted(d.id for d in mesh.devices.flatten())
+        assert ids == sorted(d.id for d in jax.devices())
+
+
+class TestHierarchicalProbe:
+    def test_sums(self, mesh):
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        hier = make_hierarchical_probe(mesh)
+        ones = jax.device_put(
+            jnp.ones((8,), dtype=jnp.float32),
+            NamedSharding(mesh, P(("slices", "hosts", "chips"))),
+        )
+        per_slice, total = jax.block_until_ready(hier(ones))
+        assert list(np.asarray(per_slice)) == [4.0, 4.0]
+        assert float(np.asarray(total).ravel()[0]) == 8.0
+
+    def test_wants_slices_axis(self):
+        from k8s_watcher_tpu.parallel.mesh import host_chip_mesh
+
+        with pytest.raises(ValueError):
+            make_hierarchical_probe(host_chip_mesh())
+
+
+class TestSubaxisPsum:
+    def test_ici_only_fixed_point(self, mesh):
+        # reducing only (hosts, chips) leaves one mean per slice
+        fn = make_subaxis_psum_probe(mesh, ("hosts", "chips"), inner_iters=4)
+        out = np.asarray(jax.block_until_ready(fn(psum_probe_input(mesh))))
+        # input 1..8 split into slices [1..4], [5..8] -> means 2.5, 6.5
+        assert out.shape == (2,)
+        assert list(out) == [2.5, 6.5]
+
+    def test_all_axes_matches_global_mean(self, mesh):
+        fn = make_subaxis_psum_probe(mesh, ("slices", "hosts", "chips"), inner_iters=4)
+        out = np.asarray(jax.block_until_ready(fn(psum_probe_input(mesh))))
+        assert float(out.ravel()[0]) == pytest.approx(4.5)  # mean of 1..8
+
+    def test_bad_axes_rejected(self, mesh):
+        with pytest.raises(ValueError):
+            make_subaxis_psum_probe(mesh, ("nope",))
+
+
+class TestMultiSliceProbe:
+    def test_healthy(self, mesh):
+        r = run_multislice_probe(mesh, iters=3, inner_iters=4)
+        assert r.ok and r.error is None
+        assert r.n_slices == 2 and r.devices_per_slice == 4
+        assert r.per_slice_sums == [4.0, 4.0]
+        assert not r.suspect_slices
+        assert r.ici_rtt_ms > 0 and r.total_rtt_ms > 0 and r.dcn_overhead_ms >= 0
+        json.dumps(r.to_dict())
+
+    def test_corrupt_device_localized_to_slice(self, mesh):
+        # device 6 lives in slice 1 of the 2-slice virtual mesh
+        r = run_multislice_probe(mesh, iters=2, inner_iters=4,
+                                 fault=IciFaultSpec(corrupt_device_id=6))
+        assert not r.ok
+        assert r.suspect_slices == [1]
+
+    def test_corrupt_device_slice0(self, mesh):
+        r = run_multislice_probe(mesh, iters=2, inner_iters=4,
+                                 fault=IciFaultSpec(corrupt_device_id=1))
+        assert r.suspect_slices == [0]
+
+    def test_default_mesh_single_slice(self):
+        r = run_multislice_probe(iters=2, inner_iters=2)
+        assert r.ok and r.n_slices == 1
+
+    def test_report_integration(self, mesh):
+        devices_ok = {"platform_mismatch": 0, "missing_local_devices": 0,
+                      "healthy_devices": 8, "visible_devices": 8}
+        bad = run_multislice_probe(mesh, iters=2, inner_iters=2,
+                                   fault=IciFaultSpec(corrupt_device_id=3))
+        report = ProbeReport(environment="test", devices=devices_ok, multislice=bad)
+        assert not report.healthy
+        assert report.to_payload()["multislice"]["suspect_slices"] == [0]
+
+
+def test_config_multislice_keys():
+    cfg = TpuConfig.from_raw(
+        {"probe": {"multislice_enabled": True, "multislice_slices": 4}}
+    )
+    assert cfg.probe_multislice_enabled is True
+    assert cfg.probe_multislice_slices == 4
+    assert TpuConfig.from_raw({}).probe_multislice_enabled is False
