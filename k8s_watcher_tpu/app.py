@@ -36,6 +36,7 @@ def build_notifier(config: AppConfig) -> ClusterApiClient:
         pod_update_endpoint=c.pod_update_endpoint,
         health_endpoint=c.health_endpoint,
         retry=c.retry,
+        verify_tls=c.verify_tls,
     )
 
 

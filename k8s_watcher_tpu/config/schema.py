@@ -196,12 +196,14 @@ class ClusterApiConfig:
     # stall the watch stream — prerequisite for the <1s p50 target)
     queue_capacity: int = 1024
     workers: int = 2
+    verify_tls: bool = True  # for https endpoints with self-signed certs
 
     @classmethod
     def from_raw(cls, raw: Mapping[str, Any]) -> "ClusterApiConfig":
         _check_known(
             raw,
-            ("base_url", "auth", "endpoints", "timeout", "retry", "queue_capacity", "workers"),
+            ("base_url", "auth", "endpoints", "timeout", "retry", "queue_capacity", "workers",
+             "verify_tls"),
             "clusterapi",
         )
         auth = raw.get("auth") or {}
@@ -219,6 +221,7 @@ class ClusterApiConfig:
             retry=RetryPolicy.from_raw(raw.get("retry") or {}, "clusterapi.retry", delay_default=2.0),
             queue_capacity=_opt_int(raw, "queue_capacity", "clusterapi", 1024),
             workers=_opt_int(raw, "workers", "clusterapi", 2),
+            verify_tls=_opt_bool(raw, "verify_tls", "clusterapi", True),
         )
 
 

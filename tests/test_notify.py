@@ -171,3 +171,66 @@ class TestDispatcher:
         assert d.drain(5.0)
         assert len(calls) == 2
         d.stop()
+
+
+class TestPersistentConnection:
+    def test_keepalive_reuse_across_posts(self, api_server):
+        server, url = api_server
+        client = ClusterApiClient(url)
+        for i in range(5):
+            assert client.update_pod_status({"name": f"pod-{i}"}) is True
+        assert len(server.received) == 5
+
+    def test_stale_keepalive_resent_transparently(self):
+        # Serve exactly ONE request on a raw socket, then close the
+        # keep-alive connection server-side; bring a real server up on the
+        # same port. The client's cached connection is now idle-closed: the
+        # second POST must transparently resend on a fresh connection
+        # without consuming the retry policy (max_attempts=1).
+        import socket
+
+        lsock = socket.socket()
+        lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(1)
+        port = lsock.getsockname()[1]
+
+        def serve_once():
+            conn, _ = lsock.accept()
+            conn.recv(65536)
+            body = b'{"ok":true}'
+            conn.sendall(
+                b"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+                + f"Content-Length: {len(body)}\r\n\r\n".encode()
+                + body
+            )
+            conn.close()
+            lsock.close()
+
+        threading.Thread(target=serve_once, daemon=True).start()
+        client = ClusterApiClient(
+            f"http://127.0.0.1:{port}", retry=RetryPolicy(max_attempts=1, delay_seconds=0.0)
+        )
+        assert client.update_pod_status({"name": "before"}) is True
+
+        server2 = ThreadingHTTPServer(("127.0.0.1", port), _ApiSink)
+        server2.received, server2.script, server2.lock = [], [], threading.Lock()
+        server2.daemon_threads = True
+        threading.Thread(target=server2.serve_forever, daemon=True).start()
+        try:
+            assert client.update_pod_status({"name": "after"}) is True
+            assert [r["payload"]["name"] for r in server2.received] == ["after"]
+        finally:
+            server2.shutdown()
+            server2.server_close()
+
+    def test_bad_scheme_rejected(self):
+        with pytest.raises(ValueError, match="http"):
+            ClusterApiClient("ftp://example.com")
+
+
+def test_verify_tls_config_key():
+    from k8s_watcher_tpu.config.schema import ClusterApiConfig
+
+    assert ClusterApiConfig.from_raw({"verify_tls": False}).verify_tls is False
+    assert ClusterApiConfig.from_raw({}).verify_tls is True
