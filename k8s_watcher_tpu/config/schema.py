@@ -284,6 +284,9 @@ class TpuConfig:
     # cross-slice DCN aggregation probe (probe/multislice.py)
     probe_multislice_enabled: bool = False
     probe_multislice_slices: int = 0  # 0 = infer from Device.slice_index
+    # SURVEY.md §5 tracing substitute: when set, each probe cycle is wrapped
+    # in jax.profiler.trace(dir) producing a TensorBoard-loadable trace
+    probe_profile_dir: Optional[str] = None
 
     @classmethod
     def from_raw(cls, raw: Mapping[str, Any]) -> "TpuConfig":
@@ -308,7 +311,7 @@ class TpuConfig:
             probe,
             ("enabled", "interval_seconds", "payload_bytes", "rtt_warn_ms", "matmul_size",
              "hbm_bytes", "expected_chips_per_host", "links_enabled", "link_rtt_factor",
-             "multislice_enabled", "multislice_slices"),
+             "multislice_enabled", "multislice_slices", "profile_dir"),
             "tpu.probe",
         )
         return cls(
@@ -327,6 +330,7 @@ class TpuConfig:
             probe_link_rtt_factor=_opt_num(probe, "link_rtt_factor", "tpu.probe", 3.0),
             probe_multislice_enabled=_opt_bool(probe, "multislice_enabled", "tpu.probe", False),
             probe_multislice_slices=_opt_int(probe, "multislice_slices", "tpu.probe", 0),
+            probe_profile_dir=_opt_str(probe, "profile_dir", "tpu.probe", None),
         )
 
 

@@ -51,7 +51,39 @@ class ProbeAgent:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
+    # traces retained under profile_dir; each probe cycle writes one run
+    # dir, so without a cap a 30s-interval agent writes ~2880/day and
+    # eventually fills the disk of the node it is meant to keep healthy
+    MAX_PROFILE_RUNS = 20
+
     def run_once(self) -> ProbeReport:
+        """One probe cycle; wrapped in a ``jax.profiler`` trace when
+        ``tpu.probe.profile_dir`` is set (SURVEY.md §5: the tracing
+        subsystem the reference lacked — each cycle becomes a
+        TensorBoard-loadable trace of the device programs)."""
+        if self.config.probe_profile_dir:
+            with jax.profiler.trace(self.config.probe_profile_dir):
+                report = self._run_once_inner()
+            self._prune_profiles(self.config.probe_profile_dir)
+            return report
+        return self._run_once_inner()
+
+    def _prune_profiles(self, profile_dir: str) -> None:
+        """Keep only the newest MAX_PROFILE_RUNS trace run-dirs."""
+        import shutil
+        from pathlib import Path
+
+        runs_root = Path(profile_dir) / "plugins" / "profile"
+        if not runs_root.is_dir():
+            return
+        runs = sorted((d for d in runs_root.iterdir() if d.is_dir()), key=lambda d: d.name)
+        for stale in runs[: -self.MAX_PROFILE_RUNS]:
+            try:
+                shutil.rmtree(stale)
+            except OSError as exc:
+                logger.warning("Could not prune old probe trace %s: %s", stale, exc)
+
+    def _run_once_inner(self) -> ProbeReport:
         t0 = time.monotonic()
         devices = enumerate_devices(
             expected_per_host=self.config.expected_chips_per_host,

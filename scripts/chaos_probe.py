@@ -1,0 +1,114 @@
+#!/usr/bin/env python3
+"""Chaos drill: inject an ICI fault and check the probes localize it.
+
+Operator tooling for the fault-injection hooks (faults/ici.py): pick a
+device to degrade, run the aggregate + per-link + multi-slice probes with
+the fault injected, and report whether each prober (a) detected it and
+(b) fingered the right device/slice. Run on real hardware to validate the
+detection thresholds for a topology before trusting them in production;
+run with --cpu-mesh N for a hardware-free drill.
+
+Examples:
+    python scripts/chaos_probe.py --cpu-mesh 8 --slow-device 3
+    python scripts/chaos_probe.py --cpu-mesh 8 --corrupt-device 5 --slices 2
+    python scripts/chaos_probe.py --slow-device 0      # real attached TPU
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--slow-device", type=int, default=None, help="device id to make slow")
+    parser.add_argument("--corrupt-device", type=int, default=None, help="device id to corrupt")
+    parser.add_argument("--slow-iters", type=int, default=200, help="injected delay (chained matmuls)")
+    parser.add_argument("--slices", type=int, default=0, help="also run the multi-slice probe with N virtual slices")
+    parser.add_argument("--cpu-mesh", type=int, default=0, metavar="N",
+                        help="run on an N-device virtual CPU mesh instead of attached hardware")
+    args = parser.parse_args()
+
+    if args.cpu_mesh:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.cpu_mesh}"
+        ).strip()
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    if args.cpu_mesh:
+        # the env var alone is NOT enough where a hardware platform plugin
+        # is pinned (it wins over JAX_PLATFORMS); the config update is the
+        # authoritative override — same belt-and-braces as tests/conftest.py
+        jax.config.update("jax_platforms", "cpu")
+
+    from k8s_watcher_tpu.faults.ici import IciFaultSpec
+    from k8s_watcher_tpu.probe.ici import run_ici_probe
+    from k8s_watcher_tpu.probe.links import run_link_probe
+
+    fault = IciFaultSpec(
+        slow_device_id=args.slow_device,
+        slow_iters=args.slow_iters,
+        corrupt_device_id=args.corrupt_device,
+    )
+    injected = [d for d in (args.slow_device, args.corrupt_device) if d is not None]
+    if not injected:
+        print("no fault requested; pass --slow-device and/or --corrupt-device", file=sys.stderr)
+        return 2
+
+    result = {"injected": fault.__dict__, "n_devices": len(jax.devices())}
+
+    baseline = run_ici_probe(payload_bytes=0, iters=3, inner_iters=4)
+    faulted = run_ici_probe(payload_bytes=0, iters=3, inner_iters=4, fault=fault)
+    result["aggregate"] = {
+        "detected": (not faulted.ok) or faulted.psum_rtt_ms > 3 * max(baseline.psum_rtt_ms, 1e-6),
+        "baseline_rtt_ms": round(baseline.psum_rtt_ms, 4),
+        "faulted_rtt_ms": round(faulted.psum_rtt_ms, 4),
+        "checksum_ok": faulted.psum_correct,
+    }
+
+    links = run_link_probe(iters=3, inner_iters=4, fault=fault)
+    result["links"] = {
+        "suspect_devices": links.suspect_devices,
+        "suspect_links": [s["name"] for s in links.suspect_links],
+        "localized_correctly": sorted(links.suspect_devices) == sorted(set(injected)),
+    }
+
+    ok = result["aggregate"]["detected"] and result["links"]["localized_correctly"]
+
+    if args.slices > 1:
+        from k8s_watcher_tpu.parallel.mesh import hybrid_slice_mesh
+        from k8s_watcher_tpu.probe.multislice import run_multislice_probe
+
+        ms = run_multislice_probe(n_slices=args.slices, iters=3, inner_iters=4, fault=fault)
+        result["multislice"] = {
+            "suspect_slices": ms.suspect_slices,
+            "per_slice_sums": ms.per_slice_sums,
+            "dcn_overhead_ms": round(ms.dcn_overhead_ms, 4),
+        }
+        if args.corrupt_device is not None:
+            # a slow chip doesn't perturb checksums, so only corruption has
+            # a slice-level localization contract to grade
+            hmesh = hybrid_slice_mesh(n_slices=args.slices)
+            expected_slices = [
+                s for s in range(args.slices)
+                if args.corrupt_device in [d.id for d in hmesh.devices[s].flatten()]
+            ]
+            localized = ms.suspect_slices == expected_slices
+            result["multislice"]["localized_correctly"] = localized
+            ok = ok and localized
+
+    print(json.dumps(result, indent=2))
+    print(f"\nchaos drill: {'PASS — fault detected and localized' if ok else 'FAIL — fault missed or mislocalized'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

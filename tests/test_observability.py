@@ -112,3 +112,46 @@ class TestHbmProbe:
         )
         agent = ProbeAgent(config, environment="development", sink=lambda n: None, expected_platform="cpu")
         assert agent.run_once().hbm is None
+
+
+class TestProbeProfiling:
+    def test_profile_dir_produces_trace(self, tmp_path):
+        from k8s_watcher_tpu.config.schema import TpuConfig
+        from k8s_watcher_tpu.probe.agent import ProbeAgent
+
+        cfg = TpuConfig.from_raw(
+            {"probe": {"enabled": True, "payload_bytes": 0, "hbm_bytes": 0,
+                       "matmul_size": 128, "profile_dir": str(tmp_path)}}
+        )
+        agent = ProbeAgent(cfg, environment="test", sink=lambda n: None,
+                           expected_platform="cpu")
+        report = agent.run_once()
+        assert report.healthy
+        # jax.profiler.trace writes plugins/profile/<run>/ under the dir
+        traces = list(tmp_path.rglob("*.xplane.pb"))
+        assert traces, f"no trace files under {tmp_path}"
+
+    def test_profile_dir_config_key(self):
+        from k8s_watcher_tpu.config.schema import TpuConfig
+
+        assert TpuConfig.from_raw({}).probe_profile_dir is None
+        cfg = TpuConfig.from_raw({"probe": {"profile_dir": "/tmp/x"}})
+        assert cfg.probe_profile_dir == "/tmp/x"
+
+    def test_profile_traces_pruned(self, tmp_path, monkeypatch):
+        from k8s_watcher_tpu.config.schema import TpuConfig
+        from k8s_watcher_tpu.probe.agent import ProbeAgent
+
+        cfg = TpuConfig.from_raw(
+            {"probe": {"enabled": True, "payload_bytes": 0, "hbm_bytes": 0,
+                       "matmul_size": 128, "profile_dir": str(tmp_path)}}
+        )
+        agent = ProbeAgent(cfg, environment="test", sink=lambda n: None,
+                           expected_platform="cpu")
+        monkeypatch.setattr(ProbeAgent, "MAX_PROFILE_RUNS", 1)
+        agent.run_once()
+        import time as _time
+        _time.sleep(1.1)  # run dirs are second-granularity timestamps
+        agent.run_once()
+        runs = [d for d in (tmp_path / "plugins" / "profile").iterdir() if d.is_dir()]
+        assert len(runs) == 1
