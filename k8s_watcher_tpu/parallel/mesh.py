@@ -31,8 +31,11 @@ def initialize_multihost(
     Args default from the standard JAX env vars / GKE JobSet injection;
     returns False (no-op) when running single-process. Safe to call twice.
     """
-    if jax.process_count() > 1:
-        return True  # already initialized
+    # NB: must not touch jax.process_count() (or any device API) here — that
+    # would initialize the backend and make distributed.initialize fail.
+    is_init = getattr(jax.distributed, "is_initialized", None)  # absent on old jax
+    if is_init is not None and is_init():
+        return True
     coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
     if coordinator_address is None:
         return False

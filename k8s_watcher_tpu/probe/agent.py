@@ -129,8 +129,12 @@ class ProbeAgent:
         return report
 
     def _report(self, report: ProbeReport) -> None:
-        # only one process per slice reports (others just join collectives)
-        if jax.process_index() == 0:
+        # Process 0 reports for the slice; every OTHER process stays quiet
+        # unless its own view is unhealthy. Local liveness only runs on a
+        # host's own addressable chips (probe/device.py), so a dead chip on
+        # host k is only ever observed by process k — gating all reporting
+        # on process 0 would detect that fault and then drop it.
+        if jax.process_index() == 0 or not report.healthy:
             self.sink(Notification(report.to_payload(), time.monotonic(), kind="probe"))
 
     def _loop(self) -> None:

@@ -68,9 +68,13 @@ def enumerate_devices(
     devices = list(devices if devices is not None else jax.devices())
     entries: List[Dict[str, Any]] = []
     healthy = 0
+    process_index = jax.process_index()
     for device in devices:
         entry = _device_entry(device)
-        if check_liveness:
+        if check_liveness and device.process_index == process_index:
+            # only local devices are addressable; each host vouches for its
+            # own chips (remote chips stay alive=None — their host's probe
+            # covers them, and the collective probes cover the links)
             entry["alive"] = _device_alive(device)
         else:
             entry["alive"] = None
@@ -78,9 +82,9 @@ def enumerate_devices(
             healthy += 1
         entries.append(entry)
 
-    local = [d for d in devices if d.process_index == jax.process_index()]
+    local = [d for d in devices if d.process_index == process_index]
     result: Dict[str, Any] = {
-        "process_index": jax.process_index(),
+        "process_index": process_index,
         "process_count": jax.process_count(),
         "visible_devices": len(devices),
         "local_devices": len(local),

@@ -139,7 +139,9 @@ def run_mxu_probe(
     2·size³·inner_iters / t. A health signal, not a benchmark.
     """
     try:
-        device = device or jax.devices()[0]
+        # first *local* device — jax.devices()[0] is remote (unaddressable)
+        # on any multi-host process other than process 0
+        device = device or jax.local_devices()[0]
 
         @jax.jit
         def step(a, b):

@@ -1,0 +1,83 @@
+"""Worker process for the true multi-process multi-host probe test.
+
+Launched by tests/test_multihost.py, one process per simulated TPU host
+(SURVEY.md §4 test tier 4: "multi-host ICI psum test" — the reference has no
+multi-node testing at all). Each worker:
+
+- joins the cluster via the framework's own ``initialize_multihost``
+  (k8s_watcher_tpu/parallel/mesh.py) — the same entry a real per-host probe
+  agent uses on a TPU slice (scripts/probe_agent.py);
+- builds the ``(hosts, chips)`` mesh over the *global* device set;
+- runs a full ``ProbeAgent`` cycle (ICI psum RTT + MXU) over that mesh;
+- applies the agent's process-0-only report gating;
+- writes its observations to ``<out_dir>/result_<pid>.json`` for the parent
+  test to assert on.
+
+Runs on CPU with gloo cross-process collectives — 2 virtual chips per
+process, so N processes model an N-host slice with 2N chips.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    coordinator, num_procs_s, pid_s, out_dir = sys.argv[1:5]
+    num_procs, pid = int(num_procs_s), int(pid_s)
+
+    # Must be set before jax import; REPLACE (not append) so the parent
+    # test-suite's own XLA_FLAGS can't leak a different device count in.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["JAX_CPU_COLLECTIVES_IMPLEMENTATION"] = "gloo"
+
+    import jax
+
+    from k8s_watcher_tpu.config.schema import TpuConfig
+    from k8s_watcher_tpu.parallel.mesh import host_chip_mesh, initialize_multihost
+    from k8s_watcher_tpu.probe.agent import ProbeAgent
+
+    initialized = initialize_multihost(coordinator, num_procs, pid)
+
+    mesh = host_chip_mesh()  # groups by process_index -> (hosts, chips)
+
+    reported = []
+    agent = ProbeAgent(
+        TpuConfig(
+            backend="tpu",
+            probe_enabled=True,
+            probe_payload_bytes=1 << 16,
+            probe_matmul_size=128,
+            probe_hbm_bytes=0,
+        ),
+        environment="multihost-test",
+        sink=reported.append,
+        mesh=mesh,
+        expected_platform="cpu",
+    )
+    report = agent.run_once()
+    agent._report(report)  # process-0-only gating under test
+
+    result = {
+        "pid": pid,
+        "initialized": initialized,
+        "process_count": jax.process_count(),
+        "process_index": jax.process_index(),
+        "local_devices": jax.local_device_count(),
+        "global_devices": jax.device_count(),
+        "mesh_shape": list(mesh.devices.shape),
+        "ici": report.ici.to_dict() if report.ici else None,
+        "mxu_ok": bool(report.mxu and report.mxu.get("ok")),
+        "healthy": report.healthy,
+        "reported": len(reported),
+        "payload_event_type": reported[0].payload["event_type"] if reported else None,
+    }
+    out = os.path.join(out_dir, f"result_{pid}.json")
+    with open(out + ".tmp", "w") as f:
+        json.dump(result, f)
+    os.replace(out + ".tmp", out)
+
+
+if __name__ == "__main__":
+    main()

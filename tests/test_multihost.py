@@ -1,0 +1,117 @@
+"""True multi-process multi-host probe test (SURVEY.md §4 tier 4).
+
+The other probe tests shard over a virtual single-process mesh; this one
+spawns real separate Python processes joined through
+``jax.distributed.initialize`` (the framework's ``initialize_multihost``)
+with gloo cross-process CPU collectives — the closest a hardware-free CI
+tier can get to a v5e-16 multi-host slice (BASELINE.md acceptance config #4).
+
+It validates the multi-host contracts the in-process tests cannot:
+- the coordinator handshake and global device visibility (N procs × 2 chips),
+- ``host_chip_mesh`` grouping by ``process_index`` into (hosts, chips),
+- a psum that actually crosses process boundaries and sums all chips,
+- the probe agent's process-0-only reporting discipline.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+WORKER = Path(__file__).resolve().parent / "multihost_worker.py"
+N_PROCS = 2
+CHIPS_PER_PROC = 2
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker_env() -> dict:
+    env = dict(os.environ)
+    # Drop site hooks that pin JAX to a hardware platform plugin (they would
+    # override the worker's JAX_PLATFORMS=cpu); keep the repo importable.
+    env["PYTHONPATH"] = str(REPO_ROOT)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    return env
+
+
+@pytest.fixture(scope="module")
+def worker_results(tmp_path_factory):
+    out_dir = tmp_path_factory.mktemp("multihost")
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = _worker_env()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(WORKER), coordinator, str(N_PROCS), str(pid), str(out_dir)],
+            env=env,
+            cwd=str(REPO_ROOT),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(N_PROCS)
+    ]
+    outputs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outputs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outputs):
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+    results = {}
+    for pid in range(N_PROCS):
+        path = out_dir / f"result_{pid}.json"
+        assert path.exists(), f"worker {pid} wrote no result"
+        results[pid] = json.loads(path.read_text())
+    return results
+
+
+def test_global_device_visibility(worker_results):
+    for pid, r in worker_results.items():
+        assert r["initialized"], f"proc {pid} did not join the cluster"
+        assert r["process_count"] == N_PROCS
+        assert r["process_index"] == pid
+        assert r["local_devices"] == CHIPS_PER_PROC
+        assert r["global_devices"] == N_PROCS * CHIPS_PER_PROC
+
+
+def test_mesh_groups_hosts_by_process(worker_results):
+    for r in worker_results.values():
+        assert r["mesh_shape"] == [N_PROCS, CHIPS_PER_PROC]
+
+
+def test_psum_crosses_process_boundary(worker_results):
+    for pid, r in worker_results.items():
+        ici = r["ici"]
+        assert ici is not None
+        assert ici["n_devices"] == N_PROCS * CHIPS_PER_PROC, (
+            f"proc {pid} psum only saw {ici['n_devices']} devices — collective "
+            "did not cross the process boundary"
+        )
+        assert ici["n_hosts"] == N_PROCS
+        assert ici["psum_correct"], f"proc {pid} psum numerically wrong"
+        assert ici["psum_rtt_ms"] > 0
+        assert r["mxu_ok"]
+        assert r["healthy"]
+
+
+def test_only_process_zero_reports(worker_results):
+    assert worker_results[0]["reported"] == 1
+    assert worker_results[0]["payload_event_type"] == "TPU_PROBE"
+    for pid in range(1, N_PROCS):
+        assert worker_results[pid]["reported"] == 0, (
+            f"proc {pid} reported too — duplicate slice reports upstream"
+        )

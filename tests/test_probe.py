@@ -157,3 +157,25 @@ class TestProbeAgentAndReport:
     def test_probe_failure_reported_not_raised(self):
         result = run_ici_probe(mesh="not-a-mesh")
         assert result.ok is False and result.error
+
+    def test_nonzero_process_reports_only_when_unhealthy(self, monkeypatch):
+        # a dead chip on host k is only observable by process k (liveness
+        # runs on addressable chips only), so non-zero processes must break
+        # their silence exactly when their local view is unhealthy
+        import k8s_watcher_tpu.probe.agent as agent_mod
+
+        got = []
+        agent = self.make_agent(sink=got.append)
+        healthy = agent.run_once()
+        unhealthy = agent.run_once()
+        unhealthy.rtt_warn_ms = -1.0  # force healthy=False
+
+        monkeypatch.setattr(agent_mod.jax, "process_index", lambda: 1)
+        agent._report(healthy)
+        assert got == [], "healthy non-zero process must stay quiet"
+        agent._report(unhealthy)
+        assert len(got) == 1 and got[0].payload["healthy"] is False
+
+        monkeypatch.setattr(agent_mod.jax, "process_index", lambda: 0)
+        agent._report(healthy)
+        assert len(got) == 2, "process 0 always reports"
