@@ -44,6 +44,7 @@ def build_source(
     config: AppConfig,
     checkpoint: Optional[CheckpointStore] = None,
     heartbeat=None,
+    metrics: Optional[MetricsRegistry] = None,
 ) -> WatchSource:
     """Pick the watch source for this environment.
 
@@ -72,6 +73,12 @@ def build_source(
     client = K8sClient(connection, request_timeout=config.kubernetes.request_timeout)
     version = client.get_api_version()
     logger.info("Successfully connected to Kubernetes API version: %s", version)
+    scanner = None
+    if config.tpu.prefilter:
+        from k8s_watcher_tpu.native.scanner import make_scanner
+
+        scanner = make_scanner(config.tpu.resource_key)
+        logger.info("Watch-frame prefilter: %s (%s)", type(scanner).__name__, config.tpu.resource_key)
     return KubernetesWatchSource(
         client,
         label_selector=config.watcher.label_selector,
@@ -79,6 +86,8 @@ def build_source(
         watch_timeout_seconds=config.kubernetes.watch_timeout_seconds,
         checkpoint=checkpoint,
         heartbeat=heartbeat,
+        scanner=scanner,
+        metrics=metrics,
     )
 
 
@@ -107,7 +116,7 @@ class WatcherApp:
             workers=config.clusterapi.workers,
             metrics=self.metrics,
         )
-        self.source = source or build_source(config, self.checkpoint, self.liveness.beat)
+        self.source = source or build_source(config, self.checkpoint, self.liveness.beat, self.metrics)
         self.slice_tracker = SliceTracker(
             config.environment,
             resource_key=config.tpu.resource_key,

@@ -266,6 +266,10 @@ class TpuConfig:
 
     backend: str = "tpu"  # "tpu" | "gpu" (gpu-compat mode filters nvidia.com/gpu)
     resource_key: str = "google.com/tpu"
+    # native watch-frame prefilter (native/scanner.py): skip json.loads for
+    # frames that cannot contain resource_key — pure speedup, no semantic
+    # change (the pipeline's TpuResourceFilter would drop them anyway)
+    prefilter: bool = True
     # GKE labels/annotations used for slice-topology inference
     topology_label: str = "cloud.google.com/gke-tpu-topology"
     accelerator_label: str = "cloud.google.com/gke-tpu-accelerator"
@@ -295,6 +299,7 @@ class TpuConfig:
             (
                 "backend",
                 "resource_key",
+                "prefilter",
                 "topology_label",
                 "accelerator_label",
                 "probe",
@@ -317,6 +322,7 @@ class TpuConfig:
         return cls(
             backend=backend,
             resource_key=_opt_str(raw, "resource_key", "tpu", default_key),
+            prefilter=_opt_bool(raw, "prefilter", "tpu", True),
             topology_label=_opt_str(raw, "topology_label", "tpu", cls.topology_label),
             accelerator_label=_opt_str(raw, "accelerator_label", "tpu", cls.accelerator_label),
             probe_enabled=_opt_bool(probe, "enabled", "tpu.probe", False),

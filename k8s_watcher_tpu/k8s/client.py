@@ -23,6 +23,7 @@ import logging
 from typing import Any, Dict, Iterator, List, Optional
 
 import requests
+import urllib3
 
 from k8s_watcher_tpu.k8s.kubeconfig import K8sConnection
 
@@ -109,9 +110,16 @@ class K8sClient:
         timeout_seconds: int = 300,
         allow_bookmarks: bool = True,
         label_selector: Optional[str] = None,
+        scanner=None,  # native.scanner.FrameScanner — hot-loop prefilter
     ) -> Iterator[Dict[str, Any]]:
         """Stream raw watch events (``{"type": ..., "object": ...}``) until
-        the server closes the bounded watch or an error occurs."""
+        the server closes the bounded watch or an error occurs.
+
+        With a ``scanner``, frames that provably cannot request the
+        accelerator resource are skipped WITHOUT a JSON parse and surface as
+        lightweight ``{"type": "PREFILTERED"}`` markers carrying only the
+        resourceVersion (the hot loop's dominant cost in a mostly-non-TPU
+        cluster is decoding pods the resource filter then discards)."""
         params: Dict[str, Any] = {"watch": "true", "timeoutSeconds": timeout_seconds}
         if resource_version:
             params["resourceVersion"] = resource_version
@@ -139,21 +147,103 @@ class K8sClient:
                 raise K8sApiError(
                     f"watch: HTTP {response.status_code}: {response.text[:300]}", status=response.status_code
                 )
-            for line in response.iter_lines():
-                if not line:
-                    continue
-                try:
-                    event = json.loads(line)
-                except json.JSONDecodeError as exc:
-                    raise K8sApiError(f"watch: malformed event frame: {line[:200]!r}") from exc
-                if event.get("type") == "ERROR":
-                    obj = event.get("object") or {}
-                    if obj.get("code") == 410:
-                        raise K8sGoneError(f"watch: {obj.get('message', '410 Gone')}", status=410)
-                    raise K8sApiError(f"watch: server error event: {obj}", status=obj.get("code"))
-                yield event
-        except requests.RequestException as exc:
+            yield from self._decode_watch_stream(response, scanner)
+        except (requests.RequestException, urllib3.exceptions.HTTPError, OSError) as exc:
+            # urllib3/socket errors surface directly on the raw-chunk fast
+            # path (iter_lines would have wrapped them in requests types)
             raise K8sApiError(f"watch stream broken: {exc}") from exc
         finally:
             if response is not None:
                 response.close()
+
+    # -- watch-stream decoding ---------------------------------------------
+
+    @staticmethod
+    def _parse_frame(line: bytes) -> Dict[str, Any]:
+        try:
+            event = json.loads(line)
+        except json.JSONDecodeError as exc:
+            raise K8sApiError(f"watch: malformed event frame: {line[:200]!r}") from exc
+        if event.get("type") == "ERROR":
+            obj = event.get("object") or {}
+            if obj.get("code") == 410:
+                raise K8sGoneError(f"watch: {obj.get('message', '410 Gone')}", status=410)
+            raise K8sApiError(f"watch: server error event: {obj}", status=obj.get("code"))
+        return event
+
+    @staticmethod
+    def _prefiltered_marker(resource_version: Optional[str], count: int = 1) -> Dict[str, Any]:
+        """rv-only stand-in for ``count`` consecutive skipped frames (only
+        the LAST resume version of a skipped run matters — rv is monotonic)."""
+        return {
+            "type": "PREFILTERED",
+            "count": count,
+            "object": {"metadata": {"resourceVersion": resource_version}},
+        }
+
+    def _decode_watch_stream(self, response, scanner) -> Iterator[Dict[str, Any]]:
+        """Turn the chunked HTTP body into watch events.
+
+        Three paths, fastest first:
+        - scanner with ``scan_chunk`` (native fastscan): whole received
+          chunks are frame-split and scanned in one C call; skipped frames'
+          bytes are never touched by the interpreter;
+        - per-frame scanner: iter_lines + scan before parse;
+        - no scanner: iter_lines + parse (reference-equivalent behavior).
+        """
+        if scanner is None:
+            for line in response.iter_lines():
+                if line:
+                    yield self._parse_frame(line)
+            return
+
+        # the raw-chunk path needs Transfer-Encoding: chunked (the real
+        # apiserver always streams watches that way): urllib3 then yields
+        # each transfer chunk as it lands. On a close-delimited body a
+        # fixed-size read would block until the buffer fills, so fall back
+        # to the per-frame path there.
+        scan_chunk = getattr(scanner, "scan_chunk", None)
+        if not getattr(response.raw, "chunked", False):
+            scan_chunk = None
+        if scan_chunk is None:
+            for line in response.iter_lines():
+                if not line:
+                    continue
+                scan = scanner.scan(line)
+                if scan.skippable:
+                    yield self._prefiltered_marker(scan.resource_version)
+                else:
+                    yield self._parse_frame(line)
+            return
+
+        tail = b""
+        # urllib3's stream() handles transfer-chunk reassembly; frame
+        # boundaries are ours to find (they don't align with HTTP chunks)
+        for chunk in response.raw.stream(64 * 1024, decode_content=True):
+            if not chunk:
+                continue
+            buf = tail + chunk if tail else chunk
+            records, consumed = scan_chunk(buf)
+            tail = buf[consumed:]
+            # skip-runs arrive pre-coalesced from the scanner; merge runs
+            # that continue across chunk boundaries so a non-TPU event storm
+            # costs one marker per chunk at most
+            skip_rv, skipped = None, 0
+            for start, length, rv, count in records:
+                if rv is not None:
+                    skip_rv, skipped = rv, skipped + count
+                    continue
+                if skipped:
+                    yield self._prefiltered_marker(skip_rv, skipped)
+                    skip_rv, skipped = None, 0
+                yield self._parse_frame(buf[start : start + length])
+            if skipped:
+                yield self._prefiltered_marker(skip_rv, skipped)
+        if tail.strip():
+            # server closed mid-line without a trailing newline: the tail is
+            # the final frame
+            scan = scanner.scan(tail)
+            if scan.skippable:
+                yield self._prefiltered_marker(scan.resource_version)
+            else:
+                yield self._parse_frame(tail)

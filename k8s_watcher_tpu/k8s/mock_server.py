@@ -172,9 +172,11 @@ class MockCluster:
 
 
 class _Handler(BaseHTTPRequestHandler):
-    # HTTP/1.0: close-delimited bodies, so the watch stream needs no chunked
-    # framing and `requests` still consumes it incrementally.
-    protocol_version = "HTTP/1.0"
+    # HTTP/1.1 with Transfer-Encoding: chunked on the watch stream — the
+    # real kube-apiserver's framing, which is also what lets clients see
+    # each event the moment its chunk arrives (a close-delimited body would
+    # make fixed-size reads block until the buffer fills or the watch ends).
+    protocol_version = "HTTP/1.1"
     # Nagle + delayed-ACK would add ~40 ms to every streamed watch frame
     disable_nagle_algorithm = True
     cluster: MockCluster  # injected by make_server
@@ -240,28 +242,31 @@ class _Handler(BaseHTTPRequestHandler):
 
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
+
+        def write_frame(payload: Dict[str, Any]) -> None:
+            data = (json.dumps(payload) + "\n").encode()
+            self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+            self.wfile.flush()
+
         try:
             while time.monotonic() < deadline:
                 batch = self.cluster.events_since(rv, min(deadline, time.monotonic() + 0.5))
                 if batch is None:
                     # compacted mid-stream: emit the in-band 410 ERROR event
-                    err = {"type": "ERROR", "object": {"kind": "Status", "code": 410, "message": "too old resource version"}}
-                    self.wfile.write((json.dumps(err) + "\n").encode())
-                    self.wfile.flush()
-                    return
+                    write_frame({"type": "ERROR", "object": {"kind": "Status", "code": 410, "message": "too old resource version"}})
+                    break
                 if not batch and send_bookmarks and time.monotonic() - last_frame >= 1.0:
                     # idle stream: k8s sends BOOKMARK frames so clients can
                     # advance their resume version without real events. Use
                     # the handler-local rv (not latest_rv()): an event
                     # recorded in the race window must not be marked seen
                     # before it is delivered.
-                    bookmark = {
+                    write_frame({
                         "type": "BOOKMARK",
                         "object": {"kind": "Pod", "metadata": {"resourceVersion": str(rv)}},
-                    }
-                    self.wfile.write((json.dumps(bookmark) + "\n").encode())
-                    self.wfile.flush()
+                    })
                     last_frame = time.monotonic()
                 for event in batch:
                     obj = event.get("object") or {}
@@ -272,9 +277,11 @@ class _Handler(BaseHTTPRequestHandler):
                         continue
                     if selector and not _matches_selector(obj, selector):
                         continue
-                    self.wfile.write((json.dumps(event) + "\n").encode())
-                    self.wfile.flush()
+                    write_frame(event)
                     last_frame = time.monotonic()
+            # terminal chunk: clean end of the bounded watch window
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
         except (BrokenPipeError, ConnectionResetError):
             pass
 

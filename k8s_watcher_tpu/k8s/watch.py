@@ -44,6 +44,8 @@ class KubernetesWatchSource:
         checkpoint=None,  # state.checkpoint.CheckpointStore, optional
         max_reconnects: Optional[int] = None,  # None = retry forever
         heartbeat=None,  # Callable[[], None]: stamped on any apiserver contact
+        scanner=None,  # native.scanner.FrameScanner: skip-parse prefilter
+        metrics=None,  # metrics.MetricsRegistry, optional
     ):
         self.client = client
         self.namespace = namespace
@@ -54,6 +56,8 @@ class KubernetesWatchSource:
         self.checkpoint = checkpoint
         self.max_reconnects = max_reconnects
         self.heartbeat = heartbeat or (lambda: None)
+        self.scanner = scanner
+        self.metrics = metrics
         self._stop = threading.Event()
         # uid -> (name, namespace, phase) of live pods, so a relist can
         # synthesize DELETED events for pods that vanished while the watch
@@ -139,6 +143,7 @@ class KubernetesWatchSource:
                     resource_version=self.resource_version,
                     timeout_seconds=self.watch_timeout_seconds,
                     label_selector=self.label_selector,
+                    scanner=self.scanner,
                 ):
                     if self._stop.is_set():
                         return
@@ -146,7 +151,19 @@ class KubernetesWatchSource:
                     obj = raw.get("object") or {}
                     rv = (obj.get("metadata") or {}).get("resourceVersion")
                     event_type = raw.get("type", "")
-                    if event_type == EventType.BOOKMARK:
+                    if event_type == EventType.BOOKMARK or event_type == EventType.PREFILTERED:
+                        # rv-only frames: bookmarks, and frames the native
+                        # prefilter dropped unparsed (no accelerator key —
+                        # the pipeline's resource filter would drop them too;
+                        # one marker may stand for a coalesced run of them)
+                        if event_type == EventType.PREFILTERED and self.metrics is not None:
+                            self.metrics.counter("events_prefiltered").inc(raw.get("count", 1))
+                        # a delivered frame proves the stream is healthy — in
+                        # an all-non-TPU cluster these may be the ONLY frames,
+                        # so backoff must reset here too or one blip escalates
+                        # every later reconnect to max_delay forever
+                        backoff = self.retry.delay_seconds
+                        reconnects = 0
                         self._save_rv(rv)
                         continue
                     event = WatchEvent(type=event_type, pod=obj, resource_version=rv)

@@ -1,0 +1,8 @@
+"""Native hot-path components (C++ via ctypes, pure-Python fallbacks)."""
+
+from k8s_watcher_tpu.native.scanner import (  # noqa: F401
+    FrameScan,
+    NativeFrameScanner,
+    PythonFrameScanner,
+    make_scanner,
+)

@@ -1,0 +1,77 @@
+"""Lazy native build: compile fastscan.cpp into a cached shared object.
+
+No pybind11 in this image, so the extension is plain C ABI loaded via
+ctypes. The build is a single g++ invocation, cached by source hash inside
+the package tree (override with K8S_WATCHER_TPU_NATIVE_CACHE); any failure
+— no compiler, read-only filesystem, exotic platform — degrades to the
+pure-Python scanner, never to an import error.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import subprocess
+import sysconfig
+import tempfile
+from pathlib import Path
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_SRC = Path(__file__).resolve().parent / "fastscan.cpp"
+
+
+def _cache_dir() -> Path:
+    override = os.environ.get("K8S_WATCHER_TPU_NATIVE_CACHE")
+    return Path(override) if override else _SRC.parent / "_cache"
+
+
+def _ext_suffix() -> str:
+    return sysconfig.get_config_var("SHLIB_SUFFIX") or ".so"
+
+
+def build_fastscan(force: bool = False) -> Optional[Path]:
+    """Path to the compiled shared object, building it if needed.
+
+    Returns None when the library cannot be produced (caller falls back to
+    the pure-Python scanner).
+    """
+    if os.environ.get("K8S_WATCHER_TPU_DISABLE_NATIVE"):
+        return None
+    try:
+        source = _SRC.read_bytes()
+    except OSError as exc:
+        logger.warning("fastscan source unreadable: %s", exc)
+        return None
+    digest = hashlib.sha256(source).hexdigest()[:16]
+    cache = _cache_dir()
+    out = cache / f"fastscan-{digest}{_ext_suffix()}"
+    if out.exists() and not force:
+        return out
+    compiler = os.environ.get("CXX", "g++")
+    try:
+        cache.mkdir(parents=True, exist_ok=True)
+        # compile to a temp name then os.replace: concurrent builders
+        # (several watcher processes starting at once) each win atomically
+        with tempfile.NamedTemporaryFile(
+            dir=cache, suffix=_ext_suffix(), delete=False
+        ) as tmp:
+            tmp_path = Path(tmp.name)
+        cmd = [
+            compiler, "-O3", "-shared", "-fPIC", "-std=c++17",
+            "-fno-exceptions", "-fno-rtti",
+            str(_SRC), "-o", str(tmp_path),
+        ]
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        if proc.returncode != 0:
+            logger.warning("fastscan build failed (%s): %s", compiler, proc.stderr[:500])
+            tmp_path.unlink(missing_ok=True)
+            return None
+        os.replace(tmp_path, out)
+        logger.info("Built native fastscan: %s", out)
+        return out
+    except (OSError, subprocess.SubprocessError) as exc:
+        logger.warning("fastscan build unavailable: %s", exc)
+        return None

@@ -21,8 +21,12 @@ class EventType:
     DELETED = "DELETED"
     BOOKMARK = "BOOKMARK"
     ERROR = "ERROR"
+    # framework-internal: a frame the native prefilter proved irrelevant
+    # (no accelerator key) and dropped unparsed; carries only the
+    # resourceVersion so the resume point still advances
+    PREFILTERED = "PREFILTERED"
 
-    ALL = (ADDED, MODIFIED, DELETED, BOOKMARK, ERROR)
+    ALL = (ADDED, MODIFIED, DELETED, BOOKMARK, ERROR, PREFILTERED)
 
 
 @dataclasses.dataclass

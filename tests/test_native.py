@@ -1,0 +1,249 @@
+"""Native watch-frame scanner tests: C++/Python parity, skip semantics, and
+the prefiltered hot loop end-to-end over the mock API server."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from k8s_watcher_tpu.k8s.client import K8sClient
+from k8s_watcher_tpu.k8s.kubeconfig import K8sConnection
+from k8s_watcher_tpu.k8s.mock_server import MockApiServer
+from k8s_watcher_tpu.k8s.watch import KubernetesWatchSource
+from k8s_watcher_tpu.metrics import MetricsRegistry
+from k8s_watcher_tpu.native.build import build_fastscan
+from k8s_watcher_tpu.native.scanner import (
+    NativeFrameScanner,
+    PythonFrameScanner,
+    make_scanner,
+)
+from k8s_watcher_tpu.watch.fake import build_pod
+
+KEY = "google.com/tpu"
+
+
+def frame(event_type: str, pod: dict) -> bytes:
+    return json.dumps({"type": event_type, "object": pod}).encode()
+
+
+CORPUS = [
+    frame("ADDED", build_pod("plain", resource_version="101")),
+    frame("MODIFIED", build_pod("tpu", tpu_chips=4, resource_version="102")),
+    frame("DELETED", build_pod("gone", phase="Failed", resource_version="103")),
+    # label mentions the key but no resource request: must NOT be skippable
+    # (conservative routing to the full-parse path)
+    frame("ADDED", build_pod("labeled", labels={"note": KEY}, resource_version="104")),
+    frame("BOOKMARK", {"metadata": {"resourceVersion": "105"}}),
+    json.dumps({"type": "ERROR", "object": {"code": 410, "message": "gone"}}).encode(),
+    b'  {"type" : "ADDED", "object": {"metadata": {"resourceVersion": "106"}}}',
+    b'{"type":"ADDED","object":{"metadata":{"resourceVersion":"esc\\"aped"}}}',
+    b"not json at all",
+    b"[1, 2, 3]",
+    b"{}",
+]
+
+
+@pytest.fixture(scope="module")
+def native_scanner():
+    lib = build_fastscan()
+    if lib is None:
+        pytest.skip("no C++ toolchain available")
+    return NativeFrameScanner(KEY, lib)
+
+
+class TestScannerSemantics:
+    def test_non_tpu_pod_is_skippable(self, native_scanner):
+        scan = native_scanner.scan(CORPUS[0])
+        assert scan.type == "ADDED"
+        assert scan.resource_version == "101"
+        assert not scan.has_key
+        assert scan.skippable
+
+    def test_tpu_pod_never_skippable(self, native_scanner):
+        scan = native_scanner.scan(CORPUS[1])
+        assert scan.has_key and not scan.skippable
+
+    def test_key_in_label_not_skippable(self, native_scanner):
+        assert not native_scanner.scan(CORPUS[3]).skippable
+
+    def test_bookmark_and_error_take_full_path(self, native_scanner):
+        assert not native_scanner.scan(CORPUS[4]).skippable  # BOOKMARK
+        assert not native_scanner.scan(CORPUS[5]).skippable  # ERROR
+
+    def test_escaped_rv_falls_back(self, native_scanner):
+        scan = native_scanner.scan(CORPUS[7])
+        assert scan.resource_version is None and not scan.skippable
+
+    def test_garbage_falls_back(self, native_scanner):
+        for raw in (CORPUS[8], CORPUS[9], b"", b"   "):
+            scan = native_scanner.scan(raw)
+            assert not scan.skippable
+
+    def test_native_python_parity(self, native_scanner):
+        py = PythonFrameScanner(KEY)
+        for raw in CORPUS:
+            assert native_scanner.scan(raw) == py.scan(raw), raw[:80]
+
+    def test_make_scanner_prefers_native(self, native_scanner):
+        # fixture dependency = skip (not fail) on hosts without a toolchain
+        assert isinstance(make_scanner(KEY), NativeFrameScanner)
+
+    def test_make_scanner_fallback(self, monkeypatch):
+        monkeypatch.setenv("K8S_WATCHER_TPU_DISABLE_NATIVE", "1")
+        assert isinstance(make_scanner(KEY), PythonFrameScanner)
+
+
+class TestChunkScan:
+    """Batch (chunk) API: frame splitting, skip-run coalescing, parity."""
+
+    def make_stream(self, n=700, tpu_every=50):
+        # >2×256 consecutive skips so native must merge across its record cap
+        frames = [
+            frame(
+                "MODIFIED",
+                build_pod(
+                    f"p{i}",
+                    tpu_chips=8 if i % tpu_every == 0 else 0,
+                    resource_version=str(i + 1),
+                ),
+            )
+            for i in range(n)
+        ]
+        return frames, b"\n".join(frames) + b"\n"
+
+    def drive(self, scanner, stream, chunk_size):
+        parsed, markers = [], []
+        tail = b""
+        for off in range(0, len(stream), chunk_size):
+            buf = tail + stream[off : off + chunk_size]
+            records, consumed = scanner.scan_chunk(buf)
+            tail = buf[consumed:]
+            for start, length, rv, count in records:
+                if rv is not None:
+                    markers.append((rv, count))
+                else:
+                    assert count == 1
+                    parsed.append(json.loads(stream_slice := buf[start : start + length]))
+        assert not tail.strip()
+        return parsed, markers
+
+    @pytest.mark.parametrize("chunk_size", [64 * 1024, 1024, 137])
+    def test_chunked_equals_full_parse_semantics(self, native_scanner, chunk_size):
+        frames, stream = self.make_stream()
+        parsed, markers = self.drive(native_scanner, stream, chunk_size)
+        # every TPU frame parsed, every other frame accounted once
+        assert [p["object"]["metadata"]["name"] for p in parsed] == [
+            f"p{i}" for i in range(0, 700, 50)
+        ]
+        assert sum(c for _, c in markers) == 700 - len(parsed)
+        # each skip-run reports its LAST (largest) resourceVersion
+        for rv, count in markers:
+            assert int(rv) >= count
+
+    def test_native_python_chunk_parity(self, native_scanner):
+        frames, stream = self.make_stream(n=120, tpu_every=7)
+        py = PythonFrameScanner(KEY)
+        for chunk_size in (len(stream), 512):
+            n_parsed, n_mark = self.drive(native_scanner, stream, chunk_size)
+            p_parsed, p_mark = self.drive(py, stream, chunk_size)
+            assert n_parsed == p_parsed
+            # coalescing granularity may differ across implementations;
+            # totals and resume points must not
+            assert sum(c for _, c in n_mark) == sum(c for _, c in p_mark)
+            assert n_mark[-1][0] == p_mark[-1][0]
+
+    def test_crlf_and_blank_lines(self, native_scanner):
+        stream = CORPUS[0] + b"\r\n\n" + CORPUS[1] + b"\n"
+        records, consumed = native_scanner.scan_chunk(stream)
+        assert consumed == len(stream)
+        assert len(records) == 2
+        assert records[0][2] is not None  # non-TPU: skip-run of 1
+        assert records[1][2] is None  # TPU pod: full parse
+
+    def test_malformed_frame_not_swallowed_by_skip_run(self, native_scanner):
+        # a non-JSON line right after skippable frames must surface as a
+        # full-parse record (flags=-1 has all bits set; a bare `& 8` test
+        # would coalesce it into the run with a stale rv)
+        stream = CORPUS[0] + b"\n" + CORPUS[0] + b"\n" + b"garbage not json" + b"\n"
+        for scanner in (native_scanner, PythonFrameScanner(KEY)):
+            records, consumed = scanner.scan_chunk(stream)
+            assert consumed == len(stream)
+            assert [r[2] is not None for r in records] == [True, False], records
+            assert records[0][3] == 2  # the two real skips coalesced
+            start, length, _, _ = records[1]
+            assert stream[start : start + length] == b"garbage not json"
+
+    def test_incomplete_tail_left_unconsumed(self, native_scanner):
+        stream = CORPUS[0] + b"\n" + CORPUS[1][:40]
+        records, consumed = native_scanner.scan_chunk(stream)
+        assert len(records) == 1
+        assert stream[consumed:] == CORPUS[1][:40]
+
+
+class TestPrefilteredWatch:
+    """End-to-end: client + watch source skip non-TPU frames unparsed while
+    the resume version still advances."""
+
+    @pytest.fixture
+    def mock_api(self):
+        with MockApiServer() as server:
+            yield server
+
+    @pytest.fixture(params=["native", "python"])
+    def scanner(self, request, native_scanner):
+        return native_scanner if request.param == "native" else PythonFrameScanner(KEY)
+
+    def test_client_yields_prefiltered_markers(self, mock_api, scanner):
+        client = K8sClient(K8sConnection(server=mock_api.url), request_timeout=5.0)
+        rv = client.list_pods()["metadata"]["resourceVersion"]
+        got = []
+
+        def consume():
+            for raw in client.watch_pods(resource_version=rv, timeout_seconds=5, scanner=scanner):
+                got.append(raw)
+                if len(got) == 3:
+                    return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        mock_api.cluster.add_pod(build_pod("boring", phase="Pending"))
+        mock_api.cluster.add_pod(build_pod("tpu-pod", tpu_chips=8, phase="Pending"))
+        mock_api.cluster.set_phase("default", "boring", "Running")
+        t.join(timeout=5)
+        assert [e["type"] for e in got] == ["PREFILTERED", "ADDED", "PREFILTERED"]
+        # markers still carry the resume point
+        assert all((e["object"]["metadata"]["resourceVersion"] or "") for e in got)
+        # the one fully-parsed event is the TPU pod
+        assert got[1]["object"]["metadata"]["name"] == "tpu-pod"
+
+    def test_watch_source_advances_rv_and_counts(self, mock_api, scanner):
+        client = K8sClient(K8sConnection(server=mock_api.url), request_timeout=5.0)
+        metrics = MetricsRegistry()
+        source = KubernetesWatchSource(client, scanner=scanner, metrics=metrics)
+        got = []
+
+        def run():
+            for ev in source.events():
+                got.append(ev)
+                if sum(1 for e in got if e.type == "ADDED") >= 1 and len(got) >= 1:
+                    if any(e.name == "tpu-pod" for e in got):
+                        source.stop()
+                        return
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        mock_api.cluster.add_pod(build_pod("boring-0"))
+        mock_api.cluster.add_pod(build_pod("boring-1"))
+        mock_api.cluster.add_pod(build_pod("tpu-pod", tpu_chips=8))
+        t.join(timeout=10)
+        source.stop()
+        # only the TPU pod surfaced as a WatchEvent
+        assert [e.name for e in got] == ["tpu-pod"]
+        assert metrics.counter("events_prefiltered").value == 2
+        # the skipped frames advanced the resume point (the TPU event's own
+        # rv is only saved once the consumer resumes the generator —
+        # crash-replay semantics — and we stopped at that event)
+        assert int(source.resource_version) >= 2
