@@ -141,6 +141,7 @@ class WatcherApp:
             accelerator_label=config.tpu.accelerator_label,
         )
         self._stop = threading.Event()
+        self.elector = None  # k8s.leader.LeaderElector when HA is enabled
         self._probe_agent = None
         if config.tpu.probe_enabled:
             from k8s_watcher_tpu.probe.agent import ProbeAgent
@@ -160,6 +161,11 @@ class WatcherApp:
                 self.metrics, self.liveness, port=self.config.watcher.status_port
             ).start()
             logger.info("Status endpoint on :%d (/metrics, /healthz)", self.status_server.port)
+        if self.config.watcher.leader_election.enabled:
+            self._campaign()  # blocks until this replica leads (or stop())
+            if self._stop.is_set():
+                self.shutdown()
+                return
         if self.notifier.health_check():
             logger.info("ClusterAPI health check passed")
         else:
@@ -183,6 +189,46 @@ class WatcherApp:
         finally:
             self.shutdown()
 
+    def _campaign(self) -> None:
+        """Stand by until this replica wins the leadership Lease.
+
+        Standbys are hot: config loaded, dispatcher + status endpoint up,
+        liveness beating (so k8s keeps them alive) — but they hold no watch
+        connection and send nothing until elected. Losing an acquired
+        leadership stops the app; the process exits and the restarted
+        replica rejoins as a standby (fail-fast, the client-go convention).
+        """
+        client = getattr(self.source, "client", None)
+        if client is None:
+            logger.warning("Leader election enabled but the watch source has no k8s client (mock/fake source); skipping")
+            return
+        from k8s_watcher_tpu.k8s.leader import LeaderElector, default_identity, elector_client
+
+        le = self.config.watcher.leader_election
+        identity = le.identity or default_identity()
+
+        def lost() -> None:
+            logger.error("Leadership lost; stopping watcher (restart to rejoin as standby)")
+            self.stop()
+
+        self.elector = LeaderElector(
+            # dedicated short-timeout client: a stalled renew RPC must not
+            # outlive the renew deadline (split-brain window otherwise)
+            elector_client(client, le.renew_deadline_seconds, le.lease_duration_seconds),
+            lease_namespace=le.lease_namespace,
+            lease_name=le.lease_name,
+            identity=identity,
+            lease_duration_seconds=le.lease_duration_seconds,
+            renew_deadline_seconds=le.renew_deadline_seconds,
+            retry_period_seconds=le.retry_period_seconds,
+            on_stopped_leading=lost,
+        ).start()
+        logger.info("Standing by for leadership of %s/%s as %s", le.lease_namespace, le.lease_name, identity)
+        while not self._stop.is_set():
+            self.liveness.beat()  # a healthy standby is alive, just not leading
+            if self.elector.wait_for_leadership(timeout=1.0):
+                return
+
     def _maybe_checkpoint(self, force: bool = False) -> None:
         if self.checkpoint is None:
             return
@@ -204,6 +250,9 @@ class WatcherApp:
 
     def shutdown(self) -> None:
         self.source.stop()
+        if self.elector is not None:
+            self.elector.stop()  # release the Lease -> standby takes over now
+            self.elector = None
         if self.status_server is not None:
             self.status_server.stop()
             self.status_server = None

@@ -133,6 +133,72 @@ class RetryPolicy:
         )
 
 
+def leader_timing_error(lease_duration: float, renew_deadline: float, retry_period: float) -> Optional[str]:
+    """The one place the leader-election timing invariants live (used by both
+    the config schema and ``LeaderElector.__init__``). Returns an error
+    message, or None if the timings are safe.
+
+    Compares against ``int(lease_duration)`` because ``leaseDurationSeconds``
+    is an integer on the wire — a fractional duration would otherwise let
+    ``renew_deadline`` exceed what observers actually enforce, and a leader
+    could believe it still leads after a standby has legally stolen the lease.
+    """
+    if lease_duration < 1.0:
+        return "lease_duration_seconds must be >= 1 (integer on the wire)"
+    if retry_period <= 0 or renew_deadline <= 0:
+        return "retry_period_seconds and renew_deadline_seconds must be > 0"
+    if renew_deadline >= float(int(lease_duration)):
+        return "renew_deadline_seconds must be < int(lease_duration_seconds) (the wire value is a truncated integer)"
+    if retry_period >= renew_deadline:
+        return "retry_period_seconds must be < renew_deadline_seconds (need >1 renew attempt per deadline)"
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class LeaderElectionConfig:
+    """The ``watcher.leader_election:`` section — net-new HA (SURVEY.md §5
+    failure detection: the reference was a singleton with no failover).
+
+    N watcher replicas campaign for a coordination.k8s.io/v1 Lease; exactly
+    one watches + notifies, the rest stand by hot and take over within
+    ``lease_duration_seconds`` of a leader crash (immediately on clean exit).
+    """
+
+    enabled: bool = False
+    lease_name: str = "k8s-watcher-tpu"
+    lease_namespace: str = "default"
+    lease_duration_seconds: float = 15.0
+    renew_deadline_seconds: float = 10.0
+    retry_period_seconds: float = 2.0
+    identity: Optional[str] = None  # default: <hostname>-<pid>
+
+    @classmethod
+    def from_raw(cls, raw: Mapping[str, Any]) -> "LeaderElectionConfig":
+        path = "watcher.leader_election"
+        _check_known(
+            raw,
+            ("enabled", "lease_name", "lease_namespace", "lease_duration_seconds",
+             "renew_deadline_seconds", "retry_period_seconds", "identity"),
+            path,
+        )
+        cfg = cls(
+            enabled=_opt_bool(raw, "enabled", path, False),
+            lease_name=_opt_str(raw, "lease_name", path, cls.lease_name),
+            lease_namespace=_opt_str(raw, "lease_namespace", path, cls.lease_namespace),
+            lease_duration_seconds=_opt_num(raw, "lease_duration_seconds", path, 15.0),
+            renew_deadline_seconds=_opt_num(raw, "renew_deadline_seconds", path, 10.0),
+            retry_period_seconds=_opt_num(raw, "retry_period_seconds", path, 2.0),
+            identity=_opt_str(raw, "identity", path, None),
+        )
+        if cfg.enabled:
+            error = leader_timing_error(
+                cfg.lease_duration_seconds, cfg.renew_deadline_seconds, cfg.retry_period_seconds
+            )
+            if error:
+                raise SchemaError(f"config key '{path}': {error}")
+        return cfg
+
+
 @dataclasses.dataclass(frozen=True)
 class WatcherConfig:
     """The ``watcher:`` section (reference base.yaml:1-12, production.yaml:16-25)."""
@@ -146,13 +212,14 @@ class WatcherConfig:
     status_port: int = 0  # 0 = no /metrics//healthz endpoint
     liveness_stale_seconds: float = 900.0
     label_selector: Optional[str] = None  # k8s labelSelector pushed to the API server
+    leader_election: LeaderElectionConfig = dataclasses.field(default_factory=LeaderElectionConfig)
 
     @classmethod
     def from_raw(cls, raw: Mapping[str, Any]) -> "WatcherConfig":
         _check_known(
             raw,
             ("watch_interval", "log_level", "namespaces", "retry", "alerts",
-             "status_port", "liveness_stale_seconds", "label_selector"),
+             "status_port", "liveness_stale_seconds", "label_selector", "leader_election"),
             "watcher",
         )
         namespaces = raw.get("namespaces") or ()
@@ -162,6 +229,7 @@ class WatcherConfig:
         alerts = raw.get("alerts") or {}
         _expect(alerts, (dict,), "watcher.alerts")
         _check_known(alerts, ("critical_events_only",), "watcher.alerts")
+        _expect(raw.get("leader_election") or {}, (dict,), "watcher.leader_election")
         level = _expect(raw.get("log_level", "INFO"), (str,), "watcher.log_level").upper()
         if level not in ("DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"):
             raise SchemaError(f"config key 'watcher.log_level': invalid level {level!r}")
@@ -174,6 +242,7 @@ class WatcherConfig:
             status_port=_opt_int(raw, "status_port", "watcher", 0),
             liveness_stale_seconds=_opt_num(raw, "liveness_stale_seconds", "watcher", 900.0),
             label_selector=_opt_str(raw, "label_selector", "watcher", None),
+            leader_election=LeaderElectionConfig.from_raw(raw.get("leader_election") or {}),
         )
 
 

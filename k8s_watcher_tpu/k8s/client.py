@@ -40,6 +40,15 @@ class K8sGoneError(K8sApiError):
     """resourceVersion too old (HTTP 410) — caller must relist."""
 
 
+class K8sConflictError(K8sApiError):
+    """HTTP 409 — create raced another writer, or update had a stale
+    resourceVersion. Leader election treats this as "lost the race"."""
+
+
+class K8sNotFoundError(K8sApiError):
+    """HTTP 404 — object does not exist."""
+
+
 class K8sClient:
     def __init__(self, connection: K8sConnection, *, request_timeout: float = 30.0):
         self.connection = connection
@@ -56,16 +65,34 @@ class K8sClient:
     def _url(self, path: str) -> str:
         return f"{self.connection.server}{path}"
 
-    def _get(self, path: str, params: Optional[Dict[str, Any]] = None, **kwargs) -> requests.Response:
+    def _request(
+        self,
+        method: str,
+        path: str,
+        params: Optional[Dict[str, Any]] = None,
+        json_body: Optional[Dict[str, Any]] = None,
+        **kwargs,
+    ) -> requests.Response:
         try:
-            response = self.session.get(self._url(path), params=params, timeout=self.request_timeout, **kwargs)
+            response = self.session.request(
+                method, self._url(path), params=params, json=json_body, timeout=self.request_timeout, **kwargs
+            )
         except requests.RequestException as exc:
-            raise K8sApiError(f"GET {path} failed: {exc}") from exc
+            raise K8sApiError(f"{method} {path} failed: {exc}") from exc
+        if response.status_code == 404:
+            raise K8sNotFoundError(f"{method} {path}: not found", status=404)
+        if response.status_code == 409:
+            raise K8sConflictError(f"{method} {path}: conflict: {response.text[:300]}", status=409)
         if response.status_code == 410:
-            raise K8sGoneError(f"GET {path}: resourceVersion expired (410 Gone)", status=410)
+            raise K8sGoneError(f"{method} {path}: resourceVersion expired (410 Gone)", status=410)
         if response.status_code >= 400:
-            raise K8sApiError(f"GET {path}: HTTP {response.status_code}: {response.text[:300]}", status=response.status_code)
+            raise K8sApiError(
+                f"{method} {path}: HTTP {response.status_code}: {response.text[:300]}", status=response.status_code
+            )
         return response
+
+    def _get(self, path: str, params: Optional[Dict[str, Any]] = None, **kwargs) -> requests.Response:
+        return self._request("GET", path, params, **kwargs)
 
     # -- API surface -------------------------------------------------------
 
@@ -85,6 +112,36 @@ class K8sClient:
 
     def _pods_path(self, namespace: Optional[str]) -> str:
         return f"/api/v1/namespaces/{namespace}/pods" if namespace else "/api/v1/pods"
+
+    # -- coordination.k8s.io/v1 Leases (leader election) -------------------
+
+    @staticmethod
+    def _leases_path(namespace: str, name: Optional[str] = None) -> str:
+        base = f"/apis/coordination.k8s.io/v1/namespaces/{namespace}/leases"
+        return f"{base}/{name}" if name else base
+
+    def get_lease(self, namespace: str, name: str) -> Optional[Dict[str, Any]]:
+        """The Lease object, or None if it does not exist."""
+        try:
+            return self._get(self._leases_path(namespace, name)).json()
+        except K8sNotFoundError:
+            return None
+
+    def create_lease(self, namespace: str, name: str, spec: Dict[str, Any]) -> Dict[str, Any]:
+        """POST a new Lease; raises K8sConflictError if it already exists
+        (another candidate won the creation race)."""
+        body = {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": spec,
+        }
+        return self._request("POST", self._leases_path(namespace), json_body=body).json()
+
+    def replace_lease(self, namespace: str, name: str, lease: Dict[str, Any]) -> Dict[str, Any]:
+        """PUT a full Lease object; the server enforces optimistic concurrency
+        on ``metadata.resourceVersion`` (stale write -> K8sConflictError)."""
+        return self._request("PUT", self._leases_path(namespace, name), json_body=lease).json()
 
     def list_pods(
         self,

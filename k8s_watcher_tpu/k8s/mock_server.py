@@ -66,6 +66,7 @@ class MockCluster:
         self._oldest_rv = 0  # journal entries <= this are compacted away
         self._fail_next = 0
         self.namespaces = ["default", "kube-system"]
+        self._leases: Dict[Tuple[str, str], Dict[str, Any]] = {}
 
     # -- state mutation (test hooks) --------------------------------------
 
@@ -170,6 +171,58 @@ class MockCluster:
         with self._lock:
             return self._rv
 
+    # -- coordination.k8s.io/v1 Leases (leader election) -------------------
+
+    def get_lease(self, namespace: str, name: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            lease = self._leases.get((namespace, name))
+            return json.loads(json.dumps(lease)) if lease else None
+
+    def create_lease(self, namespace: str, name: str, body: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+        """(status, body): 201 on create, 409 if the Lease already exists."""
+        with self._lock:
+            if (namespace, name) in self._leases:
+                return 409, {"kind": "Status", "code": 409, "message": f"leases \"{name}\" already exists"}
+            self._rv += 1
+            lease = json.loads(json.dumps(body))
+            lease.setdefault("metadata", {}).update(
+                {"name": name, "namespace": namespace, "resourceVersion": str(self._rv)}
+            )
+            self._leases[(namespace, name)] = lease
+            return 201, json.loads(json.dumps(lease))
+
+    def replace_lease(self, namespace: str, name: str, body: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+        """(status, body): 200 on replace, 404 if missing, 409 on a stale
+        metadata.resourceVersion (optimistic-concurrency contract — this is
+        what makes leader-election takeover a compare-and-swap)."""
+        with self._lock:
+            current = self._leases.get((namespace, name))
+            if current is None:
+                return 404, {"kind": "Status", "code": 404, "message": f"leases \"{name}\" not found"}
+            sent_rv = (body.get("metadata") or {}).get("resourceVersion")
+            if sent_rv != current["metadata"]["resourceVersion"]:
+                return 409, {"kind": "Status", "code": 409, "message": "the object has been modified"}
+            self._rv += 1
+            lease = json.loads(json.dumps(body))
+            lease.setdefault("metadata", {}).update(
+                {"name": name, "namespace": namespace, "resourceVersion": str(self._rv)}
+            )
+            self._leases[(namespace, name)] = lease
+            return 200, json.loads(json.dumps(lease))
+
+
+def _parse_lease_path(path: str) -> Optional[Tuple[str, Optional[str]]]:
+    """``(namespace, name-or-None)`` for coordination/v1 lease routes."""
+    prefix = "/apis/coordination.k8s.io/v1/namespaces/"
+    if not path.startswith(prefix):
+        return None
+    rest = path[len(prefix):].split("/")
+    if len(rest) == 2 and rest[1] == "leases":
+        return rest[0], None
+    if len(rest) == 3 and rest[1] == "leases" and rest[2]:
+        return rest[0], rest[2]
+    return None
+
 
 class _Handler(BaseHTTPRequestHandler):
     # HTTP/1.1 with Transfer-Encoding: chunked on the watch stream — the
@@ -209,6 +262,19 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(200, {"kind": "NamespaceList", "items": items})
             return
 
+        lease = _parse_lease_path(path)
+        if lease is not None:
+            namespace, name = lease
+            if name is None:
+                self._json(400, {"kind": "Status", "code": 400, "message": "lease collection GET not supported"})
+                return
+            found = self.cluster.get_lease(namespace, name)
+            if found is None:
+                self._json(404, {"kind": "Status", "code": 404, "message": f"leases \"{name}\" not found"})
+            else:
+                self._json(200, found)
+            return
+
         namespace: Optional[str] = None
         if path == "/api/v1/pods":
             pass
@@ -223,6 +289,47 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             limit = int(params["limit"]) if "limit" in params else None
             self._json(200, self.cluster.list_pods(namespace, limit, params.get("labelSelector")))
+
+    def _read_body(self) -> Optional[Dict[str, Any]]:
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            return json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, json.JSONDecodeError):
+            self._json(400, {"kind": "Status", "code": 400, "message": "malformed request body"})
+            return None
+
+    def do_POST(self):  # noqa: N802 (stdlib naming)
+        # read the body BEFORE any early response: unread body bytes would
+        # be parsed as the next request line on this keep-alive connection
+        body = self._read_body()
+        if body is None:
+            return
+        if self.cluster.consume_failure():
+            self._json(500, {"kind": "Status", "code": 500, "message": "injected failure"})
+            return
+        lease = _parse_lease_path(urlparse(self.path).path)
+        if lease is not None and lease[1] is None:  # POST to the collection creates
+            namespace = lease[0]
+            name = (body.get("metadata") or {}).get("name", "")
+            status, out = self.cluster.create_lease(namespace, name, body)
+            self._json(status, out)
+            return
+        self._json(404, {"kind": "Status", "code": 404, "message": f"no route {self.path}"})
+
+    def do_PUT(self):  # noqa: N802 (stdlib naming)
+        body = self._read_body()
+        if body is None:
+            return
+        if self.cluster.consume_failure():
+            self._json(500, {"kind": "Status", "code": 500, "message": "injected failure"})
+            return
+        lease = _parse_lease_path(urlparse(self.path).path)
+        if lease is not None and lease[1] is not None:
+            namespace, name = lease
+            status, out = self.cluster.replace_lease(namespace, name, body)
+            self._json(status, out)
+            return
+        self._json(404, {"kind": "Status", "code": 404, "message": f"no route {self.path}"})
 
     def _serve_watch(self, namespace: Optional[str], params: Dict[str, str]) -> None:
         try:

@@ -1,0 +1,245 @@
+"""Leader election over coordination.k8s.io/v1 Leases (net-new HA —
+SURVEY.md §5 failure detection: the reference watcher was a singleton with
+no failover story). All tiers run against the in-process mock API server."""
+
+import threading
+import time
+
+import pytest
+
+from k8s_watcher_tpu.k8s.client import K8sClient, K8sConflictError
+from k8s_watcher_tpu.k8s.kubeconfig import K8sConnection
+from k8s_watcher_tpu.k8s.leader import LeaderElector, _format_time, _now, default_identity
+from k8s_watcher_tpu.k8s.mock_server import MockApiServer
+
+from datetime import timedelta
+
+
+@pytest.fixture
+def mock_api():
+    with MockApiServer() as server:
+        yield server
+
+
+def make_client(server: MockApiServer) -> K8sClient:
+    return K8sClient(K8sConnection(server=server.url), request_timeout=5.0)
+
+
+def make_elector(server, identity, **kwargs) -> LeaderElector:
+    kwargs.setdefault("lease_duration_seconds", 1.2)
+    kwargs.setdefault("renew_deadline_seconds", 0.8)
+    kwargs.setdefault("retry_period_seconds", 0.1)
+    return LeaderElector(
+        make_client(server),
+        lease_namespace="default",
+        lease_name="watcher-test",
+        identity=identity,
+        **kwargs,
+    )
+
+
+class TestLeaseApi:
+    def test_get_missing_lease_returns_none(self, mock_api):
+        assert make_client(mock_api).get_lease("default", "nope") is None
+
+    def test_create_then_get(self, mock_api):
+        client = make_client(mock_api)
+        created = client.create_lease("default", "l1", {"holderIdentity": "a", "leaseDurationSeconds": 15})
+        assert created["metadata"]["resourceVersion"]
+        got = client.get_lease("default", "l1")
+        assert got["spec"]["holderIdentity"] == "a"
+
+    def test_create_conflict(self, mock_api):
+        client = make_client(mock_api)
+        client.create_lease("default", "l1", {"holderIdentity": "a"})
+        with pytest.raises(K8sConflictError):
+            client.create_lease("default", "l1", {"holderIdentity": "b"})
+
+    def test_replace_requires_fresh_resource_version(self, mock_api):
+        client = make_client(mock_api)
+        lease = client.create_lease("default", "l1", {"holderIdentity": "a"})
+        stale = {"metadata": dict(lease["metadata"]), "spec": {"holderIdentity": "b"}}
+        lease["spec"]["holderIdentity"] = "a2"
+        client.replace_lease("default", "l1", lease)  # fresh rv: ok
+        with pytest.raises(K8sConflictError):
+            client.replace_lease("default", "l1", stale)  # stale rv: CAS fails
+
+
+class TestLeaderElector:
+    def test_single_candidate_acquires(self, mock_api):
+        elector = make_elector(mock_api, "alpha").start()
+        try:
+            assert elector.wait_for_leadership(timeout=5.0)
+            lease = make_client(mock_api).get_lease("default", "watcher-test")
+            assert lease["spec"]["holderIdentity"] == "alpha"
+            assert lease["spec"]["leaseTransitions"] == 0
+        finally:
+            elector.stop()
+
+    def test_standby_does_not_acquire_while_leader_renews(self, mock_api):
+        a = make_elector(mock_api, "alpha").start()
+        assert a.wait_for_leadership(timeout=5.0)
+        b = make_elector(mock_api, "beta").start()
+        try:
+            # beta must stay standby across multiple lease durations
+            assert not b.wait_for_leadership(timeout=2.5)
+            assert a.is_leader
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_clean_release_fails_over_immediately(self, mock_api):
+        lost = threading.Event()
+        a = make_elector(mock_api, "alpha").start()
+        assert a.wait_for_leadership(timeout=5.0)
+        b = make_elector(mock_api, "beta", on_started_leading=lost.set).start()
+        try:
+            t0 = time.monotonic()
+            a.stop()  # releases the Lease -> beta should win well inside a lease term
+            assert b.wait_for_leadership(timeout=5.0)
+            assert time.monotonic() - t0 < 1.0
+            lease = make_client(mock_api).get_lease("default", "watcher-test")
+            assert lease["spec"]["holderIdentity"] == "beta"
+            assert lease["spec"]["leaseTransitions"] >= 1
+            assert lost.is_set()
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_steals_expired_lease_from_dead_holder(self, mock_api):
+        # a "crashed" holder: lease exists but renewTime is ancient
+        stale_time = _format_time(_now() - timedelta(seconds=60))
+        make_client(mock_api).create_lease(
+            "default",
+            "watcher-test",
+            {
+                "holderIdentity": "dead-replica",
+                "leaseDurationSeconds": 1,
+                "acquireTime": stale_time,
+                "renewTime": stale_time,
+                "leaseTransitions": 4,
+            },
+        )
+        elector = make_elector(mock_api, "gamma").start()
+        try:
+            assert elector.wait_for_leadership(timeout=5.0)
+            lease = make_client(mock_api).get_lease("default", "watcher-test")
+            assert lease["spec"]["holderIdentity"] == "gamma"
+            assert lease["spec"]["leaseTransitions"] == 5
+        finally:
+            elector.stop()
+
+    def test_loses_leadership_when_apiserver_goes_away(self, mock_api):
+        lost = threading.Event()
+        elector = make_elector(mock_api, "alpha", on_stopped_leading=lost.set).start()
+        assert elector.wait_for_leadership(timeout=5.0)
+        mock_api.cluster.fail_next(10_000)  # every renew now 500s
+        assert lost.wait(timeout=5.0), "renew failures past the deadline must drop leadership"
+        assert not elector.is_leader
+        elector.stop()
+
+    def test_validates_timing_invariants(self, mock_api):
+        with pytest.raises(ValueError):
+            make_elector(mock_api, "x", lease_duration_seconds=1.0, renew_deadline_seconds=1.0)
+        with pytest.raises(ValueError):
+            make_elector(mock_api, "x", renew_deadline_seconds=0.5, retry_period_seconds=0.5)
+
+    def test_default_identity_is_host_scoped(self):
+        ident = default_identity()
+        assert "-" in ident and len(ident) > 3
+
+
+class TestAppFailover:
+    """Two full WatcherApps against the mock apiserver: only the leader
+    watches + notifies; a clean leader exit hands over to the standby."""
+
+    def _make_app(self, mock_api, identity):
+        import dataclasses
+
+        from conftest import CONFIG_DIR
+
+        from k8s_watcher_tpu.app import WatcherApp
+        from k8s_watcher_tpu.config.loader import load_config
+        from k8s_watcher_tpu.config.schema import LeaderElectionConfig, RetryPolicy
+        from k8s_watcher_tpu.k8s.watch import KubernetesWatchSource
+
+        config = load_config("development", CONFIG_DIR, env={})
+        watcher = dataclasses.replace(
+            config.watcher,
+            leader_election=LeaderElectionConfig(
+                enabled=True,
+                lease_name="app-failover",
+                lease_namespace="default",
+                lease_duration_seconds=1.2,
+                renew_deadline_seconds=0.8,
+                retry_period_seconds=0.1,
+                identity=identity,
+            ),
+        )
+        config = dataclasses.replace(config, watcher=watcher)
+
+        class Recorder:
+            def __init__(self):
+                self.payloads = []
+                self.lock = threading.Lock()
+
+            def update_pod_status(self, payload):
+                with self.lock:
+                    self.payloads.append(payload)
+                return True
+
+            def health_check(self):
+                return True
+
+        notifier = Recorder()
+        source = KubernetesWatchSource(
+            make_client(mock_api),
+            watch_timeout_seconds=2,
+        )
+        app = WatcherApp(config, source=source, notifier=notifier)
+        return app, notifier
+
+    def test_only_leader_notifies_then_failover(self, mock_api):
+        from k8s_watcher_tpu.watch.fake import build_pod
+
+        app_a, notes_a = self._make_app(mock_api, "replica-a")
+        app_b, notes_b = self._make_app(mock_api, "replica-b")
+
+        thread_a = threading.Thread(target=app_a.run, daemon=True)
+        thread_a.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not (app_a.elector and app_a.elector.is_leader):
+            time.sleep(0.05)
+        assert app_a.elector is not None and app_a.elector.is_leader
+
+        thread_b = threading.Thread(target=app_b.run, daemon=True)
+        thread_b.start()
+
+        mock_api.cluster.add_pod(build_pod("tpu-pod-1", tpu_chips=4))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not notes_a.payloads:
+            time.sleep(0.05)
+        assert [p["name"] for p in notes_a.payloads] == ["tpu-pod-1"]
+        assert notes_b.payloads == []  # standby is silent
+
+        app_a.stop()
+        thread_a.join(timeout=10)
+        assert not thread_a.is_alive()
+
+        # standby takes over and relists: it must see the surviving pod
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not notes_b.payloads:
+            time.sleep(0.05)
+        assert [p["name"] for p in notes_b.payloads] == ["tpu-pod-1"]
+        assert notes_b.payloads[0]["event_type"] == "ADDED"
+
+        # and it is now live on the watch stream
+        mock_api.cluster.set_phase("default", "tpu-pod-1", "Running")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and len(notes_b.payloads) < 2:
+            time.sleep(0.05)
+        assert notes_b.payloads[-1]["event_type"] == "MODIFIED"
+
+        app_b.stop()
+        thread_b.join(timeout=10)
+        assert not thread_b.is_alive()
