@@ -114,6 +114,7 @@ class WatcherApp:
             self.notifier.update_pod_status,
             capacity=config.clusterapi.queue_capacity,
             workers=config.clusterapi.workers,
+            coalesce=config.clusterapi.coalesce,
             metrics=self.metrics,
         )
         self.source = source or build_source(config, self.checkpoint, self.liveness.beat, self.metrics)

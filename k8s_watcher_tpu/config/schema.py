@@ -265,6 +265,10 @@ class ClusterApiConfig:
     # stall the watch stream — prerequisite for the <1s p50 target)
     queue_capacity: int = 1024
     workers: int = 2
+    # latest-wins per pod/slice while queued: update_pod_status is a state
+    # update, so a newer payload supersedes an unsent older one for the same
+    # object (bounds queue growth per object under churn)
+    coalesce: bool = True
     verify_tls: bool = True  # for https endpoints with self-signed certs
 
     @classmethod
@@ -272,7 +276,7 @@ class ClusterApiConfig:
         _check_known(
             raw,
             ("base_url", "auth", "endpoints", "timeout", "retry", "queue_capacity", "workers",
-             "verify_tls"),
+             "coalesce", "verify_tls"),
             "clusterapi",
         )
         auth = raw.get("auth") or {}
@@ -290,6 +294,7 @@ class ClusterApiConfig:
             retry=RetryPolicy.from_raw(raw.get("retry") or {}, "clusterapi.retry", delay_default=2.0),
             queue_capacity=_opt_int(raw, "queue_capacity", "clusterapi", 1024),
             workers=_opt_int(raw, "workers", "clusterapi", 2),
+            coalesce=_opt_bool(raw, "coalesce", "clusterapi", True),
             verify_tls=_opt_bool(raw, "verify_tls", "clusterapi", True),
         )
 

@@ -7,9 +7,17 @@ Here the pipeline enqueues and returns; worker threads drain the queue and
 the event→notify latency histogram is recorded when the POST *completes* —
 the honest end-to-end number.
 
-Backpressure policy: when the bounded queue is full the oldest entry is
-dropped (and counted) rather than blocking the watch stream — under churn,
-fresh state supersedes stale state for the same pod anyway.
+Backpressure policy, in order:
+- **Coalescing** (on by default): while a notification for the same pod
+  uid / slice key is still waiting in the queue, a newer one REPLACES its
+  payload instead of queueing behind it. ``update_pod_status`` is a state
+  update, not an event log — the receiver only ever needs the latest state,
+  and under churn this bounds queue growth per object instead of per event.
+  In-flight sends are never coalesced into (their payload is already on the
+  wire); a newer event for the same key simply queues next.
+- **Drop-oldest** when the bounded queue still fills (pathological fan-out
+  of distinct keys): the oldest entry is dropped (and counted) rather than
+  blocking the watch stream.
 """
 
 from __future__ import annotations
@@ -18,12 +26,28 @@ import logging
 import queue
 import threading
 import time
-from typing import Callable, Optional
+from typing import Callable, Optional, Tuple, Union
 
 from k8s_watcher_tpu.metrics import MetricsRegistry
 from k8s_watcher_tpu.pipeline.pipeline import Notification
 
 logger = logging.getLogger(__name__)
+
+_Key = Tuple[str, str]
+
+
+def coalesce_key(notification: Notification) -> Optional[_Key]:
+    """Latest-wins identity of a notification, or None if it must never be
+    collapsed. Pods coalesce on uid, slices on the slice key; probe reports
+    pass through uncoalesced (each carries distinct measurements)."""
+    payload = notification.payload
+    if notification.kind == "pod":
+        uid = payload.get("uid")
+        return ("pod", uid) if uid else None
+    if notification.kind == "slice":
+        key = payload.get("slice")
+        return ("slice", key) if key else None
+    return None
 
 
 class Dispatcher:
@@ -33,12 +57,17 @@ class Dispatcher:
         *,
         capacity: int = 1024,
         workers: int = 2,
+        coalesce: bool = True,
         metrics: Optional[MetricsRegistry] = None,
     ):
         self._send = send
-        self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, capacity))
+        self._queue: "queue.Queue[Union[Notification, _Key]]" = queue.Queue(maxsize=max(1, capacity))
         self._workers = max(1, workers)
         self._threads: list = []
+        self._coalesce = coalesce
+        # key -> freshest Notification not yet claimed by a worker
+        self._pending: dict = {}
+        self._pending_lock = threading.Lock()
         self.metrics = metrics or MetricsRegistry()
         self._started = False
         self._stopping = threading.Event()
@@ -53,25 +82,52 @@ class Dispatcher:
             self._threads.append(t)
 
     def submit(self, notification: Notification) -> bool:
-        """Enqueue without blocking; drop-oldest on overflow. Returns False
-        only if the notification was itself dropped (or we're shutting down)."""
+        """Enqueue without blocking; coalesce per-key, drop-oldest on
+        overflow. Returns False only if the notification was itself dropped
+        (or we're shutting down)."""
         if self._stopping.is_set():
             self.metrics.counter("dispatch_dropped_stopping").inc()
             return False
         if not self._started:
             self.start()
+
+        entry: Union[Notification, _Key] = notification
+        if self._coalesce:
+            key = coalesce_key(notification)
+            if key is not None:
+                with self._pending_lock:
+                    if key in self._pending:
+                        # a queued (unclaimed) entry exists for this object:
+                        # newer state supersedes it in place, no new slot
+                        self._pending[key] = notification
+                        self.metrics.counter("dispatch_coalesced").inc()
+                        return True
+                    self._pending[key] = notification
+                entry = key
+
         while True:
             try:
-                self._queue.put_nowait(notification)
+                self._queue.put_nowait(entry)
                 self.metrics.counter("dispatch_enqueued").inc()
                 return True
             except queue.Full:
                 try:
-                    self._queue.get_nowait()
+                    oldest = self._queue.get_nowait()
                     self._queue.task_done()
+                    # (cannot be our own entry: at most one slot per key
+                    # exists, and ours hasn't been enqueued yet)
+                    if not isinstance(oldest, Notification):
+                        with self._pending_lock:
+                            self._pending.pop(oldest, None)
                     self.metrics.counter("dispatch_dropped_overflow").inc()
                 except queue.Empty:
                     pass
+
+    def _claim(self, entry: Union[Notification, _Key]) -> Optional[Notification]:
+        if isinstance(entry, Notification):
+            return entry
+        with self._pending_lock:
+            return self._pending.pop(entry, None)
 
     def _worker(self) -> None:
         hist = self.metrics.histogram("event_to_notify_latency")
@@ -83,14 +139,17 @@ class Dispatcher:
                     return
                 continue
             try:
+                notification = self._claim(item)
+                if notification is None:
+                    continue  # its slot was dropped by overflow handling
                 ok = False
                 try:
-                    ok = self._send(item.payload)
+                    ok = self._send(notification.payload)
                 except Exception as exc:  # send contract is boolean, but be safe
                     logger.error("Notifier raised: %s", exc)
                 if ok:
                     self.metrics.counter("dispatch_sent").inc()
-                    hist.record(time.monotonic() - item.received_monotonic)
+                    hist.record(time.monotonic() - notification.received_monotonic)
                 else:
                     self.metrics.counter("dispatch_failed").inc()
             finally:

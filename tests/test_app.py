@@ -32,14 +32,21 @@ class RecordingNotifier:
         return True
 
 
-def dev_config(**overrides):
+def dev_config(*, coalesce=True):
     cfg = load_config("development", CONFIG_DIR, env={})
+    if not coalesce:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, clusterapi=dataclasses.replace(cfg.clusterapi, coalesce=False))
     return cfg
 
 
 class TestWatcherApp:
     def test_end_to_end_fake_cycle(self):
-        config = dev_config()
+        # coalesce off: this test asserts the FULL event history arrives;
+        # with latest-wins coalescing a back-to-back burst for one pod
+        # legitimately collapses (covered by test_coalesced_fake_cycle)
+        config = dev_config(coalesce=False)
         notifier = RecordingNotifier()
         source = FakeWatchSource(pod_lifecycle("w0", phases=("Pending", "Running"), tpu_chips=4))
         app = WatcherApp(config, source=source, notifier=notifier)
@@ -47,8 +54,19 @@ class TestWatcherApp:
         kinds = [p["event_type"] for p in notifier.payloads]
         assert kinds == ["ADDED", "MODIFIED", "DELETED"]
 
-    def test_use_mock_source_built_from_config(self):
+    def test_coalesced_fake_cycle_delivers_final_state(self):
+        # default config (coalesce on): a burst for one pod may collapse,
+        # but the LAST delivered state must be the final one
         config = dev_config()
+        notifier = RecordingNotifier()
+        source = FakeWatchSource(pod_lifecycle("w0", phases=("Pending", "Running"), tpu_chips=4))
+        app = WatcherApp(config, source=source, notifier=notifier)
+        app.run()
+        assert notifier.payloads, "at least the final state must be delivered"
+        assert notifier.payloads[-1]["event_type"] == "DELETED"
+
+    def test_use_mock_source_built_from_config(self):
+        config = dev_config(coalesce=False)
         assert config.kubernetes.use_mock
         notifier = RecordingNotifier()
         app = WatcherApp(config, notifier=notifier)  # source from config (fake, hold_open)

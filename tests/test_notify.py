@@ -234,3 +234,93 @@ def test_verify_tls_config_key():
 
     assert ClusterApiConfig.from_raw({"verify_tls": False}).verify_tls is False
     assert ClusterApiConfig.from_raw({}).verify_tls is True
+
+
+class TestCoalescing:
+    """Latest-wins per object while queued (dispatcher backpressure tier 1)."""
+
+    def _pod(self, uid, phase, t=None):
+        return Notification({"uid": uid, "name": uid, "phase": phase}, t or time.monotonic(), kind="pod")
+
+    def _gated_dispatcher(self, **kwargs):
+        """Single worker blocked on a gate so submissions pile up queued."""
+        gate = threading.Event()
+        sent = []
+
+        def send(p):
+            gate.wait(5)
+            sent.append(p)
+            return True
+
+        d = Dispatcher(send, workers=1, metrics=MetricsRegistry(), **kwargs)
+        d.start()
+        return d, gate, sent
+
+    def test_same_uid_collapses_to_newest(self):
+        d, gate, sent = self._gated_dispatcher()
+        d.submit(self._pod("u1", "plug"))  # claimed by the worker (in flight)
+        time.sleep(0.1)
+        for phase in ("Pending", "Running", "Failed"):
+            d.submit(self._pod("u1", phase))
+        gate.set()
+        assert d.drain(5.0)
+        d.stop()
+        # in-flight send + ONE coalesced entry carrying the newest phase
+        assert [p["phase"] for p in sent] == ["plug", "Failed"]
+        assert d.metrics.counter("dispatch_coalesced").value == 2
+
+    def test_distinct_uids_do_not_coalesce(self):
+        d, gate, sent = self._gated_dispatcher()
+        for i in range(4):
+            d.submit(self._pod(f"u{i}", "Running"))
+        gate.set()
+        assert d.drain(5.0)
+        d.stop()
+        assert sorted(p["uid"] for p in sent) == ["u0", "u1", "u2", "u3"]
+
+    def test_slices_coalesce_on_slice_key(self):
+        d, gate, sent = self._gated_dispatcher()
+        d.submit(Notification({"slice": "js/a", "phase": "Forming"}, time.monotonic(), kind="slice"))
+        time.sleep(0.1)
+        d.submit(Notification({"slice": "js/a", "phase": "Ready"}, time.monotonic(), kind="slice"))
+        d.submit(Notification({"slice": "js/a", "phase": "Degraded"}, time.monotonic(), kind="slice"))
+        gate.set()
+        assert d.drain(5.0)
+        d.stop()
+        assert [p["phase"] for p in sent] == ["Forming", "Degraded"]
+
+    def test_coalesce_disabled_preserves_history(self):
+        d, gate, sent = self._gated_dispatcher(coalesce=False)
+        d.submit(self._pod("u1", "a"))
+        time.sleep(0.1)
+        d.submit(self._pod("u1", "b"))
+        d.submit(self._pod("u1", "c"))
+        gate.set()
+        assert d.drain(5.0)
+        d.stop()
+        assert [p["phase"] for p in sent] == ["a", "b", "c"]
+
+    def test_probe_reports_never_coalesce(self):
+        d, gate, sent = self._gated_dispatcher()
+        d.submit(Notification({"host": "h0", "rtt": 1}, time.monotonic(), kind="probe"))
+        time.sleep(0.1)
+        d.submit(Notification({"host": "h0", "rtt": 2}, time.monotonic(), kind="probe"))
+        d.submit(Notification({"host": "h0", "rtt": 3}, time.monotonic(), kind="probe"))
+        gate.set()
+        assert d.drain(5.0)
+        d.stop()
+        assert [p["rtt"] for p in sent] == [1, 2, 3]
+
+    def test_overflow_drop_cleans_pending_map(self):
+        gate = threading.Event()
+        d = Dispatcher(lambda p: gate.wait(5) or True, workers=1, capacity=2, metrics=MetricsRegistry())
+        d.start()
+        d.submit(self._pod("u0", "x"))  # claimed by worker
+        time.sleep(0.1)
+        for i in range(1, 6):  # 5 distinct uids through a 2-slot queue
+            d.submit(self._pod(f"u{i}", "y"))
+        gate.set()
+        assert d.drain(5.0)
+        d.stop()
+        assert d._pending == {}  # dropped slots must not leak pending payloads
+        assert d.metrics.counter("dispatch_dropped_overflow").value == 3
