@@ -7,11 +7,39 @@ Environment resolution order: CLI argument > ``ENVIRONMENT`` env var >
 
 from __future__ import annotations
 
+import logging
+import signal
 import sys
+import threading
 from typing import Optional, Sequence
 
 from k8s_watcher_tpu.config.loader import ConfigError, load_config, resolve_environment
 from k8s_watcher_tpu.logging_setup import setup_logging
+
+logger = logging.getLogger(__name__)
+
+
+def install_signal_handlers(app) -> bool:
+    """Route SIGTERM/SIGINT to a graceful ``app.stop()``.
+
+    Graceful means: abort the watch read promptly, release the leadership
+    Lease (standby takes over immediately), drain the notification queue,
+    and flush the checkpoint — all well inside k8s's default 30 s
+    terminationGracePeriod. The reference had no SIGTERM story at all: only
+    a KeyboardInterrupt handler (pod_watcher.py:271-272), so every k8s pod
+    stop was an abrupt kill. Returns False when not on the main thread
+    (signal.signal is main-thread-only; embedding callers handle signals
+    themselves)."""
+
+    def _handle(signum, frame):
+        logger.info("Received %s; shutting down gracefully", signal.Signals(signum).name)
+        app.stop()
+
+    if threading.current_thread() is not threading.main_thread():
+        return False
+    signal.signal(signal.SIGTERM, _handle)
+    signal.signal(signal.SIGINT, _handle)
+    return True
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -28,7 +56,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         setup_logging(environment, config.watcher.log_level)
         from k8s_watcher_tpu.app import WatcherApp
 
-        WatcherApp(config).run()
+        app = WatcherApp(config)
+        install_signal_handlers(app)
+        app.run()
     except KeyboardInterrupt:
         return 0
     except Exception as exc:

@@ -76,6 +76,9 @@ class KubernetesWatchSource:
 
     def stop(self) -> None:
         self._stop.set()
+        # wake a consumer blocked in the stream read: on a quiet cluster the
+        # next frame could be minutes away, far past any SIGTERM grace period
+        self.client.abort_watch()
 
     # -- internals ---------------------------------------------------------
 

@@ -1,0 +1,154 @@
+"""Graceful-shutdown tests: SIGTERM/SIGINT route to app.stop(), the blocked
+watch read is aborted promptly, the leadership Lease is released, and queued
+notifications drain — all inside a k8s terminationGracePeriod. (The
+reference only handled KeyboardInterrupt — pod_watcher.py:271-272 — so any
+real pod stop was an abrupt kill.)"""
+
+import dataclasses
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from conftest import CONFIG_DIR
+
+from k8s_watcher_tpu.app import WatcherApp
+from k8s_watcher_tpu.cli import install_signal_handlers
+from k8s_watcher_tpu.config.loader import load_config
+from k8s_watcher_tpu.config.schema import LeaderElectionConfig
+from k8s_watcher_tpu.k8s.client import K8sClient
+from k8s_watcher_tpu.k8s.kubeconfig import K8sConnection
+from k8s_watcher_tpu.k8s.mock_server import MockApiServer
+from k8s_watcher_tpu.k8s.watch import KubernetesWatchSource
+from k8s_watcher_tpu.watch.fake import build_pod
+
+
+@pytest.fixture
+def mock_api():
+    with MockApiServer() as server:
+        yield server
+
+
+@pytest.fixture
+def restore_signals():
+    old_term = signal.getsignal(signal.SIGTERM)
+    old_int = signal.getsignal(signal.SIGINT)
+    yield
+    signal.signal(signal.SIGTERM, old_term)
+    signal.signal(signal.SIGINT, old_int)
+
+
+class Recorder:
+    def __init__(self):
+        self.payloads = []
+        self.lock = threading.Lock()
+
+    def update_pod_status(self, payload):
+        with self.lock:
+            self.payloads.append(payload)
+        return True
+
+    def health_check(self):
+        return True
+
+
+def make_app(mock_api, *, leader=False):
+    config = load_config("development", CONFIG_DIR, env={})
+    if leader:
+        watcher = dataclasses.replace(
+            config.watcher,
+            leader_election=LeaderElectionConfig(
+                enabled=True,
+                lease_name="shutdown-test",
+                lease_namespace="default",
+                lease_duration_seconds=5.0,
+                renew_deadline_seconds=3.0,
+                retry_period_seconds=0.2,
+                identity="shutdown-replica",
+            ),
+        )
+        config = dataclasses.replace(config, watcher=watcher)
+    notifier = Recorder()
+    source = KubernetesWatchSource(
+        K8sClient(K8sConnection(server=mock_api.url), request_timeout=5.0),
+        # a LONG quiet watch window: shutdown must not wait it out
+        watch_timeout_seconds=120,
+    )
+    return WatcherApp(config, source=source, notifier=notifier), notifier
+
+
+class TestGracefulShutdown:
+    def test_sigterm_stops_watcher_promptly_on_quiet_stream(self, mock_api, restore_signals):
+        mock_api.cluster.add_pod(build_pod("tpu-a", tpu_chips=4))
+        app, notifier = make_app(mock_api)
+        assert install_signal_handlers(app)
+        t = threading.Thread(target=app.run, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not notifier.payloads:
+            time.sleep(0.05)
+        assert notifier.payloads, "watcher must be live before the signal"
+
+        t0 = time.monotonic()
+        os.kill(os.getpid(), signal.SIGTERM)  # handler runs on the main thread
+        t.join(timeout=10)
+        elapsed = time.monotonic() - t0
+        assert not t.is_alive(), "run() must return after SIGTERM"
+        # the 120s watch window must have been aborted, not waited out
+        assert elapsed < 8.0, f"shutdown took {elapsed:.1f}s"
+
+    def test_sigterm_releases_leadership_lease(self, mock_api, restore_signals):
+        app, _ = make_app(mock_api, leader=True)
+        assert install_signal_handlers(app)
+        t = threading.Thread(target=app.run, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not (app.elector and app.elector.is_leader):
+            time.sleep(0.05)
+        assert app.elector is not None and app.elector.is_leader
+
+        os.kill(os.getpid(), signal.SIGTERM)
+        t.join(timeout=10)
+        assert not t.is_alive()
+        lease = K8sClient(K8sConnection(server=mock_api.url)).get_lease("default", "shutdown-test")
+        assert lease["spec"]["holderIdentity"] == "", "clean exit must release the Lease"
+
+    def test_sigint_handled_same_as_sigterm(self, mock_api, restore_signals):
+        app, _ = make_app(mock_api)
+        assert install_signal_handlers(app)
+        t = threading.Thread(target=app.run, daemon=True)
+        t.start()
+        time.sleep(0.5)
+        os.kill(os.getpid(), signal.SIGINT)
+        t.join(timeout=10)
+        assert not t.is_alive()
+
+    def test_queued_notifications_drain_before_exit(self, mock_api, restore_signals):
+        mock_api.cluster.add_pod(build_pod("tpu-a", tpu_chips=4))
+        mock_api.cluster.add_pod(build_pod("tpu-b", tpu_chips=4))
+        app, notifier = make_app(mock_api)
+        assert install_signal_handlers(app)
+        t = threading.Thread(target=app.run, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and len(notifier.payloads) < 2:
+            time.sleep(0.05)
+        os.kill(os.getpid(), signal.SIGTERM)
+        t.join(timeout=10)
+        assert not t.is_alive()
+        names = {p.get("name") for p in notifier.payloads}
+        assert {"tpu-a", "tpu-b"} <= names
+
+    def test_install_refused_off_main_thread(self, mock_api):
+        app, _ = make_app(mock_api)
+        result = {}
+
+        def worker():
+            result["installed"] = install_signal_handlers(app)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        assert result["installed"] is False
