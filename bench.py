@@ -231,8 +231,12 @@ def bench_probe() -> dict:
         devices = jax.devices()
         # inner chains amortize per-dispatch overhead (large under the
         # remote-tunnel dev setup) out of the per-op measurements
+        from k8s_watcher_tpu.probe.hbm import run_hbm_probe, run_hbm_write_probe
+
         ici = run_ici_probe(payload_bytes=4 * 1024 * 1024, iters=5, inner_iters=100)
         mxu = run_mxu_probe(8192, iters=3, inner_iters=16)
+        hbm_r = run_hbm_probe(256 * 1024 * 1024)
+        hbm_w = run_hbm_write_probe(256 * 1024 * 1024)
         return {
             "platform": devices[0].platform,
             "device_kind": devices[0].device_kind,
@@ -241,7 +245,10 @@ def bench_probe() -> dict:
             "psum_compile_ms": round(ici.compile_ms, 1),
             "allreduce_bus_gbps": round(ici.bandwidth_gbps, 2),
             "mxu_tflops": round(mxu.get("tflops", 0.0), 2),
-            "probe_ok": ici.ok and mxu.get("ok", False),
+            "hbm_read_gbps": round(hbm_r.get("read_gbps", 0.0), 1),
+            "hbm_write_gbps": round(hbm_w.get("write_gbps", 0.0), 1),
+            "hbm_integrity_ok": bool(hbm_r.get("ok", False) and hbm_w.get("ok", False)),
+            "probe_ok": ici.ok and mxu.get("ok", False) and hbm_r.get("ok", False) and hbm_w.get("ok", False),
         }
     except Exception as exc:  # bench must still report the watcher numbers
         return {"error": str(exc)}

@@ -354,6 +354,9 @@ class TpuConfig:
     probe_rtt_warn_ms: float = 50.0
     probe_matmul_size: int = 1024
     probe_hbm_bytes: int = 256 * 1024 * 1024  # 0 disables the HBM sweep
+    # write-bandwidth + pattern-integrity pass (block-indexed pattern write,
+    # per-block checksum readback localizing bad HBM address ranges)
+    probe_hbm_write_enabled: bool = True
     expected_chips_per_host: int = 0  # 0 = don't enforce
     # per-link localization probe (probe/links.py): O(links) small compiles,
     # so off by default; turn on to get which-chip/which-link diagnostics
@@ -389,8 +392,8 @@ class TpuConfig:
         _check_known(
             probe,
             ("enabled", "interval_seconds", "payload_bytes", "rtt_warn_ms", "matmul_size",
-             "hbm_bytes", "expected_chips_per_host", "links_enabled", "link_rtt_factor",
-             "multislice_enabled", "multislice_slices", "profile_dir"),
+             "hbm_bytes", "hbm_write_enabled", "expected_chips_per_host", "links_enabled",
+             "link_rtt_factor", "multislice_enabled", "multislice_slices", "profile_dir"),
             "tpu.probe",
         )
         return cls(
@@ -405,6 +408,7 @@ class TpuConfig:
             probe_rtt_warn_ms=_opt_num(probe, "rtt_warn_ms", "tpu.probe", 50.0),
             probe_matmul_size=_opt_int(probe, "matmul_size", "tpu.probe", 1024),
             probe_hbm_bytes=_opt_int(probe, "hbm_bytes", "tpu.probe", 256 * 1024 * 1024),
+            probe_hbm_write_enabled=_opt_bool(probe, "hbm_write_enabled", "tpu.probe", True),
             expected_chips_per_host=_opt_int(probe, "expected_chips_per_host", "tpu.probe", 0),
             probe_links_enabled=_opt_bool(probe, "links_enabled", "tpu.probe", False),
             probe_link_rtt_factor=_opt_num(probe, "link_rtt_factor", "tpu.probe", 3.0),

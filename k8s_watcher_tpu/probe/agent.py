@@ -106,16 +106,20 @@ class ProbeAgent:
                 n_slices=self.config.probe_multislice_slices or None
             )
         hbm = None
+        hbm_write = None
         if self.config.probe_hbm_bytes > 0:
-            from k8s_watcher_tpu.probe.hbm import run_hbm_probe
+            from k8s_watcher_tpu.probe.hbm import run_hbm_probe, run_hbm_write_probe
 
             hbm = run_hbm_probe(self.config.probe_hbm_bytes)
+            if self.config.probe_hbm_write_enabled:
+                hbm_write = run_hbm_write_probe(self.config.probe_hbm_bytes)
         report = ProbeReport(
             environment=self.environment,
             devices=devices,
             ici=ici,
             mxu=mxu,
             hbm=hbm,
+            hbm_write=hbm_write,
             links=links,
             multislice=multislice,
             rtt_warn_ms=self.config.probe_rtt_warn_ms,

@@ -18,6 +18,7 @@ class ProbeReport:
     ici: Optional[IciProbeResult] = None
     mxu: Optional[Dict[str, Any]] = None
     hbm: Optional[Dict[str, Any]] = None
+    hbm_write: Optional[Dict[str, Any]] = None  # write-bw + block integrity
     links: Optional[Any] = None  # probe.links.LinkProbeResult
     multislice: Optional[Any] = None  # probe.multislice.MultiSliceProbeResult
     rtt_warn_ms: float = 50.0
@@ -39,6 +40,8 @@ class ProbeReport:
             return False
         if self.hbm is not None and not self.hbm.get("ok", False):
             return False
+        if self.hbm_write is not None and not self.hbm_write.get("ok", False):
+            return False
         if self.links is not None and not self.links.ok:
             return False
         if self.multislice is not None and not self.multislice.ok:
@@ -56,6 +59,7 @@ class ProbeReport:
             "ici": self.ici.to_dict() if self.ici else None,
             "mxu": self.mxu,
             "hbm": self.hbm,
+            "hbm_write": self.hbm_write,
             "links": self.links.to_dict() if self.links is not None else None,
             "multislice": self.multislice.to_dict() if self.multislice is not None else None,
             "duration_ms": self.duration_ms,

@@ -155,3 +155,56 @@ class TestProbeProfiling:
         agent.run_once()
         runs = [d for d in (tmp_path / "plugins" / "profile").iterdir() if d.is_dir()]
         assert len(runs) == 1
+
+
+class TestHbmWriteProbe:
+    def test_write_probe_integrity_clean(self):
+        from k8s_watcher_tpu.probe.hbm import run_hbm_write_probe
+
+        out = run_hbm_write_probe(1 << 22, iters=1)
+        assert out["ok"] and out["integrity_ok"]
+        assert out["bad_block_count"] == 0 and out["bad_blocks"] == []
+        assert out["interpreted"] is True  # CPU test mesh
+        assert out["bytes"] > 0 and out["write_gbps"] > 0
+
+    def test_write_probe_localizes_corrupted_block(self):
+        from k8s_watcher_tpu.probe.hbm import BLOCK_ROWS, run_hbm_write_probe
+
+        def corrupt(y):
+            # flip one element inside block 1 (rows BLOCK_ROWS..2*BLOCK_ROWS)
+            return y.at[BLOCK_ROWS + 7, 3].add(1e6)
+
+        out = run_hbm_write_probe(1 << 23, iters=1, corrupt_hook=corrupt)
+        assert not out["ok"]
+        assert out["bad_block_count"] == 1
+        assert out["bad_blocks"][0]["block"] == 1
+        assert out["bad_blocks"][0]["byte_offset"] == BLOCK_ROWS * 512 * 4
+
+    def test_agent_includes_hbm_write_and_health_gate(self):
+        from k8s_watcher_tpu.config.schema import TpuConfig
+        from k8s_watcher_tpu.probe.agent import ProbeAgent
+
+        config = TpuConfig(
+            probe_enabled=True, probe_payload_bytes=1 << 14, probe_matmul_size=64,
+            probe_rtt_warn_ms=10_000.0, probe_hbm_bytes=1 << 22,
+        )
+        agent = ProbeAgent(config, environment="development", sink=lambda n: None, expected_platform="cpu")
+        report = agent.run_once()
+        assert report.hbm_write is not None and report.hbm_write["ok"]
+        assert report.healthy
+        assert report.to_payload()["hbm_write"]["integrity_ok"] is True
+        # a failed write-integrity result must flip overall health
+        report.hbm_write = {"ok": False, "bad_block_count": 3}
+        assert not report.healthy
+
+    def test_agent_hbm_write_disabled(self):
+        from k8s_watcher_tpu.config.schema import TpuConfig
+        from k8s_watcher_tpu.probe.agent import ProbeAgent
+
+        config = TpuConfig(
+            probe_enabled=True, probe_payload_bytes=1 << 14, probe_matmul_size=64,
+            probe_rtt_warn_ms=10_000.0, probe_hbm_bytes=1 << 22, probe_hbm_write_enabled=False,
+        )
+        agent = ProbeAgent(config, environment="development", sink=lambda n: None, expected_platform="cpu")
+        report = agent.run_once()
+        assert report.hbm is not None and report.hbm_write is None

@@ -129,13 +129,27 @@ class TestGracefulShutdown:
         mock_api.cluster.add_pod(build_pod("tpu-a", tpu_chips=4))
         mock_api.cluster.add_pod(build_pod("tpu-b", tpu_chips=4))
         app, notifier = make_app(mock_api)
+        # hold every send hostage until AFTER the signal, so SIGTERM lands
+        # with the queue still full — this is what actually proves shutdown
+        # drains instead of dropping
+        gate = threading.Event()
+        original_send = notifier.update_pod_status
+
+        def gated_send(payload):
+            gate.wait(10)
+            return original_send(payload)
+
+        app.dispatcher._send = gated_send
         assert install_signal_handlers(app)
         t = threading.Thread(target=app.run, daemon=True)
         t.start()
         deadline = time.monotonic() + 10
-        while time.monotonic() < deadline and len(notifier.payloads) < 2:
+        while time.monotonic() < deadline and app.metrics.counter("dispatch_enqueued").value < 2:
             time.sleep(0.05)
+        assert not notifier.payloads, "sends must still be gated"
         os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(0.2)
+        gate.set()  # released only after the signal: drain must deliver them
         t.join(timeout=10)
         assert not t.is_alive()
         names = {p.get("name") for p in notifier.payloads}
