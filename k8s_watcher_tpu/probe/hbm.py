@@ -41,7 +41,6 @@ meaningless there, but the code paths stay testable on the CPU mesh.
 from __future__ import annotations
 
 import functools
-import itertools
 import logging
 import time
 from typing import Any, Dict, Optional
@@ -51,30 +50,15 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from k8s_watcher_tpu.probe.timing import fence_baseline_ms as _fence_baseline_ms
+from k8s_watcher_tpu.probe.timing import fetch_scalar as _fetch_scalar
+
 logger = logging.getLogger(__name__)
 
 LANES = 128
 BLOCK_ROWS = 1024  # 1024 x 512 f32 = 2 MiB per block: large enough to be
 WIDTH = 4 * LANES  # DMA-bound, small enough to double-buffer in ~16MB VMEM
 BYTES_PER_BLOCK = BLOCK_ROWS * WIDTH * 4
-
-
-def _fetch_scalar(x: jax.Array) -> float:
-    """Read one element back to the host — the only reliable completion
-    fence on remote/tunneled platforms (see module docstring)."""
-    return float(jnp.reshape(x, (-1,))[0])
-
-
-def _fence_baseline_ms(device: jax.Device, samples: int = 3) -> float:
-    """Median cost of the completion fence itself (dispatch + readback)."""
-    tiny = jax.device_put(jnp.zeros((2,), jnp.float32), device)
-    _fetch_scalar(tiny)  # warm the dispatch path
-    costs = []
-    for _ in range(samples):
-        t0 = time.perf_counter()
-        _fetch_scalar(tiny)
-        costs.append(1e3 * (time.perf_counter() - t0))
-    return sorted(costs)[len(costs) // 2]
 
 
 def _reduce_kernel(in_ref, out_ref):
@@ -167,16 +151,24 @@ def _pick_repeats(actual_bytes: int, target_traffic: int = 32 << 30) -> int:
     return max(1, min(256, target_traffic // max(actual_bytes, 1)))
 
 
-def _timed_pass_ms(run_fenced, iters: int, baseline_ms: float, repeats: int):
+def _timed_pass_ms(run_fenced, iters: int, baseline_ms: float, repeats: int,
+                   budget_ms: float = 10_000.0):
     """(per_pass_ms, unreliable): median-of-iters minus the fence baseline.
+
     When the measurement is swamped by fence noise (device share under a
     quarter of the baseline), the bandwidth number is flagged unreliable —
-    integrity results are unaffected."""
+    integrity results are unaffected. On a badly degraded part each
+    execution can take seconds, so the loop stops once ``budget_ms`` of
+    wall time is spent (the degradation signal is already unambiguous by
+    then) instead of stretching the whole probe cycle."""
     per_exec = []
+    loop_t0 = time.perf_counter()
     for _ in range(iters):
         t0 = time.perf_counter()
         run_fenced()
         per_exec.append(1e3 * (time.perf_counter() - t0))
+        if 1e3 * (time.perf_counter() - loop_t0) > budget_ms:
+            break
     median = sorted(per_exec)[len(per_exec) // 2]
     device_ms = median - baseline_ms
     unreliable = device_ms < 0.25 * baseline_ms
@@ -266,12 +258,17 @@ def run_hbm_write_probe(
             compile_ms = 1e3 * (time.perf_counter() - t0)
 
             baseline_ms = _fence_baseline_ms(device)
-            seeds = itertools.count(1)
+            # seeds pre-created AND pre-fenced: creating one inside the timed
+            # window would add an un-subtracted host->device transfer per
+            # iteration (observed ~2-3x low bandwidth on tunneled platforms).
+            # A fresh seed per timed run keeps executions distinct.
+            seed_arrays = [jnp.full((1, 1), float(k + 1), jnp.float32) for k in range(iters)]
+            for s in seed_arrays:
+                _fetch_scalar(s)
+            seeds = iter(seed_arrays)
 
             def run_fenced():
-                # a fresh seed per timed run keeps executions distinct
-                seed = jnp.full((1, 1), float(next(seeds)), jnp.float32)
-                _fetch_scalar(write(seed))
+                _fetch_scalar(write(next(seeds)))
 
             pass_ms, unreliable = _timed_pass_ms(run_fenced, iters, baseline_ms, repeats)
 

@@ -1,10 +1,12 @@
 """Timed ICI + MXU probes.
 
-Measurement discipline: every program is jitted once (warmup call pays the
-compile), then timed over ``iters`` steady-state iterations with
-``block_until_ready`` fencing each one. The *minimum* is reported as the
-RTT (least-noise estimate of the hardware path) alongside mean/max for
-jitter visibility.
+Measurement discipline (see probe/timing.py): every program is jitted once
+(warmup call pays the compile) and chains ``inner_iters`` ops inside one
+execution; each timed execution is fenced by a host scalar readback with
+the median fence cost subtracted — ``block_until_ready`` alone can return
+early on tunneled platforms, and the fence itself costs tens of ms there.
+The *minimum* is reported as the RTT (least-noise estimate of the hardware
+path) alongside mean/max for jitter visibility.
 
 North-star coverage (BASELINE.json): "ICI psum probe RTT" is
 ``IciProbeResult.psum_rtt_ms``; the bandwidth probe and MXU matmul catch
@@ -30,6 +32,7 @@ from k8s_watcher_tpu.parallel.collectives import (
     psum_probe_input,
 )
 from k8s_watcher_tpu.parallel.mesh import host_chip_mesh
+from k8s_watcher_tpu.probe.timing import fence_baseline_ms, fetch_scalar, timed_fenced
 
 logger = logging.getLogger(__name__)
 
@@ -52,14 +55,6 @@ class IciProbeResult:
         return dataclasses.asdict(self)
 
 
-def timed(fn, x, iters: int) -> tuple:
-    """(min, mean, max) seconds over ``iters`` fenced calls."""
-    times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        jax.block_until_ready(fn(x))
-        times.append(time.perf_counter() - t0)
-    return min(times), sum(times) / len(times), max(times)
 
 
 def run_ici_probe(
@@ -85,21 +80,23 @@ def run_ici_probe(
         t0 = time.perf_counter()
         psum = make_psum_probe(mesh, inner_iters, fault)
         x = psum_probe_input(mesh)
-        result = jax.block_until_ready(psum(x))  # warmup = compile
+        result = psum(x)
+        fetch_scalar(result)  # warmup = compile (host-fenced)
         compile_ms = 1e3 * (time.perf_counter() - t0)
 
         expected = (n + 1) / 2.0  # fixed point of chained psum(x)/n
         psum_correct = bool(np.allclose(np.asarray(result)[0], expected))
 
-        rtt_min, rtt_mean, rtt_max = timed(psum, x, iters)
+        baseline_ms = fence_baseline_ms()
+        rtt_min, rtt_mean, rtt_max = timed_fenced(psum, x, iters, baseline_ms)
         rtt_min, rtt_mean, rtt_max = (t / inner_iters for t in (rtt_min, rtt_mean, rtt_max))
 
         bw_gbps = 0.0
         if payload_bytes > 0 and n > 1:
             bw_fn = make_allreduce_bandwidth_probe(mesh, payload_bytes, fault)
             payload = bandwidth_probe_input(mesh, payload_bytes)
-            jax.block_until_ready(bw_fn(payload))  # compile
-            bw_min, _, _ = timed(bw_fn, payload, max(3, iters // 3))
+            fetch_scalar(bw_fn(payload))  # compile
+            bw_min, _, _ = timed_fenced(bw_fn, payload, max(3, iters // 3), baseline_ms)
             bw_gbps = allreduce_bus_bandwidth_gbps(payload_bytes, n, bw_min)
 
         return IciProbeResult(
@@ -156,9 +153,11 @@ def run_mxu_probe(
         key = jax.random.PRNGKey(0)
         a = jax.device_put(jax.random.normal(key, (size, size), dtype=jnp.bfloat16), device)
         b = jax.device_put(jax.random.normal(jax.random.fold_in(key, 1), (size, size), dtype=jnp.bfloat16), device)
-        out = jax.block_until_ready(step(a, b))  # compile
+        out = step(a, b)
+        fetch_scalar(out)  # compile (host-fenced)
         finite = bool(jnp.isfinite(out.astype(jnp.float32)).all())
-        tmin, tmean, tmax = timed(lambda ab: step(*ab), (a, b), iters)
+        baseline_ms = fence_baseline_ms(device)
+        tmin, tmean, tmax = timed_fenced(lambda ab: step(*ab), (a, b), iters, baseline_ms)
         tflops = 2.0 * size**3 * inner_iters / tmin / 1e12
         return {
             "ok": finite,

@@ -35,7 +35,7 @@ from k8s_watcher_tpu.parallel.collectives import (
     psum_probe_input,
 )
 from k8s_watcher_tpu.parallel.mesh import hybrid_slice_mesh
-from k8s_watcher_tpu.probe.ici import timed
+from k8s_watcher_tpu.probe.timing import fence_baseline_ms, timed_fenced
 
 logger = logging.getLogger(__name__)
 
@@ -98,8 +98,9 @@ def run_multislice_probe(
         ]
         global_ok = abs(float(np.asarray(global_sum).ravel()[0]) - mesh.size) <= 1e-3 * mesh.size
 
-        ici_s = timed(ici_fn, x, iters)[0] / inner_iters
-        total_s = timed(all_fn, x, iters)[0] / inner_iters
+        baseline_ms = fence_baseline_ms()
+        ici_s = timed_fenced(ici_fn, x, iters, baseline_ms)[0] / inner_iters
+        total_s = timed_fenced(all_fn, x, iters, baseline_ms)[0] / inner_iters
 
         if suspect:
             logger.warning(

@@ -1,0 +1,58 @@
+"""Shared probe timing discipline.
+
+Wall-clock measurement on remote/tunneled platforms (the axon dev setup)
+has two failure modes that produced physically impossible numbers before
+this module existed:
+
+- ``jax.block_until_ready`` can return before the execution actually
+  completes, so per-iteration timings undercount (multi-TB/s "bandwidth");
+- every real completion fence (a host scalar readback) costs tens of ms
+  with high variance, so per-iteration timings overcount small ops.
+
+Discipline: amortize real work inside ONE jitted execution (chained inner
+iterations / multi-pass grids), fence each timed execution with a host
+scalar readback, and subtract the separately-measured median fence cost.
+On local TPU deployments the fence is cheap and the same path is simply
+accurate.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def fetch_scalar(out: Any) -> float:
+    """Read one element of (the first leaf of) ``out`` back to the host —
+    the only reliable completion fence on tunneled platforms."""
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    return float(jnp.reshape(leaf, (-1,))[0])
+
+
+def fence_baseline_ms(device: Optional[jax.Device] = None, samples: int = 3) -> float:
+    """Median cost of the completion fence itself (dispatch + readback)."""
+    tiny = jnp.zeros((2,), jnp.float32)
+    if device is not None:
+        tiny = jax.device_put(tiny, device)
+    fetch_scalar(tiny)  # warm the dispatch path
+    costs = []
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        fetch_scalar(tiny)
+        costs.append(1e3 * (time.perf_counter() - t0))
+    return sorted(costs)[len(costs) // 2]
+
+
+def timed_fenced(fn, x, iters: int, baseline_ms: float = 0.0) -> Tuple[float, float, float]:
+    """(min, mean, max) SECONDS over ``iters`` host-fenced executions, each
+    with the fence baseline subtracted (clamped at ~0)."""
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fetch_scalar(fn(x))
+        dt = time.perf_counter() - t0 - baseline_ms / 1e3
+        times.append(max(dt, 1e-9))
+    return min(times), sum(times) / len(times), max(times)

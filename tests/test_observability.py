@@ -178,7 +178,9 @@ class TestHbmWriteProbe:
         assert not out["ok"]
         assert out["bad_block_count"] == 1
         assert out["bad_blocks"][0]["block"] == 1
-        assert out["bad_blocks"][0]["byte_offset"] == BLOCK_ROWS * 512 * 4
+        from k8s_watcher_tpu.probe.hbm import BYTES_PER_BLOCK
+
+        assert out["bad_blocks"][0]["byte_offset"] == BYTES_PER_BLOCK
 
     def test_agent_includes_hbm_write_and_health_gate(self):
         from k8s_watcher_tpu.config.schema import TpuConfig
