@@ -109,6 +109,11 @@ class WatcherApp:
         )
         self.notifier = notifier or build_notifier(config)
         self.liveness = Liveness(config.watcher.liveness_stale_seconds)
+        self.audit = None
+        if config.watcher.audit_ring_size > 0:
+            from k8s_watcher_tpu.metrics.audit import AuditRing
+
+            self.audit = AuditRing(config.watcher.audit_ring_size)
         self.status_server: Optional[StatusServer] = None
         self.dispatcher = Dispatcher(
             self.notifier.update_pod_status,
@@ -137,6 +142,7 @@ class WatcherApp:
             phase_tracker=self.phase_tracker,
             slice_tracker=self.slice_tracker,
             metrics=self.metrics,
+            audit=self.audit,
             resource_key=config.tpu.resource_key,
             topology_label=config.tpu.topology_label,
             accelerator_label=config.tpu.accelerator_label,
@@ -159,9 +165,10 @@ class WatcherApp:
         self.dispatcher.start()
         if self.config.watcher.status_port:
             self.status_server = StatusServer(
-                self.metrics, self.liveness, port=self.config.watcher.status_port
+                self.metrics, self.liveness, port=self.config.watcher.status_port, audit=self.audit
             ).start()
-            logger.info("Status endpoint on :%d (/metrics, /healthz)", self.status_server.port)
+            routes = "/metrics, /healthz" + (", /debug/events" if self.audit is not None else "")
+            logger.info("Status endpoint on :%d (%s)", self.status_server.port, routes)
         if self.config.watcher.leader_election.enabled:
             self._campaign()  # blocks until this replica leads (or stop())
             if self._stop.is_set():

@@ -213,13 +213,16 @@ class WatcherConfig:
     liveness_stale_seconds: float = 900.0
     label_selector: Optional[str] = None  # k8s labelSelector pushed to the API server
     leader_election: LeaderElectionConfig = dataclasses.field(default_factory=LeaderElectionConfig)
+    # last-N pipeline decisions served at /debug/events (0 disables)
+    audit_ring_size: int = 256
 
     @classmethod
     def from_raw(cls, raw: Mapping[str, Any]) -> "WatcherConfig":
         _check_known(
             raw,
             ("watch_interval", "log_level", "namespaces", "retry", "alerts",
-             "status_port", "liveness_stale_seconds", "label_selector", "leader_election"),
+             "status_port", "liveness_stale_seconds", "label_selector", "leader_election",
+             "audit_ring_size"),
             "watcher",
         )
         namespaces = raw.get("namespaces") or ()
@@ -243,6 +246,7 @@ class WatcherConfig:
             liveness_stale_seconds=_opt_num(raw, "liveness_stale_seconds", "watcher", 900.0),
             label_selector=_opt_str(raw, "label_selector", "watcher", None),
             leader_election=LeaderElectionConfig.from_raw(raw.get("leader_election") or {}),
+            audit_ring_size=_opt_int(raw, "audit_ring_size", "watcher", 256),
         )
 
 

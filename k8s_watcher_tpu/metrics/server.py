@@ -16,6 +16,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, urlparse
 
 from k8s_watcher_tpu.metrics.metrics import MetricsRegistry
 
@@ -46,6 +47,7 @@ class _StatusHandler(BaseHTTPRequestHandler):
     disable_nagle_algorithm = True
     metrics: MetricsRegistry
     liveness: Liveness
+    audit = None  # metrics.audit.AuditRing, optional
 
     def log_message(self, *a):
         pass
@@ -59,14 +61,26 @@ class _StatusHandler(BaseHTTPRequestHandler):
         self.wfile.write(data)
 
     def do_GET(self):  # noqa: N802
-        if self.path == "/metrics":
+        parsed = urlparse(self.path)
+        if parsed.path == "/metrics":
             self._json(200, self.metrics.dump())
-        elif self.path == "/healthz":
+        elif parsed.path == "/healthz":
             alive = self.liveness.alive()
             self._json(
                 200 if alive else 503,
                 {"alive": alive, "last_heartbeat_age_seconds": round(self.liveness.age_seconds(), 1)},
             )
+        elif parsed.path == "/debug/events":
+            if self.audit is None:
+                self._json(404, {"error": "audit ring disabled (watcher.audit_ring_size: 0)"})
+                return
+            params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+            try:
+                n = int(params.get("n", "50"))
+            except ValueError:
+                self._json(400, {"error": f"bad n={params.get('n')!r}"})
+                return
+            self._json(200, {"events": self.audit.snapshot(n), "ring_size": len(self.audit)})
         else:
             self._json(404, {"error": f"no route {self.path}"})
 
@@ -79,9 +93,12 @@ class StatusServer:
         *,
         host: str = "0.0.0.0",
         port: int = 0,
+        audit=None,  # metrics.audit.AuditRing -> serves /debug/events
     ):
         handler = type(
-            "BoundStatusHandler", (_StatusHandler,), {"metrics": metrics, "liveness": liveness}
+            "BoundStatusHandler",
+            (_StatusHandler,),
+            {"metrics": metrics, "liveness": liveness, "audit": audit},
         )
         self._server = ThreadingHTTPServer((host, port), handler)
         self._server.daemon_threads = True

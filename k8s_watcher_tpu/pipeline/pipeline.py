@@ -58,6 +58,7 @@ class EventPipeline:
         phase_tracker: Optional[PhaseTracker] = None,
         slice_tracker: Optional[Any] = None,  # slices.SliceTracker (optional stage)
         metrics: Optional[MetricsRegistry] = None,
+        audit: Optional[Any] = None,  # metrics.audit.AuditRing
         notify_all: bool = False,
         resource_key: str = "google.com/tpu",
         topology_label: str = "cloud.google.com/gke-tpu-topology",
@@ -73,12 +74,30 @@ class EventPipeline:
         self.phase_tracker = phase_tracker if phase_tracker is not None else PhaseTracker()
         self.slice_tracker = slice_tracker
         self.metrics = metrics or MetricsRegistry()
+        self.audit = audit
         self.notify_all = notify_all
         self.resource_key = resource_key
         self.topology_label = topology_label
         self.accelerator_label = accelerator_label
 
     def process(self, event: WatchEvent) -> PipelineResult:
+        result = self._process(event)
+        if self.audit is not None and event.type != EventType.BOOKMARK:
+            pod_meta = (event.pod or {}).get("metadata") or {}
+            self.audit.record(
+                {
+                    "event_type": event.type,
+                    "namespace": pod_meta.get("namespace"),
+                    "name": pod_meta.get("name"),
+                    "uid": pod_meta.get("uid"),
+                    "phase": ((event.pod or {}).get("status") or {}).get("phase"),
+                    "notified": result.notified,
+                    "outcome": result.reason,
+                }
+            )
+        return result
+
+    def _process(self, event: WatchEvent) -> PipelineResult:
         m = self.metrics
         m.counter("events_received").inc()
 

@@ -210,3 +210,84 @@ class TestHbmWriteProbe:
         agent = ProbeAgent(config, environment="development", sink=lambda n: None, expected_platform="cpu")
         report = agent.run_once()
         assert report.hbm is not None and report.hbm_write is None
+
+
+class TestAuditRing:
+    def _pipeline(self, ring):
+        from k8s_watcher_tpu.pipeline.filters import NamespaceFilter, TpuResourceFilter
+        from k8s_watcher_tpu.pipeline.pipeline import EventPipeline
+
+        return EventPipeline(
+            environment="development",
+            sink=lambda n: None,
+            namespace_filter=NamespaceFilter(()),
+            resource_filter=TpuResourceFilter("google.com/tpu"),
+            audit=ring,
+        )
+
+    def test_records_notify_and_drop_outcomes(self):
+        from k8s_watcher_tpu.metrics.audit import AuditRing
+        from k8s_watcher_tpu.watch.fake import build_pod
+        from k8s_watcher_tpu.watch.source import EventType, WatchEvent
+
+        ring = AuditRing(16)
+        pipe = self._pipeline(ring)
+        pipe.process(WatchEvent(type=EventType.ADDED, pod=build_pod("tpu-a", tpu_chips=4)))
+        pipe.process(WatchEvent(type=EventType.ADDED, pod=build_pod("cpu-b")))  # no TPU -> dropped
+        entries = ring.snapshot()
+        assert len(entries) == 2
+        # newest first
+        assert entries[0]["name"] == "cpu-b" and entries[0]["outcome"] == "resource_filter"
+        assert not entries[0]["notified"]
+        assert entries[1]["name"] == "tpu-a" and entries[1]["outcome"] == "notified"
+        assert entries[1]["notified"] and entries[1]["seq"] == 1
+
+    def test_ring_is_bounded(self):
+        from k8s_watcher_tpu.metrics.audit import AuditRing
+        from k8s_watcher_tpu.watch.fake import build_pod
+        from k8s_watcher_tpu.watch.source import EventType, WatchEvent
+
+        ring = AuditRing(4)
+        pipe = self._pipeline(ring)
+        for i in range(10):
+            pipe.process(WatchEvent(type=EventType.ADDED, pod=build_pod(f"p{i}", tpu_chips=4)))
+        assert len(ring) == 4
+        names = [e["name"] for e in ring.snapshot()]
+        assert names == ["p9", "p8", "p7", "p6"]
+        assert [e["name"] for e in ring.snapshot(2)] == ["p9", "p8"]
+
+    def test_debug_events_endpoint(self):
+        import requests
+
+        from k8s_watcher_tpu.metrics import MetricsRegistry
+        from k8s_watcher_tpu.metrics.audit import AuditRing
+        from k8s_watcher_tpu.metrics.server import Liveness, StatusServer
+
+        ring = AuditRing(8)
+        ring.record({"event_type": "ADDED", "name": "x", "notified": True, "outcome": "notified"})
+        server = StatusServer(MetricsRegistry(), Liveness(), audit=ring).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            body = requests.get(f"{url}/debug/events", timeout=5).json()
+            assert body["ring_size"] == 1
+            assert body["events"][0]["name"] == "x"
+            body = requests.get(f"{url}/debug/events?n=0", timeout=5).json()
+            assert body["events"] == []  # "last 0" is nothing, not everything
+            assert requests.get(f"{url}/debug/events?n=junk", timeout=5).status_code == 400
+        finally:
+            server.stop()
+
+    def test_debug_events_404_when_disabled(self):
+        import requests
+
+        from k8s_watcher_tpu.metrics import MetricsRegistry
+        from k8s_watcher_tpu.metrics.server import Liveness, StatusServer
+
+        server = StatusServer(MetricsRegistry(), Liveness()).start()
+        try:
+            status = requests.get(
+                f"http://127.0.0.1:{server.port}/debug/events", timeout=5
+            ).status_code
+            assert status == 404
+        finally:
+            server.stop()
