@@ -165,9 +165,15 @@ class WatcherApp:
         self.dispatcher.start()
         if self.config.watcher.status_port:
             self.status_server = StatusServer(
-                self.metrics, self.liveness, port=self.config.watcher.status_port, audit=self.audit
+                self.metrics,
+                self.liveness,
+                port=self.config.watcher.status_port,
+                audit=self.audit,
+                slices=self.slice_tracker.debug_snapshot,
             ).start()
-            routes = "/metrics, /healthz" + (", /debug/events" if self.audit is not None else "")
+            routes = "/metrics, /healthz, /debug/slices" + (
+                ", /debug/events" if self.audit is not None else ""
+            )
             logger.info("Status endpoint on :%d (%s)", self.status_server.port, routes)
         if self.config.watcher.leader_election.enabled:
             self._campaign()  # blocks until this replica leads (or stop())

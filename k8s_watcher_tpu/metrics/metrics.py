@@ -112,6 +112,19 @@ class Histogram:
             "max_ms": 1e3 * mx,
         }
 
+    def buckets(self):
+        """Cumulative (upper_bound_seconds, count) pairs, Prometheus-style —
+        the final pair is (inf, total_count)."""
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._n, self._sum
+        out, cum = [], 0
+        for bound, c in zip(self._bounds, counts):
+            cum += c
+            out.append((bound, cum))
+        out.append((float("inf"), total))
+        return out, total, s
+
 
 class MetricsRegistry:
     """Named counters/histograms for one watcher process."""
@@ -132,6 +145,42 @@ class MetricsRegistry:
             if name not in self._histograms:
                 self._histograms[name] = Histogram(name)
             return self._histograms[name]
+
+    def prometheus_text(self, prefix: str = "k8s_watcher_") -> str:
+        """Prometheus text exposition format (v0.0.4) — what real scrapers
+        consume; the JSON dump stays the human/driver-facing shape.
+
+        Counters become ``<prefix><name>_total``; histograms emit the
+        standard ``_bucket{le=...}``/``_sum``/``_count`` triplet in base
+        seconds (Prometheus convention), not the JSON dump's milliseconds.
+        """
+        with self._lock:
+            counters = dict(self._counters)
+            histograms = dict(self._histograms)
+        lines = []
+        for name, c in sorted(counters.items()):
+            metric = f"{prefix}{name}"
+            lines.append(f"# TYPE {metric}_total counter")
+            lines.append(f"{metric}_total {c.value}")
+        for name, h in sorted(histograms.items()):
+            metric = f"{prefix}{name}_seconds"
+            buckets, total, total_sum = h.buckets()
+            lines.append(f"# TYPE {metric} histogram")
+            # the ~140 internal log buckets exist for quantile accuracy;
+            # exporting them all would be ~142 series per histogram per
+            # replica. Downsample to ~2 bounds per decade for exposition
+            # (cumulative counts stay correct under subsetting).
+            last_bound = 0.0
+            for i, (bound, cum) in enumerate(buckets):
+                is_last = i == len(buckets) - 1
+                if not is_last and bound < last_bound * 3.16:
+                    continue
+                last_bound = bound
+                le = "+Inf" if bound == float("inf") else f"{bound:.3g}"
+                lines.append(f'{metric}_bucket{{le="{le}"}} {cum}')
+            lines.append(f"{metric}_sum {total_sum}")
+            lines.append(f"{metric}_count {total}")
+        return "\n".join(lines) + "\n"
 
     def dump(self) -> Dict[str, Dict]:
         with self._lock:

@@ -48,9 +48,18 @@ class _StatusHandler(BaseHTTPRequestHandler):
     metrics: MetricsRegistry
     liveness: Liveness
     audit = None  # metrics.audit.AuditRing, optional
+    slices = None  # Callable[[], dict]: live slice states, optional
 
     def log_message(self, *a):
         pass
+
+    def _text(self, status: int, body: str) -> None:
+        data = body.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
 
     def _json(self, status: int, body: dict) -> None:
         data = json.dumps(body).encode()
@@ -63,7 +72,18 @@ class _StatusHandler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802
         parsed = urlparse(self.path)
         if parsed.path == "/metrics":
-            self._json(200, self.metrics.dump())
+            # JSON by default (human/driver-facing); Prometheus text when a
+            # scraper asks for it (Accept header) or ?format=prometheus
+            accept = self.headers.get("Accept", "")
+            wants_prom = (
+                "format=prometheus" in (parsed.query or "")
+                or "text/plain" in accept
+                or "openmetrics" in accept
+            )
+            if wants_prom:
+                self._text(200, self.metrics.prometheus_text())
+            else:
+                self._json(200, self.metrics.dump())
         elif parsed.path == "/healthz":
             alive = self.liveness.alive()
             self._json(
@@ -81,6 +101,11 @@ class _StatusHandler(BaseHTTPRequestHandler):
                 self._json(400, {"error": f"bad n={params.get('n')!r}"})
                 return
             self._json(200, {"events": self.audit.snapshot(n), "ring_size": len(self.audit)})
+        elif parsed.path == "/debug/slices":
+            if self.slices is None:
+                self._json(404, {"error": "slice tracking not wired"})
+                return
+            self._json(200, {"slices": self.slices()})
         else:
             self._json(404, {"error": f"no route {self.path}"})
 
@@ -94,11 +119,12 @@ class StatusServer:
         host: str = "0.0.0.0",
         port: int = 0,
         audit=None,  # metrics.audit.AuditRing -> serves /debug/events
+        slices=None,  # Callable[[], dict] -> serves /debug/slices
     ):
         handler = type(
             "BoundStatusHandler",
             (_StatusHandler,),
-            {"metrics": metrics, "liveness": liveness, "audit": audit},
+            {"metrics": metrics, "liveness": liveness, "audit": audit, "slices": staticmethod(slices) if slices else None},
         )
         self._server = ThreadingHTTPServer((host, port), handler)
         self._server.daemon_threads = True

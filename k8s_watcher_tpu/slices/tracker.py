@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 from k8s_watcher_tpu.pipeline.phase import PhaseDelta, pod_ready, pod_restarts
@@ -120,6 +121,9 @@ class SliceTracker:
         # checkpointed {key: {"phase", "ever_ready"}} applied lazily when the
         # slice is first observed again after a restart
         self._restored: Dict[str, Any] = {}
+        # observe() runs on the watch thread; debug_snapshot()/snapshot()
+        # are read from HTTP/checkpoint paths on other threads
+        self._lock = threading.RLock()
 
     def __len__(self) -> int:
         return len(self._slices)
@@ -146,6 +150,12 @@ class SliceTracker:
         if identity is None:
             return None, []
 
+        with self._lock:
+            return self._observe_locked(event, identity)
+
+    def _observe_locked(
+        self, event: WatchEvent, identity
+    ) -> Tuple[Optional[Dict[str, Any]], List[Dict[str, Any]]]:
         state = self._slices.get(identity.key)
         if state is None:
             state = SliceState(identity=identity)
@@ -207,12 +217,19 @@ class SliceTracker:
 
     # -- checkpoint integration -------------------------------------------
 
+    def debug_snapshot(self) -> Dict[str, Any]:
+        """Full live slice states for the /debug/slices endpoint (richer
+        than the checkpoint ``snapshot``, which persists only resume state)."""
+        with self._lock:
+            return {key: st.summary() for key, st in self._slices.items() if st.ever_had_members}
+
     def snapshot(self) -> Dict[str, Any]:
-        return {
-            key: {"phase": st.phase, "ever_ready": st.ever_ready}
-            for key, st in self._slices.items()
-            if st.ever_had_members  # never-alive placeholder states aren't worth persisting
-        }
+        with self._lock:
+            return {
+                key: {"phase": st.phase, "ever_ready": st.ever_ready}
+                for key, st in self._slices.items()
+                if st.ever_had_members  # never-alive placeholder states aren't worth persisting
+            }
 
     def restore(self, snapshot: Dict[str, Any]) -> None:
         """Stash a checkpoint snapshot; applied as slices are re-observed."""

@@ -291,3 +291,87 @@ class TestAuditRing:
             assert status == 404
         finally:
             server.stop()
+
+
+class TestPrometheusExposition:
+    def test_text_format_counters_and_histograms(self):
+        m = MetricsRegistry()
+        m.counter("events_received").inc(7)
+        m.histogram("event_to_notify_latency").record(0.002)
+        text = m.prometheus_text()
+        assert "# TYPE k8s_watcher_events_received_total counter" in text
+        assert "k8s_watcher_events_received_total 7" in text
+        assert "# TYPE k8s_watcher_event_to_notify_latency_seconds histogram" in text
+        assert 'k8s_watcher_event_to_notify_latency_seconds_bucket{le="+Inf"} 1' in text
+        assert "k8s_watcher_event_to_notify_latency_seconds_count 1" in text
+        assert "k8s_watcher_event_to_notify_latency_seconds_sum 0.002" in text
+
+    def test_bucket_counts_are_cumulative(self):
+        m = MetricsRegistry()
+        h = m.histogram("lat")
+        for s in (0.0001, 0.001, 0.01, 10.0):
+            h.record(s)
+        buckets, total, _ = h.buckets()
+        assert total == 4
+        counts = [c for _, c in buckets]
+        assert counts == sorted(counts), "bucket counts must be cumulative"
+        assert counts[-1] == 4 and buckets[-1][0] == float("inf")
+
+    def test_metrics_endpoint_negotiates_format(self):
+        m = MetricsRegistry()
+        m.counter("events_received").inc(3)
+        server = StatusServer(m, Liveness(), host="127.0.0.1").start()
+        try:
+            url = f"http://127.0.0.1:{server.port}/metrics"
+            assert requests.get(url, timeout=5).json()["events_received"]["count"] == 3
+            r = requests.get(f"{url}?format=prometheus", timeout=5)
+            assert r.headers["Content-Type"].startswith("text/plain")
+            assert "k8s_watcher_events_received_total 3" in r.text
+            r = requests.get(url, headers={"Accept": "text/plain;version=0.0.4"}, timeout=5)
+            assert "k8s_watcher_events_received_total 3" in r.text
+        finally:
+            server.stop()
+
+
+class TestDebugSlicesEndpoint:
+    def test_live_slice_states_served(self):
+        from k8s_watcher_tpu.pipeline.phase import PhaseTracker
+        from k8s_watcher_tpu.slices.tracker import SliceTracker
+        from k8s_watcher_tpu.watch.fake import build_pod
+        from k8s_watcher_tpu.watch.source import EventType, WatchEvent
+
+        tracker = SliceTracker("development")
+        phases = PhaseTracker()
+        for w in range(2):
+            pod = build_pod(
+                f"train-{w}", phase="Running", tpu_chips=4, tpu_topology="2x2x2",
+                gke_slice_fields={
+                    "jobset.sigs.k8s.io/jobset-name": "train",
+                    "batch.kubernetes.io/job-completion-index": w,
+                },
+                container_statuses=[{"name": "main", "ready": True, "restart_count": 0,
+                                     "state": {"running": {}}}],
+            )
+            ev = WatchEvent(type=EventType.ADDED, pod=pod)
+            tracker.observe(ev, phases.observe(ev))
+
+        server = StatusServer(
+            MetricsRegistry(), Liveness(), host="127.0.0.1", slices=tracker.debug_snapshot
+        ).start()
+        try:
+            body = requests.get(f"http://127.0.0.1:{server.port}/debug/slices", timeout=5).json()
+            assert len(body["slices"]) == 1
+            state = next(iter(body["slices"].values()))
+            assert state["observed_workers"] == 2
+            assert len(state["workers"]) == 2
+        finally:
+            server.stop()
+
+    def test_404_when_not_wired(self):
+        server = StatusServer(MetricsRegistry(), Liveness(), host="127.0.0.1").start()
+        try:
+            assert requests.get(
+                f"http://127.0.0.1:{server.port}/debug/slices", timeout=5
+            ).status_code == 404
+        finally:
+            server.stop()
