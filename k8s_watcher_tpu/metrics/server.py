@@ -75,8 +75,9 @@ class _StatusHandler(BaseHTTPRequestHandler):
             # JSON by default (human/driver-facing); Prometheus text when a
             # scraper asks for it (Accept header) or ?format=prometheus
             accept = self.headers.get("Accept", "")
+            params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
             wants_prom = (
-                "format=prometheus" in (parsed.query or "")
+                params.get("format") == "prometheus"
                 or "text/plain" in accept
                 or "openmetrics" in accept
             )

@@ -219,9 +219,19 @@ class SliceTracker:
 
     def debug_snapshot(self) -> Dict[str, Any]:
         """Full live slice states for the /debug/slices endpoint (richer
-        than the checkpoint ``snapshot``, which persists only resume state)."""
+        than the checkpoint ``snapshot``, which persists only resume state).
+
+        Holds the lock only to shallow-copy each state (members are
+        replaced, never mutated in place, so a dict copy suffices); the
+        per-worker summary formatting happens outside so a large-fleet
+        scrape can't stall the watch thread's observe()."""
         with self._lock:
-            return {key: st.summary() for key, st in self._slices.items() if st.ever_had_members}
+            copies = [
+                (key, dataclasses.replace(st, members=dict(st.members)))
+                for key, st in self._slices.items()
+                if st.ever_had_members
+            ]
+        return {key: st.summary() for key, st in copies}
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
