@@ -149,6 +149,7 @@ class WatcherApp:
         )
         self._stop = threading.Event()
         self.elector = None  # k8s.leader.LeaderElector when HA is enabled
+        self.node_watcher = None  # nodes.NodeWatcher when tpu.node_watch is on
         self._probe_agent = None
         if config.tpu.probe_enabled:
             from k8s_watcher_tpu.probe.agent import ProbeAgent
@@ -191,6 +192,7 @@ class WatcherApp:
         )
         if self._probe_agent is not None:
             self._probe_agent.start()
+        self._start_node_watch()
         try:
             for event in self.source.events():
                 if self._stop.is_set():
@@ -243,6 +245,40 @@ class WatcherApp:
             if self.elector.wait_for_leadership(timeout=1.0):
                 return
 
+    def _start_node_watch(self) -> None:
+        """Start the node-plane watch (tpu.node_watch.enabled): a second
+        resilient list+watch over /api/v1/nodes on its own thread + client.
+        Only the elected leader runs it (run() reaches here post-campaign),
+        so a standby doesn't double-notify node transitions."""
+        if not self.config.tpu.node_watch_enabled:
+            return
+        client = getattr(self.source, "client", None)
+        if client is None:
+            logger.warning("tpu.node_watch enabled but the watch source has no k8s client (mock/fake source); skipping")
+            return
+        from k8s_watcher_tpu.k8s.client import K8sClient
+        from k8s_watcher_tpu.nodes import NodeTracker, NodeWatcher
+
+        tracker = NodeTracker(
+            self.config.environment,
+            resource_key=self.config.tpu.resource_key,
+            accelerator_label=self.config.tpu.accelerator_label,
+            topology_label=self.config.tpu.topology_label,
+        )
+        self.node_watcher = NodeWatcher(
+            # a client carries at most one live watch; the node stream gets
+            # its own (same connection/credentials)
+            K8sClient(client.connection, request_timeout=self.config.kubernetes.request_timeout),
+            tracker,
+            self.dispatcher.submit,
+            slice_tracker=self.slice_tracker,
+            label_selector=self.config.tpu.node_watch_label_selector,
+            retry=self.config.watcher.retry,
+            watch_timeout_seconds=self.config.kubernetes.watch_timeout_seconds,
+            metrics=self.metrics,
+        ).start()
+        logger.info("Node watch started (selector=%s)", self.config.tpu.node_watch_label_selector or "<all nodes>")
+
     def _maybe_checkpoint(self, force: bool = False) -> None:
         if self.checkpoint is None:
             return
@@ -264,6 +300,9 @@ class WatcherApp:
 
     def shutdown(self) -> None:
         self.source.stop()
+        if self.node_watcher is not None:
+            self.node_watcher.stop()
+            self.node_watcher = None
         if self.elector is not None:
             self.elector.stop()  # release the Lease -> standby takes over now
             self.elector = None

@@ -372,6 +372,10 @@ class TpuConfig:
     # SURVEY.md §5 tracing substitute: when set, each probe cycle is wrapped
     # in jax.profiler.trace(dir) producing a TensorBoard-loadable trace
     probe_profile_dir: Optional[str] = None
+    # node-plane watching: Ready→NotReady on a TPU node degrades its slices
+    # immediately (pod eviction lags the node drop by minutes)
+    node_watch_enabled: bool = False
+    node_watch_label_selector: Optional[str] = None
 
     @classmethod
     def from_raw(cls, raw: Mapping[str, Any]) -> "TpuConfig":
@@ -384,6 +388,7 @@ class TpuConfig:
                 "topology_label",
                 "accelerator_label",
                 "probe",
+                "node_watch",
             ),
             "tpu",
         )
@@ -391,6 +396,9 @@ class TpuConfig:
         if backend not in ("tpu", "gpu"):
             raise SchemaError(f"config key 'tpu.backend': must be 'tpu' or 'gpu', got {backend!r}")
         default_key = "google.com/tpu" if backend == "tpu" else "nvidia.com/gpu"
+        node_watch = raw.get("node_watch") or {}
+        _expect(node_watch, (dict,), "tpu.node_watch")
+        _check_known(node_watch, ("enabled", "label_selector"), "tpu.node_watch")
         probe = raw.get("probe") or {}
         _expect(probe, (dict,), "tpu.probe")
         _check_known(
@@ -419,6 +427,8 @@ class TpuConfig:
             probe_multislice_enabled=_opt_bool(probe, "multislice_enabled", "tpu.probe", False),
             probe_multislice_slices=_opt_int(probe, "multislice_slices", "tpu.probe", 0),
             probe_profile_dir=_opt_str(probe, "profile_dir", "tpu.probe", None),
+            node_watch_enabled=_opt_bool(node_watch, "enabled", "tpu.node_watch", False),
+            node_watch_label_selector=_opt_str(node_watch, "label_selector", "tpu.node_watch", None),
         )
 
 

@@ -179,6 +179,13 @@ class K8sClient:
             params["labelSelector"] = label_selector
         return self._get(self._pods_path(namespace), params).json()
 
+    def list_nodes(self, *, label_selector: Optional[str] = None) -> Dict[str, Any]:
+        """One page of nodes; raw NodeList body (items + resourceVersion)."""
+        params: Dict[str, Any] = {}
+        if label_selector:
+            params["labelSelector"] = label_selector
+        return self._get("/api/v1/nodes", params).json()
+
     def watch_pods(
         self,
         namespace: Optional[str] = None,
@@ -189,14 +196,55 @@ class K8sClient:
         label_selector: Optional[str] = None,
         scanner=None,  # native.scanner.FrameScanner — hot-loop prefilter
     ) -> Iterator[Dict[str, Any]]:
-        """Stream raw watch events (``{"type": ..., "object": ...}``) until
-        the server closes the bounded watch or an error occurs.
+        """Stream raw pod watch events (``{"type": ..., "object": ...}``)
+        until the server closes the bounded watch or an error occurs.
 
         With a ``scanner``, frames that provably cannot request the
         accelerator resource are skipped WITHOUT a JSON parse and surface as
         lightweight ``{"type": "PREFILTERED"}`` markers carrying only the
         resourceVersion (the hot loop's dominant cost in a mostly-non-TPU
         cluster is decoding pods the resource filter then discards)."""
+        return self._watch(
+            self._pods_path(namespace),
+            resource_version=resource_version,
+            timeout_seconds=timeout_seconds,
+            allow_bookmarks=allow_bookmarks,
+            label_selector=label_selector,
+            scanner=scanner,
+        )
+
+    def watch_nodes(
+        self,
+        *,
+        resource_version: Optional[str] = None,
+        timeout_seconds: int = 300,
+        allow_bookmarks: bool = True,
+        label_selector: Optional[str] = None,
+    ) -> Iterator[Dict[str, Any]]:
+        """Stream raw node watch events (same contract as ``watch_pods``;
+        no prefilter — node streams are tiny next to pod streams).
+
+        NOTE: one client carries at most one live watch (``abort_watch``
+        closes it); run the node watch on its OWN ``K8sClient``."""
+        return self._watch(
+            "/api/v1/nodes",
+            resource_version=resource_version,
+            timeout_seconds=timeout_seconds,
+            allow_bookmarks=allow_bookmarks,
+            label_selector=label_selector,
+            scanner=None,
+        )
+
+    def _watch(
+        self,
+        path: str,
+        *,
+        resource_version: Optional[str],
+        timeout_seconds: int,
+        allow_bookmarks: bool,
+        label_selector: Optional[str],
+        scanner,
+    ) -> Iterator[Dict[str, Any]]:
         params: Dict[str, Any] = {"watch": "true", "timeoutSeconds": timeout_seconds}
         if resource_version:
             params["resourceVersion"] = resource_version
@@ -211,7 +259,7 @@ class K8sClient:
         try:
             try:
                 response = self.session.get(
-                    self._url(self._pods_path(namespace)),
+                    self._url(path),
                     params=params,
                     stream=True,
                     timeout=(self.request_timeout, timeout_seconds + 30),

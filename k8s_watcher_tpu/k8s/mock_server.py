@@ -62,7 +62,10 @@ class MockCluster:
         self._lock = threading.Condition()
         self._rv = 0
         self._pods: Dict[Tuple[str, str], Dict[str, Any]] = {}
-        self._journal: List[Tuple[int, Dict[str, Any]]] = []  # (rv, raw watch event)
+        self._nodes: Dict[str, Dict[str, Any]] = {}
+        # (rv, collection, raw watch event); one cluster-global rv space,
+        # like the real apiserver
+        self._journal: List[Tuple[int, str, Dict[str, Any]]] = []
         self._oldest_rv = 0  # journal entries <= this are compacted away
         self._fail_next = 0
         self.namespaces = ["default", "kube-system"]
@@ -70,11 +73,11 @@ class MockCluster:
 
     # -- state mutation (test hooks) --------------------------------------
 
-    def _record(self, event_type: str, pod: Dict[str, Any]) -> int:
+    def _record(self, event_type: str, obj: Dict[str, Any], collection: str = "pods") -> int:
         with self._lock:
             self._rv += 1
-            pod.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
-            self._journal.append((self._rv, {"type": event_type, "object": json.loads(json.dumps(pod))}))
+            obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
+            self._journal.append((self._rv, collection, {"type": event_type, "object": json.loads(json.dumps(obj))}))
             self._lock.notify_all()
             return self._rv
 
@@ -107,6 +110,63 @@ class MockCluster:
                 return None
             pod.setdefault("status", {})["phase"] = phase
         return self._record("MODIFIED", pod)
+
+    # -- node state (the nodes collection mirrors the pods hooks) ----------
+
+    def add_node(self, node: Dict[str, Any]) -> int:
+        name = (node.get("metadata") or {}).get("name", "")
+        with self._lock:
+            self._nodes[name] = node
+        return self._record("ADDED", node, collection="nodes")
+
+    def modify_node(self, node: Dict[str, Any]) -> int:
+        name = (node.get("metadata") or {}).get("name", "")
+        with self._lock:
+            self._nodes[name] = node
+        return self._record("MODIFIED", node, collection="nodes")
+
+    def delete_node(self, name: str) -> Optional[int]:
+        with self._lock:
+            node = self._nodes.pop(name, None)
+        if node is None:
+            return None
+        return self._record("DELETED", node, collection="nodes")
+
+    def set_node_ready(self, name: str, ready: bool, reason: str = "") -> Optional[int]:
+        """Flip the node's Ready condition (the kubelet-heartbeat signal)."""
+        with self._lock:
+            node = self._nodes.get(name)
+            if node is None:
+                return None
+            conditions = node.setdefault("status", {}).setdefault("conditions", [])
+            for c in conditions:
+                if c.get("type") == "Ready":
+                    c["status"] = "True" if ready else "False"
+                    c["reason"] = reason or ("KubeletReady" if ready else "KubeletNotReady")
+                    break
+            else:
+                conditions.append({
+                    "type": "Ready",
+                    "status": "True" if ready else "False",
+                    "reason": reason or ("KubeletReady" if ready else "KubeletNotReady"),
+                })
+        return self._record("MODIFIED", node, collection="nodes")
+
+    def list_nodes(self, label_selector: Optional[str] = None) -> Dict[str, Any]:
+        selector = _parse_label_selector(label_selector)
+        with self._lock:
+            items = [
+                json.loads(json.dumps(node))
+                for _name, node in sorted(self._nodes.items())
+                if _matches_selector(node, selector)
+            ]
+            rv = str(self._rv)
+        return {
+            "kind": "NodeList",
+            "apiVersion": "v1",
+            "metadata": {"resourceVersion": rv},
+            "items": items,
+        }
 
     def compact(self) -> None:
         """Forget journal history: any watch resuming below the current rv
@@ -152,14 +212,15 @@ class MockCluster:
             "items": items,
         }
 
-    def events_since(self, rv: int, deadline: float) -> Optional[List[Dict[str, Any]]]:
-        """Block until there are journal events > rv or the deadline passes.
-        Returns None if rv has been compacted away (client must relist)."""
+    def events_since(self, rv: int, deadline: float, collection: str = "pods") -> Optional[List[Dict[str, Any]]]:
+        """Block until there are journal events > rv in ``collection`` or the
+        deadline passes. Returns None if rv has been compacted away (client
+        must relist)."""
         with self._lock:
             while True:
                 if rv < self._oldest_rv:
                     return None  # compacted (possibly while we were waiting)
-                batch = [ev for (erv, ev) in self._journal if erv > rv]
+                batch = [ev for (erv, coll, ev) in self._journal if erv > rv and coll == collection]
                 if batch:
                     return batch
                 remaining = deadline - time.monotonic()
@@ -275,6 +336,13 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(200, found)
             return
 
+        if path == "/api/v1/nodes":
+            if params.get("watch") == "true":
+                self._serve_watch(None, params, collection="nodes")
+            else:
+                self._json(200, self.cluster.list_nodes(params.get("labelSelector")))
+            return
+
         namespace: Optional[str] = None
         if path == "/api/v1/pods":
             pass
@@ -331,7 +399,7 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._json(404, {"kind": "Status", "code": 404, "message": f"no route {self.path}"})
 
-    def _serve_watch(self, namespace: Optional[str], params: Dict[str, str]) -> None:
+    def _serve_watch(self, namespace: Optional[str], params: Dict[str, str], collection: str = "pods") -> None:
         try:
             rv = int(params.get("resourceVersion", "0") or "0")
         except ValueError:
@@ -342,7 +410,7 @@ class _Handler(BaseHTTPRequestHandler):
         send_bookmarks = params.get("allowWatchBookmarks") == "true"
         last_frame = time.monotonic()
 
-        first = self.cluster.events_since(rv, time.monotonic())  # non-blocking compaction check
+        first = self.cluster.events_since(rv, time.monotonic(), collection)  # non-blocking compaction check
         if first is None:
             self._json(410, {"kind": "Status", "code": 410, "message": "too old resource version"})
             return
@@ -359,7 +427,7 @@ class _Handler(BaseHTTPRequestHandler):
 
         try:
             while time.monotonic() < deadline:
-                batch = self.cluster.events_since(rv, min(deadline, time.monotonic() + 0.5))
+                batch = self.cluster.events_since(rv, min(deadline, time.monotonic() + 0.5), collection)
                 if batch is None:
                     # compacted mid-stream: emit the in-band 410 ERROR event
                     write_frame({"type": "ERROR", "object": {"kind": "Status", "code": 410, "message": "too old resource version"}})

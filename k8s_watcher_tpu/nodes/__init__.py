@@ -1,0 +1,4 @@
+from k8s_watcher_tpu.nodes.tracker import NodeTracker, node_is_ready, node_tpu_info
+from k8s_watcher_tpu.nodes.watcher import NodeWatcher
+
+__all__ = ["NodeTracker", "NodeWatcher", "node_is_ready", "node_tpu_info"]

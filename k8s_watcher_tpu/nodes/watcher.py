@@ -1,0 +1,145 @@
+"""Resilient node watch loop (companion to k8s/watch.py's pod source).
+
+Runs on its own thread with its OWN ``K8sClient`` (a client carries at most
+one live watch — ``abort_watch`` closes it). Same resilience contract as
+the pod source: list→watch with resourceVersion resume, exponential
+backoff, 410-relist. Node readiness transitions flow two ways:
+
+- a notification payload per transition (``NODE_CONDITION_CHANGE`` /
+  ``NODE_DELETED``) through the dispatcher, and
+- into the slice tracker (``note_node``), which may emit
+  ``SLICE_PHASE_CHANGE`` notifications for slices whose members sit on the
+  affected node — THIS is the fast path that beats pod eviction by minutes.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+from k8s_watcher_tpu.config.schema import RetryPolicy
+from k8s_watcher_tpu.k8s.client import K8sApiError, K8sClient, K8sGoneError
+from k8s_watcher_tpu.nodes.tracker import NodeTracker
+from k8s_watcher_tpu.pipeline.pipeline import Notification
+
+logger = logging.getLogger(__name__)
+
+
+class NodeWatcher:
+    def __init__(
+        self,
+        client: K8sClient,
+        tracker: NodeTracker,
+        sink,  # Callable[[Notification], Any] — normally Dispatcher.submit
+        *,
+        slice_tracker=None,  # slices.SliceTracker: gets note_node() on transitions
+        label_selector: Optional[str] = None,
+        retry: Optional[RetryPolicy] = None,
+        watch_timeout_seconds: int = 300,
+        metrics=None,
+    ):
+        self.client = client
+        self.tracker = tracker
+        self.sink = sink
+        self.slice_tracker = slice_tracker
+        self.label_selector = label_selector
+        self.retry = retry or RetryPolicy()
+        self.watch_timeout_seconds = watch_timeout_seconds
+        self.metrics = metrics
+        self.resource_version: Optional[str] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "NodeWatcher":
+        self._thread = threading.Thread(target=self._run, name="node-watch", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.client.abort_watch()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    # -- internals ---------------------------------------------------------
+
+    def _emit(self, event_type: str, node: dict, received_monotonic: float) -> None:
+        name = (node.get("metadata") or {}).get("name", "")
+        payloads = self.tracker.observe(event_type, node)
+        for payload in payloads:
+            self.sink(Notification(payload, received_monotonic, kind="node"))
+            if self.metrics is not None:
+                self.metrics.counter("node_notifications_enqueued").inc()
+        if self.slice_tracker is None:
+            return
+        # Sync slice state on EVERY determination, not only on notifying
+        # transitions: a deleted node re-added Ready arrives as a silent
+        # baseline observation, and skipping the sync would leave it in the
+        # slice tracker's down-set forever. note_node is a cheap no-op when
+        # nothing changes.
+        after = self.tracker.is_ready(name)
+        if event_type == "DELETED":
+            slice_payloads = self.slice_tracker.note_node(name, False)
+        elif after is not None:  # None = untracked (non-TPU) or unheartbeated
+            slice_payloads = self.slice_tracker.note_node(name, bool(after))
+        else:
+            slice_payloads = []
+        for slice_payload in slice_payloads:
+            self.sink(Notification(slice_payload, received_monotonic, kind="slice"))
+            if self.metrics is not None:
+                self.metrics.counter("slice_notifications_enqueued").inc()
+
+    def _relist(self) -> None:
+        body = self.client.list_nodes(label_selector=self.label_selector)
+        now = time.monotonic()
+        listed = set()
+        for node in body.get("items", []):
+            listed.add((node.get("metadata") or {}).get("name", ""))
+            self._emit("ADDED", node, now)
+        # nodes that vanished while we were disconnected
+        for name in [n for n in self.tracker.known_nodes() if n not in listed]:
+            self._emit("DELETED", {"metadata": {"name": name}}, now)
+        self.resource_version = (body.get("metadata") or {}).get("resourceVersion")
+
+    def _run(self) -> None:
+        backoff = self.retry.delay_seconds
+        need_list = True
+        while not self._stop.is_set():
+            try:
+                if need_list:
+                    self._relist()
+                    need_list = False
+                for raw in self.client.watch_nodes(
+                    resource_version=self.resource_version,
+                    timeout_seconds=self.watch_timeout_seconds,
+                    label_selector=self.label_selector,
+                ):
+                    if self._stop.is_set():
+                        return
+                    obj = raw.get("object") or {}
+                    rv = (obj.get("metadata") or {}).get("resourceVersion")
+                    if rv:
+                        self.resource_version = rv
+                    event_type = raw.get("type", "")
+                    backoff = self.retry.delay_seconds
+                    if event_type == "BOOKMARK":
+                        continue
+                    self._emit(event_type, obj, time.monotonic())
+                logger.debug("Node watch window expired; reconnecting from rv=%s", self.resource_version)
+            except K8sGoneError:
+                logger.warning("Node watch resourceVersion expired; relisting")
+                self.resource_version = None
+                need_list = True
+            except Exception as exc:  # noqa: BLE001 — this daemon thread must
+                # never die silently: the pod plane's failures crash run() and
+                # restart the process, but an uncaught error here would just
+                # stop node-driven degradation while the app reports healthy
+                if self._stop.is_set():
+                    return
+                logger.warning("Node watch error (%s); reconnecting in %.1fs", exc, backoff)
+                need_list = True  # unknown failure point: relist to resync
+                if self._stop.wait(backoff):
+                    return
+                backoff = min(backoff * self.retry.backoff_multiplier, self.retry.max_delay_seconds)

@@ -38,8 +38,9 @@ _Key = Tuple[str, str]
 
 def coalesce_key(notification: Notification) -> Optional[_Key]:
     """Latest-wins identity of a notification, or None if it must never be
-    collapsed. Pods coalesce on uid, slices on the slice key; probe reports
-    pass through uncoalesced (each carries distinct measurements)."""
+    collapsed. Pods coalesce on uid, slices on the slice key, nodes on the
+    node name; probe reports pass through uncoalesced (each carries
+    distinct measurements)."""
     payload = notification.payload
     if notification.kind == "pod":
         uid = payload.get("uid")
@@ -47,6 +48,9 @@ def coalesce_key(notification: Notification) -> Optional[_Key]:
     if notification.kind == "slice":
         key = payload.get("slice")
         return ("slice", key) if key else None
+    if notification.kind == "node":
+        key = payload.get("node")
+        return ("node", key) if key else None
     return None
 
 

@@ -45,6 +45,11 @@ class _Member:
     phase: str
     ready: bool
     restarts: int = 0
+    node_name: Optional[str] = None
+    # node-plane health (nodes/tracker.py): a member on a NotReady node is
+    # degraded even while its pod still reads Running — eviction lags the
+    # node drop by minutes
+    node_ready: bool = True
 
 
 @dataclasses.dataclass
@@ -64,10 +69,16 @@ class SliceState:
         phases = [m.phase for m in self.members.values()]
         if any(p in ("Failed", "Unknown") for p in phases):
             return SlicePhase.DEGRADED
+        # a dead node under a non-terminal member degrades the slice NOW,
+        # not minutes later when the node controller evicts the pod
+        if any(not m.node_ready and m.phase != "Succeeded" for m in self.members.values()):
+            return SlicePhase.DEGRADED
         if all(p == "Succeeded" for p in phases):
             return SlicePhase.COMPLETED
         expected = self.identity.expected_workers
-        running_ready = sum(1 for m in self.members.values() if m.phase == "Running" and m.ready)
+        running_ready = sum(
+            1 for m in self.members.values() if m.phase == "Running" and m.ready and m.node_ready
+        )
         if expected is not None:
             if len(self.members) < expected and self.ever_ready:
                 return SlicePhase.DEGRADED  # lost workers after being whole
@@ -89,7 +100,9 @@ class SliceState:
             "total_chips": ident.total_chips,
             "expected_workers": ident.expected_workers,
             "observed_workers": len(self.members),
-            "ready_workers": sum(1 for m in self.members.values() if m.phase == "Running" and m.ready),
+            "ready_workers": sum(
+                1 for m in self.members.values() if m.phase == "Running" and m.ready and m.node_ready
+            ),
             "phase": self.phase,
             "workers": [
                 {
@@ -98,6 +111,8 @@ class SliceState:
                     "phase": m.phase,
                     "ready": m.ready,
                     "restarts": m.restarts,
+                    "node": m.node_name,
+                    "node_ready": m.node_ready,
                 }
                 for m in sorted(self.members.values(), key=lambda m: (m.worker_index is None, m.worker_index, m.name))
             ],
@@ -121,9 +136,10 @@ class SliceTracker:
         # checkpointed {key: {"phase", "ever_ready"}} applied lazily when the
         # slice is first observed again after a restart
         self._restored: Dict[str, Any] = {}
-        # observe() runs on the watch thread; debug_snapshot()/snapshot()
-        # are read from HTTP/checkpoint paths on other threads
+        # observe() runs on the watch thread; note_node() on the node-watch
+        # thread; debug_snapshot()/snapshot() on HTTP/checkpoint paths
         self._lock = threading.RLock()
+        self._down_nodes: set = set()
 
     def __len__(self) -> int:
         return len(self._slices)
@@ -178,6 +194,7 @@ class SliceTracker:
                 self._slices.pop(identity.key, None)
                 return None, []
         else:
+            node_name = (event.pod.get("spec") or {}).get("nodeName")
             state.members[uid] = _Member(
                 uid=uid,
                 name=event.name,
@@ -185,35 +202,68 @@ class SliceTracker:
                 phase=event.phase,
                 ready=pod_ready(event.pod),
                 restarts=pod_restarts(event.pod),
+                node_name=node_name,
+                node_ready=node_name not in self._down_nodes,
             )
 
         if state.members:
             state.ever_had_members = True
+        notifications = self._recompute_locked(state)
+
+        slice_info = {
+            "key": identity.key,
+            "worker_index": identity.worker_index,
+            "phase": state.phase,
+            "expected_workers": identity.expected_workers,
+            "observed_workers": len(state.members),
+        }
+        return slice_info, notifications
+
+    def _recompute_locked(self, state: SliceState) -> List[Dict[str, Any]]:
+        """Re-aggregate one slice's phase; emit the transition notification
+        (and drop terminated slices). Caller holds the lock."""
         old_phase = state.phase
         new_phase = state.aggregate_phase()
         state.phase = new_phase
         if new_phase == SlicePhase.READY:
             state.ever_ready = True
-
         notifications: List[Dict[str, Any]] = []
         if new_phase != old_phase:
-            logger.info("Slice %s: %s -> %s", identity.key, old_phase, new_phase)
+            logger.info("Slice %s: %s -> %s", state.identity.key, old_phase, new_phase)
             summary = state.summary()
             summary["environment"] = self.environment
             summary["event_type"] = "SLICE_PHASE_CHANGE"
             summary["phase_transition"] = {"from": old_phase, "to": new_phase}
             notifications.append(summary)
             if new_phase == SlicePhase.TERMINATED:
-                del self._slices[identity.key]
+                del self._slices[state.identity.key]
+        return notifications
 
-        slice_info = {
-            "key": identity.key,
-            "worker_index": identity.worker_index,
-            "phase": new_phase,
-            "expected_workers": identity.expected_workers,
-            "observed_workers": len(state.members),
-        }
-        return slice_info, notifications
+    # -- node-plane integration (nodes/tracker.py) -------------------------
+
+    def note_node(self, node_name: str, ready: bool) -> List[Dict[str, Any]]:
+        """Fold a node readiness change into every slice with a member on
+        that node. Returns slice notifications (a NotReady node typically
+        flips its slices to Degraded minutes before pod eviction would)."""
+        if not node_name:
+            return []
+        notifications: List[Dict[str, Any]] = []
+        with self._lock:
+            if ready:
+                self._down_nodes.discard(node_name)
+            else:
+                self._down_nodes.add(node_name)
+            for state in list(self._slices.values()):
+                touched = False
+                for uid, member in list(state.members.items()):
+                    if member.node_name == node_name and member.node_ready != ready:
+                        # replace, don't mutate: debug_snapshot() formats
+                        # shallow-copied member dicts outside the lock
+                        state.members[uid] = dataclasses.replace(member, node_ready=ready)
+                        touched = True
+                if touched:
+                    notifications.extend(self._recompute_locked(state))
+        return notifications
 
     # -- checkpoint integration -------------------------------------------
 

@@ -29,6 +29,7 @@ def seed_slice(cluster: MockCluster, name: str = "train", workers: int = 4) -> N
                 f"{name}-{w}",
                 "default",
                 phase="Pending",
+                node_name=f"tpu-node-{w % 2}",
                 tpu_chips=4,
                 tpu_topology=f"2x2x{workers}",
                 tpu_accelerator="tpu-v5p-slice",
@@ -40,10 +41,18 @@ def seed_slice(cluster: MockCluster, name: str = "train", workers: int = 4) -> N
         )
 
 
+def seed_nodes(cluster: MockCluster, count: int = 2) -> None:
+    from k8s_watcher_tpu.watch.fake import build_node
+
+    for n in range(count):
+        cluster.add_node(build_node(f"tpu-node-{n}", tpu_topology="2x2x4"))
+
+
 def main() -> int:
     port = int(sys.argv[1]) if len(sys.argv) > 1 and sys.argv[1].isdigit() else 9988
     churn = "--churn" in sys.argv
     cluster = MockCluster()
+    seed_nodes(cluster)
     seed_slice(cluster)
     server = MockApiServer(cluster, port=port).start()
     print(f"mock k8s API server listening on {server.url} (Ctrl-C to stop)")
@@ -57,6 +66,11 @@ def main() -> int:
                 phase = phase_cycle[(i // 4) % len(phase_cycle)]
                 cluster.set_phase("default", f"train-{worker}", phase)
                 print(f"churn: train-{worker} -> {phase}")
+                if i % 6 == 5:  # every ~30s, bounce a node's Ready condition
+                    node = f"tpu-node-{(i // 6) % 2}"
+                    ready = (i // 12) % 2 == 1
+                    cluster.set_node_ready(node, ready)
+                    print(f"churn: {node} Ready -> {ready}")
                 i += 1
     except KeyboardInterrupt:
         print("stopping")

@@ -1,0 +1,261 @@
+"""Node-plane tests: NodeTracker transitions, slice degradation via
+note_node, and the full NodeWatcher loop against the mock apiserver."""
+
+import threading
+import time
+
+import pytest
+
+from k8s_watcher_tpu.config.schema import RetryPolicy
+from k8s_watcher_tpu.k8s.client import K8sClient
+from k8s_watcher_tpu.k8s.kubeconfig import K8sConnection
+from k8s_watcher_tpu.k8s.mock_server import MockApiServer
+from k8s_watcher_tpu.nodes import NodeTracker, NodeWatcher, node_is_ready, node_tpu_info
+from k8s_watcher_tpu.pipeline.phase import PhaseTracker
+from k8s_watcher_tpu.slices.tracker import SlicePhase, SliceTracker
+from k8s_watcher_tpu.watch.fake import build_node, build_pod
+from k8s_watcher_tpu.watch.source import EventType, WatchEvent
+
+
+@pytest.fixture
+def mock_api():
+    with MockApiServer() as server:
+        yield server
+
+
+def make_client(server) -> K8sClient:
+    return K8sClient(K8sConnection(server=server.url), request_timeout=5.0)
+
+
+class TestNodeHelpers:
+    def test_ready_condition_parsing(self):
+        assert node_is_ready(build_node("n", ready=True)) is True
+        assert node_is_ready(build_node("n", ready=False)) is False
+        assert node_is_ready({"status": {"conditions": []}}) is None
+
+    def test_tpu_info(self):
+        info = node_tpu_info(build_node("n", tpu_chips=8, tpu_topology="2x4x4"))
+        assert info == {"chips": 8, "accelerator": "tpu-v5p-slice", "topology": "2x4x4"}
+        assert node_tpu_info(build_node("n", tpu_chips=0, tpu_accelerator=None)) is None
+
+
+class TestNodeTracker:
+    def test_first_seen_ready_is_silent(self):
+        t = NodeTracker("development")
+        assert t.observe("ADDED", build_node("n0", ready=True)) == []
+        assert t.is_ready("n0") is True
+
+    def test_first_seen_not_ready_notifies(self):
+        t = NodeTracker("development")
+        payloads = t.observe("ADDED", build_node("n0", ready=False))
+        assert len(payloads) == 1
+        assert payloads[0]["event_type"] == "NODE_CONDITION_CHANGE"
+        assert payloads[0]["ready"] is False and payloads[0]["node"] == "n0"
+
+    def test_transition_and_heartbeat(self):
+        t = NodeTracker("development")
+        t.observe("ADDED", build_node("n0", ready=True))
+        assert t.observe("MODIFIED", build_node("n0", ready=True)) == []  # heartbeat
+        down = t.observe("MODIFIED", build_node("n0", ready=False))
+        assert down[0]["ready"] is False
+        assert down[0]["tpu"]["chips"] == 4
+        up = t.observe("MODIFIED", build_node("n0", ready=True))
+        assert up[0]["ready"] is True
+
+    def test_non_tpu_nodes_ignored(self):
+        t = NodeTracker("development")
+        cpu_node = build_node("cpu0", ready=False, tpu_chips=0, tpu_accelerator=None)
+        assert t.observe("ADDED", cpu_node) == []
+        assert t.is_ready("cpu0") is None
+
+    def test_delete_of_tracked_node_notifies(self):
+        t = NodeTracker("development")
+        t.observe("ADDED", build_node("n0", ready=True))
+        payloads = t.observe("DELETED", build_node("n0"))
+        assert payloads[0]["event_type"] == "NODE_DELETED"
+        assert t.is_ready("n0") is None
+
+
+class TestSliceNodeDegradation:
+    def _slice_with_pods(self, tracker, phases, nodes):
+        for w, node in enumerate(nodes):
+            pod = build_pod(
+                f"train-{w}", phase="Running", tpu_chips=4, tpu_topology="2x2x2",
+                node_name=node,
+                gke_slice_fields={
+                    "jobset.sigs.k8s.io/jobset-name": "train",
+                    "batch.kubernetes.io/job-completion-index": w,
+                },
+                container_statuses=[{"name": "main", "ready": True, "restart_count": 0,
+                                     "state": {"running": {}}}],
+            )
+            ev = WatchEvent(type=EventType.ADDED, pod=pod)
+            tracker.observe(ev, phases.observe(ev))
+
+    def test_node_down_degrades_slice_and_recovers(self):
+        tracker, phases = SliceTracker("development"), PhaseTracker()
+        self._slice_with_pods(tracker, phases, ["nodeA", "nodeB"])
+        state = next(iter(tracker.states().values()))
+        assert state.phase == SlicePhase.READY
+
+        notes = tracker.note_node("nodeA", False)
+        assert len(notes) == 1
+        assert notes[0]["event_type"] == "SLICE_PHASE_CHANGE"
+        assert notes[0]["phase_transition"] == {"from": "Ready", "to": "Degraded"}
+        worker = next(w for w in notes[0]["workers"] if w["node"] == "nodeA")
+        assert worker["node_ready"] is False
+
+        notes = tracker.note_node("nodeA", True)
+        assert notes[0]["phase_transition"] == {"from": "Degraded", "to": "Ready"}
+
+    def test_unrelated_node_changes_nothing(self):
+        tracker, phases = SliceTracker("development"), PhaseTracker()
+        self._slice_with_pods(tracker, phases, ["nodeA"])
+        assert tracker.note_node("other-node", False) == []
+
+    def test_pod_arriving_on_known_down_node_is_degraded(self):
+        tracker, phases = SliceTracker("development"), PhaseTracker()
+        tracker.note_node("nodeA", False)  # node drops before its pods appear
+        self._slice_with_pods(tracker, phases, ["nodeA"])
+        state = next(iter(tracker.states().values()))
+        assert state.phase == SlicePhase.DEGRADED
+
+
+class TestNodeWatcherLoop:
+    def test_end_to_end_node_transitions_over_http(self, mock_api):
+        mock_api.cluster.add_node(build_node("tpu-node-0"))
+        mock_api.cluster.add_node(build_node("cpu-node", tpu_chips=0, tpu_accelerator=None))
+
+        notifications = []
+        lock = threading.Lock()
+
+        def sink(n):
+            with lock:
+                notifications.append(n)
+
+        slices, phases = SliceTracker("development"), PhaseTracker()
+        pod = build_pod(
+            "train-0", phase="Running", tpu_chips=4, tpu_topology="2x2x2",
+            node_name="tpu-node-0",
+            gke_slice_fields={"jobset.sigs.k8s.io/jobset-name": "train",
+                              "batch.kubernetes.io/job-completion-index": 0},
+            container_statuses=[{"name": "main", "ready": True, "restart_count": 0,
+                                 "state": {"running": {}}}],
+        )
+        ev = WatchEvent(type=EventType.ADDED, pod=pod)
+        slices.observe(ev, phases.observe(ev))
+
+        watcher = NodeWatcher(
+            make_client(mock_api),
+            NodeTracker("development"),
+            sink,
+            slice_tracker=slices,
+            retry=RetryPolicy(delay_seconds=0.2),
+            watch_timeout_seconds=5,
+        ).start()
+        try:
+            time.sleep(0.5)  # baseline relist (all ready -> silent)
+            with lock:
+                assert notifications == []
+
+            mock_api.cluster.set_node_ready("tpu-node-0", False)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                with lock:
+                    kinds = [(n.kind, n.payload.get("event_type")) for n in notifications]
+                if ("node", "NODE_CONDITION_CHANGE") in kinds and ("slice", "SLICE_PHASE_CHANGE") in kinds:
+                    break
+                time.sleep(0.05)
+            with lock:
+                kinds = [(n.kind, n.payload.get("event_type")) for n in notifications]
+                node_payload = next(n.payload for n in notifications if n.kind == "node")
+                slice_payload = next(n.payload for n in notifications if n.kind == "slice")
+            assert ("node", "NODE_CONDITION_CHANGE") in kinds
+            assert node_payload["ready"] is False
+            assert slice_payload["phase_transition"]["to"] == "Degraded"
+        finally:
+            watcher.stop()
+
+    def test_watcher_stop_is_prompt_on_quiet_stream(self, mock_api):
+        watcher = NodeWatcher(
+            make_client(mock_api), NodeTracker("development"), lambda n: None,
+            watch_timeout_seconds=120,
+        ).start()
+        time.sleep(0.5)
+        t0 = time.monotonic()
+        watcher.stop()
+        assert time.monotonic() - t0 < 5.0
+
+
+class TestNodeReaddRecovery:
+    """Regression: a node deleted then re-added Ready (GKE node-pool repair)
+    must clear the slice tracker's down-state — re-adds arrive as SILENT
+    baseline observations, so the sync can't depend on a notification."""
+
+    def test_deleted_then_readded_node_recovers_slices(self, mock_api):
+        notifications = []
+        lock = threading.Lock()
+
+        def sink(n):
+            with lock:
+                notifications.append(n)
+
+        slices, phases = SliceTracker("development"), PhaseTracker()
+        pod = build_pod(
+            "train-0", phase="Running", tpu_chips=4, tpu_topology="2x2x2",
+            node_name="tpu-node-0",
+            gke_slice_fields={"jobset.sigs.k8s.io/jobset-name": "train",
+                              "batch.kubernetes.io/job-completion-index": 0},
+            container_statuses=[{"name": "main", "ready": True, "restart_count": 0,
+                                 "state": {"running": {}}}],
+        )
+        ev = WatchEvent(type=EventType.ADDED, pod=pod)
+        slices.observe(ev, phases.observe(ev))
+        mock_api.cluster.add_node(build_node("tpu-node-0"))
+
+        watcher = NodeWatcher(
+            make_client(mock_api), NodeTracker("development"), sink,
+            slice_tracker=slices,
+            retry=RetryPolicy(delay_seconds=0.2),
+            watch_timeout_seconds=5,
+        ).start()
+        try:
+            def slice_phase():
+                states = slices.states()
+                return next(iter(states.values())).phase if states else None
+
+            deadline = time.monotonic() + 10
+            mock_api.cluster.delete_node("tpu-node-0")
+            while time.monotonic() < deadline and slice_phase() != SlicePhase.DEGRADED:
+                time.sleep(0.05)
+            assert slice_phase() == SlicePhase.DEGRADED
+
+            # GKE repairs the pool: same node name comes back Ready —
+            # this is a baseline (silent) observation for the tracker
+            mock_api.cluster.add_node(build_node("tpu-node-0", ready=True))
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and slice_phase() != SlicePhase.READY:
+                time.sleep(0.05)
+            assert slice_phase() == SlicePhase.READY, "re-added Ready node must clear down-state"
+        finally:
+            watcher.stop()
+
+
+class TestSliceSummaryNodeAware:
+    def test_ready_workers_excludes_node_down_members(self):
+        tracker, phases = SliceTracker("development"), PhaseTracker()
+        for w, node in enumerate(["nodeA", "nodeB"]):
+            pod = build_pod(
+                f"train-{w}", phase="Running", tpu_chips=4, tpu_topology="2x2x2",
+                node_name=node,
+                gke_slice_fields={"jobset.sigs.k8s.io/jobset-name": "train",
+                                  "batch.kubernetes.io/job-completion-index": w},
+                container_statuses=[{"name": "main", "ready": True, "restart_count": 0,
+                                     "state": {"running": {}}}],
+            )
+            ev = WatchEvent(type=EventType.ADDED, pod=pod)
+            tracker.observe(ev, phases.observe(ev))
+        notes = tracker.note_node("nodeA", False)
+        # the Degraded notification must not claim a full ready count
+        assert notes[0]["ready_workers"] == 1
+        assert notes[0]["observed_workers"] == 2
