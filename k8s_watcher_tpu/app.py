@@ -277,6 +277,10 @@ class WatcherApp:
             watch_timeout_seconds=self.config.kubernetes.watch_timeout_seconds,
             metrics=self.metrics,
         ).start()
+        # pod events folded AFTER the node plane syncs get a live existence
+        # answer, so a member landing on an already-deleted node starts
+        # node-down even though no DELETED event will ever arrive for it
+        self.slice_tracker.set_node_existence_provider(self.node_watcher.node_existence)
         logger.info("Node watch started (selector=%s)", self.config.tpu.node_watch_label_selector or "<all nodes>")
 
     def _maybe_checkpoint(self, force: bool = False) -> None:

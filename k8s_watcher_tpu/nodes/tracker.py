@@ -85,12 +85,33 @@ class NodeTracker:
         self.topology_label = topology_label
         self.tpu_only = tpu_only
         self._ready: Dict[str, Optional[bool]] = {}
+        # EVERY node name in the cluster view, including non-accelerator
+        # nodes `tpu_only` skips for readiness tracking: existence is what
+        # lets the slice plane tell "node deleted" from "node not yet seen"
+        # (a TPU pod can sit on a node whose device plugin hasn't reported
+        # capacity yet, so the readiness map alone can't answer that)
+        self._exists: set = set()
         self._lock = threading.Lock()
 
     def is_ready(self, name: str) -> Optional[bool]:
         """Last observed readiness, or None for an unknown node."""
         with self._lock:
             return self._ready.get(name)
+
+    def is_tracked(self, name: str) -> bool:
+        """O(1): has this node a readiness entry (TPU-tracked)?"""
+        with self._lock:
+            return name in self._ready
+
+    def exists(self, name: str) -> bool:
+        """O(1): is this node in the cluster view (any node, not just TPU)?"""
+        with self._lock:
+            return name in self._exists
+
+    def reconcile_existence(self, listed) -> None:
+        """Drop existence entries absent from a fresh full node list."""
+        with self._lock:
+            self._exists &= set(listed)
 
     def known_nodes(self) -> Dict[str, Optional[bool]]:
         with self._lock:
@@ -102,6 +123,11 @@ class NodeTracker:
         name = (node.get("metadata") or {}).get("name", "")
         if not name:
             return []
+        with self._lock:
+            if event_type == "DELETED":
+                self._exists.discard(name)
+            else:
+                self._exists.add(name)
         tpu = node_tpu_info(
             node,
             resource_key=self.resource_key,

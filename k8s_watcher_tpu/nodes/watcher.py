@@ -49,6 +49,9 @@ class NodeWatcher:
         self.watch_timeout_seconds = watch_timeout_seconds
         self.metrics = metrics
         self.resource_version: Optional[str] = None
+        # set once the first node list has been folded: callers (and tests)
+        # can sequence against startup instead of racing the initial relist
+        self.synced = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -65,8 +68,18 @@ class NodeWatcher:
 
     # -- internals ---------------------------------------------------------
 
+    def node_existence(self, name: str):
+        """Existence answer for the slice plane (``Optional[bool]``): None
+        when this watcher's view can't prove absence — before the first
+        list has landed, or when a label selector makes the view partial —
+        else whether the node is in the cluster view."""
+        if self.label_selector is not None or not self.synced.is_set():
+            return None
+        return self.tracker.exists(name)
+
     def _emit(self, event_type: str, node: dict, received_monotonic: float) -> None:
         name = (node.get("metadata") or {}).get("name", "")
+        was_known = self.tracker.exists(name)
         payloads = self.tracker.observe(event_type, node)
         for payload in payloads:
             self.sink(Notification(payload, received_monotonic, kind="node"))
@@ -81,7 +94,16 @@ class NodeWatcher:
         # nothing changes.
         after = self.tracker.is_ready(name)
         if event_type == "DELETED":
-            slice_payloads = self.slice_tracker.note_node(name, False)
+            if not was_known:
+                # a node never in our cluster view (deleted before the
+                # first list): nothing to fold — the slice plane's
+                # existence provider / relist reconciliation covers it
+                slice_payloads = []
+            else:
+                # any known node (TPU-tracked or not — a TPU pod can sit on
+                # a node whose device plugin never reported) folds as down;
+                # exists=False lets the entry prune once unreferenced
+                slice_payloads = self.slice_tracker.note_node(name, False, exists=False)
         elif after is not None:  # None = untracked (non-TPU) or unheartbeated
             slice_payloads = self.slice_tracker.note_node(name, bool(after))
         else:
@@ -101,7 +123,19 @@ class NodeWatcher:
         # nodes that vanished while we were disconnected
         for name in [n for n in self.tracker.known_nodes() if n not in listed]:
             self._emit("DELETED", {"metadata": {"name": name}}, now)
+        self.tracker.reconcile_existence(listed)
+        # nodes that vanished before we EVER listed them (deleted while the
+        # watcher was down/unstarted): no DELETED event exists to fold, so
+        # reconcile slice members directly against the fresh node-list.
+        # Only an UNfiltered list proves absence — with a label selector a
+        # member's node may simply not match the selector.
+        if self.slice_tracker is not None and self.label_selector is None:
+            for slice_payload in self.slice_tracker.reconcile_nodes(listed):
+                self.sink(Notification(slice_payload, now, kind="slice"))
+                if self.metrics is not None:
+                    self.metrics.counter("slice_notifications_enqueued").inc()
         self.resource_version = (body.get("metadata") or {}).get("resourceVersion")
+        self.synced.set()
 
     def _run(self) -> None:
         backoff = self.retry.delay_seconds

@@ -139,7 +139,15 @@ class SliceTracker:
         # observe() runs on the watch thread; note_node() on the node-watch
         # thread; debug_snapshot()/snapshot() on HTTP/checkpoint paths
         self._lock = threading.RLock()
-        self._down_nodes: set = set()
+        # name -> node still exists (False = observed deleted). Alive
+        # NotReady entries persist (bounded by cluster size) so a pod
+        # scheduled onto a known-down node starts node-down; deleted-node
+        # entries are pruned once no slice member references them — GKE
+        # repair/autoscale mints fresh names, so they'd otherwise
+        # accumulate forever in a long-lived leader.
+        self._down_nodes: Dict[str, bool] = {}
+        # node-plane existence provider (set_node_existence_provider)
+        self._node_existence = None
 
     def __len__(self) -> int:
         return len(self._slices)
@@ -203,7 +211,7 @@ class SliceTracker:
                 ready=pod_ready(event.pod),
                 restarts=pod_restarts(event.pod),
                 node_name=node_name,
-                node_ready=node_name not in self._down_nodes,
+                node_ready=self._node_up_locked(node_name),
             )
 
         if state.members:
@@ -218,6 +226,29 @@ class SliceTracker:
             "observed_workers": len(state.members),
         }
         return slice_info, notifications
+
+    def _node_up_locked(self, node_name) -> bool:
+        """Best current belief about a member's node when folding it in:
+        not in the down-set, and — when a node plane with a full cluster
+        view is wired — actually existing. The existence check closes the
+        startup-order hole where the node plane lists (and reconciles) an
+        empty slice tracker before pod events fold the members in: a member
+        landing on a node the synced node plane has never seen starts
+        node-down instead of silently READY."""
+        if not node_name:
+            return True  # unscheduled pod: no node verdict to apply
+        if node_name in self._down_nodes:
+            return False
+        if self._node_existence is not None:
+            return self._node_existence(node_name) is not False  # None = can't prove absence
+        return True
+
+    def set_node_existence_provider(self, provider) -> None:
+        """Wire the node plane's existence answer (``name -> Optional[bool]``,
+        None = view can't prove absence). Called under the slice lock; the
+        provider must not call back into this tracker."""
+        with self._lock:
+            self._node_existence = provider
 
     def _recompute_locked(self, state: SliceState) -> List[Dict[str, Any]]:
         """Re-aggregate one slice's phase; emit the transition notification
@@ -241,18 +272,24 @@ class SliceTracker:
 
     # -- node-plane integration (nodes/tracker.py) -------------------------
 
-    def note_node(self, node_name: str, ready: bool) -> List[Dict[str, Any]]:
+    def note_node(
+        self, node_name: str, ready: bool, *, exists: bool = True
+    ) -> List[Dict[str, Any]]:
         """Fold a node readiness change into every slice with a member on
         that node. Returns slice notifications (a NotReady node typically
-        flips its slices to Degraded minutes before pod eviction would)."""
+        flips its slices to Degraded minutes before pod eviction would).
+
+        ``exists=False`` records a node observed DELETED: its down-entry is
+        pruned once no slice member references it, unlike an alive NotReady
+        node whose entry persists until the node recovers."""
         if not node_name:
             return []
         notifications: List[Dict[str, Any]] = []
         with self._lock:
             if ready:
-                self._down_nodes.discard(node_name)
+                self._down_nodes.pop(node_name, None)
             else:
-                self._down_nodes.add(node_name)
+                self._down_nodes[node_name] = exists
             for state in list(self._slices.values()):
                 touched = False
                 for uid, member in list(state.members.items()):
@@ -260,6 +297,42 @@ class SliceTracker:
                         # replace, don't mutate: debug_snapshot() formats
                         # shallow-copied member dicts outside the lock
                         state.members[uid] = dataclasses.replace(member, node_ready=ready)
+                        touched = True
+                if touched:
+                    notifications.extend(self._recompute_locked(state))
+            self._prune_down_nodes_locked()
+        return notifications
+
+    def _prune_down_nodes_locked(self) -> None:
+        """Drop DELETED-node entries no slice member references; alive
+        NotReady entries stay (see ``_down_nodes``)."""
+        deleted = [n for n, exists in self._down_nodes.items() if not exists]
+        if not deleted:
+            return
+        referenced = {
+            member.node_name
+            for state in self._slices.values()
+            for member in state.members.values()
+            if member.node_name
+        }
+        for name in deleted:
+            if name not in referenced:
+                del self._down_nodes[name]
+
+    def reconcile_nodes(self, present_nodes) -> List[Dict[str, Any]]:
+        """Mark members on nodes ABSENT from ``present_nodes`` (the full
+        node-list result) node-down. Covers deletions the watch never saw:
+        a node removed while the watcher was down/unstarted has no DELETED
+        event to fold, but a fresh list proves it is gone."""
+        present = set(present_nodes)
+        notifications: List[Dict[str, Any]] = []
+        with self._lock:
+            for state in list(self._slices.values()):
+                touched = False
+                for uid, member in list(state.members.items()):
+                    if member.node_name and member.node_name not in present and member.node_ready:
+                        self._down_nodes[member.node_name] = False  # observed absent
+                        state.members[uid] = dataclasses.replace(member, node_ready=False)
                         touched = True
                 if touched:
                     notifications.extend(self._recompute_locked(state))

@@ -201,8 +201,10 @@ class TestNodeReaddRecovery:
                 notifications.append(n)
 
         slices, phases = SliceTracker("development"), PhaseTracker()
+        # 2x2 topology = 4 chips = 1 worker: a single Running+ready pod
+        # fully forms the slice, so recovery can land back on READY
         pod = build_pod(
-            "train-0", phase="Running", tpu_chips=4, tpu_topology="2x2x2",
+            "train-0", phase="Running", tpu_chips=4, tpu_topology="2x2",
             node_name="tpu-node-0",
             gke_slice_fields={"jobset.sigs.k8s.io/jobset-name": "train",
                               "batch.kubernetes.io/job-completion-index": 0},
@@ -211,6 +213,7 @@ class TestNodeReaddRecovery:
         )
         ev = WatchEvent(type=EventType.ADDED, pod=pod)
         slices.observe(ev, phases.observe(ev))
+        assert next(iter(slices.states().values())).phase == SlicePhase.READY
         mock_api.cluster.add_node(build_node("tpu-node-0"))
 
         watcher = NodeWatcher(
@@ -224,6 +227,9 @@ class TestNodeReaddRecovery:
                 states = slices.states()
                 return next(iter(states.values())).phase if states else None
 
+            # sequence against startup: the delete must arrive as a watch
+            # DELETED event, not win the race against the initial relist
+            assert watcher.synced.wait(10), "watcher never finished initial relist"
             deadline = time.monotonic() + 10
             mock_api.cluster.delete_node("tpu-node-0")
             while time.monotonic() < deadline and slice_phase() != SlicePhase.DEGRADED:
@@ -239,6 +245,164 @@ class TestNodeReaddRecovery:
             assert slice_phase() == SlicePhase.READY, "re-added Ready node must clear down-state"
         finally:
             watcher.stop()
+
+
+class TestRelistReconciliation:
+    """A node deleted while the watcher was down/unstarted produces no
+    DELETED watch event; the initial relist must reconcile slice members
+    against the listed node-set instead."""
+
+    def _slice_on_node(self, slices, phases, node_name):
+        pod = build_pod(
+            "train-0", phase="Running", tpu_chips=4, tpu_topology="2x2x2",
+            node_name=node_name,
+            gke_slice_fields={"jobset.sigs.k8s.io/jobset-name": "train",
+                              "batch.kubernetes.io/job-completion-index": 0},
+            container_statuses=[{"name": "main", "ready": True, "restart_count": 0,
+                                 "state": {"running": {}}}],
+        )
+        ev = WatchEvent(type=EventType.ADDED, pod=pod)
+        slices.observe(ev, phases.observe(ev))
+
+    def test_node_gone_before_first_list_degrades_slice(self, mock_api):
+        notifications = []
+        slices, phases = SliceTracker("development"), PhaseTracker()
+        self._slice_on_node(slices, phases, "vanished-node")
+        assert next(iter(slices.states().values())).phase != SlicePhase.DEGRADED
+
+        # "vanished-node" is never added to the cluster: it was deleted
+        # before this watcher ever ran
+        watcher = NodeWatcher(
+            make_client(mock_api), NodeTracker("development"), notifications.append,
+            slice_tracker=slices, watch_timeout_seconds=5,
+        ).start()
+        try:
+            assert watcher.synced.wait(10)
+            state = next(iter(slices.states().values()))
+            assert state.phase == SlicePhase.DEGRADED
+            kinds = [n.kind for n in notifications]
+            assert "slice" in kinds, "reconciliation must emit the slice notification"
+        finally:
+            watcher.stop()
+
+    def test_pod_folded_after_sync_on_vanished_node_starts_down(self, mock_api):
+        """Production startup order: the node plane lists (empty slice
+        tracker) BEFORE pod events fold members in. A member landing on a
+        node the synced plane has never seen must start node-down."""
+        slices, phases = SliceTracker("development"), PhaseTracker()
+        watcher = NodeWatcher(
+            make_client(mock_api), NodeTracker("development"), lambda n: None,
+            slice_tracker=slices, watch_timeout_seconds=5,
+        ).start()
+        slices.set_node_existence_provider(watcher.node_existence)
+        try:
+            assert watcher.synced.wait(10)
+            self._slice_on_node(slices, phases, "vanished-node")
+            assert next(iter(slices.states().values())).phase == SlicePhase.DEGRADED
+        finally:
+            watcher.stop()
+
+    def test_label_selector_disables_absence_inference(self, mock_api):
+        """With a filtered node list, absence proves nothing: members on
+        non-matching nodes must NOT be marked down."""
+        slices, phases = SliceTracker("development"), PhaseTracker()
+        self._slice_on_node(slices, phases, "unmatched-node")
+        watcher = NodeWatcher(
+            make_client(mock_api), NodeTracker("development"), lambda n: None,
+            slice_tracker=slices, watch_timeout_seconds=5,
+            label_selector="cloud.google.com/gke-tpu-accelerator",
+        ).start()
+        slices.set_node_existence_provider(watcher.node_existence)
+        try:
+            assert watcher.synced.wait(10)
+            state = next(iter(slices.states().values()))
+            assert state.phase != SlicePhase.DEGRADED
+            assert all(m.node_ready for m in state.members.values())
+        finally:
+            watcher.stop()
+
+    def test_untracked_existing_node_delete_degrades_slice(self, mock_api):
+        """A node whose device plugin never reported TPU capacity is not
+        readiness-tracked, but its deletion must still degrade slices with
+        members on it (the watch DELETED is the only signal)."""
+        notifications = []
+        slices, phases = SliceTracker("development"), PhaseTracker()
+        self._slice_on_node(slices, phases, "plain-node")
+        mock_api.cluster.add_node(
+            build_node("plain-node", ready=True, tpu_chips=0, tpu_accelerator=None)
+        )
+        watcher = NodeWatcher(
+            make_client(mock_api), NodeTracker("development"), notifications.append,
+            slice_tracker=slices, watch_timeout_seconds=5,
+        ).start()
+        try:
+            assert watcher.synced.wait(10)
+            assert next(iter(slices.states().values())).phase != SlicePhase.DEGRADED
+            mock_api.cluster.delete_node("plain-node")
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                state = next(iter(slices.states().values()))
+                if state.phase == SlicePhase.DEGRADED:
+                    break
+                time.sleep(0.05)
+            assert next(iter(slices.states().values())).phase == SlicePhase.DEGRADED
+        finally:
+            watcher.stop()
+
+    def test_synced_set_after_start(self, mock_api):
+        watcher = NodeWatcher(
+            make_client(mock_api), NodeTracker("development"), lambda n: None,
+            watch_timeout_seconds=5,
+        )
+        assert not watcher.synced.is_set()
+        watcher.start()
+        try:
+            assert watcher.synced.wait(10)
+        finally:
+            watcher.stop()
+
+
+class TestDownNodePruning:
+    def test_unreferenced_deleted_nodes_are_pruned(self):
+        slices = SliceTracker("development")
+        # a DELETED node no slice references must not persist
+        slices.note_node("long-gone-node", False, exists=False)
+        assert slices._down_nodes == {}
+
+    def test_alive_notready_node_is_retained_without_members(self):
+        slices = SliceTracker("development")
+        # an alive NotReady node must persist so a later pod scheduled on
+        # it starts node-down (bounded by cluster size, not churn history)
+        slices.note_node("nodeA", False)
+        assert "nodeA" in slices._down_nodes
+
+    def test_referenced_down_node_is_retained_until_members_leave(self):
+        slices, phases = SliceTracker("development"), PhaseTracker()
+        pod = build_pod(
+            "train-0", phase="Running", tpu_chips=4, tpu_topology="2x2x2",
+            node_name="nodeA",
+            gke_slice_fields={"jobset.sigs.k8s.io/jobset-name": "train",
+                              "batch.kubernetes.io/job-completion-index": 0},
+            container_statuses=[{"name": "main", "ready": True, "restart_count": 0,
+                                 "state": {"running": {}}}],
+        )
+        ev = WatchEvent(type=EventType.ADDED, pod=pod)
+        slices.observe(ev, phases.observe(ev))
+        slices.note_node("nodeA", False)
+        assert "nodeA" in slices._down_nodes  # still referenced by train-0
+        # a later new pod on the down node starts node-down
+        pod2 = build_pod(
+            "train-1", phase="Running", tpu_chips=4, tpu_topology="2x2x2",
+            node_name="nodeA",
+            gke_slice_fields={"jobset.sigs.k8s.io/jobset-name": "train",
+                              "batch.kubernetes.io/job-completion-index": 1},
+            container_statuses=[{"name": "main", "ready": True, "restart_count": 0,
+                                 "state": {"running": {}}}],
+        )
+        ev2 = WatchEvent(type=EventType.ADDED, pod=pod2)
+        slices.observe(ev2, phases.observe(ev2))
+        members = next(iter(slices.states().values())).members
+        assert all(not m.node_ready for m in members.values())
 
 
 class TestSliceSummaryNodeAware:
