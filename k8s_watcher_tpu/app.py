@@ -121,6 +121,9 @@ class WatcherApp:
             workers=config.clusterapi.workers,
             coalesce=config.clusterapi.coalesce,
             metrics=self.metrics,
+            # bounds shutdown: when stop()'s drain window expires, cut
+            # in-flight sends instead of waiting out attempts x timeout
+            abort=getattr(self.notifier, "abort", None),
         )
         self.source = source or build_source(config, self.checkpoint, self.liveness.beat, self.metrics)
         self.slice_tracker = SliceTracker(

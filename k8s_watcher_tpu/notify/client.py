@@ -77,12 +77,38 @@ class ClusterApiClient:
         if self.api_key:
             self._headers["Authorization"] = f"Bearer {self.api_key}"
         self._local = threading.local()
+        # shutdown support: abort() must be able to cut sends owned by
+        # OTHER threads (threading.local hides them), so every live
+        # connection is also registered here
+        self._abort = threading.Event()
+        self._conns_lock = threading.Lock()
+        self._conns: set = set()
+
+    def abort(self) -> None:
+        """Cut every in-flight send and suppress further attempts: pending
+        retry sleeps wake immediately, retry loops exit, and live sockets
+        are closed so a worker blocked in a long recv errors out now
+        instead of after the full request timeout. One-way; used to bound
+        shutdown when the notify target is dead or hung."""
+        self._abort.set()
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.close()
+            except Exception:
+                pass
 
     # -- connection management (per dispatcher-worker thread) ---------------
 
     def _connection(self) -> Tuple[http.client.HTTPConnection, bool]:
         """This thread's persistent connection, and whether it is fresh
         (fresh = no request has succeeded on it yet)."""
+        if self._abort.is_set():
+            # abort() only closes REGISTERED sockets: minting a new one
+            # here (e.g. _request's transparent resend after abort cut the
+            # old conn) would dodge the shutdown cut entirely
+            raise ConnectionError("client aborted (shutting down)")
         conn = getattr(self._local, "conn", None)
         if conn is not None:
             return conn, getattr(self._local, "fresh", True)
@@ -94,11 +120,15 @@ class ClusterApiClient:
             conn = http.client.HTTPConnection(self._host, self._port, timeout=self.timeout)
         self._local.conn = conn
         self._local.fresh = True
+        with self._conns_lock:
+            self._conns.add(conn)
         return conn, True
 
     def _drop_connection(self) -> None:
         conn = getattr(self._local, "conn", None)
         if conn is not None:
+            with self._conns_lock:
+                self._conns.discard(conn)
             try:
                 conn.close()
             except Exception:
@@ -151,6 +181,8 @@ class ClusterApiClient:
         attempts = max(1, self.retry.max_attempts)
         delay = self.retry.delay_seconds
         for attempt in range(1, attempts + 1):
+            if self._abort.is_set():
+                return False
             try:
                 logger.debug("POST %s (attempt %d/%d)", endpoint, attempt, attempts)
                 status, text = self._request("POST", self.pod_update_endpoint, body)
@@ -172,7 +204,9 @@ class ClusterApiClient:
                 logger.error("Unexpected error calling clusterapi: %s", exc)
                 return False
             if attempt < attempts and delay > 0:
-                time.sleep(min(delay, self.retry.max_delay_seconds))
+                # abort-aware backoff: wakes immediately on shutdown
+                if self._abort.wait(min(delay, self.retry.max_delay_seconds)):
+                    return False
                 delay *= self.retry.backoff_multiplier
         return False
 

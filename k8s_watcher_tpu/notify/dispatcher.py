@@ -63,8 +63,15 @@ class Dispatcher:
         workers: int = 2,
         coalesce: bool = True,
         metrics: Optional[MetricsRegistry] = None,
+        abort: Optional[Callable[[], None]] = None,
     ):
+        """``abort``: called when stop()'s drain window expires with sends
+        still in flight — it must cut them fast (ClusterApiClient.abort
+        closes live sockets and cancels retry backoff), making
+        ``drain_timeout`` a real bound on shutdown even against a dead or
+        hung notify target."""
         self._send = send
+        self._abort = abort
         self._queue: "queue.Queue[Union[Notification, _Key]]" = queue.Queue(maxsize=max(1, capacity))
         self._workers = max(1, workers)
         self._threads: list = []
@@ -75,6 +82,8 @@ class Dispatcher:
         self.metrics = metrics or MetricsRegistry()
         self._started = False
         self._stopping = threading.Event()
+        # set when the drain window expired: workers stop claiming work
+        self._abandon = threading.Event()
 
     def start(self) -> None:
         if self._started:
@@ -87,8 +96,14 @@ class Dispatcher:
 
     def submit(self, notification: Notification) -> bool:
         """Enqueue without blocking; coalesce per-key, drop-oldest on
-        overflow. Returns False only if the notification was itself dropped
-        (or we're shutting down)."""
+        overflow. Returns True when the notification (or, under coalescing,
+        a queue slot now carrying ITS payload as the key's latest state)
+        was accepted. Lossy latest-wins semantics: acceptance is not a
+        delivery guarantee — a concurrent overflow drop may still evict the
+        key's slot, discarding the newest payload for that key (counted as
+        ``dispatch_dropped_overflow_coalesced``). Returns False when the
+        notification was rejected outright (overflow of uncoalesced
+        entries, or shutdown in progress)."""
         if self._stopping.is_set():
             self.metrics.counter("dispatch_dropped_stopping").inc()
             return False
@@ -121,8 +136,13 @@ class Dispatcher:
                     # (cannot be our own entry: at most one slot per key
                     # exists, and ours hasn't been enqueued yet)
                     if not isinstance(oldest, Notification):
+                        # evicting a coalesced slot drops the NEWEST payload
+                        # for that key (latest-wins), not the oldest — count
+                        # it distinctly so the loss is attributable
                         with self._pending_lock:
-                            self._pending.pop(oldest, None)
+                            evicted = self._pending.pop(oldest, None)
+                        if evicted is not None:
+                            self.metrics.counter("dispatch_dropped_overflow_coalesced").inc()
                     self.metrics.counter("dispatch_dropped_overflow").inc()
                 except queue.Empty:
                     pass
@@ -136,6 +156,8 @@ class Dispatcher:
     def _worker(self) -> None:
         hist = self.metrics.histogram("event_to_notify_latency")
         while True:
+            if self._abandon.is_set():
+                return  # drain window expired: leave the backlog unclaimed
             try:
                 item = self._queue.get(timeout=0.1)
             except queue.Empty:
@@ -169,9 +191,30 @@ class Dispatcher:
         return self._queue.unfinished_tasks == 0
 
     def stop(self, drain_timeout: float = 5.0) -> None:
+        """Shut down within ~``drain_timeout``: signal stop first (new
+        submits are rejected), give in-flight + queued sends the drain
+        window, then hard-abort whatever is still running so a dead or
+        hung notify target cannot push shutdown past the grace budget
+        k8s grants the pod (cli.py installs SIGTERM around this)."""
         if not self._started or self._stopping.is_set():
             return
-        self.drain(drain_timeout)
-        self._stopping.set()  # workers exit once the queue runs dry
+        drain_timeout = max(0.1, drain_timeout)
+        deadline = time.monotonic() + drain_timeout
+        self._stopping.set()  # reject new submits; workers exit once dry
+        # 90% of the budget drains; the rest joins workers post-abort
+        drained = self.drain(drain_timeout * 0.9)
+        if not drained:
+            backlog = self._queue.unfinished_tasks
+            logger.warning(
+                "Notify drain window expired with %d undelivered; aborting in-flight sends",
+                backlog,
+            )
+            self.metrics.counter("dispatch_abandoned_shutdown").inc(backlog)
+            self._abandon.set()
+            if self._abort is not None:
+                try:
+                    self._abort()
+                except Exception:
+                    logger.exception("Dispatcher abort callback failed")
         for t in self._threads:
-            t.join(timeout=2.0)
+            t.join(timeout=max(0.1, deadline - time.monotonic()))

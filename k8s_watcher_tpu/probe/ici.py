@@ -50,6 +50,9 @@ class IciProbeResult:
     payload_bytes: int
     compile_ms: float
     error: Optional[str] = None
+    # True when the fence-noise floor makes rtt/bandwidth untrustworthy
+    # (tunneled dev links); consumers must discount derived rates
+    timing_unreliable: bool = False
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -88,16 +91,18 @@ def run_ici_probe(
         psum_correct = bool(np.allclose(np.asarray(result)[0], expected))
 
         baseline_ms = fence_baseline_ms()
-        rtt_min, rtt_mean, rtt_max = timed_fenced(psum, x, iters, baseline_ms)
-        rtt_min, rtt_mean, rtt_max = (t / inner_iters for t in (rtt_min, rtt_mean, rtt_max))
+        rtt_stats = timed_fenced(psum, x, iters, baseline_ms)
+        rtt_min, rtt_mean, rtt_max = (t / inner_iters for t in rtt_stats)
+        unreliable = rtt_stats.unreliable
 
         bw_gbps = 0.0
         if payload_bytes > 0 and n > 1:
             bw_fn = make_allreduce_bandwidth_probe(mesh, payload_bytes, fault)
             payload = bandwidth_probe_input(mesh, payload_bytes)
             fetch_scalar(bw_fn(payload))  # compile
-            bw_min, _, _ = timed_fenced(bw_fn, payload, max(3, iters // 3), baseline_ms)
-            bw_gbps = allreduce_bus_bandwidth_gbps(payload_bytes, n, bw_min)
+            bw_stats = timed_fenced(bw_fn, payload, max(3, iters // 3), baseline_ms)
+            bw_gbps = allreduce_bus_bandwidth_gbps(payload_bytes, n, bw_stats[0])
+            unreliable = unreliable or bw_stats.unreliable
 
         return IciProbeResult(
             ok=psum_correct,
@@ -110,6 +115,7 @@ def run_ici_probe(
             bandwidth_gbps=bw_gbps,
             payload_bytes=payload_bytes,
             compile_ms=compile_ms,
+            timing_unreliable=unreliable,
         )
     except Exception as exc:
         logger.error("ICI probe failed: %s", exc)
@@ -157,7 +163,8 @@ def run_mxu_probe(
         fetch_scalar(out)  # compile (host-fenced)
         finite = bool(jnp.isfinite(out.astype(jnp.float32)).all())
         baseline_ms = fence_baseline_ms(device)
-        tmin, tmean, tmax = timed_fenced(lambda ab: step(*ab), (a, b), iters, baseline_ms)
+        stats = timed_fenced(lambda ab: step(*ab), (a, b), iters, baseline_ms)
+        tmin = stats[0]
         tflops = 2.0 * size**3 * inner_iters / tmin / 1e12
         return {
             "ok": finite,
@@ -167,6 +174,7 @@ def run_mxu_probe(
             "time_ms": 1e3 * tmin,
             "tflops": tflops,
             "finite": finite,
+            "timing_unreliable": stats.unreliable,
         }
     except Exception as exc:
         logger.error("MXU probe failed: %s", exc)

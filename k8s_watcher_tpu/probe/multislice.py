@@ -52,6 +52,8 @@ class MultiSliceProbeResult:
     dcn_overhead_ms: float  # total - ici, clamped at 0
     compile_ms: float
     error: Optional[str] = None
+    # True when fence noise swamps the timed ops (see probe/timing.py)
+    timing_unreliable: bool = False
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -99,8 +101,10 @@ def run_multislice_probe(
         global_ok = abs(float(np.asarray(global_sum).ravel()[0]) - mesh.size) <= 1e-3 * mesh.size
 
         baseline_ms = fence_baseline_ms()
-        ici_s = timed_fenced(ici_fn, x, iters, baseline_ms)[0] / inner_iters
-        total_s = timed_fenced(all_fn, x, iters, baseline_ms)[0] / inner_iters
+        ici_stats = timed_fenced(ici_fn, x, iters, baseline_ms)
+        total_stats = timed_fenced(all_fn, x, iters, baseline_ms)
+        ici_s = ici_stats[0] / inner_iters
+        total_s = total_stats[0] / inner_iters
 
         if suspect:
             logger.warning(
@@ -117,6 +121,7 @@ def run_multislice_probe(
             total_rtt_ms=1e3 * total_s,
             dcn_overhead_ms=max(0.0, 1e3 * (total_s - ici_s)),
             compile_ms=compile_ms,
+            timing_unreliable=ici_stats.unreliable or total_stats.unreliable,
         )
     except Exception as exc:
         logger.error("Multi-slice probe failed: %s", exc)

@@ -46,13 +46,36 @@ def fence_baseline_ms(device: Optional[jax.Device] = None, samples: int = 3) -> 
     return sorted(costs)[len(costs) // 2]
 
 
-def timed_fenced(fn, x, iters: int, baseline_ms: float = 0.0) -> Tuple[float, float, float]:
+class TimedStats(tuple):
+    """(min, mean, max) seconds — a plain 3-tuple for unpacking — plus an
+    ``unreliable`` attribute: True when the op's device time is buried in
+    fence noise, so derived TFLOP/s / GB/s must be discounted (the same
+    contract hbm.py's ``bandwidth_unreliable`` flag carries)."""
+
+    unreliable: bool
+
+    def __new__(cls, tmin: float, tmean: float, tmax: float, unreliable: bool = False):
+        obj = super().__new__(cls, (tmin, tmean, tmax))
+        obj.unreliable = unreliable
+        return obj
+
+
+def timed_fenced(fn, x, iters: int, baseline_ms: float = 0.0) -> TimedStats:
     """(min, mean, max) SECONDS over ``iters`` host-fenced executions, each
-    with the fence baseline subtracted (clamped at ~0)."""
+    with the fence baseline subtracted (clamped at ~0).
+
+    The result's ``unreliable`` flag is set when the best sample's device
+    share is under a quarter of the fence baseline: subtracting a noisy
+    ~baseline-sized fence from a ~baseline-sized wall time leaves mostly
+    noise, and the clamped-at-~0 minima turn into physically impossible
+    derived rates if trusted."""
     times = []
+    raw_min = float("inf")
     for _ in range(iters):
         t0 = time.perf_counter()
         fetch_scalar(fn(x))
-        dt = time.perf_counter() - t0 - baseline_ms / 1e3
-        times.append(max(dt, 1e-9))
-    return min(times), sum(times) / len(times), max(times)
+        raw = time.perf_counter() - t0
+        raw_min = min(raw_min, raw)
+        times.append(max(raw - baseline_ms / 1e3, 1e-9))
+    unreliable = baseline_ms > 0 and (raw_min - baseline_ms / 1e3) < 0.25 * baseline_ms / 1e3
+    return TimedStats(min(times), sum(times) / len(times), max(times), unreliable)

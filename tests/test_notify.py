@@ -173,6 +173,87 @@ class TestDispatcher:
         d.stop()
 
 
+class TestBoundedShutdown:
+    """stop(drain_timeout) must be a REAL bound even when the notify target
+    is dead or hung: in-flight sends are cut, retry backoff is cancelled."""
+
+    @pytest.fixture
+    def hung_server(self):
+        """Accepts connections, reads the request, never responds."""
+        import socketserver
+
+        release = threading.Event()
+
+        class _Hang(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    self.request.recv(65536)
+                    release.wait(30)
+                except Exception:
+                    pass
+
+        server = socketserver.ThreadingTCPServer(("127.0.0.1", 0), _Hang)
+        server.daemon_threads = True
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        yield f"http://127.0.0.1:{server.server_address[1]}"
+        release.set()
+        server.shutdown()
+        server.server_close()
+
+    def test_stop_bounded_against_hung_server(self, hung_server):
+        # 30 s request timeout x 3 attempts: without the abort path this
+        # shutdown would take minutes
+        client = ClusterApiClient(
+            hung_server, timeout=30.0,
+            retry=RetryPolicy(max_attempts=3, delay_seconds=2.0),
+        )
+        d = Dispatcher(client.update_pod_status, workers=2, abort=client.abort)
+        d.start()
+        for i in range(4):
+            d.submit(Notification({"name": f"p{i}", "uid": f"u{i}"}, time.monotonic(), kind="pod"))
+        time.sleep(0.3)  # let workers enter the hung send
+        t0 = time.monotonic()
+        d.stop(drain_timeout=2.0)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 4.0, f"stop took {elapsed:.1f}s — drain_timeout is not a bound"
+        assert d.metrics.counter("dispatch_abandoned_shutdown").value > 0
+
+    def test_abort_cancels_retry_backoff(self):
+        # dead target (connection refused) + long backoff: abort() must
+        # wake the sleeping retry immediately
+        client = ClusterApiClient(
+            "http://127.0.0.1:9",  # discard port: refuses instantly
+            timeout=5.0,
+            retry=RetryPolicy(max_attempts=5, delay_seconds=30.0),
+        )
+        done = threading.Event()
+        result = {}
+
+        def run():
+            result["ok"] = client.update_pod_status({"name": "p"})
+            done.set()
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        time.sleep(0.3)  # let it fail attempt 1 and enter the 30 s backoff
+        client.abort()
+        assert done.wait(2.0), "abort did not cancel the retry backoff"
+        assert result["ok"] is False
+
+    def test_graceful_drain_still_delivers(self, api_server):
+        # healthy target: stop() must still deliver the backlog, not abort
+        server, url = api_server
+        client = ClusterApiClient(url)
+        d = Dispatcher(client.update_pod_status, workers=2, abort=client.abort)
+        d.start()
+        for i in range(5):
+            d.submit(Notification({"name": f"p{i}", "uid": f"u{i}"}, time.monotonic(), kind="pod"))
+        d.stop(drain_timeout=5.0)
+        assert len(server.received) == 5
+        assert d.metrics.counter("dispatch_abandoned_shutdown").value == 0
+
+
 class TestPersistentConnection:
     def test_keepalive_reuse_across_posts(self, api_server):
         server, url = api_server
