@@ -54,7 +54,10 @@ class K8sClient:
         self.connection = connection
         self.request_timeout = request_timeout
         self.session = requests.Session()
-        if connection.token:
+        # static tokens install once; exec-plugin credentials resolve
+        # lazily per request (running a subprocess in a constructor would
+        # block init and crash callers on transient plugin failures)
+        if connection.token and connection.exec_credential is None:
             self.session.headers["Authorization"] = f"Bearer {connection.token}"
         if connection.client_cert:
             self.session.cert = connection.client_cert
@@ -82,6 +85,35 @@ class K8sClient:
 
     # -- plumbing ----------------------------------------------------------
 
+    def _refresh_auth(self) -> None:
+        """(Re)install the bearer token. Static tokens are a one-time set;
+        exec-plugin credentials (kubeconfig.ExecCredential) are re-checked
+        per request so a token past its expirationTimestamp is replaced
+        before it can 401 a long-lived watcher.
+
+        Plugin failures surface as K8sApiError so the watch/leader retry
+        loops treat them like any other transient API failure (backoff and
+        reconnect) instead of dying on an uncaught KubeconfigError."""
+        if self.connection.exec_credential is None:
+            return  # static auth installed at construction
+        try:
+            token = self.connection.auth_token()
+        except Exception as exc:
+            raise K8sApiError(f"credential refresh failed: {exc}") from exc
+        if token:
+            self.session.headers["Authorization"] = f"Bearer {token}"
+
+    def _handle_401(self, response) -> bool:
+        """A 401 with an exec credential means the cached token was revoked
+        before its expirationTimestamp: drop it so the next attempt re-runs
+        the plugin (client-go behavior). Returns True when a retry is worth
+        it."""
+        if response.status_code != 401 or self.connection.exec_credential is None:
+            return False
+        logger.warning("API server returned 401; re-running exec credential plugin")
+        self.connection.exec_credential.invalidate()
+        return True
+
     def _url(self, path: str) -> str:
         return f"{self.connection.server}{path}"
 
@@ -93,12 +125,17 @@ class K8sClient:
         json_body: Optional[Dict[str, Any]] = None,
         **kwargs,
     ) -> requests.Response:
-        try:
-            response = self.session.request(
-                method, self._url(path), params=params, json=json_body, timeout=self.request_timeout, **kwargs
-            )
-        except requests.RequestException as exc:
-            raise K8sApiError(f"{method} {path} failed: {exc}") from exc
+        for retry_401 in (True, False):
+            self._refresh_auth()
+            try:
+                response = self.session.request(
+                    method, self._url(path), params=params, json=json_body, timeout=self.request_timeout, **kwargs
+                )
+            except requests.RequestException as exc:
+                raise K8sApiError(f"{method} {path} failed: {exc}") from exc
+            if retry_401 and self._handle_401(response):
+                continue  # token re-minted; one retry
+            break
         if response.status_code == 404:
             raise K8sNotFoundError(f"{method} {path}: not found", status=404)
         if response.status_code == 409:
@@ -256,6 +293,7 @@ class K8sClient:
         # Read timeout must outlast the server-side watch window or we'd kill
         # healthy idle watches; +30 s of slack over timeoutSeconds.
         response = None
+        self._refresh_auth()
         try:
             try:
                 response = self.session.get(
@@ -269,6 +307,9 @@ class K8sClient:
             if response.status_code == 410:
                 raise K8sGoneError("watch: resourceVersion expired (410 Gone)", status=410)
             if response.status_code >= 400:
+                # a 401 with an exec credential: invalidate so the watch
+                # loop's normal backoff-reconnect re-runs the plugin
+                self._handle_401(response)
                 raise K8sApiError(
                     f"watch: HTTP {response.status_code}: {response.text[:300]}", status=response.status_code
                 )

@@ -8,20 +8,30 @@ Covers the reference's three auth modes (pod_watcher.py:110-157):
 
 Implemented natively (no ``kubernetes`` SDK): the kubeconfig subset parsed is
 clusters (server, CA data/file, insecure-skip-tls-verify), users (token,
-client cert/key as data or file), contexts and current-context — everything
-the bundled mock kubeconfig (reference assets/config) and standard GKE
-kubeconfigs use, minus exec/auth-provider plugins which raise a clear error.
+client cert/key as data or file, exec credential plugins per the
+client.authentication.k8s.io contract), contexts and current-context —
+everything the bundled mock kubeconfig (reference assets/config) and
+standard GKE kubeconfigs (including ``gke-gcloud-auth-plugin``) use. The
+reference got exec support implicitly from the SDK's ``load_kube_config``
+(pod_watcher.py:129); here the plugin protocol is implemented directly:
+run the command, parse the ExecCredential JSON, cache the token, refresh
+on ``expirationTimestamp``. Only interactive plugins (and the legacy
+``auth-provider`` stanza) raise.
 """
 
 from __future__ import annotations
 
 import base64
 import dataclasses
+import datetime
+import json
 import logging
 import os
+import subprocess
 import tempfile
+import threading
 from pathlib import Path
-from typing import Any, Dict, Optional, Tuple, Union
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 import yaml
 
@@ -34,6 +44,131 @@ class KubeconfigError(Exception):
     """Unreadable/unsupported kubeconfig or in-cluster environment."""
 
 
+# refresh this long before expirationTimestamp so a token never expires
+# mid-request (matches client-go's expiry delta)
+_EXEC_EXPIRY_SKEW_S = 60.0
+
+
+class ExecCredential:
+    """A ``users[].user.exec`` credential plugin (client.authentication.k8s.io).
+
+    Runs the configured command, parses the ExecCredential JSON it prints,
+    caches the token, and re-runs the plugin when ``expirationTimestamp``
+    (minus a skew) passes. Thread-safe: one plugin run at a time, shared by
+    the pod- and node-plane clients that share a ``K8sConnection``.
+    """
+
+    def __init__(
+        self,
+        command: str,
+        args: Optional[List[str]] = None,
+        env: Optional[List[Dict[str, str]]] = None,
+        api_version: str = "client.authentication.k8s.io/v1beta1",
+        provide_cluster_info: bool = False,
+        cluster_info: Optional[Dict[str, Any]] = None,
+        timeout: float = 60.0,
+    ):
+        self.command = command
+        self.args = list(args or [])
+        self.env = list(env or [])
+        self.api_version = api_version
+        self.provide_cluster_info = provide_cluster_info
+        self.cluster_info = cluster_info or {}
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._token: Optional[str] = None
+        self._expires_at: Optional[float] = None  # unix seconds
+
+    def token(self) -> str:
+        with self._lock:
+            if self._token is not None and not self._expired():
+                return self._token
+            self._refresh_locked()
+            return self._token  # type: ignore[return-value]
+
+    def invalidate(self) -> None:
+        """Drop the cached token (e.g. after a 401): next use re-runs the
+        plugin even if expirationTimestamp hasn't passed."""
+        with self._lock:
+            self._token = None
+            self._expires_at = None
+
+    def _expired(self) -> bool:
+        if self._expires_at is None:
+            return False  # no expirationTimestamp: cache for process life
+        import time
+
+        return time.time() >= self._expires_at - _EXEC_EXPIRY_SKEW_S
+
+    def _refresh_locked(self) -> None:
+        env = dict(os.environ)
+        for entry in self.env:
+            name = entry.get("name")
+            if name:
+                env[name] = entry.get("value", "")
+        exec_info: Dict[str, Any] = {
+            "apiVersion": self.api_version,
+            "kind": "ExecCredential",
+            "spec": {"interactive": False},
+        }
+        if self.provide_cluster_info:
+            exec_info["spec"]["cluster"] = self.cluster_info
+        env["KUBERNETES_EXEC_INFO"] = json.dumps(exec_info)
+        try:
+            proc = subprocess.run(
+                [self.command, *self.args],
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=self.timeout,
+            )
+        except FileNotFoundError as exc:
+            raise KubeconfigError(
+                f"exec credential plugin {self.command!r} not found on PATH"
+            ) from exc
+        except subprocess.TimeoutExpired as exc:
+            raise KubeconfigError(
+                f"exec credential plugin {self.command!r} timed out after {self.timeout:.0f}s"
+            ) from exc
+        if proc.returncode != 0:
+            raise KubeconfigError(
+                f"exec credential plugin {self.command!r} failed "
+                f"(rc={proc.returncode}): {proc.stderr.strip()[:500]}"
+            )
+        try:
+            doc = json.loads(proc.stdout)
+        except json.JSONDecodeError as exc:
+            raise KubeconfigError(
+                f"exec credential plugin {self.command!r} printed invalid JSON"
+            ) from exc
+        status = doc.get("status") or {}
+        token = status.get("token")
+        if not token:
+            if status.get("clientCertificateData"):
+                raise KubeconfigError(
+                    f"exec credential plugin {self.command!r} returned a client "
+                    "certificate; only token-based exec credentials are supported"
+                )
+            raise KubeconfigError(
+                f"exec credential plugin {self.command!r} returned no status.token"
+            )
+        self._token = token
+        self._expires_at = _parse_rfc3339(status.get("expirationTimestamp"))
+
+
+def _parse_rfc3339(value: Optional[str]) -> Optional[float]:
+    """RFC3339 timestamp -> unix seconds, or None (bad/missing → None, so
+    the token is cached for the process lifetime per the exec contract)."""
+    if not value:
+        return None
+    try:
+        text = value.replace("Z", "+00:00")
+        return datetime.datetime.fromisoformat(text).timestamp()
+    except ValueError:
+        logger.warning("exec credential: unparseable expirationTimestamp %r", value)
+        return None
+
+
 @dataclasses.dataclass
 class K8sConnection:
     """Everything needed to open an authenticated session to an API server."""
@@ -43,6 +178,14 @@ class K8sConnection:
     ca_file: Optional[str] = None
     client_cert: Optional[Tuple[str, str]] = None  # (certfile, keyfile)
     verify_tls: bool = True
+    exec_credential: Optional[ExecCredential] = None
+
+    def auth_token(self) -> Optional[str]:
+        """The bearer token to send right now: exec plugins re-run on
+        expiry, static tokens pass through."""
+        if self.exec_credential is not None:
+            return self.exec_credential.token()
+        return self.token
 
     @property
     def verify(self) -> Union[bool, str]:
@@ -108,10 +251,39 @@ def load_kubeconfig(path: Union[str, os.PathLike], context: Optional[str] = None
 
     user_entry = users.get(ctx.get("user", "")) or {"user": {}}
     user = user_entry.get("user") or {}
-    if "exec" in user or "auth-provider" in user:
+    if "auth-provider" in user:
+        # legacy stanza removed in client-go 1.26; its gcp/azure providers
+        # were interactive-or-SDK-bound, so there is nothing to run headless
         raise KubeconfigError(
-            f"kubeconfig {path}: exec/auth-provider credential plugins are not supported; "
-            "use a token or client-certificate kubeconfig"
+            f"kubeconfig {path}: legacy auth-provider credential plugins are not "
+            "supported; migrate to an exec plugin (e.g. gke-gcloud-auth-plugin) "
+            "or a token/client-certificate kubeconfig"
+        )
+
+    exec_credential = None
+    if "exec" in user:
+        # an empty/null exec stanza must fail HERE with a clear message,
+        # not connect anonymously and 401 later
+        exec_spec = user.get("exec") or {}
+        if exec_spec.get("interactiveMode") == "Always":
+            raise KubeconfigError(
+                f"kubeconfig {path}: exec plugin requires interactiveMode=Always, "
+                "which a headless watcher cannot satisfy"
+            )
+        command = exec_spec.get("command")
+        if not command:
+            raise KubeconfigError(f"kubeconfig {path}: exec stanza has no command")
+        exec_credential = ExecCredential(
+            command=command,
+            args=exec_spec.get("args"),
+            env=exec_spec.get("env"),
+            api_version=exec_spec.get("apiVersion", "client.authentication.k8s.io/v1beta1"),
+            provide_cluster_info=bool(exec_spec.get("provideClusterInfo")),
+            cluster_info={
+                "server": server,
+                "certificate-authority-data": cluster.get("certificate-authority-data"),
+                "insecure-skip-tls-verify": bool(cluster.get("insecure-skip-tls-verify", False)),
+            },
         )
 
     ca_file = _materialize(cluster.get("certificate-authority-data"), cluster.get("certificate-authority"), "ca")
@@ -125,6 +297,7 @@ def load_kubeconfig(path: Union[str, os.PathLike], context: Optional[str] = None
         ca_file=ca_file,
         client_cert=client_cert,
         verify_tls=not cluster.get("insecure-skip-tls-verify", False),
+        exec_credential=exec_credential,
     )
 
 

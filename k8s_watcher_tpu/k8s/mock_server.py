@@ -175,17 +175,20 @@ class MockCluster:
             self._oldest_rv = self._rv
             self._journal.clear()
 
-    def fail_next(self, n: int = 1) -> None:
-        """Make the next ``n`` HTTP requests fail with 500 (backoff tests)."""
+    def fail_next(self, n: int = 1, status: int = 500) -> None:
+        """Make the next ``n`` HTTP requests fail with ``status``
+        (backoff and auth-retry tests)."""
         with self._lock:
             self._fail_next = n
+            self._fail_status = status
 
-    def consume_failure(self) -> bool:
+    def consume_failure(self) -> int:
+        """The injected failure status for this request, or 0 for none."""
         with self._lock:
             if self._fail_next > 0:
                 self._fail_next -= 1
-                return True
-            return False
+                return getattr(self, "_fail_status", 500)
+            return 0
 
     # -- reads -------------------------------------------------------------
 
@@ -293,10 +296,19 @@ class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     # Nagle + delayed-ACK would add ~40 ms to every streamed watch frame
     disable_nagle_algorithm = True
-    cluster: MockCluster  # injected by make_server
+    cluster: MockCluster  # injected by MockApiServer
+    server_ref = None  # the owning MockApiServer, for header recording
 
     def log_message(self, fmt, *args):  # silence default stderr spam
         pass
+
+    def parse_request(self):
+        ok = super().parse_request()
+        if ok and self.server_ref is not None:
+            self.server_ref.request_headers.append(
+                {"Authorization": self.headers.get("Authorization"), "path": self.path}
+            )
+        return ok
 
     def _json(self, status: int, body: Dict[str, Any]) -> None:
         data = json.dumps(body).encode()
@@ -307,8 +319,9 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(data)
 
     def do_GET(self):  # noqa: N802 (stdlib naming)
-        if self.cluster.consume_failure():
-            self._json(500, {"kind": "Status", "code": 500, "message": "injected failure"})
+        fail = self.cluster.consume_failure()
+        if fail:
+            self._json(fail, {"kind": "Status", "code": fail, "message": "injected failure"})
             return
 
         parsed = urlparse(self.path)
@@ -372,8 +385,9 @@ class _Handler(BaseHTTPRequestHandler):
         body = self._read_body()
         if body is None:
             return
-        if self.cluster.consume_failure():
-            self._json(500, {"kind": "Status", "code": 500, "message": "injected failure"})
+        fail = self.cluster.consume_failure()
+        if fail:
+            self._json(fail, {"kind": "Status", "code": fail, "message": "injected failure"})
             return
         lease = _parse_lease_path(urlparse(self.path).path)
         if lease is not None and lease[1] is None:  # POST to the collection creates
@@ -388,8 +402,9 @@ class _Handler(BaseHTTPRequestHandler):
         body = self._read_body()
         if body is None:
             return
-        if self.cluster.consume_failure():
-            self._json(500, {"kind": "Status", "code": 500, "message": "injected failure"})
+        fail = self.cluster.consume_failure()
+        if fail:
+            self._json(fail, {"kind": "Status", "code": fail, "message": "injected failure"})
             return
         lease = _parse_lease_path(urlparse(self.path).path)
         if lease is not None and lease[1] is not None:
@@ -466,7 +481,11 @@ class MockApiServer:
 
     def __init__(self, cluster: Optional[MockCluster] = None, host: str = "127.0.0.1", port: int = 0):
         self.cluster = cluster or MockCluster()
-        handler = type("BoundHandler", (_Handler,), {"cluster": self.cluster})
+        # auth-relevant headers per request, for credential-plumbing tests
+        self.request_headers: List[Dict[str, Optional[str]]] = []
+        handler = type(
+            "BoundHandler", (_Handler,), {"cluster": self.cluster, "server_ref": self}
+        )
         self._server = ThreadingHTTPServer((host, port), handler)
         self._server.daemon_threads = True
         self._thread: Optional[threading.Thread] = None

@@ -23,3 +23,18 @@ sys.path.insert(0, str(REPO_ROOT))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# JAX's atexit cleanup logs "Clearing JAX backend caches." after pytest has
+# closed its captured streams, and the logging module then prints a full
+# "--- Logging error ---" traceback that buries the suite summary. atexit
+# hooks run LIFO, so registering AFTER jax is imported means this runs
+# FIRST: silence logging's own error reporting for interpreter teardown.
+import atexit  # noqa: E402
+import logging  # noqa: E402
+
+def _quiet_teardown() -> None:
+    logging.raiseExceptions = False
+    logging.disable(logging.CRITICAL)  # nothing useful logs after the summary
+
+
+atexit.register(_quiet_teardown)

@@ -82,6 +82,173 @@ class TestKubeconfig:
             load_connection(use_incluster=True)
 
 
+EXEC_KUBECONFIG_YAML = """
+apiVersion: v1
+kind: Config
+clusters:
+- cluster:
+    server: {server}
+  name: mock
+contexts:
+- context:
+    cluster: mock
+    user: execuser
+  name: mock
+current-context: mock
+users:
+- name: execuser
+  user:
+    exec:
+      apiVersion: client.authentication.k8s.io/v1beta1
+      command: {command}
+      args: [{args}]
+      env:
+      - name: FAKE_PLUGIN_MARKER
+        value: marker-value
+      interactiveMode: Never
+"""
+
+
+def _write_fake_plugin(tmp_path, *, token="exec-token-1", expiry_s=None, fail=False):
+    """A fake exec credential plugin: counts invocations in calls.txt,
+    asserts the protocol env var is present, prints an ExecCredential."""
+    import textwrap
+
+    script = tmp_path / "fake-auth-plugin.py"
+    calls = tmp_path / "calls.txt"
+    expiry_line = ""
+    if expiry_s is not None:
+        expiry_line = (
+            "import datetime\n"
+            f"exp = datetime.datetime.now(datetime.timezone.utc) + datetime.timedelta(seconds={expiry_s})\n"
+            "status['expirationTimestamp'] = exp.strftime('%Y-%m-%dT%H:%M:%SZ')\n"
+        )
+    body = textwrap.dedent(
+        f"""
+        import json, os, sys
+        assert "KUBERNETES_EXEC_INFO" in os.environ, "protocol env var missing"
+        info = json.loads(os.environ["KUBERNETES_EXEC_INFO"])
+        assert info["kind"] == "ExecCredential"
+        assert os.environ.get("FAKE_PLUGIN_MARKER") == "marker-value"
+        with open({str(calls)!r}, "a") as fh:
+            fh.write("call\\n")
+        if {fail!r}:
+            print("simulated auth failure", file=sys.stderr)
+            sys.exit(3)
+        status = {{"token": {token!r}}}
+        {expiry_line.replace(chr(10), chr(10) + "        ")}
+        print(json.dumps({{
+            "apiVersion": "client.authentication.k8s.io/v1beta1",
+            "kind": "ExecCredential",
+            "status": status,
+        }}))
+        """
+    )
+    script.write_text(body)
+    return script, calls
+
+
+class TestExecCredentialAuth:
+    def _kubeconfig(self, tmp_path, script, server="https://k8s.example:6443"):
+        import sys
+
+        p = tmp_path / "config"
+        p.write_text(
+            EXEC_KUBECONFIG_YAML.format(
+                server=server, command=sys.executable, args=f'"{script}"'
+            )
+        )
+        return p
+
+    def test_exec_token_fetched_and_cached(self, tmp_path):
+        script, calls = _write_fake_plugin(tmp_path, token="tok-A")
+        conn = load_kubeconfig(self._kubeconfig(tmp_path, script))
+        assert conn.auth_token() == "tok-A"
+        assert conn.auth_token() == "tok-A"
+        # no expirationTimestamp -> cached for the process lifetime
+        assert calls.read_text().count("call") == 1
+
+    def test_exec_token_refreshes_on_expiry(self, tmp_path):
+        # expiry inside the refresh skew: every token() re-runs the plugin
+        script, calls = _write_fake_plugin(tmp_path, token="tok-B", expiry_s=5)
+        conn = load_kubeconfig(self._kubeconfig(tmp_path, script))
+        assert conn.auth_token() == "tok-B"
+        assert conn.auth_token() == "tok-B"
+        assert calls.read_text().count("call") == 2
+
+    def test_exec_token_used_on_requests(self, tmp_path, mock_api):
+        script, _ = _write_fake_plugin(tmp_path, token="tok-C")
+        conn = load_kubeconfig(self._kubeconfig(tmp_path, script, server=mock_api.url))
+        client = K8sClient(conn, request_timeout=5.0)
+        client.get_api_version()
+        # the mock server records request headers
+        auths = [h.get("Authorization") for h in mock_api.request_headers]
+        assert "Bearer tok-C" in auths
+
+    def test_exec_plugin_failure_raises_clear_error(self, tmp_path):
+        script, _ = _write_fake_plugin(tmp_path, fail=True)
+        conn = load_kubeconfig(self._kubeconfig(tmp_path, script))
+        with pytest.raises(KubeconfigError, match="simulated auth failure"):
+            conn.auth_token()
+
+    def test_interactive_always_rejected(self, tmp_path):
+        p = tmp_path / "config"
+        p.write_text(
+            EXEC_KUBECONFIG_YAML.format(
+                server="https://k8s.example:6443", command="whatever", args='"x"'
+            ).replace("interactiveMode: Never", "interactiveMode: Always")
+        )
+        with pytest.raises(KubeconfigError, match="interactiveMode"):
+            load_kubeconfig(p)
+
+    def test_legacy_auth_provider_rejected(self, tmp_path):
+        p = tmp_path / "config"
+        p.write_text(
+            KUBECONFIG_YAML.format(server="https://k8s.example:6443").replace(
+                "token: test-token-123", "auth-provider: {name: gcp}"
+            )
+        )
+        with pytest.raises(KubeconfigError, match="auth-provider"):
+            load_kubeconfig(p)
+
+    def test_empty_exec_stanza_rejected_at_load(self, tmp_path):
+        p = tmp_path / "config"
+        p.write_text(
+            KUBECONFIG_YAML.format(server="https://k8s.example:6443").replace(
+                "token: test-token-123", "exec: {}"
+            )
+        )
+        with pytest.raises(KubeconfigError, match="no command"):
+            load_kubeconfig(p)
+
+    def test_plugin_failure_surfaces_as_api_error(self, tmp_path, mock_api):
+        # a transient plugin failure must hit the watch/leader retry loops
+        # as K8sApiError, not kill them with an uncaught KubeconfigError
+        script, _ = _write_fake_plugin(tmp_path, fail=True)
+        conn = load_kubeconfig(self._kubeconfig(tmp_path, script, server=mock_api.url))
+        client = K8sClient(conn, request_timeout=5.0)
+        with pytest.raises(K8sApiError, match="credential refresh failed"):
+            client.get_api_version()
+
+    def test_401_invalidates_and_retries_once(self, tmp_path, mock_api):
+        # the server rejects the first token; the client must re-run the
+        # plugin and succeed on the retry within the same request call
+        script, calls = _write_fake_plugin(tmp_path, token="tok-R")
+        conn = load_kubeconfig(self._kubeconfig(tmp_path, script, server=mock_api.url))
+        client = K8sClient(conn, request_timeout=5.0)
+        mock_api.cluster.fail_next(status=401)
+        client.get_api_version()
+        assert calls.read_text().count("call") == 2
+
+    def test_invalidate_forces_rerun(self, tmp_path):
+        script, calls = _write_fake_plugin(tmp_path, token="tok-D")
+        conn = load_kubeconfig(self._kubeconfig(tmp_path, script))
+        assert conn.auth_token() == "tok-D"
+        conn.exec_credential.invalidate()
+        assert conn.auth_token() == "tok-D"
+        assert calls.read_text().count("call") == 2
+
+
 class TestK8sClient:
     def test_version_smoke(self, mock_api):
         assert make_client(mock_api).get_api_version() == "v1.31"
