@@ -99,15 +99,26 @@ def bench_watch_pipeline(n_events: int = 3000, events_per_sec: float = 100.0) ->
     latency = metrics.histogram("event_to_notify_latency")
     summary = latency.summary()
     dump = metrics.dump()
+
+    def count(name: str) -> int:
+        return dump.get(name, {}).get("count", 0)
+
     return {
         "p50_ms": summary.get("p50_ms", float("nan")),
         "p90_ms": summary.get("p90_ms", float("nan")),
         "p99_ms": summary.get("p99_ms", float("nan")),
-        "notifications_sent": dump.get("dispatch_sent", {}).get("count", 0),
+        "notifications_sent": count("dispatch_sent"),
+        # p50 is measured over SURVIVING notifications: coalescing collapses
+        # same-object updates (latest-wins) and the significance filter
+        # drops no-op deltas, so sent < ingested by design — report the
+        # fate of every event so the p50 can't be read as N sub-ms sends
+        "notifications_coalesced": count("dispatch_coalesced"),
+        "notifications_dropped_overflow": count("dispatch_dropped_overflow"),
+        "events_dropped_insignificant": count("events_dropped_insignificant"),
         "events_ingested": n_events,
         "offered_events_per_sec": events_per_sec,
         "sustained_events_per_sec": round(n_events / ingest_seconds, 1),
-        "slice_notifications": dump.get("slice_notifications_enqueued", {}).get("count", 0),
+        "slice_notifications": count("slice_notifications_enqueued"),
     }
 
 
@@ -222,6 +233,71 @@ def bench_frame_scan(n_frames: int = 4000, tpu_fraction: float = 0.05) -> dict:
     return result
 
 
+def bench_virtual_probes(n_devices: int = 8) -> dict:
+    """The multi-device collective probes over a VIRTUAL CPU mesh, in a
+    subprocess so the platform forcing can't disturb this process's real
+    accelerator backend.
+
+    On the 1-chip bench host the real-device ICI numbers degenerate to 0
+    (nothing to reduce across), which made the north-star "ICI psum probe
+    RTT" metric vacuous in BENCH_r01. Virtual-mesh numbers are NOT hardware
+    ICI performance — they're labelled ``virtual`` — but they make the
+    collective path's health and latency trends visible in every round's
+    BENCH artifact rather than only inside pytest."""
+    import os
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--virtual-probes", str(n_devices)],
+            capture_output=True,
+            text=True,
+            timeout=600,
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if proc.returncode != 0:
+            return {"error": f"rc={proc.returncode}: {proc.stderr[-500:]}"}
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as exc:
+        return {"error": str(exc)}
+
+
+def _virtual_probes_child(n_devices: int) -> int:
+    """Runs in the CPU-forced subprocess: ICI + per-link + multislice."""
+    import jax
+
+    # the env var alone is not authoritative on hosts whose site config
+    # pins a hardware platform plugin; force it at the config level too
+    jax.config.update("jax_platforms", "cpu")
+    from k8s_watcher_tpu.probe.ici import run_ici_probe
+    from k8s_watcher_tpu.probe.links import run_link_probe
+    from k8s_watcher_tpu.probe.multislice import run_multislice_probe
+
+    ici = run_ici_probe(payload_bytes=1024 * 1024, iters=5, inner_iters=20)
+    links = run_link_probe(iters=3, inner_iters=4)
+    multi = run_multislice_probe(n_slices=2, iters=3, inner_iters=8)
+    out = {
+        "virtual": True,  # CPU mesh: collective-path health, not ICI hardware
+        "n_devices": n_devices,
+        "psum_rtt_ms": round(ici.psum_rtt_ms, 4),
+        "psum_correct": ici.psum_correct,
+        "allreduce_bus_gbps": round(ici.bandwidth_gbps, 3),
+        "link_count": links.n_links,
+        "link_median_rtt_ms": round(links.median_rtt_ms, 4),
+        "link_suspects": len(links.suspect_links),
+        "multislice_ok": multi.ok,
+        "multislice_ici_rtt_ms": round(multi.ici_rtt_ms, 4),
+        "multislice_dcn_overhead_ms": round(multi.dcn_overhead_ms, 4),
+        "probe_ok": ici.ok and links.ok and multi.ok,
+    }
+    print(json.dumps(out))
+    return 0
+
+
 def bench_probe() -> dict:
     try:
         import jax
@@ -258,6 +334,7 @@ def main() -> int:
     pipeline_stats = bench_watch_pipeline(n_events=2000, events_per_sec=100.0)
     burst_stats = bench_burst_drain()
     scan_stats = bench_frame_scan()
+    virtual_stats = bench_virtual_probes()
     probe_stats = bench_probe()
     p50 = pipeline_stats["p50_ms"]
     result = {
@@ -270,6 +347,7 @@ def main() -> int:
             "burst": burst_stats,
             "frame_scan": scan_stats,
             "probe": probe_stats,
+            "probe_virtual_mesh": virtual_stats,
         },
     }
     print(json.dumps(result))
@@ -277,4 +355,7 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--virtual-probes":
+        n = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+        sys.exit(_virtual_probes_child(n))
     sys.exit(main())

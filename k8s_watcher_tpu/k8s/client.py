@@ -54,10 +54,11 @@ class K8sClient:
         self.connection = connection
         self.request_timeout = request_timeout
         self.session = requests.Session()
-        # static tokens install once; exec-plugin credentials resolve
-        # lazily per request (running a subprocess in a constructor would
-        # block init and crash callers on transient plugin failures)
-        if connection.token and connection.exec_credential is None:
+        # static tokens install once; dynamic credentials (exec plugins,
+        # rotating token files) resolve lazily per request — running a
+        # subprocess in a constructor would block init and crash callers
+        # on transient plugin failures
+        if connection.token and not connection.dynamic_auth:
             self.session.headers["Authorization"] = f"Bearer {connection.token}"
         if connection.client_cert:
             self.session.cert = connection.client_cert
@@ -94,7 +95,7 @@ class K8sClient:
         Plugin failures surface as K8sApiError so the watch/leader retry
         loops treat them like any other transient API failure (backoff and
         reconnect) instead of dying on an uncaught KubeconfigError."""
-        if self.connection.exec_credential is None:
+        if not self.connection.dynamic_auth:
             return  # static auth installed at construction
         try:
             token = self.connection.auth_token()
@@ -104,14 +105,14 @@ class K8sClient:
             self.session.headers["Authorization"] = f"Bearer {token}"
 
     def _handle_401(self, response) -> bool:
-        """A 401 with an exec credential means the cached token was revoked
-        before its expirationTimestamp: drop it so the next attempt re-runs
-        the plugin (client-go behavior). Returns True when a retry is worth
-        it."""
-        if response.status_code != 401 or self.connection.exec_credential is None:
+        """A 401 under dynamic auth means the cached token was revoked or
+        rotated early: drop it so the next attempt re-derives it (re-run
+        the exec plugin / re-read the token file — client-go behavior).
+        Returns True when a retry is worth it."""
+        if response.status_code != 401 or not self.connection.dynamic_auth:
             return False
-        logger.warning("API server returned 401; re-running exec credential plugin")
-        self.connection.exec_credential.invalidate()
+        logger.warning("API server returned 401; re-deriving credentials")
+        self.connection.invalidate_token()
         return True
 
     def _url(self, path: str) -> str:

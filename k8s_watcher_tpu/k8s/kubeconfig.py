@@ -169,6 +169,11 @@ def _parse_rfc3339(value: Optional[str]) -> Optional[float]:
         return None
 
 
+# bound service-account tokens are rotated on disk by the kubelet; re-read
+# at most this often (client-go uses a similar period for file reloads)
+_TOKEN_FILE_TTL_S = 60.0
+
+
 @dataclasses.dataclass
 class K8sConnection:
     """Everything needed to open an authenticated session to an API server."""
@@ -179,13 +184,39 @@ class K8sConnection:
     client_cert: Optional[Tuple[str, str]] = None  # (certfile, keyfile)
     verify_tls: bool = True
     exec_credential: Optional[ExecCredential] = None
+    # re-read this file for the token (in-cluster bound SA tokens rotate
+    # ~hourly; a once-read token would 401 a long-lived watcher mid-life)
+    token_file: Optional[str] = None
+
+    @property
+    def dynamic_auth(self) -> bool:
+        """True when the token can change mid-process (exec plugin or
+        rotating token file) and a 401 is worth an invalidate-and-retry."""
+        return self.exec_credential is not None or self.token_file is not None
 
     def auth_token(self) -> Optional[str]:
         """The bearer token to send right now: exec plugins re-run on
-        expiry, static tokens pass through."""
+        expiry, token files re-read on a TTL, static tokens pass through."""
         if self.exec_credential is not None:
             return self.exec_credential.token()
+        if self.token_file:
+            import time
+
+            cached = getattr(self, "_file_token_cache", None)
+            if cached is None or time.monotonic() - cached[1] > _TOKEN_FILE_TTL_S:
+                try:
+                    self.token = Path(self.token_file).read_text().strip()
+                except OSError as exc:
+                    logger.warning("Could not re-read token file %s: %s", self.token_file, exc)
+                self._file_token_cache = (self.token, time.monotonic())
         return self.token
+
+    def invalidate_token(self) -> None:
+        """Drop cached credentials after a 401 so the next request
+        re-derives them (plugin re-run / token-file re-read)."""
+        if self.exec_credential is not None:
+            self.exec_credential.invalidate()
+        self._file_token_cache = None
 
     @property
     def verify(self) -> Union[bool, str]:
@@ -273,6 +304,10 @@ def load_kubeconfig(path: Union[str, os.PathLike], context: Optional[str] = None
         command = exec_spec.get("command")
         if not command:
             raise KubeconfigError(f"kubeconfig {path}: exec stanza has no command")
+        if os.sep in command and not os.path.isabs(command):
+            # client-go contract: relative plugin paths resolve against the
+            # kubeconfig's directory, not the process CWD
+            command = str(path.parent / command)
         exec_credential = ExecCredential(
             command=command,
             args=exec_spec.get("args"),
@@ -316,6 +351,8 @@ def load_incluster(sa_dir: Union[str, os.PathLike] = SERVICE_ACCOUNT_DIR) -> K8s
         server=f"https://{host}:{port}",
         token=token_path.read_text().strip(),
         ca_file=str(ca_path) if ca_path.exists() else None,
+        # bound SA tokens rotate on disk ~hourly; keep re-reading
+        token_file=str(token_path),
     )
 
 

@@ -68,6 +68,7 @@ class MockCluster:
         self._journal: List[Tuple[int, str, Dict[str, Any]]] = []
         self._oldest_rv = 0  # journal entries <= this are compacted away
         self._fail_next = 0
+        self._fail_status = 500
         self.namespaces = ["default", "kube-system"]
         self._leases: Dict[Tuple[str, str], Dict[str, Any]] = {}
 
@@ -187,7 +188,7 @@ class MockCluster:
         with self._lock:
             if self._fail_next > 0:
                 self._fail_next -= 1
-                return getattr(self, "_fail_status", 500)
+                return self._fail_status
             return 0
 
     # -- reads -------------------------------------------------------------

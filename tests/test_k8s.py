@@ -248,6 +248,53 @@ class TestExecCredentialAuth:
         assert conn.auth_token() == "tok-D"
         assert calls.read_text().count("call") == 2
 
+    def test_relative_exec_command_resolves_against_kubeconfig_dir(self, tmp_path):
+        # client-go contract: "./bin/plugin" is relative to the kubeconfig
+        script, _ = _write_fake_plugin(tmp_path, token="tok-rel")
+        plugin_dir = tmp_path / "bin"
+        plugin_dir.mkdir()
+        wrapper = plugin_dir / "plugin"
+        import sys
+
+        wrapper.write_text(f"#!/bin/sh\nexec {sys.executable} {script} \"$@\"\n")
+        wrapper.chmod(0o755)
+        p = tmp_path / "config"
+        p.write_text(
+            EXEC_KUBECONFIG_YAML.format(
+                server="https://k8s.example:6443", command="./bin/plugin", args=""
+            )
+        )
+        conn = load_kubeconfig(p)
+        assert conn.exec_credential.command == str(wrapper)
+        assert conn.auth_token() == "tok-rel"
+
+
+class TestRotatingTokenFile:
+    def test_401_triggers_token_file_reread(self, tmp_path, mock_api):
+        # the kubelet rotates bound SA tokens on disk; a 401 must re-read
+        # the file instead of retrying the dead cached token forever
+        token_file = tmp_path / "token"
+        token_file.write_text("stale-token")
+        conn = K8sConnection(server=mock_api.url, token="stale-token", token_file=str(token_file))
+        client = K8sClient(conn, request_timeout=5.0)
+        client.get_api_version()
+        token_file.write_text("fresh-token")  # kubelet rotation
+        mock_api.cluster.fail_next(status=401)
+        client.get_api_version()  # 401 -> invalidate -> re-read -> retry
+        auths = [h["Authorization"] for h in mock_api.request_headers]
+        assert auths[-1] == "Bearer fresh-token"
+
+    def test_incluster_connection_carries_token_file(self, tmp_path, monkeypatch):
+        from k8s_watcher_tpu.k8s.kubeconfig import load_incluster
+
+        (tmp_path / "token").write_text("sa-token")
+        (tmp_path / "ca.crt").write_text("ca")
+        monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.0.0.1")
+        conn = load_incluster(sa_dir=tmp_path)
+        assert conn.token == "sa-token"
+        assert conn.token_file == str(tmp_path / "token")
+        assert conn.dynamic_auth
+
 
 class TestK8sClient:
     def test_version_smoke(self, mock_api):
