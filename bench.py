@@ -310,7 +310,9 @@ def bench_probe() -> dict:
         from k8s_watcher_tpu.probe.hbm import run_hbm_probe, run_hbm_write_probe
 
         ici = run_ici_probe(payload_bytes=4 * 1024 * 1024, iters=5, inner_iters=100)
-        mxu = run_mxu_probe(8192, iters=3, inner_iters=16)
+        # 4096 = VMEM-resident operands (MXU-bound); inner chain long
+        # enough that compute dwarfs the host fence even over a tunnel
+        mxu = run_mxu_probe(4096, iters=3, inner_iters=128)
         hbm_r = run_hbm_probe(256 * 1024 * 1024)
         hbm_w = run_hbm_write_probe(256 * 1024 * 1024)
         return {

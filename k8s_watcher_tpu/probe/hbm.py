@@ -60,6 +60,15 @@ BLOCK_ROWS = 1024  # 1024 x 512 f32 = 2 MiB per block: large enough to be
 WIDTH = 4 * LANES  # DMA-bound, small enough to double-buffer in ~16MB VMEM
 BYTES_PER_BLOCK = BLOCK_ROWS * WIDTH * 4
 
+# The write path peaks at a SMALLER block than the read path: a v5e sweep
+# (ARCHITECTURE.md) measured 512 KiB write blocks ~14% faster than the
+# 2 MiB read-optimal shape (760 vs 664 GB/s median) — write DMAs pipeline
+# better with more, smaller in-flight transfers, while reads prefer the
+# larger block. Each probe uses its own shape.
+WRITE_BLOCK_ROWS = 512
+WRITE_WIDTH = 2 * LANES
+WRITE_BYTES_PER_BLOCK = WRITE_BLOCK_ROWS * WRITE_WIDTH * 4
+
 
 def _reduce_kernel(in_ref, out_ref):
     r, i = pl.program_id(0), pl.program_id(1)
@@ -101,7 +110,7 @@ def _fill_kernel(seed_ref, out_ref):
     # that gets constant-folded at compile time — the "write" then takes 0s)
     i = pl.program_id(1)
     value = (i + 1).astype(jnp.float32) + seed_ref[0, 0]
-    out_ref[:] = jnp.full((BLOCK_ROWS, WIDTH), 1.0, jnp.float32) * value
+    out_ref[:] = jnp.full(out_ref.shape, 1.0, jnp.float32) * value
 
 
 def _blocksum_kernel(in_ref, out_ref):
@@ -118,16 +127,16 @@ def make_hbm_write_probe(total_bytes: int, *, repeats: int = 1, interpret: bool 
     buffer back and returns per-block checksums so a mismatch localizes the
     bad block's HBM address range.
     """
-    num_blocks = max(1, total_bytes // BYTES_PER_BLOCK)
-    rows = num_blocks * BLOCK_ROWS
+    num_blocks = max(1, total_bytes // WRITE_BYTES_PER_BLOCK)
+    rows = num_blocks * WRITE_BLOCK_ROWS
 
     def write(seed: jax.Array) -> jax.Array:
         return pl.pallas_call(
             _fill_kernel,
             grid=(repeats, num_blocks),
             in_specs=[pl.BlockSpec((1, 1), lambda r, i: (0, 0), memory_space=pltpu.SMEM)],
-            out_specs=pl.BlockSpec((BLOCK_ROWS, WIDTH), lambda r, i: (i, 0)),
-            out_shape=jax.ShapeDtypeStruct((rows, WIDTH), jnp.float32),
+            out_specs=pl.BlockSpec((WRITE_BLOCK_ROWS, WRITE_WIDTH), lambda r, i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((rows, WRITE_WIDTH), jnp.float32),
             interpret=interpret,
         )(seed)
 
@@ -135,13 +144,13 @@ def make_hbm_write_probe(total_bytes: int, *, repeats: int = 1, interpret: bool 
         return pl.pallas_call(
             _blocksum_kernel,
             grid=(num_blocks,),
-            in_specs=[pl.BlockSpec((BLOCK_ROWS, WIDTH), lambda i: (i, 0))],
+            in_specs=[pl.BlockSpec((WRITE_BLOCK_ROWS, WRITE_WIDTH), lambda i: (i, 0))],
             out_specs=pl.BlockSpec((1, num_blocks), lambda i: (0, 0), memory_space=pltpu.SMEM),
             out_shape=jax.ShapeDtypeStruct((1, num_blocks), jnp.float32),
             interpret=interpret,
         )(x)
 
-    return jax.jit(write), jax.jit(blocksums), rows, num_blocks * BYTES_PER_BLOCK
+    return jax.jit(write), jax.jit(blocksums), rows, num_blocks * WRITE_BYTES_PER_BLOCK
 
 
 def _pick_repeats(actual_bytes: int, target_traffic: int = 32 << 30) -> int:
@@ -242,10 +251,10 @@ def run_hbm_write_probe(
         device = device or jax.devices()[0]
         interpret = device.platform != "tpu"
         if interpret:
-            total_bytes = min(total_bytes, BYTES_PER_BLOCK * 2)
+            total_bytes = min(total_bytes, WRITE_BYTES_PER_BLOCK * 2)
 
-        num_blocks = max(1, total_bytes // BYTES_PER_BLOCK)
-        repeats = 1 if interpret else _pick_repeats(num_blocks * BYTES_PER_BLOCK)
+        num_blocks = max(1, total_bytes // WRITE_BYTES_PER_BLOCK)
+        repeats = 1 if interpret else _pick_repeats(num_blocks * WRITE_BYTES_PER_BLOCK)
         write, blocksums, rows, actual_bytes = make_hbm_write_probe(
             total_bytes, repeats=repeats, interpret=interpret
         )
@@ -282,16 +291,16 @@ def run_hbm_write_probe(
 
         import numpy as np
 
-        block_elems = BLOCK_ROWS * WIDTH
+        block_elems = WRITE_BLOCK_ROWS * WRITE_WIDTH
         expected = (np.arange(1, num_blocks + 1, dtype=np.float64)) * block_elems
         got = np.asarray(sums, dtype=np.float64).reshape(-1)
-        # block sums are v * 2^19 with small integer v — exactly representable
+        # block sums are v * 2^17 with small integer v — exactly representable
         # in f32, so the tolerance only absorbs reduction-order effects
         bad = np.nonzero(np.abs(got - expected) > 1e-5 * expected)[0]
         bad_blocks = [
             {
                 "block": int(b),
-                "byte_offset": int(b) * BYTES_PER_BLOCK,
+                "byte_offset": int(b) * WRITE_BYTES_PER_BLOCK,
                 "expected_sum": float(expected[b]),
                 "got_sum": float(got[b]),
             }

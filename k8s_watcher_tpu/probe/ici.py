@@ -128,7 +128,7 @@ def run_ici_probe(
 
 
 def run_mxu_probe(
-    size: int = 1024,
+    size: int = 4096,
     *,
     iters: int = 5,
     inner_iters: int = 8,
@@ -137,22 +137,33 @@ def run_mxu_probe(
     """Chained bf16 matmuls on one device: MXU throughput + numeric sanity.
 
     bf16 inputs with f32 accumulation is the MXU-native regime. The jitted
-    program chains ``inner_iters`` dependent matmuls (renormalized each step
-    so bf16 can't overflow), amortizing dispatch overhead; TFLOP/s =
-    2·size³·inner_iters / t. A health signal, not a benchmark.
+    program chains ``inner_iters`` dependent matmuls, amortizing dispatch
+    overhead; TFLOP/s = 2·size³·inner_iters / t. A health signal, not a
+    benchmark — but tuned so a healthy chip reads ~peak (sweep data in
+    ARCHITECTURE.md):
+
+    - size 4096: operands resident in VMEM → MXU-bound (~100% of v5e
+      nominal peak). 8192 streams 128 MiB operands from HBM every
+      iteration and tops out ~12% lower — that measures HBM, which the
+      dedicated hbm probes already do.
+    - the chain renormalizes with a CONSTANT 1/sqrt(size) scale (entries of
+      ``b`` are unit-normal, so a matmul scales RMS by ~sqrt(size)); the
+      earlier data-dependent rsqrt(mean) renorm added a full reduction per
+      step for a few % of throughput.
     """
     try:
         # first *local* device — jax.devices()[0] is remote (unaddressable)
         # on any multi-host process other than process 0
         device = device or jax.local_devices()[0]
+        inv_scale = 1.0 / (size**0.5)
 
         @jax.jit
         def step(a, b):
             def body(_, carry):
                 y = jnp.dot(carry, b, preferred_element_type=jnp.float32)
-                # renormalize to unit RMS so the chain stays in bf16 range
-                y = y * jax.lax.rsqrt(jnp.mean(y * y) + 1e-6)
-                return y.astype(jnp.bfloat16)
+                # constant rescale keeps the chain in bf16 range (fuses
+                # into the matmul epilogue, unlike a mean-reduction)
+                return (y * inv_scale).astype(jnp.bfloat16)
 
             return jax.lax.fori_loop(0, inner_iters, body, a)
 
