@@ -168,19 +168,20 @@ class TestHbmWriteProbe:
         assert out["bytes"] > 0 and out["write_gbps"] > 0
 
     def test_write_probe_localizes_corrupted_block(self):
-        from k8s_watcher_tpu.probe.hbm import BLOCK_ROWS, run_hbm_write_probe
+        from k8s_watcher_tpu.probe.hbm import WRITE_BLOCK_ROWS, run_hbm_write_probe
 
         def corrupt(y):
-            # flip one element inside block 1 (rows BLOCK_ROWS..2*BLOCK_ROWS)
-            return y.at[BLOCK_ROWS + 7, 3].add(1e6)
+            # flip one element inside block 1 (the write path's own
+            # block geometry, not the read path's)
+            return y.at[WRITE_BLOCK_ROWS + 7, 3].add(1e6)
 
         out = run_hbm_write_probe(1 << 23, iters=1, corrupt_hook=corrupt)
         assert not out["ok"]
         assert out["bad_block_count"] == 1
         assert out["bad_blocks"][0]["block"] == 1
-        from k8s_watcher_tpu.probe.hbm import BYTES_PER_BLOCK
+        from k8s_watcher_tpu.probe.hbm import WRITE_BYTES_PER_BLOCK
 
-        assert out["bad_blocks"][0]["byte_offset"] == BYTES_PER_BLOCK
+        assert out["bad_blocks"][0]["byte_offset"] == WRITE_BYTES_PER_BLOCK
 
     def test_agent_includes_hbm_write_and_health_gate(self):
         from k8s_watcher_tpu.config.schema import TpuConfig
