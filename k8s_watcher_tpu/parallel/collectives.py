@@ -221,9 +221,16 @@ def make_pair_probe(
 
 
 def pair_probe_input(mesh: Mesh) -> jax.Array:
-    """Per-member scalars (1.0, 2.0) laid out over the pair mesh."""
-    x = jnp.arange(1.0, 3.0, dtype=jnp.float32)
-    return jax.device_put(x, NamedSharding(mesh, P("pair")))
+    """Per-member scalars (1.0, 2.0) laid out over the pair mesh.
+
+    When the pair spans processes (an inter-host link in multi-controller
+    mode), ``device_put`` can't place the remote shard — build the global
+    array from per-process addressable shards instead."""
+    sharding = NamedSharding(mesh, P("pair"))
+    if any(d.process_index != jax.process_index() for d in mesh.devices.flat):
+        x = np.arange(1.0, 3.0, dtype=np.float32)
+        return jax.make_array_from_callback((2,), sharding, lambda idx: x[idx])
+    return jax.device_put(jnp.arange(1.0, 3.0, dtype=jnp.float32), sharding)
 
 
 def allreduce_bus_bandwidth_gbps(payload_bytes: int, n_devices: int, seconds: float) -> float:

@@ -17,10 +17,15 @@ on a healthy mesh all links are within a small factor of each other), with
 an absolute floor so microsecond-scale jitter can't trip it.
 
 Process model: single-controller probes every link. In multi-controller
-(DaemonSet) mode each host probes its own intra-host links — a 2-device
-program over a remote host's devices can't be launched locally — and
-inter-host paths stay covered by the aggregate psum/bandwidth probes, so
-localization granularity there is per-host, not per-link.
+(DaemonSet) mode every process walks the SAME deterministic global link
+list and participates in exactly the pair programs that touch one of its
+own devices: intra-host links run solo, and an inter-host link runs as a
+2-process SPMD pair program — both endpoint processes execute it in
+lockstep (same list order on every process, so overlapping pairs can't
+deadlock), and the lower-indexed endpoint process records the result so
+each edge is measured once. Inter-host edges are thereby localized
+per-link, not just covered in aggregate; a host-level merge of the
+per-process results yields the full edge map.
 
 Faults for chaos tests are injected via ``IciFaultSpec`` (faults/ici.py);
 tests assert the prober fingers exactly the injected device.
@@ -48,18 +53,22 @@ class LinkResult:
     axis: str  # "chips" (intra-host) | "hosts" (inter-host)
     name: str  # e.g. "host0/chip1-chip2"
     device_ids: Tuple[int, int]
-    rtt_ms: float  # min per-hop over iters
+    rtt_ms: float  # min per-hop over iters (-1 when the probe errored)
     rtt_mean_ms: float
     correct: bool
+    # this process is the canonical recorder for the edge (lower-indexed
+    # endpoint); non-owned observations still feed suspect triangulation
+    owner: bool = True
+    error: Optional[str] = None
 
 
 @dataclasses.dataclass
 class LinkProbeResult:
     ok: bool
-    n_links: int
+    n_links: int  # edges this process canonically records (owner=True)
     median_rtt_ms: float
-    links: List[LinkResult]
-    suspect_links: List[Dict[str, Any]]  # {name, device_ids, reason, rtt_ms}
+    links: List[LinkResult]  # owned records only — merge across hosts dedup-free
+    suspect_links: List[Dict[str, Any]]  # {name, device_ids, reason, rtt_ms} over ALL observed
     suspect_devices: List[int]  # device ids implicated by >1 suspect link
     compile_ms: float
     error: Optional[str] = None
@@ -133,21 +142,24 @@ def run_link_probe(
         if mesh is None:
             mesh = host_chip_mesh()
         links = enumerate_links(mesh)
+        pid = jax.process_index()
         if jax.process_count() > 1:
-            # Multi-controller mode: a 2-device program over another host's
-            # devices cannot be launched from here (non-addressable shards),
-            # so each host probes its own intra-host links; inter-host paths
-            # are covered by the aggregate psum/bandwidth probes (detection
-            # at host granularity rather than per-link localization).
-            pid = jax.process_index()
-            local = [l for l in links if l[2].process_index == pid and l[3].process_index == pid]
-            if len(local) < len(links):
+            # Multi-controller mode: participate in every pair program that
+            # touches one of this process's devices. An inter-host link is
+            # a 2-process SPMD program both endpoints must execute in
+            # lockstep; every process walks the same global list order, so
+            # overlapping pairs rendezvous deterministically.
+            participating = [
+                l for l in links
+                if l[2].process_index == pid or l[3].process_index == pid
+            ]
+            if len(participating) < len(links):
                 logger.info(
-                    "Multi-host link probe: probing %d/%d process-local links "
-                    "(inter-host links covered by the aggregate probes)",
-                    len(local), len(links),
+                    "Multi-host link probe: participating in %d/%d links "
+                    "(others belong entirely to other hosts)",
+                    len(participating), len(links),
                 )
-            links = local
+            links = participating
         if not links:
             return LinkProbeResult(
                 ok=True, n_links=0, median_rtt_ms=0.0, links=[],
@@ -155,33 +167,69 @@ def run_link_probe(
             )
 
         compile_s = 0.0
-        results: List[LinkResult] = []
+        observed: List[LinkResult] = []
         for axis, name, dev_a, dev_b in links:
-            fn, pair_mesh, expected = make_pair_probe(dev_a, dev_b, inner_iters, fault)
-            x = pair_probe_input(pair_mesh)
-            t0 = time.perf_counter()
-            np.asarray(fn(x))  # warmup, host-fenced (compile on first cycle)
-            compile_s += time.perf_counter() - t0
-            rtt_min, rtt_mean, correct = _timed_pair(fn, x, expected, iters, inner_iters)
-            results.append(
-                LinkResult(
-                    axis=axis,
-                    name=name,
-                    device_ids=(dev_a.id, dev_b.id),
-                    rtt_ms=1e3 * rtt_min,
-                    rtt_mean_ms=1e3 * rtt_mean,
-                    correct=correct,
-                )
-            )
+            owner = pid == min(dev_a.process_index, dev_b.process_index)
+            # Per-link containment: a failure must NOT abort the walk —
+            # peers execute the same list in lockstep, and bailing out here
+            # would leave them blocked forever inside the next cross-process
+            # pair program this process never joins. (A collective that
+            # fails on one side errors on both, so both sides continue in
+            # step.) The errored link is recorded and fed to the suspect
+            # analysis instead.
+            try:
+                fn, pair_mesh, expected = make_pair_probe(dev_a, dev_b, inner_iters, fault)
+                x = pair_probe_input(pair_mesh)
+                t0 = time.perf_counter()
+                np.asarray(fn(x))  # warmup, host-fenced (compile on first cycle)
+                compile_s += time.perf_counter() - t0
+                rtt_min, rtt_mean, correct = _timed_pair(fn, x, expected, iters, inner_iters)
+            except Exception as exc:  # noqa: BLE001 — lockstep preservation
+                logger.warning("Link probe %s failed: %s", name, exc)
+                observed.append(LinkResult(
+                    axis=axis, name=name, device_ids=(dev_a.id, dev_b.id),
+                    rtt_ms=-1.0, rtt_mean_ms=-1.0, correct=False,
+                    owner=owner, error=str(exc),
+                ))
+                continue
+            observed.append(LinkResult(
+                axis=axis, name=name, device_ids=(dev_a.id, dev_b.id),
+                rtt_ms=1e3 * rtt_min, rtt_mean_ms=1e3 * rtt_mean,
+                correct=correct, owner=owner,
+            ))
         compile_ms = 1e3 * compile_s
+        # cross-process links are executed by BOTH endpoint processes (they
+        # must run in lockstep); the lower-indexed endpoint owns the
+        # canonical record, so a host-level merge counts each edge once —
+        # but suspect analysis below uses EVERYTHING this process observed,
+        # or a slow chip whose links are owned by different processes would
+        # never accumulate the >=2 suspect links triangulation needs
+        results = [r for r in observed if r.owner]
+        if not observed:
+            return LinkProbeResult(
+                ok=True, n_links=0, median_rtt_ms=0.0, links=[],
+                suspect_links=[], suspect_devices=[], compile_ms=compile_ms,
+            )
 
-        median = float(np.median([r.rtt_ms for r in results]))
-        threshold = max(rtt_floor_ms, rtt_factor * median)
+        valid = [r.rtt_ms for r in observed if r.rtt_ms >= 0]
+        median = float(np.median(valid)) if valid else -1.0
+        # like-for-like thresholds: intra-host ("chips") and inter-host
+        # ("hosts") hops have different healthy baselines (the columns can
+        # be DCN-backed), so one mixed median would flag every healthy
+        # inter-host link on asymmetric fabrics — or mask a degraded
+        # intra-host link under the inflated threshold
+        thresholds: Dict[str, float] = {}
+        for axis in {r.axis for r in observed}:
+            population = [r.rtt_ms for r in observed if r.axis == axis and r.rtt_ms >= 0]
+            axis_median = float(np.median(population)) if population else 0.0
+            thresholds[axis] = max(rtt_floor_ms, rtt_factor * axis_median)
         suspects: List[Dict[str, Any]] = []
-        for r in results:
-            if not r.correct:
+        for r in observed:
+            if r.error is not None:
+                suspects.append({"name": r.name, "device_ids": list(r.device_ids), "reason": "error", "rtt_ms": r.rtt_ms})
+            elif not r.correct:
                 suspects.append({"name": r.name, "device_ids": list(r.device_ids), "reason": "corrupt", "rtt_ms": r.rtt_ms})
-            elif r.rtt_ms > threshold:
+            elif r.rtt_ms > thresholds[r.axis]:
                 suspects.append({"name": r.name, "device_ids": list(r.device_ids), "reason": "slow", "rtt_ms": r.rtt_ms})
 
         endpoint_counts: Dict[int, int] = {}
@@ -193,7 +241,7 @@ def run_link_probe(
         if suspects:
             logger.warning(
                 "Link probe: %d/%d suspect links (median %.3f ms): %s; suspect devices: %s",
-                len(suspects), len(results), median,
+                len(suspects), len(observed), median,
                 [s["name"] for s in suspects], suspect_devices,
             )
         return LinkProbeResult(

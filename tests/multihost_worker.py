@@ -59,6 +59,23 @@ def main() -> None:
     report = agent.run_once()
     agent._report(report)  # process-0-only gating under test
 
+    # per-link localization across processes: every worker walks the same
+    # global link list; inter-host pair programs run on both endpoint
+    # processes and are recorded by the lower-indexed one
+    from k8s_watcher_tpu.probe.links import run_link_probe
+
+    fault = None
+    corrupt_device = os.environ.get("MULTIHOST_CORRUPT_DEVICE")
+    if corrupt_device is not None:
+        from k8s_watcher_tpu.faults.ici import IciFaultSpec
+
+        fault = IciFaultSpec(corrupt_device_id=int(corrupt_device))
+    # generous floor: the test asserts coverage and recording placement,
+    # not latency — CI gloo/TCP jitter must not flip an outlier flag
+    link_report = run_link_probe(
+        mesh, iters=2, inner_iters=4, rtt_floor_ms=250.0, fault=fault
+    )
+
     result = {
         "pid": pid,
         "initialized": initialized,
@@ -70,6 +87,18 @@ def main() -> None:
         "ici": report.ici.to_dict() if report.ici else None,
         "mxu_ok": bool(report.mxu and report.mxu.get("ok")),
         "healthy": report.healthy,
+        "links": {
+            "ok": link_report.ok,
+            "n_links": link_report.n_links,
+            "recorded": [
+                {"axis": l.axis, "name": l.name, "correct": l.correct,
+                 "device_ids": list(l.device_ids), "rtt_ms": l.rtt_ms}
+                for l in link_report.links
+            ],
+            "suspect_links": link_report.suspect_links,
+            "suspect_devices": link_report.suspect_devices,
+            "error": link_report.error,
+        },
         "reported": len(reported),
         "payload_event_type": reported[0].payload["event_type"] if reported else None,
     }

@@ -44,11 +44,10 @@ def _worker_env() -> dict:
     return env
 
 
-@pytest.fixture(scope="module")
-def worker_results(tmp_path_factory):
-    out_dir = tmp_path_factory.mktemp("multihost")
+def _run_cluster(out_dir, extra_env=None):
     coordinator = f"127.0.0.1:{_free_port()}"
     env = _worker_env()
+    env.update(extra_env or {})
     procs = [
         subprocess.Popen(
             [sys.executable, str(WORKER), coordinator, str(N_PROCS), str(pid), str(out_dir)],
@@ -79,6 +78,22 @@ def worker_results(tmp_path_factory):
     return results
 
 
+@pytest.fixture(scope="module")
+def worker_results(tmp_path_factory):
+    return _run_cluster(tmp_path_factory.mktemp("multihost"))
+
+
+@pytest.fixture(scope="module")
+def faulted_results(tmp_path_factory):
+    # corrupt process 1's chip 0 (JAX CPU global id = process_index *
+    # 2048 + local_id): its two links are OWNED by different processes
+    # (intra-host by proc 1, inter-host by proc 0)
+    return _run_cluster(
+        tmp_path_factory.mktemp("multihost_fault"),
+        extra_env={"MULTIHOST_CORRUPT_DEVICE": "2048"},
+    )
+
+
 def test_global_device_visibility(worker_results):
     for pid, r in worker_results.items():
         assert r["initialized"], f"proc {pid} did not join the cluster"
@@ -106,6 +121,57 @@ def test_psum_crosses_process_boundary(worker_results):
         assert ici["psum_rtt_ms"] > 0
         assert r["mxu_ok"]
         assert r["healthy"]
+
+
+def test_inter_host_links_localized_per_link(worker_results):
+    """Inter-host edges must be probed as cross-process pair programs and
+    recorded exactly once (by the lower-indexed endpoint) — per-link
+    localization, not per-host aggregation (the round-1 limitation)."""
+    for pid, r in worker_results.items():
+        assert r["links"]["error"] is None, f"proc {pid}: {r['links']['error']}"
+        assert r["links"]["ok"], f"proc {pid} link probe flagged suspects"
+
+    all_recorded = [l for r in worker_results.values() for l in r["links"]["recorded"]]
+    names = [l["name"] for l in all_recorded]
+    assert len(names) == len(set(names)), f"some edge recorded twice: {sorted(names)}"
+
+    # (2 hosts x 2 chips) grid: 1 intra-host link per host + 1 inter-host
+    # link per chip column = 4 edges, all covered across the fleet
+    inter = [l for l in all_recorded if l["axis"] == "hosts"]
+    intra = [l for l in all_recorded if l["axis"] == "chips"]
+    assert len(inter) == CHIPS_PER_PROC, f"inter-host edges not localized: {names}"
+    assert len(intra) == N_PROCS
+    assert all(l["correct"] for l in all_recorded)
+    assert all(l["rtt_ms"] > 0 for l in all_recorded)
+    # inter-host records live on the lower-indexed endpoint process
+    assert all(l["axis"] == "chips" for r in worker_results.values() if r["pid"] > 0
+               for l in r["links"]["recorded"]), "inter-host edge recorded on the wrong process"
+
+
+def test_corrupt_chip_triangulated_across_process_ownership(faulted_results):
+    """A bad chip whose links are owned by DIFFERENT processes must still
+    be triangulated: suspect analysis runs over everything a process
+    observed (including edges it doesn't canonically record), so the
+    process that participates in both of the chip's links accumulates the
+    >=2 suspect links the device-level verdict needs."""
+    suspect_union = set()
+    for r in faulted_results.values():
+        suspect_union.update(r["links"]["suspect_devices"])
+        assert not r["links"]["ok"]
+    assert 2048 in suspect_union, (
+        f"corrupt device 2048 not triangulated; per-proc suspects: "
+        f"{[r['links']['suspect_devices'] for r in faulted_results.values()]}"
+    )
+    # process 1 participates in BOTH of device 2's links (one owned, one
+    # observed) — it must localize the chip locally
+    assert 2048 in faulted_results[1]["links"]["suspect_devices"]
+    reasons = {
+        s["reason"]
+        for r in faulted_results.values()
+        for s in r["links"]["suspect_links"]
+        if 2048 in s["device_ids"]
+    }
+    assert reasons == {"corrupt"}
 
 
 def test_only_process_zero_reports(worker_results):
