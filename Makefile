@@ -1,0 +1,22 @@
+PY ?= python
+
+.PHONY: test integration integration-kind integration-mock bench dryrun
+
+test:
+	$(PY) -m pytest tests/ -q
+
+# Acceptance tier #2 (BASELINE.md config #2): records artifacts/integration_<backend>.json
+integration:
+	$(PY) scripts/run_integration_tier.py --backend auto
+
+integration-kind:
+	$(PY) scripts/run_integration_tier.py --backend kind
+
+integration-mock:
+	$(PY) scripts/run_integration_tier.py --backend mock
+
+bench:
+	$(PY) bench.py
+
+dryrun:
+	$(PY) __graft_entry__.py 8

@@ -166,20 +166,58 @@ def run_link_probe(
                 suspect_links=[], suspect_devices=[], compile_ms=0.0,
             )
 
-        compile_s = 0.0
+        # PREPARATION phase — everything local (tracing, input building)
+        # happens BEFORE any cross-process program launches. A local
+        # failure here is one-sided: the peer would block forever in a
+        # collective this process never joins, so prepared links are
+        # reconciled across processes below before anything executes.
+        prepared = []  # (axis, name, dev_a, dev_b, owner, fn, x, expected) | error LinkResult
+        prep_ok = True
         observed: List[LinkResult] = []
         for axis, name, dev_a, dev_b in links:
             owner = pid == min(dev_a.process_index, dev_b.process_index)
-            # Per-link containment: a failure must NOT abort the walk —
-            # peers execute the same list in lockstep, and bailing out here
-            # would leave them blocked forever inside the next cross-process
-            # pair program this process never joins. (A collective that
-            # fails on one side errors on both, so both sides continue in
-            # step.) The errored link is recorded and fed to the suspect
-            # analysis instead.
+            cross = dev_a.process_index != dev_b.process_index
             try:
+                if _PREP_FAILURE_HOOK is not None and _PREP_FAILURE_HOOK(name):
+                    raise RuntimeError("injected preparation failure (test hook)")
                 fn, pair_mesh, expected = make_pair_probe(dev_a, dev_b, inner_iters, fault)
                 x = pair_probe_input(pair_mesh)
+            except Exception as exc:  # noqa: BLE001 — containment, see above
+                logger.warning("Link probe %s preparation failed: %s", name, exc)
+                observed.append(LinkResult(
+                    axis=axis, name=name, device_ids=(dev_a.id, dev_b.id),
+                    rtt_ms=-1.0, rtt_mean_ms=-1.0, correct=False,
+                    owner=owner, error=f"preparation: {exc}",
+                ))
+                if cross:
+                    prep_ok = False
+                continue
+            prepared.append((axis, name, dev_a, dev_b, owner, cross, fn, x, expected))
+
+        # AGREEMENT: one full-mesh psum carries every process's "all my
+        # cross-process preparations succeeded" flag. If anyone failed,
+        # ALL processes skip ALL cross-process programs this cycle —
+        # otherwise the failed process's peers would hang waiting for it.
+        run_cross = _all_processes_ready(mesh, prep_ok)
+        if not run_cross and jax.process_count() > 1:
+            logger.warning(
+                "Link probe: a process failed preparation; probing intra-host "
+                "links only this cycle"
+            )
+
+        compile_s = 0.0
+        for axis, name, dev_a, dev_b, owner, cross, fn, x, expected in prepared:
+            if cross and not run_cross:
+                observed.append(LinkResult(
+                    axis=axis, name=name, device_ids=(dev_a.id, dev_b.id),
+                    rtt_ms=-1.0, rtt_mean_ms=-1.0, correct=False, owner=owner,
+                    error="skipped: a peer process failed preparation",
+                ))
+                continue
+            # EXECUTION phase: a collective that fails mid-flight errors on
+            # every participant (they are all inside the same program), so
+            # per-link containment here keeps the walk in lockstep.
+            try:
                 t0 = time.perf_counter()
                 np.asarray(fn(x))  # warmup, host-fenced (compile on first cycle)
                 compile_s += time.perf_counter() - t0
@@ -205,11 +243,6 @@ def run_link_probe(
         # or a slow chip whose links are owned by different processes would
         # never accumulate the >=2 suspect links triangulation needs
         results = [r for r in observed if r.owner]
-        if not observed:
-            return LinkProbeResult(
-                ok=True, n_links=0, median_rtt_ms=0.0, links=[],
-                suspect_links=[], suspect_devices=[], compile_ms=compile_ms,
-            )
 
         valid = [r.rtt_ms for r in observed if r.rtt_ms >= 0]
         median = float(np.median(valid)) if valid else -1.0
@@ -217,12 +250,24 @@ def run_link_probe(
         # ("hosts") hops have different healthy baselines (the columns can
         # be DCN-backed), so one mixed median would flag every healthy
         # inter-host link on asymmetric fabrics — or mask a degraded
-        # intra-host link under the inflated threshold
+        # intra-host link under the inflated threshold. Small populations
+        # need a different statistic: the median of 2 samples is dragged
+        # halfway toward an outlier (a 10x-degraded link would set its own
+        # threshold), so with <=2 samples the MIN anchors the healthy
+        # baseline; with one sample there is no reference and only the
+        # floor applies (corruption/error detection still covers it).
         thresholds: Dict[str, float] = {}
         for axis in {r.axis for r in observed}:
             population = [r.rtt_ms for r in observed if r.axis == axis and r.rtt_ms >= 0]
-            axis_median = float(np.median(population)) if population else 0.0
-            thresholds[axis] = max(rtt_floor_ms, rtt_factor * axis_median)
+            if not population:
+                base = 0.0
+            elif len(population) >= 3:
+                base = float(np.median(population))
+            elif len(population) == 2:
+                base = min(population)
+            else:
+                base = population[0]
+            thresholds[axis] = max(rtt_floor_ms, rtt_factor * base)
         suspects: List[Dict[str, Any]] = []
         for r in observed:
             if r.error is not None:
