@@ -36,16 +36,51 @@ from __future__ import annotations
 import dataclasses
 import logging
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
 
 from k8s_watcher_tpu.faults.ici import IciFaultSpec
-from k8s_watcher_tpu.parallel.collectives import make_pair_probe, pair_probe_input
+from k8s_watcher_tpu.parallel.collectives import (
+    make_pair_probe,
+    make_subaxis_psum_probe,
+    pair_probe_input,
+)
 from k8s_watcher_tpu.parallel.mesh import host_chip_mesh
 
 logger = logging.getLogger(__name__)
+
+# Test hook: when set, ``_PREP_FAILURE_HOOK(link_name)`` is consulted during
+# the preparation phase and a truthy return injects a preparation failure for
+# that link — the only way to exercise the cross-process agreement protocol
+# below without real breakage. Production leaves it None.
+_PREP_FAILURE_HOOK: Optional[Callable[[str], bool]] = None
+
+
+def _all_processes_ready(mesh, prep_ok: bool) -> bool:
+    """Full-mesh AND of every process's "my cross-process preps succeeded".
+
+    The agreement round of the probe's prepare/agree/execute protocol: every
+    process ALWAYS joins this one psum (it is the only collective whose
+    membership doesn't depend on per-link prep outcomes), contributing 1.0
+    from each of its devices when its cross-process preparations all
+    succeeded, else 0.0. The psum probe returns the mean of the flags, so
+    every process derives the same verdict — mean == 1.0 iff nobody failed —
+    without a side channel. Single-process mode has nobody to agree with.
+    """
+    if jax.process_count() == 1:
+        return prep_ok
+    axes = tuple(mesh.axis_names)
+    flag = 1.0 if prep_ok else 0.0
+    sharding = NamedSharding(mesh, PartitionSpec(axes))
+    arr = jax.make_array_from_callback(
+        (mesh.size,), sharding, lambda idx: np.full((1,), flag, dtype=np.float32)
+    )
+    fn = make_subaxis_psum_probe(mesh, axes)
+    mean = float(np.asarray(fn(arr)).ravel()[0])
+    return mean >= 1.0 - 1e-6
 
 
 @dataclasses.dataclass
