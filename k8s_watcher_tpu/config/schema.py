@@ -366,6 +366,9 @@ class TpuConfig:
     # so off by default; turn on to get which-chip/which-link diagnostics
     probe_links_enabled: bool = False
     probe_link_rtt_factor: float = 3.0
+    # absolute outlier floor per hop — raise on fabrics whose healthy RTT
+    # jitter exceeds the default (e.g. DCN-backed inter-host columns)
+    probe_link_rtt_floor_ms: float = 0.05
     # cross-slice DCN aggregation probe (probe/multislice.py)
     probe_multislice_enabled: bool = False
     probe_multislice_slices: int = 0  # 0 = infer from Device.slice_index
@@ -405,7 +408,8 @@ class TpuConfig:
             probe,
             ("enabled", "interval_seconds", "payload_bytes", "rtt_warn_ms", "matmul_size",
              "hbm_bytes", "hbm_write_enabled", "expected_chips_per_host", "links_enabled",
-             "link_rtt_factor", "multislice_enabled", "multislice_slices", "profile_dir"),
+             "link_rtt_factor", "link_rtt_floor_ms", "multislice_enabled",
+             "multislice_slices", "profile_dir"),
             "tpu.probe",
         )
         return cls(
@@ -424,6 +428,7 @@ class TpuConfig:
             expected_chips_per_host=_opt_int(probe, "expected_chips_per_host", "tpu.probe", 0),
             probe_links_enabled=_opt_bool(probe, "links_enabled", "tpu.probe", False),
             probe_link_rtt_factor=_opt_num(probe, "link_rtt_factor", "tpu.probe", 3.0),
+            probe_link_rtt_floor_ms=_opt_num(probe, "link_rtt_floor_ms", "tpu.probe", 0.05),
             probe_multislice_enabled=_opt_bool(probe, "multislice_enabled", "tpu.probe", False),
             probe_multislice_slices=_opt_int(probe, "multislice_slices", "tpu.probe", 0),
             probe_profile_dir=_opt_str(probe, "profile_dir", "tpu.probe", None),

@@ -95,7 +95,11 @@ class ProbeAgent:
         if self.config.probe_links_enabled:
             from k8s_watcher_tpu.probe.links import run_link_probe
 
-            links = run_link_probe(self.mesh, rtt_factor=self.config.probe_link_rtt_factor)
+            links = run_link_probe(
+                self.mesh,
+                rtt_factor=self.config.probe_link_rtt_factor,
+                rtt_floor_ms=self.config.probe_link_rtt_floor_ms,
+            )
         multislice = None
         if self.config.probe_multislice_enabled:
             from k8s_watcher_tpu.probe.multislice import run_multislice_probe

@@ -70,6 +70,17 @@ def main() -> None:
         from k8s_watcher_tpu.faults.ici import IciFaultSpec
 
         fault = IciFaultSpec(corrupt_device_id=int(corrupt_device))
+
+    # agreement-protocol injection: "<pid>:<name-prefix>" makes that process
+    # fail preparation of matching links, so the parent can assert ALL
+    # processes then skip ALL cross-process pair programs (no hang)
+    prep_fail = os.environ.get("MULTIHOST_PREP_FAIL")
+    if prep_fail is not None:
+        fail_pid, _, prefix = prep_fail.partition(":")
+        if pid == int(fail_pid):
+            from k8s_watcher_tpu.probe import links as links_mod
+
+            links_mod._PREP_FAILURE_HOOK = lambda name: name.startswith(prefix)
     # generous floor: the test asserts coverage and recording placement,
     # not latency — CI gloo/TCP jitter must not flip an outlier flag
     link_report = run_link_probe(
@@ -92,7 +103,8 @@ def main() -> None:
             "n_links": link_report.n_links,
             "recorded": [
                 {"axis": l.axis, "name": l.name, "correct": l.correct,
-                 "device_ids": list(l.device_ids), "rtt_ms": l.rtt_ms}
+                 "device_ids": list(l.device_ids), "rtt_ms": l.rtt_ms,
+                 "error": l.error}
                 for l in link_report.links
             ],
             "suspect_links": link_report.suspect_links,

@@ -137,8 +137,12 @@ class TestReportIntegration:
 
 def test_config_link_probe_keys():
     cfg = TpuConfig.from_raw(
-        {"probe": {"enabled": True, "links_enabled": True, "link_rtt_factor": 5.0}}
+        {"probe": {"enabled": True, "links_enabled": True, "link_rtt_factor": 5.0,
+                   "link_rtt_floor_ms": 2.5}}
     )
     assert cfg.probe_links_enabled is True
     assert cfg.probe_link_rtt_factor == 5.0
-    assert TpuConfig.from_raw({}).probe_links_enabled is False
+    assert cfg.probe_link_rtt_floor_ms == 2.5
+    defaults = TpuConfig.from_raw({})
+    assert defaults.probe_links_enabled is False
+    assert defaults.probe_link_rtt_floor_ms == 0.05

@@ -94,6 +94,16 @@ def faulted_results(tmp_path_factory):
     )
 
 
+@pytest.fixture(scope="module")
+def prep_fail_results(tmp_path_factory):
+    # process 1 fails preparation of the chip0 inter-host link — the
+    # one-sided failure mode the agreement round exists for
+    return _run_cluster(
+        tmp_path_factory.mktemp("multihost_prepfail"),
+        extra_env={"MULTIHOST_PREP_FAIL": "1:chip0/"},
+    )
+
+
 def test_global_device_visibility(worker_results):
     for pid, r in worker_results.items():
         assert r["initialized"], f"proc {pid} did not join the cluster"
@@ -172,6 +182,37 @@ def test_corrupt_chip_triangulated_across_process_ownership(faulted_results):
         if 2048 in s["device_ids"]
     }
     assert reasons == {"corrupt"}
+
+
+def test_prep_failure_skips_all_cross_process_links(prep_fail_results):
+    """When ONE process fails preparation of ONE cross-process link, the
+    agreement round must make EVERY process skip EVERY cross-process pair
+    program that cycle — otherwise the healthy peer launches a 2-process
+    collective its peer never joins and hangs forever. Intra-host links
+    must still be measured (reaching here at all proves no worker hung:
+    _run_cluster bounds communicate() and asserts exit 0)."""
+    r0, r1 = prep_fail_results[0], prep_fail_results[1]
+    for r in (r0, r1):
+        assert r["links"]["error"] is None
+        assert not r["links"]["ok"]  # the prep failure is a suspect
+        intra = [l for l in r["links"]["recorded"] if l["axis"] == "chips"]
+        assert len(intra) == 1, "intra-host link must still be measured"
+        assert intra[0]["correct"] and intra[0]["rtt_ms"] > 0
+
+    # proc 0's own preparations ALL succeeded, yet agreement must stop it
+    # from executing BOTH inter-host pair programs (incl. chip1's, whose
+    # preparation succeeded on both sides)
+    inter0 = [l for l in r0["links"]["recorded"] if l["axis"] == "hosts"]
+    assert len(inter0) == CHIPS_PER_PROC, "proc 0 still owns the skipped edges"
+    for l in inter0:
+        assert l["rtt_ms"] < 0
+        assert "skipped" in (l["error"] or ""), l
+
+    # proc 1 surfaced its injected failure against the right link
+    assert any(
+        s["name"].startswith("chip0/") and s["reason"] == "error"
+        for s in r1["links"]["suspect_links"]
+    ), r1["links"]["suspect_links"]
 
 
 def test_only_process_zero_reports(worker_results):

@@ -125,6 +125,24 @@ class TestProbeAgentAndReport:
         assert payload["mxu"]["ok"]
         assert payload["devices"]["visible_devices"] == 8
 
+    def test_links_enabled_populates_report(self):
+        # agent-level regression guard for the link sub-probe: with
+        # links_enabled the whole path (config -> agent -> run_link_probe)
+        # must execute and a healthy mesh must yield a populated block —
+        # the default-off config left this wiring untested end-to-end
+        agent = self.make_agent(self.make_config(
+            probe_links_enabled=True, probe_link_rtt_floor_ms=5.0,
+        ))
+        report = agent.run_once()
+        assert report.links is not None
+        assert report.links.error is None
+        assert report.links.ok, report.links.suspect_links
+        # default mesh groups by process: 1 host x 8 chips -> an 8-edge ring
+        assert report.links.n_links == 8
+        assert report.healthy
+        payload = report.to_payload()
+        assert payload["links"]["n_links"] == 8
+
     def test_rtt_threshold_marks_unhealthy(self):
         agent = self.make_agent(self.make_config(probe_rtt_warn_ms=1e-9))
         assert agent.run_once().healthy is False
