@@ -121,6 +121,17 @@ class ClusterApiClient:
         self._local.conn = conn
         self._local.fresh = True
         with self._conns_lock:
+            # re-check under the lock that serializes registration against
+            # abort()'s sweep: a conn minted after the is_set() check above
+            # but registered after the sweep copied _conns would otherwise
+            # escape the cut for up to a full request timeout
+            if self._abort.is_set():
+                self._local.conn = None
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                raise ConnectionError("client aborted (shutting down)")
             self._conns.add(conn)
         return conn, True
 

@@ -146,8 +146,22 @@ class SliceTracker:
         # repair/autoscale mints fresh names, so they'd otherwise
         # accumulate forever in a long-lived leader.
         self._down_nodes: Dict[str, bool] = {}
+        # node_name -> number of live members scheduled on it, maintained at
+        # the two member-mutation sites in _observe_locked. Makes the
+        # "is this node still referenced?" pruning checks O(1) instead of a
+        # full member walk under the watch thread's lock on every event.
+        self._node_refs: Dict[str, int] = {}
         # node-plane existence provider (set_node_existence_provider)
         self._node_existence = None
+
+    def _node_ref_delta_locked(self, name: Optional[str], delta: int) -> None:
+        if not name:
+            return
+        new = self._node_refs.get(name, 0) + delta
+        if new > 0:
+            self._node_refs[name] = new
+        else:
+            self._node_refs.pop(name, None)
 
     def __len__(self) -> int:
         return len(self._slices)
@@ -195,14 +209,23 @@ class SliceTracker:
             state.identity = identity  # later pods may carry richer metadata
 
         uid = event.uid
+        removed = None
         if event.type == EventType.DELETED:
-            state.members.pop(uid, None)
+            removed = state.members.pop(uid, None)
+            if removed is not None:
+                self._node_ref_delta_locked(removed.node_name, -1)
             if not state.ever_had_members:
                 # DELETED for a slice we never saw alive: nothing to report
                 self._slices.pop(identity.key, None)
                 return None, []
         else:
             node_name = (event.pod.get("spec") or {}).get("nodeName")
+            prev = state.members.get(uid)
+            if prev is None or prev.node_name != node_name:
+                # node_name changes at most once per pod (None -> scheduled)
+                if prev is not None:
+                    self._node_ref_delta_locked(prev.node_name, -1)
+                self._node_ref_delta_locked(node_name, +1)
             state.members[uid] = _Member(
                 uid=uid,
                 name=event.name,
@@ -217,6 +240,14 @@ class SliceTracker:
         if state.members:
             state.ever_had_members = True
         notifications = self._recompute_locked(state)
+        if removed is not None and removed.node_name:
+            # the pod may have held a deleted node's last reference — drop
+            # the down-entry now instead of waiting for an unrelated
+            # note_node() call. Two dict lookups: O(1) even under
+            # mass-teardown churn
+            name = removed.node_name
+            if self._down_nodes.get(name) is False and self._node_refs.get(name, 0) == 0:
+                del self._down_nodes[name]
 
         slice_info = {
             "key": identity.key,
@@ -306,18 +337,12 @@ class SliceTracker:
     def _prune_down_nodes_locked(self) -> None:
         """Drop DELETED-node entries no slice member references; alive
         NotReady entries stay (see ``_down_nodes``)."""
-        deleted = [n for n, exists in self._down_nodes.items() if not exists]
-        if not deleted:
-            return
-        referenced = {
-            member.node_name
-            for state in self._slices.values()
-            for member in state.members.values()
-            if member.node_name
-        }
-        for name in deleted:
-            if name not in referenced:
-                del self._down_nodes[name]
+        unreferenced = [
+            n for n, exists in self._down_nodes.items()
+            if not exists and self._node_refs.get(n, 0) == 0
+        ]
+        for name in unreferenced:
+            del self._down_nodes[name]
 
     def reconcile_nodes(self, present_nodes) -> List[Dict[str, Any]]:
         """Mark members on nodes ABSENT from ``present_nodes`` (the full
@@ -336,6 +361,11 @@ class SliceTracker:
                         touched = True
                 if touched:
                     notifications.extend(self._recompute_locked(state))
+            # sweep entries orphaned by paths with no inline prune (e.g. a
+            # member's node_name changing on MODIFIED) — each reconcile is
+            # already a full-list operation, so the O(down_nodes) walk is
+            # noise here, unlike on the per-event observe() path
+            self._prune_down_nodes_locked()
         return notifications
 
     # -- checkpoint integration -------------------------------------------

@@ -405,6 +405,57 @@ class TestDownNodePruning:
         assert all(not m.node_ready for m in members.values())
 
 
+    def test_node_refcounts_track_member_lifecycle(self):
+        # the O(1) pruning checks depend on _node_refs mirroring live
+        # membership exactly — including the unscheduled -> scheduled
+        # transition, the only time a pod's node_name changes
+        slices, phases = SliceTracker("development"), PhaseTracker()
+        kw = dict(
+            uid="u0", tpu_chips=4, tpu_topology="2x2x2",
+            gke_slice_fields={"jobset.sigs.k8s.io/jobset-name": "train",
+                              "batch.kubernetes.io/job-completion-index": 0},
+        )
+        ev = WatchEvent(type=EventType.ADDED, pod=build_pod("train-0", phase="Pending", **kw))
+        slices.observe(ev, phases.observe(ev))
+        assert slices._node_refs == {}  # unscheduled: no node reference
+
+        ev = WatchEvent(type=EventType.MODIFIED, pod=build_pod(
+            "train-0", phase="Running", node_name="nodeA", **kw))
+        slices.observe(ev, phases.observe(ev))
+        assert slices._node_refs == {"nodeA": 1}
+
+        # a second MODIFIED on the same node must not double-count
+        slices.observe(ev, phases.observe(ev))
+        assert slices._node_refs == {"nodeA": 1}
+
+        ev = WatchEvent(type=EventType.DELETED, pod=build_pod(
+            "train-0", phase="Running", node_name="nodeA", **kw))
+        slices.observe(ev, phases.observe(ev))
+        assert slices._node_refs == {}
+
+    def test_reconcile_absent_entry_pruned_when_last_member_deleted(self):
+        # reconcile_nodes records nodeA observed-absent; when a pod DELETED
+        # event removes the last member referencing it, the entry must be
+        # dropped promptly — not linger until an unrelated note_node() call
+        slices, phases = SliceTracker("development"), PhaseTracker()
+        pod = build_pod(
+            "train-0", phase="Running", tpu_chips=4, tpu_topology="2x2x2",
+            node_name="nodeA",
+            gke_slice_fields={"jobset.sigs.k8s.io/jobset-name": "train",
+                              "batch.kubernetes.io/job-completion-index": 0},
+            container_statuses=[{"name": "main", "ready": True, "restart_count": 0,
+                                 "state": {"running": {}}}],
+        )
+        ev = WatchEvent(type=EventType.ADDED, pod=pod)
+        slices.observe(ev, phases.observe(ev))
+        slices.reconcile_nodes(present_nodes=["some-other-node"])
+        assert slices._down_nodes == {"nodeA": False}  # observed absent, referenced
+
+        deleted = WatchEvent(type=EventType.DELETED, pod=pod)
+        slices.observe(deleted, phases.observe(deleted))
+        assert slices._down_nodes == {}
+
+
 class TestSliceSummaryNodeAware:
     def test_ready_workers_excludes_node_down_members(self):
         tracker, phases = SliceTracker("development"), PhaseTracker()

@@ -241,6 +241,29 @@ class TestBoundedShutdown:
         assert done.wait(2.0), "abort did not cancel the retry backoff"
         assert result["ok"] is False
 
+    def test_connection_registered_during_abort_is_cut(self):
+        # TOCTOU window: a worker passes the pre-mint is_set() check, then
+        # abort() sweeps the registry, then the worker registers its new
+        # connection — the re-check under _conns_lock must cut it, or the
+        # send escapes the shutdown bound for a full request timeout
+        client = ClusterApiClient("http://127.0.0.1:9", timeout=30.0)
+
+        class RacedEvent:
+            """is_set() False at the pre-mint check, True (abort landed)
+            by the re-check under the registration lock."""
+            def __init__(self):
+                self.checks = 0
+            def is_set(self):
+                self.checks += 1
+                return self.checks > 1
+
+        client._abort = RacedEvent()
+        with pytest.raises(ConnectionError):
+            client._connection()
+        assert client._conns == set(), "raced connection left registered"
+        assert getattr(client._local, "conn", None) is None
+        assert client._abort.checks == 2
+
     def test_graceful_drain_still_delivers(self, api_server):
         # healthy target: stop() must still deliver the backlog, not abort
         server, url = api_server
