@@ -1,9 +1,22 @@
 PY ?= python
 
-.PHONY: test integration integration-kind integration-mock bench dryrun
+.PHONY: test check integration integration-kind integration-mock bench dryrun
 
 test:
 	$(PY) -m pytest tests/ -q
+
+# The pre-snapshot gate: full suite + a live link-probe run on the virtual
+# mesh (the exact path a half-finished refactor once shipped broken while
+# tests were skipped). Run before EVERY end-of-round commit; a red gate
+# invalidates every other claim in the round.
+check: test dryrun
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PY) -c "import jax; jax.config.update('jax_platforms', 'cpu'); \
+	from k8s_watcher_tpu.probe.links import run_link_probe; \
+	r = run_link_probe(iters=2, inner_iters=4, rtt_floor_ms=5.0); \
+	ok = r.error is None and r.ok and r.n_links == 8; \
+	print('check: link probe OK (%d links, median %.3f ms)' % (r.n_links, r.median_rtt_ms) if ok else 'link probe gate FAILED'); \
+	raise SystemExit(0 if ok else repr(r))"
 
 # Acceptance tier #2 (BASELINE.md config #2): records artifacts/integration_<backend>.json
 integration:
