@@ -27,6 +27,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 BASELINE_TARGET_MS = 1000.0  # BASELINE.json north star: <1s p50
 
 
+def _probe_errors(**sources) -> dict:
+    """Non-empty error strings by probe name — failure causes must travel
+    with the artifact (BENCH_r02's probe_ok:false was undiagnosable)."""
+    return {k: v for k, v in sources.items() if v}
+
+
 class _SinkHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     # without TCP_NODELAY, Nagle + delayed-ACK adds ~40 ms per POST
@@ -278,14 +284,19 @@ def _virtual_probes_child(n_devices: int) -> int:
     from k8s_watcher_tpu.probe.multislice import run_multislice_probe
 
     ici = run_ici_probe(payload_bytes=1024 * 1024, iters=5, inner_iters=20)
-    links = run_link_probe(iters=3, inner_iters=4)
+    # generous floor: virtual-mesh links jitter with host scheduling; the
+    # block reports collective-path health, not latency outliers
+    links = run_link_probe(iters=3, inner_iters=4, rtt_floor_ms=5.0)
     multi = run_multislice_probe(n_slices=2, iters=3, inner_iters=8)
     out = {
         "virtual": True,  # CPU mesh: collective-path health, not ICI hardware
         "n_devices": n_devices,
         "psum_rtt_ms": round(ici.psum_rtt_ms, 4),
+        "psum_rtt_median_ms": round(ici.psum_rtt_median_ms, 4),
         "psum_correct": ici.psum_correct,
         "allreduce_bus_gbps": round(ici.bandwidth_gbps, 3),
+        "allreduce_bus_gbps_median": round(ici.bandwidth_gbps_median, 3),
+        "timing_unreliable": ici.timing_unreliable,
         "link_count": links.n_links,
         "link_median_rtt_ms": round(links.median_rtt_ms, 4),
         "link_suspects": len(links.suspect_links),
@@ -293,6 +304,7 @@ def _virtual_probes_child(n_devices: int) -> int:
         "multislice_ici_rtt_ms": round(multi.ici_rtt_ms, 4),
         "multislice_dcn_overhead_ms": round(multi.dcn_overhead_ms, 4),
         "probe_ok": ici.ok and links.ok and multi.ok,
+        "errors": _probe_errors(ici=ici.error, links=links.error, multislice=multi.error),
     }
     print(json.dumps(out))
     return 0
@@ -320,13 +332,29 @@ def bench_probe() -> dict:
             "device_kind": devices[0].device_kind,
             "n_devices": len(devices),
             "psum_rtt_ms": round(ici.psum_rtt_ms, 4),
+            "psum_rtt_median_ms": round(ici.psum_rtt_median_ms, 4),
             "psum_compile_ms": round(ici.compile_ms, 1),
             "allreduce_bus_gbps": round(ici.bandwidth_gbps, 2),
+            "allreduce_bus_gbps_median": round(ici.bandwidth_gbps_median, 2),
+            "psum_timing_unreliable": ici.timing_unreliable,
+            # min-based (best case) AND median-based (robust) readings: the
+            # min estimator over-subtracts the median fence and can read
+            # above physical peak; degradation verdicts use the median
             "mxu_tflops": round(mxu.get("tflops", 0.0), 2),
+            "mxu_tflops_median": round(mxu.get("tflops_median", 0.0), 2),
+            "mxu_timing_unreliable": bool(mxu.get("timing_unreliable", False)),
             "hbm_read_gbps": round(hbm_r.get("read_gbps", 0.0), 1),
+            "hbm_read_gbps_best": round(hbm_r.get("read_gbps_best", 0.0), 1),
+            "hbm_read_unreliable": bool(hbm_r.get("bandwidth_unreliable", False)),
             "hbm_write_gbps": round(hbm_w.get("write_gbps", 0.0), 1),
+            "hbm_write_gbps_best": round(hbm_w.get("write_gbps_best", 0.0), 1),
+            "hbm_write_unreliable": bool(hbm_w.get("bandwidth_unreliable", False)),
             "hbm_integrity_ok": bool(hbm_r.get("ok", False) and hbm_w.get("ok", False)),
             "probe_ok": ici.ok and mxu.get("ok", False) and hbm_r.get("ok", False) and hbm_w.get("ok", False),
+            "errors": _probe_errors(
+                ici=ici.error, mxu=mxu.get("error"),
+                hbm_read=hbm_r.get("error"), hbm_write=hbm_w.get("error"),
+            ),
         }
     except Exception as exc:  # bench must still report the watcher numbers
         return {"error": str(exc)}

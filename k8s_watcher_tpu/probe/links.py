@@ -137,6 +137,60 @@ def enumerate_links(mesh) -> List[Tuple[str, str, jax.Device, jax.Device]]:
     return links
 
 
+def classify_links(
+    observed: List[LinkResult], rtt_factor: float, rtt_floor_ms: float
+) -> Tuple[List[Dict[str, Any]], List[int]]:
+    """Pure suspect classification: ``(suspect_links, suspect_devices)``.
+
+    A link is suspect when it errored, failed its payload checksum
+    ("corrupt"), or its RTT exceeds ``max(rtt_floor_ms, rtt_factor *
+    per-axis baseline)`` ("slow") — so the per-link detection floor IS
+    ``rtt_factor`` (default 3x): a 2x-degraded link is deliberately below
+    the default threshold (false-positive margin against scheduler/fence
+    jitter) and requires ``tpu.probe.link_rtt_factor <= ~1.8`` to resolve.
+    Corruption has no such floor — any magnitude, first cycle. The exact
+    boundary is pinned by tests/test_links.py::TestClassifySensitivity.
+
+    Like-for-like thresholds: intra-host ("chips") and inter-host ("hosts")
+    hops have different healthy baselines (the columns can be DCN-backed),
+    so one mixed median would flag every healthy inter-host link on
+    asymmetric fabrics — or mask a degraded intra-host link under the
+    inflated threshold. Small populations need a different statistic: the
+    median of 2 samples is dragged halfway toward an outlier (a
+    10x-degraded link would set its own threshold), so with <=2 samples the
+    MIN anchors the healthy baseline; with one sample there is no reference
+    and only the floor applies. A device is suspect when it is an endpoint
+    of >=2 suspect links (one bad link implicates the link, not a chip).
+    """
+    thresholds: Dict[str, float] = {}
+    for axis in {r.axis for r in observed}:
+        population = [r.rtt_ms for r in observed if r.axis == axis and r.rtt_ms >= 0]
+        if not population:
+            base = 0.0
+        elif len(population) >= 3:
+            base = float(np.median(population))
+        elif len(population) == 2:
+            base = min(population)
+        else:
+            base = population[0]
+        thresholds[axis] = max(rtt_floor_ms, rtt_factor * base)
+    suspects: List[Dict[str, Any]] = []
+    for r in observed:
+        if r.error is not None:
+            suspects.append({"name": r.name, "device_ids": list(r.device_ids), "reason": "error", "rtt_ms": r.rtt_ms})
+        elif not r.correct:
+            suspects.append({"name": r.name, "device_ids": list(r.device_ids), "reason": "corrupt", "rtt_ms": r.rtt_ms})
+        elif r.rtt_ms > thresholds[r.axis]:
+            suspects.append({"name": r.name, "device_ids": list(r.device_ids), "reason": "slow", "rtt_ms": r.rtt_ms})
+
+    endpoint_counts: Dict[int, int] = {}
+    for s in suspects:
+        for d in s["device_ids"]:
+            endpoint_counts[d] = endpoint_counts.get(d, 0) + 1
+    suspect_devices = sorted(d for d, c in endpoint_counts.items() if c >= 2)
+    return suspects, suspect_devices
+
+
 def _timed_pair(fn, x, expected: float, iters: int, inner_iters: int) -> Tuple[float, float, bool]:
     """(min_per_hop_s, mean_per_hop_s, correct) over ``iters`` fenced calls.
 
@@ -281,42 +335,7 @@ def run_link_probe(
 
         valid = [r.rtt_ms for r in observed if r.rtt_ms >= 0]
         median = float(np.median(valid)) if valid else -1.0
-        # like-for-like thresholds: intra-host ("chips") and inter-host
-        # ("hosts") hops have different healthy baselines (the columns can
-        # be DCN-backed), so one mixed median would flag every healthy
-        # inter-host link on asymmetric fabrics — or mask a degraded
-        # intra-host link under the inflated threshold. Small populations
-        # need a different statistic: the median of 2 samples is dragged
-        # halfway toward an outlier (a 10x-degraded link would set its own
-        # threshold), so with <=2 samples the MIN anchors the healthy
-        # baseline; with one sample there is no reference and only the
-        # floor applies (corruption/error detection still covers it).
-        thresholds: Dict[str, float] = {}
-        for axis in {r.axis for r in observed}:
-            population = [r.rtt_ms for r in observed if r.axis == axis and r.rtt_ms >= 0]
-            if not population:
-                base = 0.0
-            elif len(population) >= 3:
-                base = float(np.median(population))
-            elif len(population) == 2:
-                base = min(population)
-            else:
-                base = population[0]
-            thresholds[axis] = max(rtt_floor_ms, rtt_factor * base)
-        suspects: List[Dict[str, Any]] = []
-        for r in observed:
-            if r.error is not None:
-                suspects.append({"name": r.name, "device_ids": list(r.device_ids), "reason": "error", "rtt_ms": r.rtt_ms})
-            elif not r.correct:
-                suspects.append({"name": r.name, "device_ids": list(r.device_ids), "reason": "corrupt", "rtt_ms": r.rtt_ms})
-            elif r.rtt_ms > thresholds[r.axis]:
-                suspects.append({"name": r.name, "device_ids": list(r.device_ids), "reason": "slow", "rtt_ms": r.rtt_ms})
-
-        endpoint_counts: Dict[int, int] = {}
-        for s in suspects:
-            for d in s["device_ids"]:
-                endpoint_counts[d] = endpoint_counts.get(d, 0) + 1
-        suspect_devices = sorted(d for d, c in endpoint_counts.items() if c >= 2)
+        suspects, suspect_devices = classify_links(observed, rtt_factor, rtt_floor_ms)
 
         if suspects:
             logger.warning(

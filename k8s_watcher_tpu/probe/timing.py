@@ -47,22 +47,33 @@ def fence_baseline_ms(device: Optional[jax.Device] = None, samples: int = 3) -> 
 
 
 class TimedStats(tuple):
-    """(min, mean, max) seconds — a plain 3-tuple for unpacking — plus an
-    ``unreliable`` attribute: True when the op's device time is buried in
-    fence noise, so derived TFLOP/s / GB/s must be discounted (the same
-    contract hbm.py's ``bandwidth_unreliable`` flag carries)."""
+    """(min, mean, max) seconds — a plain 3-tuple for unpacking — plus two
+    attributes: ``median`` (robust against the min-estimator's high bias on
+    derived rates: subtracting a median fence from the LUCKIEST sample
+    systematically over-subtracts, inflating TFLOP/s / GB/s) and
+    ``unreliable``, True when the op's device time is buried in fence
+    noise, so derived rates must be discounted (the same contract hbm.py's
+    ``bandwidth_unreliable`` flag carries)."""
 
+    median: float
     unreliable: bool
 
-    def __new__(cls, tmin: float, tmean: float, tmax: float, unreliable: bool = False):
+    def __new__(
+        cls, tmin: float, tmean: float, tmax: float,
+        unreliable: bool, median: float,
+    ):
+        # median is REQUIRED: a default that silently falls back to tmin
+        # would reintroduce the min-as-median bias this type exists to fix
         obj = super().__new__(cls, (tmin, tmean, tmax))
         obj.unreliable = unreliable
+        obj.median = median
         return obj
 
 
 def timed_fenced(fn, x, iters: int, baseline_ms: float = 0.0) -> TimedStats:
     """(min, mean, max) SECONDS over ``iters`` host-fenced executions, each
-    with the fence baseline subtracted (clamped at ~0).
+    with the fence baseline subtracted (clamped at ~0); ``.median`` carries
+    the median sample.
 
     The result's ``unreliable`` flag is set when the best sample's device
     share is under a quarter of the fence baseline: subtracting a noisy
@@ -78,4 +89,7 @@ def timed_fenced(fn, x, iters: int, baseline_ms: float = 0.0) -> TimedStats:
         raw_min = min(raw_min, raw)
         times.append(max(raw - baseline_ms / 1e3, 1e-9))
     unreliable = baseline_ms > 0 and (raw_min - baseline_ms / 1e3) < 0.25 * baseline_ms / 1e3
-    return TimedStats(min(times), sum(times) / len(times), max(times), unreliable)
+    median = sorted(times)[len(times) // 2]
+    return TimedStats(
+        min(times), sum(times) / len(times), max(times), unreliable, median
+    )

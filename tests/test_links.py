@@ -12,7 +12,13 @@ from k8s_watcher_tpu.config.schema import TpuConfig
 from k8s_watcher_tpu.faults.ici import IciFaultSpec
 from k8s_watcher_tpu.parallel.collectives import make_pair_probe, pair_probe_input
 from k8s_watcher_tpu.probe.ici import run_ici_probe
-from k8s_watcher_tpu.probe.links import LinkProbeResult, enumerate_links, run_link_probe
+from k8s_watcher_tpu.probe.links import (
+    LinkProbeResult,
+    LinkResult,
+    classify_links,
+    enumerate_links,
+    run_link_probe,
+)
 from k8s_watcher_tpu.probe.report import ProbeReport
 
 
@@ -104,6 +110,70 @@ class TestLinkProbe:
         monkeypatch.setattr(jax, "process_index", lambda: 1)
         r = run_link_probe(mesh, iters=2, inner_iters=4, rtt_floor_ms=FLOOR_MS)
         assert r.ok and r.error is None and r.n_links == 0
+
+
+def _link(name, rtt_ms, axis="chips", ids=(0, 1), correct=True, error=None):
+    return LinkResult(axis=axis, name=name, device_ids=ids, rtt_ms=rtt_ms,
+                      rtt_mean_ms=rtt_ms, correct=correct, error=error)
+
+
+class TestClassifySensitivity:
+    """Pin the per-link minimum detectable degradation exactly
+    (ARCHITECTURE.md "minimum detectable degradation"): the floor is
+    rtt_factor x per-axis median; corruption has no floor."""
+
+    def _ring(self, slow_factor, n=8, base_ms=0.05):
+        links = [_link(f"l{i}", base_ms, ids=(i, (i + 1) % n)) for i in range(n - 1)]
+        links.append(_link("slow", base_ms * slow_factor, ids=(n - 1, 0)))
+        return links
+
+    def test_2x_slowed_link_below_default_floor(self):
+        # deliberate: 2x is inside the default false-positive margin
+        suspects, devices = classify_links(self._ring(2.0), 3.0, 0.001)
+        assert suspects == [] and devices == []
+
+    def test_2x_slowed_link_flagged_at_tightened_factor(self):
+        # operators resolve 2x by setting tpu.probe.link_rtt_factor <= ~1.8
+        suspects, _ = classify_links(self._ring(2.0), 1.8, 0.001)
+        assert [s["name"] for s in suspects] == ["slow"]
+        assert suspects[0]["reason"] == "slow"
+
+    def test_just_above_default_factor_flagged(self):
+        suspects, _ = classify_links(self._ring(3.1), 3.0, 0.001)
+        assert [s["name"] for s in suspects] == ["slow"]
+
+    def test_just_below_default_factor_not_flagged(self):
+        suspects, _ = classify_links(self._ring(2.9), 3.0, 0.001)
+        assert suspects == []
+
+    def test_absolute_floor_suppresses_microsecond_jitter(self):
+        # 10x outlier, but everything under the absolute floor: healthy
+        suspects, _ = classify_links(self._ring(10.0, base_ms=0.001), 3.0, 0.05)
+        assert suspects == []
+
+    def test_corruption_has_no_floor(self):
+        links = self._ring(1.0)
+        links[3] = _link("l3", 0.05, ids=(3, 4), correct=False)
+        suspects, _ = classify_links(links, 3.0, 5.0)
+        assert [s["name"] for s in suspects] == ["l3"]
+        assert suspects[0]["reason"] == "corrupt"
+
+    def test_per_axis_thresholds_are_independent(self):
+        # inter-host links 20x slower than intra-host: healthy on an
+        # asymmetric (DCN-backed) fabric, and a mixed median would both
+        # flag the healthy "hosts" links and mask a 5x intra-host outlier
+        links = [_link(f"c{i}", 0.05, ids=(i, i + 1)) for i in range(4)]
+        links += [_link(f"h{i}", 1.0, axis="hosts", ids=(i, i + 4)) for i in range(4)]
+        links.append(_link("c-bad", 0.25, ids=(6, 7)))  # 5x intra median
+        suspects, _ = classify_links(links, 3.0, 0.001)
+        assert [s["name"] for s in suspects] == ["c-bad"]
+
+    def test_device_triangulation_needs_two_links(self):
+        links = self._ring(1.0)
+        links[0] = _link("l0", 1.0, ids=(0, 1))  # 20x: suspect
+        suspects, devices = classify_links(links, 3.0, 0.001)
+        assert [s["name"] for s in suspects] == ["l0"]
+        assert devices == []  # one bad link implicates the link, not a chip
 
 
 class TestAggregateProbeUnderFault:
