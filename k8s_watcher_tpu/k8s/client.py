@@ -224,6 +224,27 @@ class K8sClient:
             params["labelSelector"] = label_selector
         return self._get("/api/v1/nodes", params).json()
 
+    # -- write surface (integration/chaos tooling) -------------------------
+    # The watcher itself is read-only; these drive REAL create/delete churn
+    # through the watch->pipeline path in the acceptance write tier
+    # (tests/test_integration_cluster.py) without shelling out to kubectl —
+    # the same calls work against kind, GKE, and the in-repo mock apiserver.
+
+    def create_pod(self, namespace: str, pod: Dict[str, Any]) -> Dict[str, Any]:
+        """POST a Pod manifest; raises K8sConflictError if it exists."""
+        return self._request("POST", self._pods_path(namespace), json_body=pod).json()
+
+    def delete_pod(self, namespace: str, name: str) -> Dict[str, Any]:
+        """DELETE a pod (raises K8sNotFoundError if absent)."""
+        return self._request("DELETE", f"{self._pods_path(namespace)}/{name}").json()
+
+    def create_namespace(self, name: str) -> Dict[str, Any]:
+        body = {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": name}}
+        return self._request("POST", "/api/v1/namespaces", json_body=body).json()
+
+    def delete_namespace(self, name: str) -> Dict[str, Any]:
+        return self._request("DELETE", f"/api/v1/namespaces/{name}").json()
+
     def watch_pods(
         self,
         namespace: Optional[str] = None,

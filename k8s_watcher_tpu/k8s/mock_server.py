@@ -104,6 +104,59 @@ class MockCluster:
             return None
         return self._record("DELETED", pod)
 
+    # -- REST write surface (K8sClient.create_pod/delete_pod/...) ----------
+    # The test hooks above mutate state directly; these enforce the
+    # apiserver's status contract (201/409/404) so the acceptance write
+    # tier can drive REAL create/delete churn through HTTP on hosts
+    # without Docker/kind.
+
+    def create_pod(self, namespace: str, pod: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+        meta = pod.setdefault("metadata", {})
+        meta["namespace"] = namespace
+        name = meta.get("name", "")
+        if not name:
+            return 400, {"kind": "Status", "code": 400, "message": "pod has no name"}
+        pod.setdefault("status", {}).setdefault("phase", "Pending")
+        # uniqueness check + insert under ONE lock hold (the Condition's
+        # RLock is re-entrant, so the nested add_pod/_record acquisitions
+        # are fine) — a check-then-insert window would let two concurrent
+        # POSTs both 201 and journal a phantom duplicate ADDED
+        with self._lock:
+            if namespace not in self.namespaces:
+                # parity with the real apiserver: pods can't land in a
+                # namespace that doesn't exist (or was just deleted)
+                return 404, {"kind": "Status", "code": 404, "message": f"namespaces \"{namespace}\" not found"}
+            if (namespace, name) in self._pods:
+                return 409, {"kind": "Status", "code": 409, "message": f"pods \"{name}\" already exists"}
+            self.add_pod(pod)
+        return 201, json.loads(json.dumps(pod))
+
+    def remove_pod(self, namespace: str, name: str) -> Tuple[int, Dict[str, Any]]:
+        rv = self.delete_pod(namespace, name)
+        if rv is None:
+            return 404, {"kind": "Status", "code": 404, "message": f"pods \"{name}\" not found"}
+        return 200, {"kind": "Status", "code": 200, "status": "Success"}
+
+    def create_namespace(self, name: str) -> Tuple[int, Dict[str, Any]]:
+        with self._lock:
+            if name in self.namespaces:
+                return 409, {"kind": "Status", "code": 409, "message": f"namespaces \"{name}\" already exists"}
+            self.namespaces.append(name)
+        return 201, {"kind": "Namespace", "metadata": {"name": name}}
+
+    def delete_namespace(self, name: str) -> Tuple[int, Dict[str, Any]]:
+        with self._lock:
+            if name not in self.namespaces:
+                return 404, {"kind": "Status", "code": 404, "message": f"namespaces \"{name}\" not found"}
+            self.namespaces.remove(name)
+            # evict under the SAME lock hold (re-entrant): a create racing
+            # the delete must either land before the eviction sweep or be
+            # rejected by create_pod's namespace check — never orphaned.
+            # DELETED events flow to watchers, like the apiserver's cascade
+            for ns, pod_name in [key for key in self._pods if key[0] == name]:
+                self.delete_pod(ns, pod_name)
+        return 200, {"kind": "Status", "code": 200, "status": "Success"}
+
     def set_phase(self, namespace: str, name: str, phase: str) -> Optional[int]:
         with self._lock:
             pod = self._pods.get((namespace, name))
@@ -390,11 +443,40 @@ class _Handler(BaseHTTPRequestHandler):
         if fail:
             self._json(fail, {"kind": "Status", "code": fail, "message": "injected failure"})
             return
-        lease = _parse_lease_path(urlparse(self.path).path)
+        path = urlparse(self.path).path
+        lease = _parse_lease_path(path)
         if lease is not None and lease[1] is None:  # POST to the collection creates
             namespace = lease[0]
             name = (body.get("metadata") or {}).get("name", "")
             status, out = self.cluster.create_lease(namespace, name, body)
+            self._json(status, out)
+            return
+        if path == "/api/v1/namespaces":
+            status, out = self.cluster.create_namespace((body.get("metadata") or {}).get("name", ""))
+            self._json(status, out)
+            return
+        if path.startswith("/api/v1/namespaces/") and path.endswith("/pods"):
+            namespace = path[len("/api/v1/namespaces/"):-len("/pods")]
+            status, out = self.cluster.create_pod(namespace, body)
+            self._json(status, out)
+            return
+        self._json(404, {"kind": "Status", "code": 404, "message": f"no route {self.path}"})
+
+    def do_DELETE(self):  # noqa: N802 (stdlib naming)
+        fail = self.cluster.consume_failure()
+        if fail:
+            self._json(fail, {"kind": "Status", "code": fail, "message": "injected failure"})
+            return
+        path = urlparse(self.path).path
+        parts = path.strip("/").split("/")
+        # /api/v1/namespaces/{ns}/pods/{name}
+        if len(parts) == 6 and parts[:2] == ["api", "v1"] and parts[2] == "namespaces" and parts[4] == "pods":
+            status, out = self.cluster.remove_pod(parts[3], parts[5])
+            self._json(status, out)
+            return
+        # /api/v1/namespaces/{name}
+        if len(parts) == 4 and parts[:3] == ["api", "v1", "namespaces"]:
+            status, out = self.cluster.delete_namespace(parts[3])
             self._json(status, out)
             return
         self._json(404, {"kind": "Status", "code": 404, "message": f"no route {self.path}"})

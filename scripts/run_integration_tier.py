@@ -8,13 +8,15 @@ records the outcome under ``artifacts/``:
 
 - ``--backend kind`` (default when ``kind`` is on PATH): create a throwaway
   kind cluster from deploy/kind-config.yaml, run the tier INCLUDING the
-  write path (real pod create/delete via kubectl), tear the cluster down.
+  write path (real pod create/delete over REST through K8sClient — no
+  kubectl needed), tear the cluster down.
 - ``--backend mock``: serve the in-repo mock apiserver
   (k8s_watcher_tpu/k8s/mock_server.py) over HTTP, point a generated
-  kubeconfig at it, and run the read-only tier through the SAME gate.
-  This is NOT a substitute for the kind artifact — it proves the gated
-  test path works end-to-end on hosts without Docker (the artifact is
-  labelled with its backend).
+  kubeconfig at it, and run the FULL tier — including the write path
+  (real pod create/delete over REST through K8sClient) — through the
+  SAME gate. This is NOT a substitute for the kind artifact — it proves
+  the gated test path works end-to-end on hosts without Docker (the
+  artifact is labelled with its backend).
 
 Usage:
     python scripts/run_integration_tier.py [--backend kind|mock|auto]
@@ -98,9 +100,11 @@ def backend_kind() -> dict:
              "--kubeconfig", str(kubeconfig)],
             check=True, timeout=60,
         )
-        result = run_pytest(str(kubeconfig), write=shutil.which("kubectl") is not None)
+        # the write path drives create/delete through K8sClient itself —
+        # no kubectl needed on any backend
+        result = run_pytest(str(kubeconfig), write=True)
         result["backend"] = "kind"
-        result["write_tier"] = shutil.which("kubectl") is not None
+        result["write_tier"] = True
         return result
     finally:
         kubeconfig.unlink(missing_ok=True)
@@ -127,11 +131,11 @@ def backend_mock() -> dict:
         path = _mkstemp_path("mock-kubeconfig-")
         try:
             path.write_text(json.dumps(kubeconfig))
-            result = run_pytest(str(path), write=False)
+            result = run_pytest(str(path), write=True)
         finally:
             path.unlink(missing_ok=True)
         result["backend"] = "mock"
-        result["write_tier"] = False
+        result["write_tier"] = True
         return result
 
 

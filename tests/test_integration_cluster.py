@@ -16,9 +16,7 @@ Run:
         tests/test_integration_cluster.py -v
 """
 
-import json
 import os
-import subprocess
 import threading
 import time
 import uuid
@@ -72,23 +70,17 @@ class TestClusterConnectivity:
 
 @pytest.mark.skipif(not WRITE, reason="set WATCHER_INTEGRATION_WRITE=1 to exercise pod create/delete")
 class TestRealPodLifecycle:
-    """Full watch→pipeline cycle against real pod churn (needs kubectl)."""
+    """Full watch→pipeline cycle against real pod churn, driven through the
+    framework's own write surface (K8sClient.create_pod/delete_pod) — no
+    kubectl dependency, so the same tier runs against kind, GKE, and the
+    in-repo mock apiserver."""
 
     @pytest.fixture()
-    def namespace(self):
+    def namespace(self, client):
         ns = f"watcher-it-{uuid.uuid4().hex[:8]}"
-        self._kubectl("create", "namespace", ns)
+        client.create_namespace(ns)
         yield ns
-        self._kubectl("delete", "namespace", ns, "--wait=false")
-
-    @staticmethod
-    def _kubectl(*args) -> str:
-        out = subprocess.run(
-            ["kubectl", "--kubeconfig", KUBECONFIG, *args],
-            capture_output=True, text=True, timeout=60,
-        )
-        assert out.returncode == 0, out.stderr
-        return out.stdout
+        client.delete_namespace(ns)
 
     def test_pipeline_sees_real_pod_cycle(self, client, namespace):
         notifications = []
@@ -115,7 +107,7 @@ class TestRealPodLifecycle:
         t.start()
         time.sleep(1.0)
 
-        pod = {
+        client.create_pod(namespace, {
             "apiVersion": "v1",
             "kind": "Pod",
             "metadata": {"name": "it-pod", "namespace": namespace},
@@ -130,12 +122,7 @@ class TestRealPodLifecycle:
                 ],
                 "restartPolicy": "Never",
             },
-        }
-        proc = subprocess.run(
-            ["kubectl", "--kubeconfig", KUBECONFIG, "apply", "-f", "-"],
-            input=json.dumps(pod), capture_output=True, text=True, timeout=60,
-        )
-        assert proc.returncode == 0, proc.stderr
+        })
 
         deadline = time.monotonic() + 60
         while time.monotonic() < deadline:
@@ -143,7 +130,7 @@ class TestRealPodLifecycle:
                 if any(n.payload.get("name") == "it-pod" for n in notifications):
                     break
             time.sleep(0.5)
-        self._kubectl("delete", "pod", "it-pod", "-n", namespace, "--wait=false")
+        client.delete_pod(namespace, "it-pod")
         deadline = time.monotonic() + 60
         while time.monotonic() < deadline:
             with lock:

@@ -8,7 +8,13 @@ import time
 import pytest
 
 from k8s_watcher_tpu.config.schema import RetryPolicy
-from k8s_watcher_tpu.k8s.client import K8sApiError, K8sClient, K8sGoneError
+from k8s_watcher_tpu.k8s.client import (
+    K8sApiError,
+    K8sClient,
+    K8sConflictError,
+    K8sGoneError,
+    K8sNotFoundError,
+)
 from k8s_watcher_tpu.k8s.kubeconfig import (
     K8sConnection,
     KubeconfigError,
@@ -336,6 +342,47 @@ class TestK8sClient:
         mock_api.cluster.delete_pod("default", "w0")
         t.join(timeout=5)
         assert [e["type"] for e in got] == ["ADDED", "MODIFIED", "DELETED"]
+
+    def test_write_surface_pod_lifecycle(self, mock_api):
+        # the integration write tier's primitives: create/delete over REST
+        # with the apiserver status contract (201/409/404), events flowing
+        # to watchers like any other churn
+        client = make_client(mock_api)
+        client.create_namespace("it-ns")
+        assert "it-ns" in client.list_namespaces()
+        with pytest.raises(K8sConflictError):
+            client.create_namespace("it-ns")
+
+        pod = build_pod("w0", "it-ns")
+        created = client.create_pod("it-ns", pod)
+        assert created["metadata"]["name"] == "w0"
+        assert created["status"]["phase"] == "Pending"
+        with pytest.raises(K8sConflictError):
+            client.create_pod("it-ns", build_pod("w0", "it-ns"))
+        assert len(client.list_pods("it-ns")["items"]) == 1
+
+        client.delete_pod("it-ns", "w0")
+        assert client.list_pods("it-ns")["items"] == []
+        with pytest.raises(K8sNotFoundError):
+            client.delete_pod("it-ns", "w0")
+
+        client.delete_namespace("it-ns")
+        assert "it-ns" not in client.list_namespaces()
+        with pytest.raises(K8sNotFoundError):
+            client.delete_namespace("it-ns")
+
+    def test_namespace_deletion_evicts_pods_with_events(self, mock_api):
+        client = make_client(mock_api)
+        client.create_namespace("doomed")
+        client.create_pod("doomed", build_pod("p0", "doomed"))
+        rv = client.list_pods()["metadata"]["resourceVersion"]
+        client.delete_namespace("doomed")
+        events = []
+        for raw in client.watch_pods(resource_version=rv, timeout_seconds=2):
+            events.append(raw)
+            break
+        assert events and events[0]["type"] == "DELETED"
+        assert events[0]["object"]["metadata"]["name"] == "p0"
 
     def test_watch_410_raises_gone(self, mock_api):
         mock_api.cluster.add_pod(build_pod("w0"))
