@@ -369,6 +369,16 @@ class TpuConfig:
     # absolute outlier floor per hop — raise on fabrics whose healthy RTT
     # jitter exceeds the default (e.g. DCN-backed inter-host columns)
     probe_link_rtt_floor_ms: float = 0.05
+    # cross-cycle drift detection (probe/trend.py): flags sustained decay
+    # hiding inside the per-cycle noise band. Factors are deliberately far
+    # outside the documented noise (ARCHITECTURE.md) to avoid false alerts
+    # on tunneled dev links; tighten on local deployments.
+    probe_trend_enabled: bool = True
+    probe_trend_window: int = 16
+    probe_trend_recent: int = 3
+    probe_trend_drop_factor: float = 0.75
+    probe_trend_rise_factor: float = 2.5
+    probe_trend_min_history: int = 6
     # cross-slice DCN aggregation probe (probe/multislice.py)
     probe_multislice_enabled: bool = False
     probe_multislice_slices: int = 0  # 0 = infer from Device.slice_index
@@ -409,9 +419,41 @@ class TpuConfig:
             ("enabled", "interval_seconds", "payload_bytes", "rtt_warn_ms", "matmul_size",
              "hbm_bytes", "hbm_write_enabled", "expected_chips_per_host", "links_enabled",
              "link_rtt_factor", "link_rtt_floor_ms", "multislice_enabled",
-             "multislice_slices", "profile_dir"),
+             "multislice_slices", "profile_dir", "trend_enabled", "trend_window",
+             "trend_recent", "trend_drop_factor", "trend_rise_factor",
+             "trend_min_history"),
             "tpu.probe",
         )
+        # trend knobs have relational constraints; reject them HERE with the
+        # key path (the repo's SchemaError convention) instead of letting
+        # TrendTracker's bare ValueError crash the watcher at agent startup
+        trend_window = _opt_int(probe, "trend_window", "tpu.probe", 16)
+        trend_recent = _opt_int(probe, "trend_recent", "tpu.probe", 3)
+        trend_min_history = _opt_int(probe, "trend_min_history", "tpu.probe", 6)
+        trend_drop = _opt_num(probe, "trend_drop_factor", "tpu.probe", 0.75)
+        trend_rise = _opt_num(probe, "trend_rise_factor", "tpu.probe", 2.5)
+        if not 0.0 < trend_drop < 1.0:
+            raise SchemaError(
+                f"config key 'tpu.probe.trend_drop_factor': must be in (0, 1) — a "
+                f"factor >= 1 alerts on every healthy cycle — got {trend_drop}"
+            )
+        if trend_rise <= 1.0:
+            raise SchemaError(
+                f"config key 'tpu.probe.trend_rise_factor': must be > 1 — a "
+                f"factor <= 1 alerts on every healthy cycle — got {trend_rise}"
+            )
+        if not 1 <= trend_recent < trend_window:
+            raise SchemaError(
+                f"config key 'tpu.probe.trend_recent': need trend_window > "
+                f"trend_recent >= 1, got recent={trend_recent} window={trend_window}"
+            )
+        if not trend_recent + 1 <= trend_min_history <= trend_window:
+            raise SchemaError(
+                f"config key 'tpu.probe.trend_min_history': need trend_recent+1 <= "
+                f"trend_min_history <= trend_window (the anchor freezes at window "
+                f"samples), got min_history={trend_min_history} recent={trend_recent} "
+                f"window={trend_window}"
+            )
         return cls(
             backend=backend,
             resource_key=_opt_str(raw, "resource_key", "tpu", default_key),
@@ -429,6 +471,12 @@ class TpuConfig:
             probe_links_enabled=_opt_bool(probe, "links_enabled", "tpu.probe", False),
             probe_link_rtt_factor=_opt_num(probe, "link_rtt_factor", "tpu.probe", 3.0),
             probe_link_rtt_floor_ms=_opt_num(probe, "link_rtt_floor_ms", "tpu.probe", 0.05),
+            probe_trend_enabled=_opt_bool(probe, "trend_enabled", "tpu.probe", True),
+            probe_trend_window=trend_window,
+            probe_trend_recent=trend_recent,
+            probe_trend_drop_factor=trend_drop,
+            probe_trend_rise_factor=trend_rise,
+            probe_trend_min_history=trend_min_history,
             probe_multislice_enabled=_opt_bool(probe, "multislice_enabled", "tpu.probe", False),
             probe_multislice_slices=_opt_int(probe, "multislice_slices", "tpu.probe", 0),
             probe_profile_dir=_opt_str(probe, "profile_dir", "tpu.probe", None),

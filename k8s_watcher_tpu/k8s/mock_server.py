@@ -117,10 +117,12 @@ class MockCluster:
         if not name:
             return 400, {"kind": "Status", "code": 400, "message": "pod has no name"}
         pod.setdefault("status", {}).setdefault("phase", "Pending")
-        # uniqueness check + insert under ONE lock hold (the Condition's
-        # RLock is re-entrant, so the nested add_pod/_record acquisitions
-        # are fine) — a check-then-insert window would let two concurrent
-        # POSTs both 201 and journal a phantom duplicate ADDED
+        # uniqueness check + insert + response snapshot under ONE lock hold
+        # (the Condition's RLock is re-entrant, so the nested add_pod/_record
+        # acquisitions are fine) — a check-then-insert window would let two
+        # concurrent POSTs both 201 and journal a phantom duplicate ADDED,
+        # and serializing the live stored dict outside the lock would race a
+        # concurrent set_phase/modify_pod mutating it mid-iteration
         with self._lock:
             if namespace not in self.namespaces:
                 # parity with the real apiserver: pods can't land in a
@@ -129,7 +131,7 @@ class MockCluster:
             if (namespace, name) in self._pods:
                 return 409, {"kind": "Status", "code": 409, "message": f"pods \"{name}\" already exists"}
             self.add_pod(pod)
-        return 201, json.loads(json.dumps(pod))
+            return 201, json.loads(json.dumps(pod))
 
     def remove_pod(self, namespace: str, name: str) -> Tuple[int, Dict[str, Any]]:
         rv = self.delete_pod(namespace, name)

@@ -1,4 +1,4 @@
 """Observability: latency histograms + counters (SURVEY.md §5 — ABSENT in
 the reference; the north-star metric is event→notify p50 latency)."""
 
-from k8s_watcher_tpu.metrics.metrics import Histogram, Counter, MetricsRegistry  # noqa: F401
+from k8s_watcher_tpu.metrics.metrics import Counter, Gauge, Histogram, MetricsRegistry  # noqa: F401

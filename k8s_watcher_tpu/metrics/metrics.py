@@ -57,6 +57,39 @@ class Counter:
             return float(sum(1 for t in self._window if t > now - 60.0))
 
 
+class Gauge:
+    """A point-in-time reading (probe medians, queue depths): last value
+    wins, unlike a Counter's monotonic accumulation."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0.0
+        self._set = False
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+            self._set = True
+
+    def clear(self) -> None:
+        """Withdraw the reading: a gauge whose source started erroring must
+        disappear from scrapes, not freeze at its last healthy value."""
+        with self._lock:
+            self._value = 0.0
+            self._set = False
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    @property
+    def has_value(self) -> bool:
+        with self._lock:
+            return self._set
+
+
 class Histogram:
     """Log-bucketed latency histogram (seconds)."""
 
@@ -133,6 +166,7 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._counters: Dict[str, Counter] = {}
         self._histograms: Dict[str, Histogram] = {}
+        self._gauges: Dict[str, Gauge] = {}
 
     def counter(self, name: str) -> Counter:
         with self._lock:
@@ -146,6 +180,12 @@ class MetricsRegistry:
                 self._histograms[name] = Histogram(name)
             return self._histograms[name]
 
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            if name not in self._gauges:
+                self._gauges[name] = Gauge(name)
+            return self._gauges[name]
+
     def prometheus_text(self, prefix: str = "k8s_watcher_") -> str:
         """Prometheus text exposition format (v0.0.4) — what real scrapers
         consume; the JSON dump stays the human/driver-facing shape.
@@ -157,11 +197,18 @@ class MetricsRegistry:
         with self._lock:
             counters = dict(self._counters)
             histograms = dict(self._histograms)
+            gauges = dict(self._gauges)
         lines = []
         for name, c in sorted(counters.items()):
             metric = f"{prefix}{name}"
             lines.append(f"# TYPE {metric}_total counter")
             lines.append(f"{metric}_total {c.value}")
+        for name, g in sorted(gauges.items()):
+            if not g.has_value:
+                continue  # never-set gauges would scrape as a misleading 0
+            metric = f"{prefix}{name}"
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f"{metric} {g.value:g}")
         for name, h in sorted(histograms.items()):
             metric = f"{prefix}{name}_seconds"
             buckets, total, total_sum = h.buckets()
@@ -186,9 +233,13 @@ class MetricsRegistry:
         with self._lock:
             counters = dict(self._counters)
             histograms = dict(self._histograms)
+            gauges = dict(self._gauges)
         out: Dict[str, Dict] = {}
         for name, c in counters.items():
             out[name] = {"count": c.value, "per_minute": c.rate_per_minute()}
         for name, h in histograms.items():
             out[name] = h.summary()
+        for name, g in gauges.items():
+            if g.has_value:
+                out[name] = {"value": g.value}
         return out

@@ -24,6 +24,7 @@ from k8s_watcher_tpu.pipeline.pipeline import Notification
 from k8s_watcher_tpu.probe.device import enumerate_devices
 from k8s_watcher_tpu.probe.ici import run_ici_probe, run_mxu_probe
 from k8s_watcher_tpu.probe.report import ProbeReport
+from k8s_watcher_tpu.probe.trend import TrendTracker
 
 logger = logging.getLogger(__name__)
 
@@ -50,6 +51,15 @@ class ProbeAgent:
         self.expected_platform = tpu_config.backend if expected_platform == "auto" else expected_platform
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self.trend: Optional[TrendTracker] = None
+        if tpu_config.probe_trend_enabled:
+            self.trend = TrendTracker(
+                window=tpu_config.probe_trend_window,
+                recent=tpu_config.probe_trend_recent,
+                drop_factor=tpu_config.probe_trend_drop_factor,
+                rise_factor=tpu_config.probe_trend_rise_factor,
+                min_history=tpu_config.probe_trend_min_history,
+            )
 
     # traces retained under profile_dir; each probe cycle writes one run
     # dir, so without a cap a 30s-interval agent writes ~2880/day and
@@ -117,6 +127,7 @@ class ProbeAgent:
             hbm = run_hbm_probe(self.config.probe_hbm_bytes)
             if self.config.probe_hbm_write_enabled:
                 hbm_write = run_hbm_write_probe(self.config.probe_hbm_bytes)
+        trend_alerts = self._fold_trends(ici, mxu, hbm, hbm_write, links)
         report = ProbeReport(
             environment=self.environment,
             devices=devices,
@@ -126,6 +137,7 @@ class ProbeAgent:
             hbm_write=hbm_write,
             links=links,
             multislice=multislice,
+            trend_alerts=trend_alerts,
             rtt_warn_ms=self.config.probe_rtt_warn_ms,
             duration_ms=1e3 * (time.monotonic() - t0),
         )
@@ -135,6 +147,59 @@ class ProbeAgent:
         if not report.healthy:
             self.metrics.counter("probe_unhealthy").inc()
         return report
+
+    # (reading, gauge name, higher_is_better) per sub-probe — the gauges
+    # make per-cycle readings scrapeable and the trend tracker turns their
+    # sustained drift into alerts. Median-based readings only: the noise
+    # analysis the trend factors are calibrated for assumes them. A reading
+    # of None means the sub-probe errored or doesn't apply THIS cycle: its
+    # gauge is cleared (a frozen last-healthy value would show dashboards a
+    # healthy chip while it is dead) and no trend sample is folded.
+    def _fold_trends(self, ici, mxu, hbm, hbm_write, links) -> list:
+        # gate on the SAME ok fields ProbeReport.healthy uses — an
+        # integrity-failed or non-finite probe has no 'error' string but its
+        # readings describe a broken chip and must neither stay on a gauge
+        # nor shape the trend anchor
+        ici_ok = ici is not None and ici.error is None and ici.ok
+        mxu_ok = mxu is not None and mxu.get("ok", False)
+        # interpreter-mode (non-TPU) bandwidth numbers are meaningless
+        hbm_ok = hbm is not None and hbm.get("ok", False) and not hbm.get("interpreted")
+        hbm_w_ok = hbm_write is not None and hbm_write.get("ok", False) and not hbm_write.get("interpreted")
+        # links: an errored walk withdraws the gauges, but a walk that FOUND
+        # suspects is a valid reading — probe_link_suspects > 0 is exactly
+        # what operators scrape for, so links.ok is deliberately not gated on
+        links_ok = links is not None and links.error is None and links.n_links > 0
+        readings = [
+            ("psum_rtt_median_ms", ici.psum_rtt_median_ms if ici_ok else None, False),
+            ("allreduce_bus_gbps_median", ici.bandwidth_gbps_median if ici_ok else None, True),
+            ("mxu_tflops_median", mxu.get("tflops_median", 0.0) if mxu_ok else None, True),
+            ("hbm_read_gbps", hbm.get("read_gbps", 0.0) if hbm_ok else None, True),
+            ("hbm_write_gbps", hbm_write.get("write_gbps", 0.0) if hbm_w_ok else None, True),
+            ("link_median_rtt_ms", links.median_rtt_ms if links_ok else None, False),
+        ]
+        if links_ok:
+            self.metrics.gauge("probe_link_suspects").set(len(links.suspect_links))
+        elif links is not None:
+            self.metrics.gauge("probe_link_suspects").clear()
+        alerts = []
+        for name, value, higher_is_better in readings:
+            gauge = self.metrics.gauge(f"probe_{name}")
+            if value is not None and value > 0:
+                gauge.set(value)
+            else:
+                gauge.clear()
+                continue
+            if self.trend is not None:
+                alert = self.trend.observe(name, value, higher_is_better=higher_is_better)
+                if alert is not None:
+                    logger.warning(
+                        "Probe trend alert: %s %s to %.4g (baseline %.4g, ratio %.2f)",
+                        alert.metric, alert.direction, alert.recent, alert.baseline, alert.ratio,
+                    )
+                    alerts.append(alert)
+        if alerts:
+            self.metrics.counter("probe_trend_alerts").inc(len(alerts))
+        return alerts
 
     def _report(self, report: ProbeReport) -> None:
         # Process 0 reports for the slice; every OTHER process stays quiet

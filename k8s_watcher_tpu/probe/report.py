@@ -6,7 +6,7 @@ from __future__ import annotations
 import dataclasses
 import time
 from datetime import datetime, timezone
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from k8s_watcher_tpu.probe.ici import IciProbeResult
 
@@ -21,6 +21,10 @@ class ProbeReport:
     hbm_write: Optional[Dict[str, Any]] = None  # write-bw + block integrity
     links: Optional[Any] = None  # probe.links.LinkProbeResult
     multislice: Optional[Any] = None  # probe.multislice.MultiSliceProbeResult
+    # sustained cross-cycle drift alerts (probe.trend.TrendAlert list):
+    # every individual cycle may have passed its own checks, but a slide
+    # beyond the trend factors is an actionable degradation signal
+    trend_alerts: List[Any] = dataclasses.field(default_factory=list)
     rtt_warn_ms: float = 50.0
     duration_ms: float = 0.0
 
@@ -46,6 +50,8 @@ class ProbeReport:
             return False
         if self.multislice is not None and not self.multislice.ok:
             return False
+        if self.trend_alerts:
+            return False
         return True
 
     def to_payload(self) -> Dict[str, Any]:
@@ -62,6 +68,7 @@ class ProbeReport:
             "hbm_write": self.hbm_write,
             "links": self.links.to_dict() if self.links is not None else None,
             "multislice": self.multislice.to_dict() if self.multislice is not None else None,
+            "trend_alerts": [a.to_dict() for a in self.trend_alerts],
             "duration_ms": self.duration_ms,
             "event_timestamp": datetime.now(timezone.utc).isoformat(),
         }

@@ -1,0 +1,232 @@
+"""Cross-cycle trend detection (probe/trend.py) + its agent wiring: the
+capability that catches slow decay hiding inside the per-cycle noise band
+(ARCHITECTURE.md "minimum detectable degradation")."""
+
+import pytest
+
+from k8s_watcher_tpu.config.schema import TpuConfig
+from k8s_watcher_tpu.probe.agent import ProbeAgent
+from k8s_watcher_tpu.probe.trend import TrendAlert, TrendTracker
+
+
+def make_tracker(**kw):
+    defaults = dict(window=8, recent=3, drop_factor=0.8, rise_factor=1.5, min_history=5)
+    defaults.update(kw)
+    return TrendTracker(**defaults)
+
+
+class TestTrendTracker:
+    def test_no_verdict_below_min_history(self):
+        t = make_tracker()
+        for _ in range(3):
+            assert t.observe("tflops", 100.0, higher_is_better=True) is None
+
+    def test_sustained_drop_alerts(self):
+        t = make_tracker()
+        for _ in range(5):
+            assert t.observe("tflops", 100.0, higher_is_better=True) is None
+        # one bad cycle: recent median (of 3) still anchored by good ones
+        assert t.observe("tflops", 70.0, higher_is_better=True) is None
+        # second consecutive bad cycle: recent median 70 < 0.8 * 100
+        alert = t.observe("tflops", 70.0, higher_is_better=True)
+        assert isinstance(alert, TrendAlert)
+        assert alert.direction == "drop"
+        assert alert.baseline == pytest.approx(100.0)
+        assert alert.recent == pytest.approx(70.0)
+        assert alert.ratio == pytest.approx(0.7)
+
+    def test_latency_rise_alerts(self):
+        t = make_tracker()
+        for _ in range(5):
+            t.observe("rtt", 1.0, higher_is_better=False)
+        assert t.observe("rtt", 2.0, higher_is_better=False) is None
+        alert = t.observe("rtt", 2.0, higher_is_better=False)
+        assert alert is not None and alert.direction == "rise"
+        # the drop factor must not fire for latency metrics (lower = better)
+        t2 = make_tracker()
+        for _ in range(6):
+            assert t2.observe("rtt", 1.0, higher_is_better=False) is None
+        assert t2.observe("rtt", 0.3, higher_is_better=False) is None  # got FASTER
+
+    def test_within_band_is_quiet(self):
+        t = make_tracker()
+        for v in (100, 95, 105, 90, 110, 96, 104, 93, 101):
+            assert t.observe("tflops", float(v), higher_is_better=True) is None
+
+    def test_single_spike_cannot_alert_or_poison_baseline(self):
+        t = make_tracker()
+        for _ in range(5):
+            t.observe("tflops", 100.0, higher_is_better=True)
+        # a lone dead-cycle reading: the 3-sample recent median ignores it
+        assert t.observe("tflops", 10.0, higher_is_better=True) is None
+        # recovery: the spike ages into the baseline window where the
+        # median ignores it
+        for _ in range(4):
+            assert t.observe("tflops", 100.0, higher_is_better=True) is None
+
+    def test_frozen_anchor_keeps_alerting_on_sustained_degradation(self):
+        # the anchor does NOT roll: a degraded part keeps alerting until
+        # fixed/drained/agent restart — a rolling baseline would absorb the
+        # new level and go quiet while the chip is still degraded
+        t = make_tracker(window=7)
+        for _ in range(7):
+            t.observe("tflops", 100.0, higher_is_better=True)  # anchor frozen at 100
+        for i in range(20):
+            alert = t.observe("tflops", 70.0, higher_is_better=True)
+            if i >= 2:  # once the recent median is all-70
+                assert alert is not None, f"cycle {i} went quiet"
+                assert alert.baseline == pytest.approx(100.0)
+
+    def test_degradation_during_forming_cannot_poison_the_anchor(self):
+        # degradation starting mid-forming: alerting samples are excluded
+        # from the buffer, so the anchor never freezes around the degraded
+        # level and alerts keep firing (a naive freeze at window samples
+        # would blend 100s and 70s into an anchor the 70s sit above)
+        t = make_tracker(window=6, min_history=5)
+        for _ in range(4):
+            t.observe("tflops", 100.0, higher_is_better=True)
+        fired = 0
+        for _ in range(30):  # way past the would-be freeze point
+            if t.observe("tflops", 70.0, higher_is_better=True) is not None:
+                fired += 1
+        assert fired >= 28, f"alerts stopped ({fired}/30) — anchor was poisoned"
+        assert t.snapshot()["tflops"]["anchor"] is None, "froze around degraded data"
+
+    def test_slow_decay_eventually_alerts(self):
+        # the motivating case: a few-% slide per cycle hides inside every
+        # individual cycle's noise band, but against the frozen anchor the
+        # cumulative drift must cross the factor and alert
+        t = make_tracker(window=6, min_history=5)
+        value, fired = 100.0, False
+        for _ in range(60):
+            if t.observe("tflops", value, higher_is_better=True) is not None:
+                fired = True
+                break
+            value *= 0.97  # 3% decay per cycle: never alertable cycle-on-cycle
+        assert fired, "slow decay never crossed the frozen anchor's factor"
+        assert value < 85.0, "fired before the cumulative drift was real"
+
+    def test_non_positive_readings_ignored(self):
+        t = make_tracker()
+        for _ in range(6):
+            t.observe("gbps", 100.0, higher_is_better=True)
+        assert t.observe("gbps", -1.0, higher_is_better=True) is None
+        assert t.observe("gbps", 0.0, higher_is_better=True) is None
+        # and they must not have entered the series
+        assert all(v == 100.0 for v in t.snapshot()["gbps"]["recent"])
+
+    def test_invalid_parameters_rejected(self):
+        with pytest.raises(ValueError):
+            TrendTracker(window=3, recent=3)
+        with pytest.raises(ValueError):
+            TrendTracker(recent=3, min_history=3)
+        # min_history > window would never accumulate enough samples (the
+        # forming buffer freezes at window): detection silently dead
+        with pytest.raises(ValueError):
+            TrendTracker(window=4, recent=2, min_history=6)
+
+
+class TestAgentTrendWiring:
+    def make_agent(self, monkeypatch, readings):
+        """Agent whose MXU probe replays ``readings`` cycle by cycle."""
+        import k8s_watcher_tpu.probe.agent as agent_mod
+
+        it = iter(readings)
+
+        def fake_mxu(size, **kw):
+            v = next(it)
+            return {"ok": True, "finite": True, "tflops": v, "tflops_median": v}
+
+        monkeypatch.setattr(agent_mod, "run_mxu_probe", fake_mxu)
+        config = TpuConfig(
+            probe_enabled=True, probe_payload_bytes=1 << 14, probe_matmul_size=64,
+            probe_rtt_warn_ms=10_000.0, probe_hbm_bytes=0,
+            probe_trend_window=8, probe_trend_recent=3,
+            probe_trend_drop_factor=0.8, probe_trend_min_history=5,
+        )
+        return ProbeAgent(config, environment="development",
+                          sink=lambda n: None, expected_platform="cpu")
+
+    def test_sustained_mxu_drop_flips_report_unhealthy(self, monkeypatch):
+        agent = self.make_agent(monkeypatch, [100.0] * 5 + [60.0, 60.0])
+        for _ in range(6):
+            assert agent.run_once().healthy
+        report = agent.run_once()
+        assert not report.healthy
+        payload = report.to_payload()
+        assert payload["trend_alerts"], "alert must ship in the payload"
+        alert = payload["trend_alerts"][0]
+        assert alert["metric"] == "mxu_tflops_median"
+        assert alert["direction"] == "drop"
+        assert agent.metrics.counter("probe_trend_alerts").value == 1
+
+    def test_gauges_track_latest_cycle(self, monkeypatch):
+        agent = self.make_agent(monkeypatch, [100.0, 90.0])
+        agent.run_once()
+        agent.run_once()
+        assert agent.metrics.gauge("probe_mxu_tflops_median").value == 90.0
+        text = agent.metrics.prometheus_text()
+        assert "k8s_watcher_probe_mxu_tflops_median 90" in text
+
+    def test_errored_probe_clears_its_gauge(self, monkeypatch):
+        # a gauge frozen at its last healthy value would show dashboards a
+        # healthy chip while it is dead — erroring must withdraw it
+        import k8s_watcher_tpu.probe.agent as agent_mod
+
+        results = iter([
+            {"ok": True, "finite": True, "tflops": 90.0, "tflops_median": 90.0},
+            {"ok": False, "error": "device lost"},
+        ])
+        monkeypatch.setattr(agent_mod, "run_mxu_probe", lambda size, **kw: next(results))
+        config = TpuConfig(probe_enabled=True, probe_hbm_bytes=0,
+                           probe_payload_bytes=1 << 14, probe_matmul_size=64,
+                           probe_rtt_warn_ms=10_000.0)
+        agent = ProbeAgent(config, environment="development",
+                           sink=lambda n: None, expected_platform="cpu")
+        agent.run_once()
+        gauge = agent.metrics.gauge("probe_mxu_tflops_median")
+        assert gauge.has_value and gauge.value == 90.0
+        assert "probe_mxu_tflops_median 90" in agent.metrics.prometheus_text()
+        agent.run_once()
+        assert not gauge.has_value
+        assert "probe_mxu_tflops_median" not in agent.metrics.prometheus_text()
+
+    def test_trend_disabled_never_alerts(self, monkeypatch):
+        import k8s_watcher_tpu.probe.agent as agent_mod
+
+        def fake_mxu(size, **kw):
+            return {"ok": True, "finite": True, "tflops": 1.0, "tflops_median": 1.0}
+
+        monkeypatch.setattr(agent_mod, "run_mxu_probe", fake_mxu)
+        config = TpuConfig(probe_enabled=True, probe_hbm_bytes=0,
+                           probe_payload_bytes=1 << 14, probe_matmul_size=64,
+                           probe_rtt_warn_ms=10_000.0, probe_trend_enabled=False)
+        agent = ProbeAgent(config, environment="development",
+                           sink=lambda n: None, expected_platform="cpu")
+        assert agent.trend is None
+        assert agent.run_once().healthy
+
+
+def test_config_trend_keys():
+    cfg = TpuConfig.from_raw({"probe": {"trend_enabled": True, "trend_window": 32,
+                                        "trend_drop_factor": 0.9}})
+    assert cfg.probe_trend_window == 32
+    assert cfg.probe_trend_drop_factor == 0.9
+    assert TpuConfig.from_raw({}).probe_trend_enabled is True
+
+
+def test_config_trend_constraints_rejected_at_load():
+    # mis-ranged knobs must die at config load with the key path, not
+    # crash agent startup (or alert on every healthy cycle forever)
+    from k8s_watcher_tpu.config.schema import SchemaError
+
+    cases = [
+        {"trend_drop_factor": 1.25},  # typo for 0.75: every cycle alerts
+        {"trend_rise_factor": 0.9},
+        {"trend_window": 4},  # < default min_history 6: detection silently dead
+        {"trend_recent": 16},  # == default window
+        {"trend_min_history": 2},  # < recent+1
+    ]
+    for probe in cases:
+        with pytest.raises(SchemaError, match="tpu.probe.trend"):
+            TpuConfig.from_raw({"probe": probe})
