@@ -89,6 +89,13 @@ class Gauge:
         with self._lock:
             return self._set
 
+    def read(self) -> Optional[float]:
+        """Value-or-None in ONE lock hold — exporters must use this, not
+        has_value-then-value (a clear() between the two reads would scrape
+        a bogus 0.0, the exact misleading zero has_value exists to stop)."""
+        with self._lock:
+            return self._value if self._set else None
+
 
 class Histogram:
     """Log-bucketed latency histogram (seconds)."""
@@ -204,11 +211,12 @@ class MetricsRegistry:
             lines.append(f"# TYPE {metric}_total counter")
             lines.append(f"{metric}_total {c.value}")
         for name, g in sorted(gauges.items()):
-            if not g.has_value:
-                continue  # never-set gauges would scrape as a misleading 0
+            reading = g.read()
+            if reading is None:
+                continue  # never-set/cleared gauges would scrape as a misleading 0
             metric = f"{prefix}{name}"
             lines.append(f"# TYPE {metric} gauge")
-            lines.append(f"{metric} {g.value:g}")
+            lines.append(f"{metric} {reading:g}")
         for name, h in sorted(histograms.items()):
             metric = f"{prefix}{name}_seconds"
             buckets, total, total_sum = h.buckets()
@@ -240,6 +248,7 @@ class MetricsRegistry:
         for name, h in histograms.items():
             out[name] = h.summary()
         for name, g in gauges.items():
-            if g.has_value:
-                out[name] = {"value": g.value}
+            reading = g.read()
+            if reading is not None:
+                out[name] = {"value": reading}
         return out

@@ -127,7 +127,6 @@ class ProbeAgent:
             hbm = run_hbm_probe(self.config.probe_hbm_bytes)
             if self.config.probe_hbm_write_enabled:
                 hbm_write = run_hbm_write_probe(self.config.probe_hbm_bytes)
-        trend_alerts = self._fold_trends(ici, mxu, hbm, hbm_write, links)
         report = ProbeReport(
             environment=self.environment,
             devices=devices,
@@ -137,9 +136,16 @@ class ProbeAgent:
             hbm_write=hbm_write,
             links=links,
             multislice=multislice,
-            trend_alerts=trend_alerts,
             rtt_warn_ms=self.config.probe_rtt_warn_ms,
             duration_ms=1e3 * (time.monotonic() - t0),
+        )
+        # trend folding sees the PRE-TREND health verdict: a cycle already
+        # unhealthy by per-cycle checks (RTT threshold, missing devices) is
+        # still judged for drift, but its readings must not shape the
+        # "healthy" anchor — an agent started during congestion would
+        # otherwise freeze the congested readings in as the baseline
+        report.trend_alerts = self._fold_trends(
+            ici, mxu, hbm, hbm_write, links, cycle_healthy=report.healthy
         )
         self.metrics.counter("probe_runs").inc()
         if ici.psum_rtt_ms >= 0:
@@ -155,7 +161,7 @@ class ProbeAgent:
     # of None means the sub-probe errored or doesn't apply THIS cycle: its
     # gauge is cleared (a frozen last-healthy value would show dashboards a
     # healthy chip while it is dead) and no trend sample is folded.
-    def _fold_trends(self, ici, mxu, hbm, hbm_write, links) -> list:
+    def _fold_trends(self, ici, mxu, hbm, hbm_write, links, *, cycle_healthy: bool = True) -> list:
         # gate on the SAME ok fields ProbeReport.healthy uses — an
         # integrity-failed or non-finite probe has no 'error' string but its
         # readings describe a broken chip and must neither stay on a gauge
@@ -190,7 +196,11 @@ class ProbeAgent:
                 gauge.clear()
                 continue
             if self.trend is not None:
-                alert = self.trend.observe(name, value, higher_is_better=higher_is_better)
+                alert = self.trend.observe(
+                    name, value,
+                    higher_is_better=higher_is_better,
+                    contribute_baseline=cycle_healthy,
+                )
                 if alert is not None:
                     logger.warning(
                         "Probe trend alert: %s %s to %.4g (baseline %.4g, ratio %.2f)",

@@ -104,7 +104,19 @@ class TrendTracker:
         self._anchor: Dict[str, float] = {}
         self._recent: Dict[str, Deque[float]] = {}
 
-    def observe(self, name: str, value: float, *, higher_is_better: bool) -> Optional[TrendAlert]:
+    def observe(
+        self,
+        name: str,
+        value: float,
+        *,
+        higher_is_better: bool,
+        contribute_baseline: bool = True,
+    ) -> Optional[TrendAlert]:
+        """Fold one reading; ``contribute_baseline=False`` judges the
+        reading against the anchor but keeps it out of the forming buffer —
+        for cycles the caller already knows are unhealthy by per-cycle
+        checks (RTT threshold breach, missing devices), whose readings must
+        not freeze into the "healthy" anchor."""
         if value is None or value <= 0:
             return None  # errored/absent readings carry no trend signal
         value = float(value)
@@ -118,11 +130,14 @@ class TrendTracker:
                 # forming buffer (see below)
                 forming = self._forming.setdefault(name, [])
                 if len(forming) + 1 < self.min_history:
-                    forming.append(value)
+                    if contribute_baseline:
+                        forming.append(value)
                     return None
                 # judge against the pre-recent forming samples: the trailing
                 # recent-1 entries are already inside the recent window
                 baseline_samples = forming[: len(forming) - (self.recent - 1)] or forming[:1]
+                if not baseline_samples:
+                    return None
                 anchor = statistics.median(baseline_samples)
             recent_samples = list(recent)
 
@@ -135,13 +150,14 @@ class TrendTracker:
                 elif not higher_is_better and ratio > self.rise_factor:
                     alert = TrendAlert(name, anchor, recent_median, ratio, "rise")
 
-            if forming is not None and alert is None:
-                # only non-alerting samples may shape the anchor: degradation
-                # that starts mid-forming must not freeze into the baseline
-                # (it would silence alerts that were already firing and judge
-                # all future decay against a poisoned anchor). If degradation
-                # persists, the anchor simply never freezes and every cycle
-                # keeps alerting against the early-healthy baseline.
+            if forming is not None and alert is None and contribute_baseline:
+                # only non-alerting samples from healthy cycles may shape
+                # the anchor: degradation that starts mid-forming must not
+                # freeze into the baseline (it would silence alerts that
+                # were already firing and judge all future decay against a
+                # poisoned anchor). If degradation persists, the anchor
+                # simply never freezes and every cycle keeps alerting
+                # against the early-healthy baseline.
                 forming.append(value)
                 if len(forming) >= self.window:
                     self._anchor[name] = statistics.median(forming)

@@ -44,20 +44,20 @@ def _worker_env() -> dict:
     return env
 
 
-def _run_cluster(out_dir, extra_env=None):
+def _run_cluster(out_dir, extra_env=None, n_procs=N_PROCS):
     coordinator = f"127.0.0.1:{_free_port()}"
     env = _worker_env()
     env.update(extra_env or {})
     procs = [
         subprocess.Popen(
-            [sys.executable, str(WORKER), coordinator, str(N_PROCS), str(pid), str(out_dir)],
+            [sys.executable, str(WORKER), coordinator, str(n_procs), str(pid), str(out_dir)],
             env=env,
             cwd=str(REPO_ROOT),
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
         )
-        for pid in range(N_PROCS)
+        for pid in range(n_procs)
     ]
     outputs = []
     try:
@@ -71,7 +71,7 @@ def _run_cluster(out_dir, extra_env=None):
     for p, out in zip(procs, outputs):
         assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
     results = {}
-    for pid in range(N_PROCS):
+    for pid in range(n_procs):
         path = out_dir / f"result_{pid}.json"
         assert path.exists(), f"worker {pid} wrote no result"
         results[pid] = json.loads(path.read_text())
@@ -182,6 +182,42 @@ def test_corrupt_chip_triangulated_across_process_ownership(faulted_results):
         if 2048 in s["device_ids"]
     }
     assert reasons == {"corrupt"}
+
+
+@pytest.fixture(scope="module")
+def ring_results(tmp_path_factory):
+    # 3 hosts x 2 chips: the smallest topology with a WRAPAROUND inter-host
+    # edge and overlapping 2-process pair programs on 3+ processes — the
+    # rendezvous-ordering shape where a deterministic-walk bug deadlocks
+    return _run_cluster(tmp_path_factory.mktemp("multihost_ring"), n_procs=3)
+
+
+def test_three_host_ring_links_localized(ring_results):
+    """On a (3 hosts, 2 chips) grid every process joins TWO different
+    inter-host pair programs with TWO different peers; all processes walk
+    the same global list so the rendezvous order must line up (reaching
+    here at all proves no deadlock — _run_cluster bounds communicate()).
+    The wraparound edge host2-host0 exists only with >2 hosts and its
+    canonical record lives on the lower-indexed endpoint, process 0."""
+    all_recorded = [l for r in ring_results.values() for l in r["links"]["recorded"]]
+    names = [l["name"] for l in all_recorded]
+    assert len(names) == len(set(names)), f"edge recorded twice: {sorted(names)}"
+    # 3 intra (1 per host; a 2-ring has no chip wrap) + 6 inter
+    # (3 host-pairs per chip column x 2 chips, incl. the wraparound)
+    assert sorted(n for n in names if n.startswith("host")) == [
+        "host0/chip0-chip1", "host1/chip0-chip1", "host2/chip0-chip1"]
+    inter = sorted(n for n in names if n.startswith("chip"))
+    assert inter == [
+        "chip0/host0-host1", "chip0/host1-host2", "chip0/host2-host0",
+        "chip1/host0-host1", "chip1/host1-host2", "chip1/host2-host0"]
+    assert all(l["correct"] and l["rtt_ms"] > 0 for l in all_recorded)
+    for r in ring_results.values():
+        assert r["links"]["error"] is None
+        assert r["links"]["ok"]
+    # wraparound edges: endpoints are processes 2 and 0 -> recorded by 0
+    wrap_owned = [l["name"] for l in ring_results[0]["links"]["recorded"]
+                  if "host2-host0" in l["name"]]
+    assert sorted(wrap_owned) == ["chip0/host2-host0", "chip1/host2-host0"]
 
 
 def test_prep_failure_skips_all_cross_process_links(prep_fail_results):

@@ -115,6 +115,26 @@ class TestTrendTracker:
         # and they must not have entered the series
         assert all(v == 100.0 for v in t.snapshot()["gbps"]["recent"])
 
+    def test_uncontributed_readings_judged_but_never_form_anchor(self):
+        t = make_tracker(window=6, min_history=5)
+        # readings from unhealthy cycles: judged (once an anchor exists)
+        # but never allowed to shape it
+        for _ in range(10):
+            assert t.observe("rtt", 60.0, higher_is_better=False,
+                             contribute_baseline=False) is None
+        snap = t.snapshot()["rtt"]
+        assert snap["anchor"] is None and snap["forming_samples"] == 0
+        # healthy cycles then form the real anchor at the true level
+        for _ in range(6):
+            t.observe("rtt", 5.0, higher_is_better=False)
+        assert t.snapshot()["rtt"]["anchor"] == pytest.approx(5.0)
+        # drift is judged even on a non-contributing cycle
+        for _ in range(2):
+            t.observe("rtt", 20.0, higher_is_better=False, contribute_baseline=False)
+        alert = t.observe("rtt", 20.0, higher_is_better=False, contribute_baseline=False)
+        assert alert is not None and alert.direction == "rise"
+        assert alert.baseline == pytest.approx(5.0)
+
     def test_invalid_parameters_rejected(self):
         with pytest.raises(ValueError):
             TrendTracker(window=3, recent=3)
@@ -190,6 +210,29 @@ class TestAgentTrendWiring:
         agent.run_once()
         assert not gauge.has_value
         assert "probe_mxu_tflops_median" not in agent.metrics.prometheus_text()
+
+    def test_unhealthy_cycles_do_not_form_the_anchor(self, monkeypatch):
+        # an agent started during congestion (every cycle unhealthy by the
+        # per-cycle RTT threshold) must not freeze the congested readings
+        # in as the "healthy" baseline
+        import k8s_watcher_tpu.probe.agent as agent_mod
+
+        monkeypatch.setattr(
+            agent_mod, "run_mxu_probe",
+            lambda size, **kw: {"ok": True, "finite": True, "tflops": 90.0, "tflops_median": 90.0},
+        )
+        config = TpuConfig(
+            probe_enabled=True, probe_hbm_bytes=0,
+            probe_payload_bytes=1 << 14, probe_matmul_size=64,
+            probe_rtt_warn_ms=1e-9,  # every cycle breaches the threshold
+        )
+        agent = ProbeAgent(config, environment="development",
+                           sink=lambda n: None, expected_platform="cpu")
+        for _ in range(7):
+            assert not agent.run_once().healthy
+        snap = agent.trend.snapshot().get("mxu_tflops_median")
+        assert snap is not None
+        assert snap["anchor"] is None and snap["forming_samples"] == 0
 
     def test_trend_disabled_never_alerts(self, monkeypatch):
         import k8s_watcher_tpu.probe.agent as agent_mod
