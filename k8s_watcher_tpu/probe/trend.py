@@ -134,11 +134,10 @@ class TrendTracker:
                         forming.append(value)
                     return None
                 # judge against the pre-recent forming samples: the trailing
-                # recent-1 entries are already inside the recent window
-                baseline_samples = forming[: len(forming) - (self.recent - 1)] or forming[:1]
-                if not baseline_samples:
-                    return None
-                anchor = statistics.median(baseline_samples)
+                # recent-1 entries are already inside the recent window.
+                # Reaching here needs len(forming)+1 >= min_history >=
+                # recent+1, so the slice always keeps >= 1 sample
+                anchor = statistics.median(forming[: len(forming) - (self.recent - 1)])
             recent_samples = list(recent)
 
             alert = None

@@ -144,6 +144,81 @@ class TestChurnLoad:
         assert p50 is not None and p50 < 1.0, f"p50 {p50*1000:.1f}ms breaches 1s target"
         assert notifier.injected_failures > 0  # faults actually exercised
 
+    def test_ici_fault_localized_during_churn(self, monkeypatch):
+        """Acceptance config #5's full shape: pod churn AND an injected ICI
+        fault, concurrently, through one dispatcher. The pod notifications
+        must keep flowing while the probe agent's unhealthy report fingers
+        the injected device — the north star covers BOTH signal paths."""
+        import k8s_watcher_tpu.probe.links as links_mod
+        from k8s_watcher_tpu.config.schema import TpuConfig
+        from k8s_watcher_tpu.faults.ici import IciFaultSpec
+        from k8s_watcher_tpu.probe.agent import ProbeAgent
+
+        # the REAL per-link SPMD walk, parameterized with a real injected
+        # fault (the agent API deliberately has no fault knob — injection
+        # is test/chaos tooling). Patched at the source module: the agent
+        # imports it lazily per cycle.
+        real = links_mod.run_link_probe
+        monkeypatch.setattr(
+            links_mod, "run_link_probe",
+            lambda mesh=None, **kw: real(
+                mesh, **kw, fault=IciFaultSpec(corrupt_device_id=5)
+            ),
+        )
+
+        metrics = MetricsRegistry()
+        payloads = []
+        lock = threading.Lock()
+
+        def send(p):
+            with lock:
+                payloads.append(p)
+            return True
+
+        dispatcher = Dispatcher(send, capacity=4096, workers=2, metrics=metrics)
+        dispatcher.start()
+        pipeline = EventPipeline(
+            environment="production",
+            sink=dispatcher.submit,
+            slice_tracker=SliceTracker("production"),
+            metrics=metrics,
+            resource_filter=TpuResourceFilter("google.com/tpu"),
+        )
+        agent = ProbeAgent(
+            TpuConfig(probe_enabled=True, probe_interval_seconds=0.1,
+                      probe_payload_bytes=1 << 14, probe_matmul_size=64,
+                      probe_hbm_bytes=0, probe_links_enabled=True,
+                      probe_link_rtt_floor_ms=5.0, probe_rtt_warn_ms=10_000.0),
+            environment="production", sink=dispatcher.submit,
+            metrics=metrics, expected_platform="cpu",
+        )
+        agent.start()
+        try:
+            for event in ChurnGenerator(n_slices=4, workers_per_slice=4, seed=11).events(400):
+                pipeline.process(event)
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                with lock:
+                    if any(p.get("event_type") == "TPU_PROBE" for p in payloads):
+                        break
+                time.sleep(0.1)
+        finally:
+            agent.stop()
+            dispatcher.drain(20.0)
+            dispatcher.stop()
+
+        with lock:
+            pod_payloads = [p for p in payloads if p.get("event_type") in
+                            ("ADDED", "MODIFIED", "DELETED")]
+            probe_payloads = [p for p in payloads if p.get("event_type") == "TPU_PROBE"]
+        assert pod_payloads, "churn notifications stopped flowing"
+        assert probe_payloads, "probe report never arrived during churn"
+        report = probe_payloads[-1]
+        assert report["healthy"] is False
+        assert report["links"]["suspect_devices"] == [5], (
+            f"injected device not localized: {report['links']['suspect_devices']}"
+        )
+
     def test_slice_events_under_churn(self):
         got = []
         pipeline = EventPipeline(
