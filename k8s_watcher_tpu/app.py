@@ -168,16 +168,22 @@ class WatcherApp:
         """Blocking steady-state loop (parity: pod_watcher.py:243-277)."""
         self.dispatcher.start()
         if self.config.watcher.status_port:
+            agent_trend = (
+                self._probe_agent.trend.snapshot
+                if self._probe_agent is not None and self._probe_agent.trend is not None
+                else None
+            )
             self.status_server = StatusServer(
                 self.metrics,
                 self.liveness,
                 port=self.config.watcher.status_port,
                 audit=self.audit,
                 slices=self.slice_tracker.debug_snapshot,
+                trend=agent_trend,
             ).start()
             routes = "/metrics, /healthz, /debug/slices" + (
                 ", /debug/events" if self.audit is not None else ""
-            )
+            ) + (", /debug/trend" if agent_trend is not None else "")
             logger.info("Status endpoint on :%d (%s)", self.status_server.port, routes)
         if self.config.watcher.leader_election.enabled:
             self._campaign()  # blocks until this replica leads (or stop())

@@ -49,6 +49,7 @@ class _StatusHandler(BaseHTTPRequestHandler):
     liveness: Liveness
     audit = None  # metrics.audit.AuditRing, optional
     slices = None  # Callable[[], dict]: live slice states, optional
+    trend = None  # Callable[[], dict]: probe trend anchors/windows, optional
 
     def log_message(self, *a):
         pass
@@ -107,6 +108,11 @@ class _StatusHandler(BaseHTTPRequestHandler):
                 self._json(404, {"error": "slice tracking not wired"})
                 return
             self._json(200, {"slices": self.slices()})
+        elif parsed.path == "/debug/trend":
+            if self.trend is None:
+                self._json(404, {"error": "trend tracking not wired (tpu.probe.trend_enabled)"})
+                return
+            self._json(200, {"trend": self.trend()})
         else:
             self._json(404, {"error": f"no route {self.path}"})
 
@@ -121,11 +127,18 @@ class StatusServer:
         port: int = 0,
         audit=None,  # metrics.audit.AuditRing -> serves /debug/events
         slices=None,  # Callable[[], dict] -> serves /debug/slices
+        trend=None,  # Callable[[], dict] -> serves /debug/trend
     ):
         handler = type(
             "BoundStatusHandler",
             (_StatusHandler,),
-            {"metrics": metrics, "liveness": liveness, "audit": audit, "slices": staticmethod(slices) if slices else None},
+            {
+                "metrics": metrics,
+                "liveness": liveness,
+                "audit": audit,
+                "slices": staticmethod(slices) if slices else None,
+                "trend": staticmethod(trend) if trend else None,
+            },
         )
         self._server = ThreadingHTTPServer((host, port), handler)
         self._server.daemon_threads = True

@@ -376,3 +376,31 @@ class TestDebugSlicesEndpoint:
             ).status_code == 404
         finally:
             server.stop()
+
+
+class TestDebugTrendEndpoint:
+    def test_debug_trend_endpoint(self):
+        from k8s_watcher_tpu.probe.trend import TrendTracker
+
+        t = TrendTracker(window=6, recent=3, min_history=4)
+        for _ in range(6):
+            t.observe("mxu_tflops_median", 100.0, higher_is_better=True)
+        server = StatusServer(
+            MetricsRegistry(), Liveness(), host="127.0.0.1", trend=t.snapshot
+        ).start()
+        try:
+            body = requests.get(f"http://127.0.0.1:{server.port}/debug/trend", timeout=5).json()
+            series = body["trend"]["mxu_tflops_median"]
+            assert series["anchor"] == 100.0
+            assert series["recent"] == [100.0, 100.0, 100.0]
+        finally:
+            server.stop()
+
+    def test_404_when_not_wired(self):
+        server = StatusServer(MetricsRegistry(), Liveness(), host="127.0.0.1").start()
+        try:
+            assert requests.get(
+                f"http://127.0.0.1:{server.port}/debug/trend", timeout=5
+            ).status_code == 404
+        finally:
+            server.stop()
