@@ -21,7 +21,7 @@ import jax
 from k8s_watcher_tpu.config.schema import TpuConfig
 from k8s_watcher_tpu.metrics import MetricsRegistry
 from k8s_watcher_tpu.pipeline.pipeline import Notification
-from k8s_watcher_tpu.probe.device import enumerate_devices
+from k8s_watcher_tpu.probe.device import enumerate_devices, host_identity, host_identity_map
 from k8s_watcher_tpu.probe.ici import run_ici_probe, run_mxu_probe
 from k8s_watcher_tpu.probe.report import ProbeReport
 from k8s_watcher_tpu.probe.trend import TrendTracker
@@ -136,6 +136,8 @@ class ProbeAgent:
             hbm_write=hbm_write,
             links=links,
             multislice=multislice,
+            host=host_identity(),
+            hosts=host_identity_map(),
             rtt_warn_ms=self.config.probe_rtt_warn_ms,
             duration_ms=1e3 * (time.monotonic() - t0),
         )

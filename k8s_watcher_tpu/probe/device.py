@@ -10,12 +10,92 @@ isolates chips that enumerate but cannot execute (a failure mode a bare
 from __future__ import annotations
 
 import logging
+import os
+import socket
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 
 logger = logging.getLogger(__name__)
+
+
+def host_identity() -> Dict[str, Any]:
+    """This process's host identity — the join key that turns a suspect
+    chip (``device.process_index``) into a drainable k8s node.
+
+    ``NODE_NAME`` comes from the downward API (deploy/probe-daemonset.yaml);
+    ``TPU_WORKER_ID``/``TPU_WORKER_HOSTNAMES`` are injected by GKE on TPU
+    slice pods."""
+    out: Dict[str, Any] = {
+        "hostname": socket.gethostname(),
+        "process_index": jax.process_index(),
+    }
+    for env in ("NODE_NAME", "TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES"):
+        value = os.environ.get(env)
+        if value:
+            out[env.lower()] = value
+    return out
+
+
+# gathered once per process lifetime: identities (hostname, NODE_NAME) are
+# stable, and the gather is a cross-process collective worth not repeating
+# every probe cycle. Single-process identities are NOT cached (tests and
+# sidecars may change env between agents).
+_IDENTITY_MAP_CACHE: Optional[Dict[str, Dict[str, Any]]] = None
+_IDENTITY_WIRE_BYTES = 512
+
+
+def host_identity_map() -> Dict[str, Dict[str, Any]]:
+    """``str(process_index) -> host_identity()`` for EVERY process.
+
+    Suspect chips found by the link probe live on remote processes, but
+    process 0 does the reporting (probe/agent.py `_report`) — without this
+    map a report saying "device.process_index == 2 is suspect" names no
+    drainable node. Multi-controller mode gathers each process's identity
+    (fixed-size utf-8 buffers over one allgather) exactly once."""
+    global _IDENTITY_MAP_CACHE
+    if jax.process_count() == 1:
+        mine = host_identity()
+        return {str(mine["process_index"]): mine}
+    if _IDENTITY_MAP_CACHE is not None:
+        return _IDENTITY_MAP_CACHE
+
+    import json
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    # wire identity excludes TPU_WORKER_HOSTNAMES: it is identical on every
+    # worker and grows with slice size — on 16+ worker slices it would
+    # overflow the fixed wire buffer and corrupt the JSON mid-string,
+    # killing the node_name join exactly on the large slices it targets
+    mine = {k: v for k, v in host_identity().items() if k != "tpu_worker_hostnames"}
+    raw = json.dumps(mine).encode("utf-8")
+    if len(raw) >= _IDENTITY_WIRE_BYTES:
+        logger.warning(
+            "Host identity JSON (%d bytes) exceeds the %d-byte wire buffer; "
+            "gathering a minimal identity instead", len(raw), _IDENTITY_WIRE_BYTES
+        )
+        minimal = {"hostname": mine.get("hostname", "")[:200],
+                   "process_index": mine["process_index"]}
+        if "node_name" in mine:
+            minimal["node_name"] = mine["node_name"][:200]
+        raw = json.dumps(minimal).encode("utf-8")[: _IDENTITY_WIRE_BYTES - 1]
+    buf = np.zeros(_IDENTITY_WIRE_BYTES, dtype=np.uint8)
+    buf[: len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+    gathered = np.asarray(multihost_utils.process_allgather(buf))
+    out: Dict[str, Dict[str, Any]] = {}
+    for idx in range(gathered.shape[0]):
+        row = bytes(gathered[idx]).rstrip(b"\x00")
+        try:
+            out[str(idx)] = json.loads(row.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            # a peer sent garbage: keep the index mapped so the operator
+            # still sees WHICH process is unidentifiable
+            out[str(idx)] = {"process_index": idx, "error": "identity decode failed"}
+    _IDENTITY_MAP_CACHE = out
+    return out
 
 
 def _device_entry(device: jax.Device) -> Dict[str, Any]:

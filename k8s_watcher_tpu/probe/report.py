@@ -25,6 +25,13 @@ class ProbeReport:
     # every individual cycle may have passed its own checks, but a slide
     # beyond the trend factors is an actionable degradation signal
     trend_alerts: List[Any] = dataclasses.field(default_factory=list)
+    # reporting process's host identity (probe/device.py:host_identity)
+    host: Optional[Dict[str, Any]] = None
+    # str(process_index) -> identity for EVERY slice process
+    # (probe/device.py:host_identity_map) — the join that turns a suspect
+    # chip's process_index into a drainable k8s node even when the suspect
+    # lives on a remote host and process 0 is the one reporting
+    hosts: Optional[Dict[str, Any]] = None
     rtt_warn_ms: float = 50.0
     duration_ms: float = 0.0
 
@@ -69,6 +76,8 @@ class ProbeReport:
             "links": self.links.to_dict() if self.links is not None else None,
             "multislice": self.multislice.to_dict() if self.multislice is not None else None,
             "trend_alerts": [a.to_dict() for a in self.trend_alerts],
+            "host": self.host,
+            "hosts": self.hosts,
             "duration_ms": self.duration_ms,
             "event_timestamp": datetime.now(timezone.utc).isoformat(),
         }

@@ -32,6 +32,13 @@ def main() -> None:
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
     os.environ["JAX_CPU_COLLECTIVES_IMPLEMENTATION"] = "gloo"
 
+    # distinct per-process node identity, as the downward API would inject,
+    # so the parent can assert the gathered identity map is per-host.
+    # Unconditional REPLACE: CI running inside k8s injects a real NODE_NAME
+    # that would otherwise leak in identically on every worker (same reason
+    # JAX_PLATFORMS/XLA_FLAGS are force-set above)
+    os.environ["NODE_NAME"] = f"test-node-{pid}"
+
     import jax
 
     from k8s_watcher_tpu.config.schema import TpuConfig
@@ -98,6 +105,8 @@ def main() -> None:
         "ici": report.ici.to_dict() if report.ici else None,
         "mxu_ok": bool(report.mxu and report.mxu.get("ok")),
         "healthy": report.healthy,
+        "host": report.host,
+        "hosts": report.hosts,
         "links": {
             "ok": link_report.ok,
             "n_links": link_report.n_links,

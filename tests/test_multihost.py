@@ -251,6 +251,20 @@ def test_prep_failure_skips_all_cross_process_links(prep_fail_results):
     ), r1["links"]["suspect_links"]
 
 
+def test_host_identity_map_covers_every_process(worker_results):
+    """A suspect chip on a remote process is only actionable if process 0's
+    report can map that process_index to a node — every worker must see the
+    SAME gathered map naming every process's own NODE_NAME."""
+    for pid, r in worker_results.items():
+        assert r["host"]["node_name"] == f"test-node-{pid}"
+        assert r["host"]["process_index"] == pid
+        hosts = r["hosts"]
+        assert set(hosts.keys()) == {"0", "1"}, hosts
+        for idx in range(N_PROCS):
+            assert hosts[str(idx)]["node_name"] == f"test-node-{idx}"
+            assert hosts[str(idx)]["process_index"] == idx
+
+
 def test_only_process_zero_reports(worker_results):
     assert worker_results[0]["reported"] == 1
     assert worker_results[0]["payload_event_type"] == "TPU_PROBE"

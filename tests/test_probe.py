@@ -125,6 +125,18 @@ class TestProbeAgentAndReport:
         assert payload["mxu"]["ok"]
         assert payload["devices"]["visible_devices"] == 8
 
+    def test_report_carries_host_identity(self, monkeypatch):
+        # a suspect chip is only actionable if the report names the host it
+        # was observed from — NODE_NAME (downward API) is the drain target
+        monkeypatch.setenv("NODE_NAME", "gke-tpu-node-7")
+        monkeypatch.setenv("TPU_WORKER_ID", "3")
+        report = self.make_agent().run_once()
+        payload = report.to_payload()
+        assert payload["host"]["node_name"] == "gke-tpu-node-7"
+        assert payload["host"]["tpu_worker_id"] == "3"
+        assert payload["host"]["hostname"]
+        assert payload["host"]["process_index"] == 0
+
     def test_links_enabled_populates_report(self):
         # agent-level regression guard for the link sub-probe: with
         # links_enabled the whole path (config -> agent -> run_link_probe)
