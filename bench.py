@@ -4,8 +4,8 @@ Headline metric (BASELINE.md north star): p50 latency from pod event receipt
 to completed clusterapi notification, measured end-to-end — churn-generated
 slice-pod events through filters, phase-delta, slice aggregation, payload
 extraction, async dispatch, and a real HTTP POST to a local sink server.
-Target: < 1 s on v5p-128-scale churn (1 k events/min); the bench drives
-~20× that event rate.
+Target: < 1 s on v5p-128-scale churn (1 k events/min); the bench drives the
+pipeline at 6x and 30x that event rate (p50 must hold as load grows).
 
 Also measured (details): sustained ingest throughput, ICI psum RTT and MXU
 matmul TFLOP/s on the real attached accelerator (single chip here; the same
@@ -370,6 +370,9 @@ def bench_probe() -> dict:
 
 def main() -> int:
     pipeline_stats = bench_watch_pipeline(n_events=2000, events_per_sec=100.0)
+    # the same path at 30x the 1k/min acceptance rate: p50 must hold, not
+    # degrade with offered load (queueing would show here first)
+    pipeline_500 = bench_watch_pipeline(n_events=2500, events_per_sec=500.0)
     burst_stats = bench_burst_drain()
     scan_stats = bench_frame_scan()
     virtual_stats = bench_virtual_probes()
@@ -382,6 +385,7 @@ def main() -> int:
         "vs_baseline": round(BASELINE_TARGET_MS / p50, 1) if p50 > 0 else 0.0,
         "details": {
             "pipeline": pipeline_stats,
+            "pipeline_500eps": pipeline_500,
             "burst": burst_stats,
             "frame_scan": scan_stats,
             "probe": probe_stats,
