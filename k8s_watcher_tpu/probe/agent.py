@@ -175,8 +175,11 @@ class ProbeAgent:
         hbm_w_ok = hbm_write is not None and hbm_write.get("ok", False) and not hbm_write.get("interpreted")
         # links: an errored walk withdraws the gauges, but a walk that FOUND
         # suspects is a valid reading — probe_link_suspects > 0 is exactly
-        # what operators scrape for, so links.ok is deliberately not gated on
-        links_ok = links is not None and links.error is None and links.n_links > 0
+        # what operators scrape for, so links.ok is deliberately not gated
+        # on. Gate on n_observed, not n_links: a process can observe (and
+        # suspect) links it doesn't own — its inter-host edges record on
+        # the lower-indexed peer, leaving n_links == 0 on valid walks
+        links_ok = links is not None and links.error is None and links.n_observed > 0
         readings = [
             ("psum_rtt_median_ms", ici.psum_rtt_median_ms if ici_ok else None, False),
             ("allreduce_bus_gbps_median", ici.bandwidth_gbps_median if ici_ok else None, True),

@@ -46,6 +46,38 @@ _IDENTITY_MAP_CACHE: Optional[Dict[str, Dict[str, Any]]] = None
 _IDENTITY_WIRE_BYTES = 512
 
 
+def _encode_identity_wire(identity: Dict[str, Any]) -> bytes:
+    """JSON-encode an identity to at most ``_IDENTITY_WIRE_BYTES - 1`` bytes
+    of ALWAYS-decodable utf-8 — a blind byte slice could cut a multibyte
+    sequence (or a ``\\uXXXX`` escape, which is why ``ensure_ascii=False``:
+    the encoded length must equal the real byte cost) and make every peer's
+    decode fail, losing the node join exactly in the oversize case."""
+    import json
+
+    def clip(s: str, max_bytes: int) -> str:
+        return s.encode("utf-8")[:max_bytes].decode("utf-8", errors="ignore")
+
+    raw = json.dumps(identity, ensure_ascii=False).encode("utf-8")
+    if len(raw) < _IDENTITY_WIRE_BYTES:
+        return raw
+    logger.warning(
+        "Host identity JSON (%d bytes) exceeds the %d-byte wire buffer; "
+        "gathering a minimal identity instead", len(raw), _IDENTITY_WIRE_BYTES
+    )
+    minimal: Dict[str, Any] = {
+        "hostname": clip(str(identity.get("hostname", "")), 180),
+        "process_index": identity["process_index"],
+    }
+    if "node_name" in identity:
+        minimal["node_name"] = clip(str(identity["node_name"]), 180)
+    raw = json.dumps(minimal, ensure_ascii=False).encode("utf-8")
+    if len(raw) < _IDENTITY_WIRE_BYTES:
+        return raw
+    # pathological values (every char escaping to multiple bytes): the
+    # index alone still names WHICH process the operator must inspect
+    return json.dumps({"process_index": identity["process_index"]}).encode("utf-8")
+
+
 def host_identity_map() -> Dict[str, Dict[str, Any]]:
     """``str(process_index) -> host_identity()`` for EVERY process.
 
@@ -71,17 +103,7 @@ def host_identity_map() -> Dict[str, Dict[str, Any]]:
     # overflow the fixed wire buffer and corrupt the JSON mid-string,
     # killing the node_name join exactly on the large slices it targets
     mine = {k: v for k, v in host_identity().items() if k != "tpu_worker_hostnames"}
-    raw = json.dumps(mine).encode("utf-8")
-    if len(raw) >= _IDENTITY_WIRE_BYTES:
-        logger.warning(
-            "Host identity JSON (%d bytes) exceeds the %d-byte wire buffer; "
-            "gathering a minimal identity instead", len(raw), _IDENTITY_WIRE_BYTES
-        )
-        minimal = {"hostname": mine.get("hostname", "")[:200],
-                   "process_index": mine["process_index"]}
-        if "node_name" in mine:
-            minimal["node_name"] = mine["node_name"][:200]
-        raw = json.dumps(minimal).encode("utf-8")[: _IDENTITY_WIRE_BYTES - 1]
+    raw = _encode_identity_wire(mine)
     buf = np.zeros(_IDENTITY_WIRE_BYTES, dtype=np.uint8)
     buf[: len(raw)] = np.frombuffer(raw, dtype=np.uint8)
     gathered = np.asarray(multihost_utils.process_allgather(buf))

@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import statistics
 import time
 from typing import Any, Dict, Optional
 
@@ -181,7 +182,10 @@ def _timed_pass_ms(run_fenced, iters: int, baseline_ms: float, repeats: int,
         per_exec.append(1e3 * (time.perf_counter() - t0))
         if 1e3 * (time.perf_counter() - loop_t0) > budget_ms:
             break
-    median = sorted(per_exec)[len(per_exec) // 2]
+    # statistics.median, not sorted()[n//2]: the latter picks the UPPER
+    # middle for even n — a systematic high bias in the very statistic
+    # that exists to de-bias the bandwidth numbers
+    median = statistics.median(per_exec)
     device_ms = median - baseline_ms
     device_min_ms = min(per_exec) - baseline_ms
     unreliable = device_ms < 0.25 * baseline_ms

@@ -101,6 +101,10 @@ class LinkResult:
 class LinkProbeResult:
     ok: bool
     n_links: int  # edges this process canonically records (owner=True)
+    # edges this process OBSERVED (walked), owned or not — the "did the
+    # walk measure anything" signal: a process can observe links it does
+    # not own (its inter-host edges record on the lower-indexed peer)
+    n_observed: int
     median_rtt_ms: float
     links: List[LinkResult]  # owned records only — merge across hosts dedup-free
     suspect_links: List[Dict[str, Any]]  # {name, device_ids, reason, rtt_ms} over ALL observed
@@ -251,7 +255,7 @@ def run_link_probe(
             links = participating
         if not links:
             return LinkProbeResult(
-                ok=True, n_links=0, median_rtt_ms=0.0, links=[],
+                ok=True, n_links=0, n_observed=0, median_rtt_ms=0.0, links=[],
                 suspect_links=[], suspect_devices=[], compile_ms=0.0,
             )
 
@@ -346,6 +350,7 @@ def run_link_probe(
         return LinkProbeResult(
             ok=not suspects,
             n_links=len(results),
+            n_observed=len(observed),
             median_rtt_ms=median,
             links=results,
             suspect_links=suspects,
@@ -355,6 +360,6 @@ def run_link_probe(
     except Exception as exc:
         logger.error("Link probe failed: %s", exc)
         return LinkProbeResult(
-            ok=False, n_links=0, median_rtt_ms=-1.0, links=[],
+            ok=False, n_links=0, n_observed=0, median_rtt_ms=-1.0, links=[],
             suspect_links=[], suspect_devices=[], compile_ms=0.0, error=str(exc),
         )

@@ -18,6 +18,7 @@ accurate.
 
 from __future__ import annotations
 
+import statistics
 import time
 from typing import Any, Optional, Tuple
 
@@ -43,7 +44,7 @@ def fence_baseline_ms(device: Optional[jax.Device] = None, samples: int = 3) -> 
         t0 = time.perf_counter()
         fetch_scalar(tiny)
         costs.append(1e3 * (time.perf_counter() - t0))
-    return sorted(costs)[len(costs) // 2]
+    return statistics.median(costs)
 
 
 class TimedStats(tuple):
@@ -89,7 +90,9 @@ def timed_fenced(fn, x, iters: int, baseline_ms: float = 0.0) -> TimedStats:
         raw_min = min(raw_min, raw)
         times.append(max(raw - baseline_ms / 1e3, 1e-9))
     unreliable = baseline_ms > 0 and (raw_min - baseline_ms / 1e3) < 0.25 * baseline_ms / 1e3
-    median = sorted(times)[len(times) // 2]
+    # statistics.median (not sorted()[n//2], whose upper-middle pick biases
+    # even-iters runs high — the exact bias this statistic exists to remove)
     return TimedStats(
-        min(times), sum(times) / len(times), max(times), unreliable, median
+        min(times), sum(times) / len(times), max(times), unreliable,
+        statistics.median(times),
     )

@@ -103,6 +103,12 @@ class TrendTracker:
         self._forming: Dict[str, List[float]] = {}
         self._anchor: Dict[str, float] = {}
         self._recent: Dict[str, Deque[float]] = {}
+        # per-metric flags mirroring the recent deque: did that observe
+        # contribute to the forming buffer? The interim anchor must exclude
+        # exactly the trailing forming entries still inside the recent
+        # window, and with non-contributing cycles interleaved that count
+        # is NOT always recent-1
+        self._recent_contributed: Dict[str, Deque[bool]] = {}
 
     def observe(
         self,
@@ -122,7 +128,11 @@ class TrendTracker:
         value = float(value)
         with self._lock:
             recent = self._recent.setdefault(name, collections.deque(maxlen=self.recent))
+            contributed = self._recent_contributed.setdefault(
+                name, collections.deque(maxlen=self.recent)
+            )
             recent.append(value)
+            contributed.append(False)  # flipped below if this sample forms
             anchor = self._anchor.get(name)
             forming = None
             if anchor is None:
@@ -132,12 +142,18 @@ class TrendTracker:
                 if len(forming) + 1 < self.min_history:
                     if contribute_baseline:
                         forming.append(value)
+                        contributed[-1] = True
                     return None
-                # judge against the pre-recent forming samples: the trailing
-                # recent-1 entries are already inside the recent window.
-                # Reaching here needs len(forming)+1 >= min_history >=
-                # recent+1, so the slice always keeps >= 1 sample
-                anchor = statistics.median(forming[: len(forming) - (self.recent - 1)])
+                # judge against the forming samples NOT still inside the
+                # recent window (the overlap is however many of the last
+                # ``recent`` observes contributed — with non-contributing
+                # cycles interleaved it is less than recent-1). All-overlap
+                # (reachable right at min_history == recent+1) degrades to
+                # judging recent against itself: ratio ~1, no alert — the
+                # correct bootstrap behavior.
+                overlap = sum(1 for c in contributed if c)
+                baseline_samples = forming[: len(forming) - overlap] or forming
+                anchor = statistics.median(baseline_samples)
             recent_samples = list(recent)
 
             alert = None
@@ -158,9 +174,11 @@ class TrendTracker:
                 # simply never freezes and every cycle keeps alerting
                 # against the early-healthy baseline.
                 forming.append(value)
+                contributed[-1] = True
                 if len(forming) >= self.window:
                     self._anchor[name] = statistics.median(forming)
                     del self._forming[name]
+                    del self._recent_contributed[name]
         return alert
 
     def snapshot(self) -> Dict[str, Dict[str, Any]]:

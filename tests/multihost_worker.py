@@ -110,6 +110,7 @@ def main() -> None:
         "links": {
             "ok": link_report.ok,
             "n_links": link_report.n_links,
+            "n_observed": link_report.n_observed,
             "recorded": [
                 {"axis": l.axis, "name": l.name, "correct": l.correct,
                  "device_ids": list(l.device_ids), "rtt_ms": l.rtt_ms,

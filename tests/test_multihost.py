@@ -140,6 +140,9 @@ def test_inter_host_links_localized_per_link(worker_results):
     for pid, r in worker_results.items():
         assert r["links"]["error"] is None, f"proc {pid}: {r['links']['error']}"
         assert r["links"]["ok"], f"proc {pid} link probe flagged suspects"
+        # every process OBSERVES its intra link + both inter links (3),
+        # regardless of how many it canonically records
+        assert r["links"]["n_observed"] == 3, r["links"]
 
     all_recorded = [l for r in worker_results.values() for l in r["links"]["recorded"]]
     names = [l["name"] for l in all_recorded]

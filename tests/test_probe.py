@@ -125,6 +125,24 @@ class TestProbeAgentAndReport:
         assert payload["mxu"]["ok"]
         assert payload["devices"]["visible_devices"] == 8
 
+    def test_identity_wire_encoding_survives_pathological_values(self):
+        from k8s_watcher_tpu.probe.device import _IDENTITY_WIRE_BYTES, _encode_identity_wire
+        import json
+
+        # normal identity round-trips untouched
+        small = {"hostname": "host-a", "process_index": 3, "node_name": "n1"}
+        assert json.loads(_encode_identity_wire(small).decode()) == small
+
+        # oversize multibyte node name: must degrade to a DECODABLE minimal
+        # identity that keeps the node join, not corrupt JSON mid-sequence
+        big = {"hostname": "h" * 300, "process_index": 7, "node_name": "ü" * 300}
+        raw = _encode_identity_wire(big)
+        assert len(raw) < _IDENTITY_WIRE_BYTES
+        out = json.loads(raw.decode("utf-8"))
+        assert out["process_index"] == 7
+        assert out["hostname"].startswith("hhh")
+        assert out["node_name"].startswith("üü")
+
     def test_report_carries_host_identity(self, monkeypatch):
         # a suspect chip is only actionable if the report names the host it
         # was observed from — NODE_NAME (downward API) is the drain target

@@ -135,6 +135,27 @@ class TestTrendTracker:
         assert alert is not None and alert.direction == "rise"
         assert alert.baseline == pytest.approx(5.0)
 
+    def test_interim_anchor_excludes_only_overlapping_forming_entries(self):
+        # with non-contributing cycles interleaved, the forming entries are
+        # no longer the trailing recent-window samples — the interim anchor
+        # must use ALL forming samples that have left the recent window,
+        # not a fixed recent-1 exclusion (which would judge against a
+        # single, possibly-outlier sample)
+        t = make_tracker(window=8, min_history=4)
+        for v in (80.0, 120.0, 100.0):
+            t.observe("rtt", v, higher_is_better=False)  # all form
+        for _ in range(3):  # push the contributed flags out of the window
+            assert t.observe("rtt", 100.0, higher_is_better=False,
+                             contribute_baseline=False) is None
+        alert = None
+        for _ in range(3):
+            alert = t.observe("rtt", 300.0, higher_is_better=False,
+                              contribute_baseline=False)
+        assert alert is not None and alert.direction == "rise"
+        # median of ALL three formed samples — a fixed recent-1 exclusion
+        # would have judged against [80.0] alone
+        assert alert.baseline == pytest.approx(100.0)
+
     def test_invalid_parameters_rejected(self):
         with pytest.raises(ValueError):
             TrendTracker(window=3, recent=3)
