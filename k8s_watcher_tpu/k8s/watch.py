@@ -59,20 +59,65 @@ class KubernetesWatchSource:
         self.scanner = scanner
         self.metrics = metrics
         self._stop = threading.Event()
-        # uid -> (name, namespace, phase) of live pods, so a relist can
-        # synthesize DELETED events for pods that vanished while the watch
-        # was disconnected (a plain relist only re-ADDs survivors, which
-        # would leak dead members in downstream phase/slice trackers).
-        # Restored from the checkpoint so the tombstones survive restarts
-        # that land past the apiserver's compaction window.
+        # uid -> pod SKELETON of live pods, so a relist can synthesize
+        # DELETED events for pods that vanished while the watch was
+        # disconnected (a plain relist only re-ADDs survivors, which would
+        # leak dead members in downstream phase/slice trackers). The
+        # skeleton keeps labels/annotations/nodeName/container resources —
+        # a bare {name, namespace} tombstone would be DROPPED by the
+        # accelerator resource filter and carry no slice identity, so the
+        # slice tracker could never remove the member (the leak this map
+        # exists to prevent, resurfacing one stage downstream). Restored
+        # from the checkpoint so tombstones survive restarts that land past
+        # the apiserver's compaction window.
         self._known: dict = {}
         if checkpoint is not None:
             for uid, entry in (checkpoint.get("known_pods") or {}).items():
-                self._known[uid] = tuple(entry)
+                if isinstance(entry, dict):
+                    self._known[uid] = entry
+                else:
+                    # pre-skeleton checkpoint format: [name, namespace, phase];
+                    # pad positionally so a truncated entry gets the RIGHT
+                    # defaults for the missing fields
+                    defaults = ["", "default", "Unknown"]
+                    entry = list(entry)[:3]
+                    name, namespace, phase = entry + defaults[len(entry):]
+                    self._known[uid] = {
+                        "metadata": {"name": name, "namespace": namespace, "uid": uid},
+                        "spec": {},
+                        "status": {"phase": phase},
+                    }
+
+    @staticmethod
+    def _skeleton(pod: dict) -> dict:
+        """The minimal pod that downstream stages treat like the original:
+        identity + labels/annotations (slice identity inference), node
+        placement, container resources (accelerator filter), and phase."""
+        meta = pod.get("metadata") or {}
+        spec = pod.get("spec") or {}
+        skel_meta = {
+            k: meta[k] for k in ("name", "namespace", "uid", "labels", "annotations")
+            if meta.get(k)
+        }
+        skel_spec: dict = {
+            k: spec[k] for k in ("nodeName", "nodeSelector") if spec.get(k)
+        }
+        containers = [
+            {"name": c.get("name", ""), "resources": c["resources"]}
+            for c in (spec.get("containers") or [])
+            if c.get("resources")
+        ]
+        if containers:
+            skel_spec["containers"] = containers
+        return {
+            "metadata": skel_meta,
+            "spec": skel_spec,
+            "status": {"phase": (pod.get("status") or {}).get("phase", "Unknown")},
+        }
 
     def known_pods(self) -> dict:
-        """JSON-serializable live-pod map for the checkpoint subsystem."""
-        return {uid: list(entry) for uid, entry in self._known.items()}
+        """JSON-serializable live-pod skeleton map for the checkpoint."""
+        return dict(self._known)
 
     def stop(self) -> None:
         self._stop.set()
@@ -89,18 +134,13 @@ class KubernetesWatchSource:
                 self.checkpoint.update_resource_version(rv)
 
     def _track(self, event_type: str, pod: dict) -> None:
-        meta = pod.get("metadata") or {}
-        uid = meta.get("uid")
+        uid = (pod.get("metadata") or {}).get("uid")
         if not uid:
             return
         if event_type == EventType.DELETED:
             self._known.pop(uid, None)
         else:
-            self._known[uid] = (
-                meta.get("name", ""),
-                meta.get("namespace", "default"),
-                (pod.get("status") or {}).get("phase", "Unknown"),
-            )
+            self._known[uid] = self._skeleton(pod)
 
     def _relist(self) -> Iterator[WatchEvent]:
         """LIST current pods: ADDED for each, synthetic DELETED for pods
@@ -113,13 +153,12 @@ class KubernetesWatchSource:
             self._track(EventType.ADDED, pod)
             yield WatchEvent(type=EventType.ADDED, pod=pod, resource_version=rv)
         for uid in [u for u in self._known if u not in listed_uids]:
-            name, namespace, phase = self._known.pop(uid)
-            logger.info("Relist: pod %s/%s vanished during disconnect; emitting DELETED", namespace, name)
-            tombstone = {
-                "metadata": {"name": name, "namespace": namespace, "uid": uid},
-                "status": {"phase": phase},
-                "spec": {},
-            }
+            tombstone = self._known.pop(uid)
+            meta = tombstone.get("metadata") or {}
+            logger.info(
+                "Relist: pod %s/%s vanished during disconnect; emitting DELETED",
+                meta.get("namespace", "default"), meta.get("name", ""),
+            )
             yield WatchEvent(type=EventType.DELETED, pod=tombstone, resource_version=rv)
         self._save_rv(rv)
 

@@ -128,14 +128,17 @@ class TrendTracker:
         value = float(value)
         with self._lock:
             recent = self._recent.setdefault(name, collections.deque(maxlen=self.recent))
-            contributed = self._recent_contributed.setdefault(
-                name, collections.deque(maxlen=self.recent)
-            )
             recent.append(value)
-            contributed.append(False)  # flipped below if this sample forms
             anchor = self._anchor.get(name)
             forming = None
+            contributed = None
             if anchor is None:
+                # contributed mirrors the recent deque while forming only;
+                # once the anchor freezes nothing reads it again
+                contributed = self._recent_contributed.setdefault(
+                    name, collections.deque(maxlen=self.recent)
+                )
+                contributed.append(False)  # flipped below if this sample forms
                 # the current sample is judged BEFORE it may enter the
                 # forming buffer (see below)
                 forming = self._forming.setdefault(name, [])

@@ -507,6 +507,88 @@ class TestKubernetesWatchSource:
         deleted = [e for e in got if e.type == "DELETED"]
         assert any(e.name == "w1" for e in deleted), f"no synthetic DELETE: {[(e.type, e.name) for e in got]}"
 
+    def test_tombstones_survive_filters_and_clear_slice_state(self, mock_api):
+        """The disconnect-gap tombstone must behave like the real DELETED
+        event downstream: pass the accelerator resource filter and carry
+        the slice identity labels — a bare {name, namespace} tombstone was
+        silently dropped by the filter, leaking the dead member in slice
+        state forever (the exact leak the tombstone exists to prevent)."""
+        from k8s_watcher_tpu.pipeline.filters import TpuResourceFilter
+        from k8s_watcher_tpu.pipeline.phase import PhaseTracker
+        from k8s_watcher_tpu.pipeline.pipeline import EventPipeline
+        from k8s_watcher_tpu.slices.tracker import SliceTracker
+
+        retry = RetryPolicy(max_attempts=10, delay_seconds=0.05, backoff_multiplier=1.0)
+        source = KubernetesWatchSource(make_client(mock_api), retry=retry, watch_timeout_seconds=2)
+        slices = SliceTracker("development")
+        pipeline = EventPipeline(
+            environment="development", sink=lambda n: None,
+            resource_filter=TpuResourceFilter("google.com/tpu"),
+            phase_tracker=PhaseTracker(), slice_tracker=slices,
+        )
+        processed = []
+        done = threading.Event()
+
+        def pump():
+            for event in source.events():
+                processed.append((event.type, event.name, pipeline.process(event)))
+                if any(t == "DELETED" for t, _, _ in processed):
+                    done.set()
+                    return
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        time.sleep(0.2)
+        pod = build_pod(
+            "train-0", uid="uid-t0", phase="Running", tpu_chips=4,
+            tpu_topology="2x2x2", node_name="nodeA",
+            gke_slice_fields={"jobset.sigs.k8s.io/jobset-name": "train",
+                              "batch.kubernetes.io/job-completion-index": 0},
+            container_statuses=[{"name": "main", "ready": True, "restart_count": 0,
+                                 "state": {"running": {}}}],
+        )
+        mock_api.cluster.add_pod(pod)
+        deadline = time.monotonic() + 5
+        while not slices.states() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert slices.states(), "slice member never tracked"
+
+        # delete + compact: the watcher can only learn via relist tombstone
+        mock_api.cluster.delete_pod("default", "train-0")
+        mock_api.cluster.compact()
+        assert done.wait(10), f"no DELETED observed: {processed}"
+        source.stop()
+        t.join(timeout=5)
+
+        deleted = next(r for ty, _, r in processed if ty == "DELETED")
+        assert deleted.reason != "resource_filter", "tombstone dropped by the accelerator filter"
+        assert slices.states() == {}, "slice member leaked past the tombstone"
+        assert slices._node_refs == {}, "node refcount leaked past the tombstone"
+
+    def test_pre_skeleton_checkpoint_entries_still_tombstone(self, mock_api, tmp_path):
+        # checkpoints written before the skeleton format stored
+        # [name, namespace, phase] lists; they must still produce a
+        # (minimal) tombstone instead of crashing the restore
+        from k8s_watcher_tpu.state.checkpoint import CheckpointStore
+
+        ckpt = CheckpointStore(tmp_path / "ck.json", interval_seconds=0.0)
+        ckpt.put("known_pods", {"uid-old": ["ghost", "default", "Running"]})
+        ckpt.update_resource_version("1")
+        source = KubernetesWatchSource(
+            make_client(mock_api), watch_timeout_seconds=2, checkpoint=ckpt,
+            retry=RetryPolicy(max_attempts=5, delay_seconds=0.05, backoff_multiplier=1.0),
+        )
+        # expire the checkpointed rv: advance the cluster past it, then
+        # compact — the resumed watch 410s and relists, where the restored
+        # entry must tombstone
+        mock_api.cluster.add_pod(build_pod("transient", uid="uid-tr"))
+        mock_api.cluster.delete_pod("default", "transient")
+        mock_api.cluster.compact()
+        got, done, t = self.collect(source, 1)
+        assert done.wait(10)
+        source.stop()
+        assert got[0].type == "DELETED" and got[0].name == "ghost"
+
     def test_bookmarks_advance_resume_version(self, mock_api):
         # a namespace-scoped watch never sees other-namespace events, but the
         # idle-stream BOOKMARK frames must still advance its resume version
