@@ -354,6 +354,11 @@ class TpuConfig:
     # probe plane
     probe_enabled: bool = False
     probe_interval_seconds: float = 30.0
+    # standalone probe agent's own scrape surface (scripts/probe_agent.py):
+    # /metrics (gauges incl. per-cycle medians), /healthz (cycle liveness),
+    # /debug/trend. 0 = off. The watcher's in-process agent shares the
+    # watcher's watcher.status_port server instead.
+    probe_status_port: int = 0
     probe_payload_bytes: int = 4 * 1024 * 1024
     probe_rtt_warn_ms: float = 50.0
     probe_matmul_size: int = 1024
@@ -416,7 +421,7 @@ class TpuConfig:
         _expect(probe, (dict,), "tpu.probe")
         _check_known(
             probe,
-            ("enabled", "interval_seconds", "payload_bytes", "rtt_warn_ms", "matmul_size",
+            ("enabled", "interval_seconds", "status_port", "payload_bytes", "rtt_warn_ms", "matmul_size",
              "hbm_bytes", "hbm_write_enabled", "expected_chips_per_host", "links_enabled",
              "link_rtt_factor", "link_rtt_floor_ms", "multislice_enabled",
              "multislice_slices", "profile_dir", "trend_enabled", "trend_window",
@@ -462,6 +467,7 @@ class TpuConfig:
             accelerator_label=_opt_str(raw, "accelerator_label", "tpu", cls.accelerator_label),
             probe_enabled=_opt_bool(probe, "enabled", "tpu.probe", False),
             probe_interval_seconds=_opt_num(probe, "interval_seconds", "tpu.probe", 30.0),
+            probe_status_port=_opt_int(probe, "status_port", "tpu.probe", 0),
             probe_payload_bytes=_opt_int(probe, "payload_bytes", "tpu.probe", 4 * 1024 * 1024),
             probe_rtt_warn_ms=_opt_num(probe, "rtt_warn_ms", "tpu.probe", 50.0),
             probe_matmul_size=_opt_int(probe, "matmul_size", "tpu.probe", 1024),

@@ -22,20 +22,40 @@ from k8s_watcher_tpu.metrics.metrics import MetricsRegistry
 
 
 class Liveness:
-    """Heartbeat stamped by the watch loop; consulted by /healthz."""
+    """Heartbeat stamped by the watch loop; consulted by /healthz.
 
-    def __init__(self, stale_after_seconds: float = 900.0):
+    ``first_beat_grace_seconds`` widens the staleness threshold until the
+    FIRST beat lands: a probe agent's first cycle pays every jit compile
+    (and on multi-host slices, the mesh-init barrier), so arming the normal
+    threshold at construction would 503 — and crashloop — a healthy agent
+    mid-first-compile, throwing the compile cache away each restart."""
+
+    def __init__(
+        self,
+        stale_after_seconds: float = 900.0,
+        *,
+        first_beat_grace_seconds: Optional[float] = None,
+    ):
         self.stale_after_seconds = stale_after_seconds
+        self.first_beat_grace_seconds = (
+            first_beat_grace_seconds if first_beat_grace_seconds is not None
+            else stale_after_seconds
+        )
         self._last_beat = time.monotonic()
+        self._beaten = False
         self._lock = threading.Lock()
 
     def beat(self) -> None:
         with self._lock:
             self._last_beat = time.monotonic()
+            self._beaten = True
+
+    def _threshold(self) -> float:
+        return self.stale_after_seconds if self._beaten else self.first_beat_grace_seconds
 
     def alive(self) -> bool:
         with self._lock:
-            return time.monotonic() - self._last_beat < self.stale_after_seconds
+            return time.monotonic() - self._last_beat < self._threshold()
 
     def age_seconds(self) -> float:
         with self._lock:

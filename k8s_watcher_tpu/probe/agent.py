@@ -39,12 +39,14 @@ class ProbeAgent:
         metrics: Optional[MetricsRegistry] = None,
         mesh=None,
         expected_platform: Optional[str] = "auto",
+        heartbeat: Optional[Callable[[], None]] = None,  # stamped per completed cycle
     ):
         self.config = tpu_config
         self.environment = environment
         self.sink = sink
         self.metrics = metrics or MetricsRegistry()
         self.mesh = mesh
+        self.heartbeat = heartbeat or (lambda: None)
         # "auto": the configured backend IS the platform contract — a tpu
         # probe finding only CPU devices reports unhealthy, not healthy-CPU.
         # Pass an explicit platform (or None to disable) for test meshes.
@@ -154,6 +156,9 @@ class ProbeAgent:
             self.metrics.histogram("probe_psum_rtt").record(ici.psum_rtt_ms / 1e3)
         if not report.healthy:
             self.metrics.counter("probe_unhealthy").inc()
+        # a completed cycle — healthy or not — proves the agent is alive;
+        # /healthz goes stale when cycles stop (wedged device, hung jit)
+        self.heartbeat()
         return report
 
     # (reading, gauge name, higher_is_better) per sub-probe — the gauges

@@ -43,7 +43,34 @@ def main() -> int:
         workers=1,
     )
     dispatcher.start()
-    agent = ProbeAgent(config.tpu, environment=environment, sink=dispatcher.submit)
+
+    # the agent's own scrape surface (tpu.probe.status_port): per-host
+    # gauges + /debug/trend, and /healthz that goes stale when probe
+    # cycles stop — the DaemonSet's livenessProbe target
+    status_server = None
+    liveness = None
+    if config.tpu.probe_status_port and not once:
+        from k8s_watcher_tpu.metrics.server import Liveness, StatusServer
+
+        liveness = Liveness(
+            stale_after_seconds=max(60.0, 3 * config.tpu.probe_interval_seconds),
+            # the first cycle pays every jit compile (+ the multi-host mesh
+            # barrier); don't report stale mid-first-compile
+            first_beat_grace_seconds=max(900.0, 10 * config.tpu.probe_interval_seconds),
+        )
+
+    agent = ProbeAgent(
+        config.tpu, environment=environment, sink=dispatcher.submit,
+        heartbeat=liveness.beat if liveness is not None else None,
+    )
+    if liveness is not None:
+        status_server = StatusServer(
+            agent.metrics,
+            liveness,
+            port=config.tpu.probe_status_port,
+            trend=agent.trend.snapshot if agent.trend is not None else None,
+        ).start()
+        print(f"probe status endpoint on :{status_server.port} (/metrics, /healthz, /debug/trend)")
 
     if once:
         report = agent.run_once()
@@ -59,6 +86,8 @@ def main() -> int:
             time.sleep(60)
     except KeyboardInterrupt:
         agent.stop()
+        if status_server is not None:
+            status_server.stop()
         dispatcher.stop()
     return 0
 

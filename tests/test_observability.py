@@ -378,6 +378,22 @@ class TestDebugSlicesEndpoint:
             server.stop()
 
 
+class TestLivenessFirstBeatGrace:
+    def test_grace_until_first_beat_then_normal_threshold(self):
+        lv = Liveness(stale_after_seconds=0.05, first_beat_grace_seconds=30.0)
+        time.sleep(0.1)  # past stale_after, inside the grace
+        assert lv.alive(), "pre-first-beat staleness must use the grace window"
+        lv.beat()
+        assert lv.alive()
+        time.sleep(0.1)  # past stale_after, grace no longer applies
+        assert not lv.alive()
+
+    def test_grace_defaults_to_stale_after(self):
+        lv = Liveness(stale_after_seconds=0.05)
+        time.sleep(0.1)
+        assert not lv.alive()
+
+
 class TestDebugTrendEndpoint:
     def test_debug_trend_endpoint(self):
         from k8s_watcher_tpu.probe.trend import TrendTracker

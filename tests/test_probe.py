@@ -125,6 +125,24 @@ class TestProbeAgentAndReport:
         assert payload["mxu"]["ok"]
         assert payload["devices"]["visible_devices"] == 8
 
+    def test_heartbeat_stamped_every_cycle_even_unhealthy(self):
+        # /healthz liveness for the standalone agent: a completed cycle —
+        # healthy or not — proves the loop is alive; only a WEDGED agent
+        # (no cycles) must go stale
+        beats = []
+        agent = self.make_agent(
+            self.make_config(probe_rtt_warn_ms=1e-9),  # every cycle unhealthy
+            heartbeat=lambda: beats.append(1),
+        )
+        assert not agent.run_once().healthy
+        agent.run_once()
+        assert len(beats) == 2
+
+    def test_probe_status_port_config_key(self):
+        cfg = TpuConfig.from_raw({"probe": {"status_port": 8081}})
+        assert cfg.probe_status_port == 8081
+        assert TpuConfig.from_raw({}).probe_status_port == 0
+
     def test_identity_wire_encoding_survives_pathological_values(self):
         from k8s_watcher_tpu.probe.device import _IDENTITY_WIRE_BYTES, _encode_identity_wire
         import json
