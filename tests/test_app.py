@@ -107,6 +107,98 @@ class TestWatcherApp:
         assert set(data["phases"].values()) == {"Running"}
 
 
+class TestRestartResume:
+    """Checkpoint/resume across a REAL restart (SURVEY.md §5 — the
+    reference lost everything on restart): a second app instance sharing
+    the first's checkpoint resumes the watch, re-ADDs without spurious
+    phase-change noise, and still emits DELETED for a pod removed while
+    the watcher was down — even though compaction destroyed the event."""
+
+    def _config(self, tmp_path, server_url):
+        import dataclasses
+        import json as _json
+
+        kc_path = tmp_path / "kubeconfig.json"
+        kc_path.write_text(_json.dumps({
+            "apiVersion": "v1", "kind": "Config",
+            "clusters": [{"name": "m", "cluster": {"server": server_url}}],
+            "contexts": [{"name": "m", "context": {"cluster": "m", "user": "m"}}],
+            "current-context": "m",
+            "users": [{"name": "m", "user": {"token": "t"}}],
+        }))
+        config = dev_config(coalesce=False)
+        return dataclasses.replace(
+            config,
+            kubernetes=dataclasses.replace(
+                config.kubernetes, use_mock=False, config_file=str(kc_path),
+                watch_timeout_seconds=5,
+            ),
+            state=dataclasses.replace(
+                config.state, checkpoint_path=str(tmp_path / "ck.json"),
+                checkpoint_interval_seconds=0.0,
+            ),
+        )
+
+    @staticmethod
+    def _run_app(app):
+        t = threading.Thread(target=app.run, daemon=True)
+        t.start()
+        return t
+
+    def test_restart_resumes_and_tombstones(self, tmp_path):
+        from k8s_watcher_tpu.k8s.mock_server import MockApiServer
+        from k8s_watcher_tpu.watch.fake import build_pod
+
+        with MockApiServer() as server:
+            config = self._config(tmp_path, server.url)
+
+            def tpu_pod(name, uid):
+                return build_pod(
+                    name, uid=uid, phase="Running", tpu_chips=4, tpu_topology="2x2x2",
+                    gke_slice_fields={"jobset.sigs.k8s.io/jobset-name": "train",
+                                      "batch.kubernetes.io/job-completion-index": 0},
+                )
+
+            server.cluster.add_pod(tpu_pod("survivor", "uid-s"))
+            server.cluster.add_pod(tpu_pod("doomed", "uid-d"))
+
+            n1 = RecordingNotifier()
+            app1 = WatcherApp(config, notifier=n1)
+            t1 = self._run_app(app1)
+            deadline = time.monotonic() + 10
+            while len(n1.payloads) < 2 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            app1.stop()
+            t1.join(timeout=10)
+            assert {p["name"] for p in n1.payloads} == {"survivor", "doomed"}
+
+            # while the watcher is down: one pod deleted, history compacted
+            # (the restarted watcher can never see the DELETED event)
+            server.cluster.delete_pod("default", "doomed")
+            server.cluster.compact()
+
+            n2 = RecordingNotifier()
+            app2 = WatcherApp(config, notifier=n2)
+            t2 = self._run_app(app2)
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                with n2.lock:
+                    if any(p["event_type"] == "DELETED" for p in n2.payloads):
+                        break
+                time.sleep(0.05)
+            app2.stop()
+            t2.join(timeout=10)
+
+            with n2.lock:
+                deleted = [p for p in n2.payloads if p["event_type"] == "DELETED"]
+                survivor_payloads = [p for p in n2.payloads if p.get("name") == "survivor"]
+            assert [p["name"] for p in deleted] == ["doomed"], n2.payloads
+            # restored phase state dedupes the relist's re-ADD: ANY survivor
+            # notification on resume is spurious noise (the delta is
+            # Running -> Running, insignificant, dropped)
+            assert not survivor_payloads, survivor_payloads
+
+
 class TestChurnLoad:
     """1 k+ events through the full pipeline with faulty notifier — the
     CPU-scale shape of acceptance config #5."""
