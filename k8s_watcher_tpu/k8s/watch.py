@@ -75,40 +75,68 @@ class KubernetesWatchSource:
             for uid, entry in (checkpoint.get("known_pods") or {}).items():
                 if isinstance(entry, dict):
                     self._known[uid] = entry
-                else:
-                    # pre-skeleton checkpoint format: [name, namespace, phase];
-                    # pad positionally so a truncated entry gets the RIGHT
-                    # defaults for the missing fields
-                    defaults = ["", "default", "Unknown"]
-                    entry = list(entry)[:3]
-                    name, namespace, phase = entry + defaults[len(entry):]
-                    self._known[uid] = {
-                        "metadata": {"name": name, "namespace": namespace, "uid": uid},
-                        "spec": {},
-                        "status": {"phase": phase},
-                    }
+                    continue
+                if not isinstance(entry, (list, tuple)):
+                    # garbage entry (null/number/string from a foreign
+                    # writer — strings would iterate into characters): a
+                    # corrupt checkpoint degrades, never crashes or invents
+                    logger.warning("Discarding malformed known_pods entry for uid %s", uid)
+                    continue
+                # pre-skeleton checkpoint format: [name, namespace, phase];
+                # pad positionally so a truncated entry gets the RIGHT
+                # defaults for the missing fields
+                defaults = ["", "default", "Unknown"]
+                entry = list(entry)[:3]
+                name, namespace, phase = entry + defaults[len(entry):]
+                self._known[uid] = {
+                    "metadata": {"name": name, "namespace": namespace, "uid": uid},
+                    "spec": {},
+                    "status": {"phase": phase},
+                    # no resource spec exists to reconstruct, so the
+                    # eventual tombstone must be flagged past the
+                    # accelerator filter. Stored IN the entry so it
+                    # survives checkpoint round-trips across further
+                    # restarts; unspoofable because _skeleton builds
+                    # entries from fixed keys only — pod content can never
+                    # plant a top-level key here. Cleared naturally when a
+                    # relist replaces the entry with a fresh skeleton.
+                    "legacy_tombstone": True,
+                }
 
-    @staticmethod
-    def _skeleton(pod: dict) -> dict:
+    # annotation values this long are blobs (kubectl's
+    # last-applied-configuration can be the whole manifest) — skeletons
+    # exist for identity, and every tracked pod's skeleton lands in the
+    # checkpoint JSON on each flush, so bound them
+    _SKELETON_ANNOTATION_MAX = 256
+
+    @classmethod
+    def _skeleton(cls, pod: dict) -> dict:
         """The minimal pod that downstream stages treat like the original:
         identity + labels/annotations (slice identity inference), node
-        placement, container resources (accelerator filter), and phase."""
+        placement, container resources (accelerator filter — init
+        containers included, same as the filter itself), and phase."""
         meta = pod.get("metadata") or {}
         spec = pod.get("spec") or {}
         skel_meta = {
-            k: meta[k] for k in ("name", "namespace", "uid", "labels", "annotations")
-            if meta.get(k)
+            k: meta[k] for k in ("name", "namespace", "uid", "labels") if meta.get(k)
         }
+        annotations = {
+            k: v for k, v in (meta.get("annotations") or {}).items()
+            if isinstance(v, str) and len(v) <= cls._SKELETON_ANNOTATION_MAX
+        }
+        if annotations:
+            skel_meta["annotations"] = annotations
         skel_spec: dict = {
             k: spec[k] for k in ("nodeName", "nodeSelector") if spec.get(k)
         }
-        containers = [
-            {"name": c.get("name", ""), "resources": c["resources"]}
-            for c in (spec.get("containers") or [])
-            if c.get("resources")
-        ]
-        if containers:
-            skel_spec["containers"] = containers
+        for field in ("containers", "initContainers"):
+            kept = [
+                {"name": c.get("name", ""), "resources": c["resources"]}
+                for c in (spec.get(field) or [])
+                if c.get("resources")
+            ]
+            if kept:
+                skel_spec[field] = kept
         return {
             "metadata": skel_meta,
             "spec": skel_spec,
@@ -154,12 +182,16 @@ class KubernetesWatchSource:
             yield WatchEvent(type=EventType.ADDED, pod=pod, resource_version=rv)
         for uid in [u for u in self._known if u not in listed_uids]:
             tombstone = self._known.pop(uid)
+            legacy = bool(tombstone.pop("legacy_tombstone", False))
             meta = tombstone.get("metadata") or {}
             logger.info(
                 "Relist: pod %s/%s vanished during disconnect; emitting DELETED",
                 meta.get("namespace", "default"), meta.get("name", ""),
             )
-            yield WatchEvent(type=EventType.DELETED, pod=tombstone, resource_version=rv)
+            yield WatchEvent(
+                type=EventType.DELETED, pod=tombstone, resource_version=rv,
+                legacy_tombstone=legacy,
+            )
         self._save_rv(rv)
 
     def events(self) -> Iterator[WatchEvent]:

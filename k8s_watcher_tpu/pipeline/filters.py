@@ -79,4 +79,10 @@ class TpuResourceFilter:
     def __call__(self, event: WatchEvent) -> bool:
         if not self.enabled:
             return True
-        return pod_accelerator_chips(event.pod, self.resource_key) > 0
+        if pod_accelerator_chips(event.pod, self.resource_key) > 0:
+            return True
+        # legacy-checkpoint tombstones have no resource spec to match;
+        # dropping their DELETED would silently leak the pod in downstream
+        # trackers. The flag is watcher-internal event state — pod content
+        # (e.g. a crafted annotation) cannot spoof a bypass.
+        return event.type == EventType.DELETED and event.legacy_tombstone

@@ -156,8 +156,12 @@ class ProbeAgent:
             self.metrics.histogram("probe_psum_rtt").record(ici.psum_rtt_ms / 1e3)
         if not report.healthy:
             self.metrics.counter("probe_unhealthy").inc()
-        # a completed cycle — healthy or not — proves the agent is alive;
-        # /healthz goes stale when cycles stop (wedged device, hung jit)
+        # a COMPLETED cycle — healthy or not — proves the agent is alive;
+        # /healthz goes stale when cycles stop (wedged device, hung jit).
+        # Deliberately NOT stamped at cycle start or on a raised cycle: a
+        # crash-looping or mid-cycle-hung probe must read as dead. The
+        # steady-state threshold must therefore bound cycle_duration +
+        # interval (scripts/probe_agent.py sizes it accordingly).
         self.heartbeat()
         return report
 

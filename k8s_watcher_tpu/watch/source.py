@@ -43,6 +43,11 @@ class WatchEvent:
     resource_version: Optional[str] = None
     received_monotonic: float = dataclasses.field(default_factory=time.monotonic)
     received_at: float = dataclasses.field(default_factory=time.time)
+    # watcher-INTERNAL flag (never derived from pod content, so a pod
+    # cannot spoof it): this DELETED was synthesized from a pre-skeleton
+    # checkpoint entry that carries no resource spec, and the accelerator
+    # filter must pass it rather than silently leak the deletion
+    legacy_tombstone: bool = False
 
     @property
     def name(self) -> str:

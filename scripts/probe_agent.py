@@ -52,8 +52,12 @@ def main() -> int:
     if config.tpu.probe_status_port and not once:
         from k8s_watcher_tpu.metrics.server import Liveness, StatusServer
 
+        # beats land at cycle END only (a crash-looping or mid-cycle-hung
+        # probe must read as dead), so the steady-state inter-beat gap is
+        # cycle_duration + interval; the threshold leaves room for cycles
+        # several intervals long (large-slice walks with tracing on)
         liveness = Liveness(
-            stale_after_seconds=max(60.0, 3 * config.tpu.probe_interval_seconds),
+            stale_after_seconds=max(300.0, 5 * config.tpu.probe_interval_seconds),
             # the first cycle pays every jit compile (+ the multi-host mesh
             # barrier); don't report stale mid-first-compile
             first_beat_grace_seconds=max(900.0, 10 * config.tpu.probe_interval_seconds),

@@ -588,6 +588,98 @@ class TestKubernetesWatchSource:
         assert done.wait(10)
         source.stop()
         assert got[0].type == "DELETED" and got[0].name == "ghost"
+        # a legacy entry carries no resource spec — the watcher-internal
+        # event flag must carry its DELETED past the accelerator filter
+        from k8s_watcher_tpu.pipeline.filters import TpuResourceFilter
+
+        assert got[0].legacy_tombstone
+        assert TpuResourceFilter("google.com/tpu")(got[0])
+
+    def test_legacy_marker_survives_checkpoint_roundtrip(self, mock_api, tmp_path):
+        # the migrated entry may be re-persisted (app checkpoints
+        # known_pods) and the process restarted BEFORE any relist runs;
+        # the marker must survive the round-trip or the eventual tombstone
+        # is silently dropped by the accelerator filter
+        from k8s_watcher_tpu.pipeline.filters import TpuResourceFilter
+        from k8s_watcher_tpu.state.checkpoint import CheckpointStore
+
+        ckpt = CheckpointStore(tmp_path / "ck.json", interval_seconds=0.0)
+        ckpt.put("known_pods", {"uid-old": ["ghost", "default", "Running"]})
+        first = KubernetesWatchSource(make_client(mock_api), checkpoint=ckpt)
+        ckpt.put("known_pods", first.known_pods())  # app-style re-persist
+        ckpt.update_resource_version("1")
+
+        source = KubernetesWatchSource(
+            make_client(mock_api), watch_timeout_seconds=2, checkpoint=ckpt,
+            retry=RetryPolicy(max_attempts=5, delay_seconds=0.05, backoff_multiplier=1.0),
+        )
+        mock_api.cluster.add_pod(build_pod("transient", uid="uid-tr"))
+        mock_api.cluster.delete_pod("default", "transient")
+        mock_api.cluster.compact()
+        got, done, t = self.collect(source, 1)
+        assert done.wait(10)
+        source.stop()
+        assert got[0].type == "DELETED" and got[0].name == "ghost"
+        assert got[0].legacy_tombstone
+        assert TpuResourceFilter("google.com/tpu")(got[0])
+
+    def test_malformed_legacy_entries_discarded_not_invented(self, mock_api, tmp_path):
+        # null/number/STRING entries (strings iterate into characters!)
+        # must be discarded, not turned into garbage tombstones
+        from k8s_watcher_tpu.state.checkpoint import CheckpointStore
+
+        ckpt = CheckpointStore(tmp_path / "ck.json", interval_seconds=0.0)
+        ckpt.put("known_pods", {"u1": None, "u2": 7, "u3": "my-pod"})
+        source = KubernetesWatchSource(make_client(mock_api), checkpoint=ckpt)
+        assert source.known_pods() == {}
+
+    def test_spoofed_tombstone_annotation_does_not_bypass_filter(self):
+        # the legacy bypass keys on watcher-INTERNAL event state; a pod
+        # carrying a lookalike annotation must still be filtered
+        from k8s_watcher_tpu.pipeline.filters import TpuResourceFilter
+        from k8s_watcher_tpu.watch.source import EventType, WatchEvent
+
+        pod = {
+            "metadata": {"name": "sneaky", "namespace": "default", "uid": "u9",
+                         "annotations": {"k8s-watcher-tpu/tombstone": "legacy"}},
+            "spec": {"containers": [{"name": "c", "image": "i"}]},
+            "status": {"phase": "Running"},
+        }
+        f = TpuResourceFilter("google.com/tpu")
+        assert not f(WatchEvent(type=EventType.DELETED, pod=pod))
+        assert not f(WatchEvent(type=EventType.ADDED, pod=pod))
+
+    def test_skeleton_keeps_init_container_resources_and_bounds_annotations(self):
+        # the accelerator filter matches initContainers too; a tombstone
+        # skeleton that dropped them would leak init-container-only TPU
+        # pods. Manifest-sized annotation blobs stay out of the checkpoint.
+        from k8s_watcher_tpu.pipeline.filters import TpuResourceFilter
+        from k8s_watcher_tpu.watch.source import EventType, WatchEvent
+
+        pod = {
+            "metadata": {
+                "name": "init-tpu", "namespace": "default", "uid": "u1",
+                "annotations": {
+                    "batch.kubernetes.io/job-completion-index": "0",
+                    "kubectl.kubernetes.io/last-applied-configuration": "x" * 10_000,
+                },
+            },
+            "spec": {
+                "containers": [{"name": "main", "image": "i"}],
+                "initContainers": [{
+                    "name": "init",
+                    "resources": {"requests": {"google.com/tpu": "4"}},
+                }],
+            },
+            "status": {"phase": "Running"},
+        }
+        skel = KubernetesWatchSource._skeleton(pod)
+        assert TpuResourceFilter("google.com/tpu")(
+            WatchEvent(type=EventType.DELETED, pod=skel)
+        ), "init-container TPU request lost in the skeleton"
+        annotations = skel["metadata"]["annotations"]
+        assert "batch.kubernetes.io/job-completion-index" in annotations
+        assert "kubectl.kubernetes.io/last-applied-configuration" not in annotations
 
     def test_bookmarks_advance_resume_version(self, mock_api):
         # a namespace-scoped watch never sees other-namespace events, but the

@@ -136,6 +136,8 @@ class TestProbeAgentAndReport:
         )
         assert not agent.run_once().healthy
         agent.run_once()
+        # one beat per COMPLETED cycle, at the end — a crash-looping or
+        # mid-cycle-hung probe must accumulate zero beats and go stale
         assert len(beats) == 2
 
     def test_probe_status_port_config_key(self):
