@@ -153,6 +153,7 @@ class WatcherApp:
         self._stop = threading.Event()
         self.elector = None  # k8s.leader.LeaderElector when HA is enabled
         self.node_watcher = None  # nodes.NodeWatcher when tpu.node_watch is on
+        self.remediation = None  # remediate.ProbeRemediationPolicy when armed
         self._probe_agent = None
         if config.tpu.probe_enabled:
             from k8s_watcher_tpu.probe.agent import ProbeAgent
@@ -173,6 +174,13 @@ class WatcherApp:
                 if self._probe_agent is not None and self._probe_agent.trend is not None
                 else None
             )
+            remediation_state = (
+                # the policy arms post-campaign; the route answers "not
+                # armed yet" until then instead of 404ing on a standby
+                (lambda: self.remediation.snapshot() if self.remediation is not None else None)
+                if self.config.tpu.remediation_enabled
+                else None
+            )
             self.status_server = StatusServer(
                 self.metrics,
                 self.liveness,
@@ -180,10 +188,13 @@ class WatcherApp:
                 audit=self.audit,
                 slices=self.slice_tracker.debug_snapshot,
                 trend=agent_trend,
+                remediation=remediation_state,
             ).start()
             routes = "/metrics, /healthz, /debug/slices" + (
                 ", /debug/events" if self.audit is not None else ""
-            ) + (", /debug/trend" if agent_trend is not None else "")
+            ) + (", /debug/trend" if agent_trend is not None else "") + (
+                ", /debug/remediation" if remediation_state is not None else ""
+            )
             logger.info("Status endpoint on :%d (%s)", self.status_server.port, routes)
         if self.config.watcher.leader_election.enabled:
             self._campaign()  # blocks until this replica leads (or stop())
@@ -199,6 +210,7 @@ class WatcherApp:
         logger.info(
             "Monitoring %s", f"namespaces: {list(namespaces)}" if namespaces else "all namespaces"
         )
+        self._start_remediation()
         if self._probe_agent is not None:
             self._probe_agent.start()
         self._start_node_watch()
@@ -253,6 +265,58 @@ class WatcherApp:
             self.liveness.beat()  # a healthy standby is alive, just not leading
             if self.elector.wait_for_leadership(timeout=1.0):
                 return
+
+    def _start_remediation(self) -> None:
+        """Wire the remediation plane (tpu.remediation.enabled): the probe
+        agent's reports feed a confirmation policy which may quarantine
+        (cordon + taint) implicated nodes through a dedicated k8s client.
+        Leader-gated — run() reaches here post-campaign, so N standby
+        replicas never multiply the actuator's safety fences by N."""
+        if not self.config.tpu.remediation_enabled:
+            return
+        if self._probe_agent is None:
+            logger.warning("tpu.remediation enabled but tpu.probe is not; nothing to act on — skipping")
+            return
+        client = getattr(self.source, "client", None)
+        if client is None:
+            logger.warning("tpu.remediation enabled but the watch source has no k8s client (mock/fake source); skipping")
+            return
+        import time as _time
+
+        from k8s_watcher_tpu.k8s.client import K8sClient
+        from k8s_watcher_tpu.pipeline.pipeline import Notification
+        from k8s_watcher_tpu.remediate import NodeActuator, ProbeRemediationPolicy
+
+        t = self.config.tpu
+        actuator = NodeActuator(
+            # dedicated client: node PATCHes must not contend with the
+            # watch stream (one client carries at most one live watch)
+            K8sClient(client.connection, request_timeout=self.config.kubernetes.request_timeout),
+            dry_run=t.remediation_dry_run,
+            cordon=t.remediation_cordon,
+            taint_key=t.remediation_taint_key,
+            taint_value=t.remediation_taint_value,
+            taint_effect=t.remediation_taint_effect,
+            cooldown_seconds=t.remediation_cooldown_seconds,
+            max_actions_per_hour=t.remediation_max_actions_per_hour,
+            max_quarantined_nodes=t.remediation_max_quarantined_nodes,
+            metrics=self.metrics,
+        )
+        self.remediation = ProbeRemediationPolicy(
+            actuator,
+            confirm_cycles=t.remediation_confirm_cycles,
+            sink=lambda payload: self.dispatcher.submit(
+                Notification(payload, _time.monotonic(), kind="remediation")
+            ),
+            metrics=self.metrics,
+            environment=self.config.environment,
+        )
+        self._probe_agent.report_observer = self.remediation.observe_report
+        logger.info(
+            "Remediation plane armed (dry_run=%s, confirm_cycles=%d, budget=%d nodes, taint %s=%s:%s)",
+            t.remediation_dry_run, t.remediation_confirm_cycles, t.remediation_max_quarantined_nodes,
+            t.remediation_taint_key, t.remediation_taint_value, t.remediation_taint_effect,
+        )
 
     def _start_node_watch(self) -> None:
         """Start the node-plane watch (tpu.node_watch.enabled): a second

@@ -42,6 +42,12 @@ class SchemaError(ValueError):
     """A config value failed schema validation."""
 
 
+# The single home of the accepted taint effects — the schema validates
+# config against it and remediate.NodeActuator validates its argument
+# against it (schema is the dependency-light layer, so it lives here).
+VALID_TAINT_EFFECTS = ("NoSchedule", "PreferNoSchedule", "NoExecute")
+
+
 def _type_name(value: Any) -> str:
     return type(value).__name__
 
@@ -394,6 +400,20 @@ class TpuConfig:
     # immediately (pod eviction lags the node drop by minutes)
     node_watch_enabled: bool = False
     node_watch_label_selector: Optional[str] = None
+    # remediation plane (remediate/): quarantine (cordon + taint) nodes the
+    # probe implicates across confirm_cycles consecutive cycles. dry_run
+    # stays the default — flip it only after watching the dry-run decisions
+    # in production for a while (RUNBOOK.md "Remediation").
+    remediation_enabled: bool = False
+    remediation_dry_run: bool = True
+    remediation_cordon: bool = True
+    remediation_taint_key: str = "k8s-watcher-tpu/ici-fault"
+    remediation_taint_value: str = "suspect"
+    remediation_taint_effect: str = "NoSchedule"
+    remediation_confirm_cycles: int = 3
+    remediation_cooldown_seconds: float = 3600.0
+    remediation_max_actions_per_hour: int = 4
+    remediation_max_quarantined_nodes: int = 2
 
     @classmethod
     def from_raw(cls, raw: Mapping[str, Any]) -> "TpuConfig":
@@ -407,6 +427,7 @@ class TpuConfig:
                 "accelerator_label",
                 "probe",
                 "node_watch",
+                "remediation",
             ),
             "tpu",
         )
@@ -417,6 +438,36 @@ class TpuConfig:
         node_watch = raw.get("node_watch") or {}
         _expect(node_watch, (dict,), "tpu.node_watch")
         _check_known(node_watch, ("enabled", "label_selector"), "tpu.node_watch")
+        remediation = raw.get("remediation") or {}
+        _expect(remediation, (dict,), "tpu.remediation")
+        _check_known(
+            remediation,
+            ("enabled", "dry_run", "cordon", "taint_key", "taint_value", "taint_effect",
+             "confirm_cycles", "cooldown_seconds", "max_actions_per_hour",
+             "max_quarantined_nodes"),
+            "tpu.remediation",
+        )
+        taint_effect = _opt_str(remediation, "taint_effect", "tpu.remediation", "NoSchedule")
+        if taint_effect not in VALID_TAINT_EFFECTS:
+            raise SchemaError(
+                f"config key 'tpu.remediation.taint_effect': must be one of "
+                f"{', '.join(VALID_TAINT_EFFECTS)}, got {taint_effect!r}"
+            )
+        remediation_confirm = _opt_int(remediation, "confirm_cycles", "tpu.remediation", 3)
+        if remediation_confirm < 1:
+            raise SchemaError("config key 'tpu.remediation.confirm_cycles': must be >= 1")
+        remediation_budget = _opt_int(remediation, "max_quarantined_nodes", "tpu.remediation", 2)
+        if remediation_budget < 1:
+            raise SchemaError("config key 'tpu.remediation.max_quarantined_nodes': must be >= 1")
+        remediation_rate = _opt_int(remediation, "max_actions_per_hour", "tpu.remediation", 4)
+        if remediation_rate < 1:
+            raise SchemaError("config key 'tpu.remediation.max_actions_per_hour': must be >= 1")
+        remediation_cooldown = _opt_num(remediation, "cooldown_seconds", "tpu.remediation", 3600.0)
+        if remediation_cooldown < 0:
+            raise SchemaError(
+                "config key 'tpu.remediation.cooldown_seconds': must be >= 0 "
+                "(a negative value would silently disable the cooldown fence)"
+            )
         probe = raw.get("probe") or {}
         _expect(probe, (dict,), "tpu.probe")
         _check_known(
@@ -488,6 +539,16 @@ class TpuConfig:
             probe_profile_dir=_opt_str(probe, "profile_dir", "tpu.probe", None),
             node_watch_enabled=_opt_bool(node_watch, "enabled", "tpu.node_watch", False),
             node_watch_label_selector=_opt_str(node_watch, "label_selector", "tpu.node_watch", None),
+            remediation_enabled=_opt_bool(remediation, "enabled", "tpu.remediation", False),
+            remediation_dry_run=_opt_bool(remediation, "dry_run", "tpu.remediation", True),
+            remediation_cordon=_opt_bool(remediation, "cordon", "tpu.remediation", True),
+            remediation_taint_key=_opt_str(remediation, "taint_key", "tpu.remediation", cls.remediation_taint_key),
+            remediation_taint_value=_opt_str(remediation, "taint_value", "tpu.remediation", cls.remediation_taint_value),
+            remediation_taint_effect=taint_effect,
+            remediation_confirm_cycles=remediation_confirm,
+            remediation_cooldown_seconds=remediation_cooldown,
+            remediation_max_actions_per_hour=remediation_rate,
+            remediation_max_quarantined_nodes=remediation_budget,
         )
 
 

@@ -224,6 +224,23 @@ class K8sClient:
             params["labelSelector"] = label_selector
         return self._get("/api/v1/nodes", params).json()
 
+    def get_node(self, name: str) -> Dict[str, Any]:
+        """One Node object (raises K8sNotFoundError if absent)."""
+        return self._get(f"/api/v1/nodes/{name}").json()
+
+    def patch_node(self, name: str, patch: Dict[str, Any]) -> Dict[str, Any]:
+        """JSON merge-patch (RFC 7386) a Node — the write the remediation
+        plane uses to cordon (``spec.unschedulable``) and taint
+        (``spec.taints``) a suspect node. Merge-patch replaces lists
+        wholesale, so taint edits are read-modify-write on the caller side
+        (the same contract ``kubectl taint`` uses)."""
+        return self._request(
+            "PATCH",
+            f"/api/v1/nodes/{name}",
+            json_body=patch,
+            headers={"Content-Type": "application/merge-patch+json"},
+        ).json()
+
     # -- write surface (integration/chaos tooling) -------------------------
     # The watcher itself is read-only; these drive REAL create/delete churn
     # through the watch->pipeline path in the acceptance write tier

@@ -208,6 +208,36 @@ class MockCluster:
                 })
         return self._record("MODIFIED", node, collection="nodes")
 
+    def get_node(self, name: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            node = self._nodes.get(name)
+            return json.loads(json.dumps(node)) if node else None
+
+    @staticmethod
+    def _merge_patch(target: Dict[str, Any], patch: Dict[str, Any]) -> Dict[str, Any]:
+        """RFC 7386 JSON merge patch: dicts merge recursively, ``null``
+        deletes a key, everything else (including lists) replaces."""
+        for key, value in patch.items():
+            if value is None:
+                target.pop(key, None)
+            elif isinstance(value, dict) and isinstance(target.get(key), dict):
+                MockCluster._merge_patch(target[key], value)
+            else:
+                target[key] = json.loads(json.dumps(value))
+        return target
+
+    def patch_node(self, name: str, patch: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+        """(status, body) for ``PATCH /api/v1/nodes/{name}`` with
+        merge-patch semantics; journals a MODIFIED node event, so the
+        node-plane watch observes cordons the remediation plane applies."""
+        with self._lock:
+            node = self._nodes.get(name)
+            if node is None:
+                return 404, {"kind": "Status", "code": 404, "message": f"nodes \"{name}\" not found"}
+            self._merge_patch(node, patch)
+            self.modify_node(node)
+            return 200, json.loads(json.dumps(node))
+
     def list_nodes(self, label_selector: Optional[str] = None) -> Dict[str, Any]:
         selector = _parse_label_selector(label_selector)
         with self._lock:
@@ -411,6 +441,14 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._json(200, self.cluster.list_nodes(params.get("labelSelector")))
             return
+        if path.startswith("/api/v1/nodes/"):
+            name = path[len("/api/v1/nodes/"):]
+            node = self.cluster.get_node(name)
+            if node is None:
+                self._json(404, {"kind": "Status", "code": 404, "message": f"nodes \"{name}\" not found"})
+            else:
+                self._json(200, node)
+            return
 
         namespace: Optional[str] = None
         if path == "/api/v1/pods":
@@ -479,6 +517,21 @@ class _Handler(BaseHTTPRequestHandler):
         # /api/v1/namespaces/{name}
         if len(parts) == 4 and parts[:3] == ["api", "v1", "namespaces"]:
             status, out = self.cluster.delete_namespace(parts[3])
+            self._json(status, out)
+            return
+        self._json(404, {"kind": "Status", "code": 404, "message": f"no route {self.path}"})
+
+    def do_PATCH(self):  # noqa: N802 (stdlib naming)
+        body = self._read_body()
+        if body is None:
+            return
+        fail = self.cluster.consume_failure()
+        if fail:
+            self._json(fail, {"kind": "Status", "code": fail, "message": "injected failure"})
+            return
+        path = urlparse(self.path).path
+        if path.startswith("/api/v1/nodes/"):
+            status, out = self.cluster.patch_node(path[len("/api/v1/nodes/"):], body)
             self._json(status, out)
             return
         self._json(404, {"kind": "Status", "code": 404, "message": f"no route {self.path}"})

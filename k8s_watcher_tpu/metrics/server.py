@@ -70,6 +70,10 @@ class _StatusHandler(BaseHTTPRequestHandler):
     audit = None  # metrics.audit.AuditRing, optional
     slices = None  # Callable[[], dict]: live slice states, optional
     trend = None  # Callable[[], dict]: probe trend anchors/windows, optional
+    # Callable[[], Optional[dict]]: remediation policy state; the callable
+    # may return None while the plane is configured but not yet armed
+    # (standby replica pre-campaign)
+    remediation = None
 
     def log_message(self, *a):
         pass
@@ -133,6 +137,15 @@ class _StatusHandler(BaseHTTPRequestHandler):
                 self._json(404, {"error": "trend tracking not wired (tpu.probe.trend_enabled)"})
                 return
             self._json(200, {"trend": self.trend()})
+        elif parsed.path == "/debug/remediation":
+            if self.remediation is None:
+                self._json(404, {"error": "remediation not wired (tpu.remediation.enabled)"})
+                return
+            state = self.remediation()
+            if state is None:
+                self._json(200, {"remediation": None, "note": "configured but not armed (not leading yet)"})
+                return
+            self._json(200, {"remediation": state})
         else:
             self._json(404, {"error": f"no route {self.path}"})
 
@@ -148,6 +161,7 @@ class StatusServer:
         audit=None,  # metrics.audit.AuditRing -> serves /debug/events
         slices=None,  # Callable[[], dict] -> serves /debug/slices
         trend=None,  # Callable[[], dict] -> serves /debug/trend
+        remediation=None,  # Callable[[], Optional[dict]] -> /debug/remediation
     ):
         handler = type(
             "BoundStatusHandler",
@@ -158,6 +172,7 @@ class StatusServer:
                 "audit": audit,
                 "slices": staticmethod(slices) if slices else None,
                 "trend": staticmethod(trend) if trend else None,
+                "remediation": staticmethod(remediation) if remediation else None,
             },
         )
         self._server = ThreadingHTTPServer((host, port), handler)

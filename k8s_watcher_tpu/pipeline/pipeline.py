@@ -33,7 +33,7 @@ class Notification:
 
     payload: Dict[str, Any]
     received_monotonic: float
-    kind: str = "pod"  # "pod" | "slice" | "probe"
+    kind: str = "pod"  # "pod" | "slice" | "probe" | "remediation"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -14,7 +14,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 import jax
 
@@ -53,6 +53,9 @@ class ProbeAgent:
         self.expected_platform = tpu_config.backend if expected_platform == "auto" else expected_platform
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # optional per-report observer (remediate.ProbeRemediationPolicy):
+        # sees every completed report, healthy or not, on the agent thread
+        self.report_observer: Optional[Callable[..., Any]] = None
         self.trend: Optional[TrendTracker] = None
         if tpu_config.probe_trend_enabled:
             self.trend = TrendTracker(
@@ -163,6 +166,13 @@ class ProbeAgent:
         # steady-state threshold must therefore bound cycle_duration +
         # interval (scripts/probe_agent.py sizes it accordingly).
         self.heartbeat()
+        observer = self.report_observer
+        if observer is not None:
+            try:
+                observer(report)
+            except Exception as exc:  # noqa: BLE001 — policy bugs must not kill probing
+                logger.error("Probe report observer failed: %s", exc)
+                self.metrics.counter("probe_observer_errors").inc()
         return report
 
     # (reading, gauge name, higher_is_better) per sub-probe — the gauges

@@ -1,0 +1,8 @@
+"""Remediation plane: act on confirmed probe findings (net-new vs the
+reference, whose notify path was read-only and disabled —
+clusterapi_client.py via SURVEY.md §2.8)."""
+
+from k8s_watcher_tpu.remediate.actuator import ActionRecord, NodeActuator
+from k8s_watcher_tpu.remediate.policy import ProbeRemediationPolicy
+
+__all__ = ["ActionRecord", "NodeActuator", "ProbeRemediationPolicy"]

@@ -1,0 +1,336 @@
+"""Node actuator: the write half of the remediation plane.
+
+The probe plane *detects* faults and maps them to nodes (probe/links.py
+suspect triangulation + probe/device.py host identity); the RUNBOOK tells a
+human to drain. This module closes that loop for the cases that are safe to
+automate: **quarantine** a suspect node by cordoning it
+(``spec.unschedulable``) and applying a NoSchedule taint, so the scheduler
+stops placing new TPU workloads there while the operator investigates. It
+deliberately does NOT evict running pods (no NoExecute by default, no drain)
+— killing a live training job is a human decision.
+
+Every destructive capability is fenced:
+
+- **dry-run by default**: the actuator logs, audits, and notifies exactly
+  what it would do, without touching the cluster — the recommended first
+  deployment mode, and what ``config/production.yaml`` ships with;
+- **per-node cooldown**: one action per node per ``cooldown_seconds``;
+- **global rate limit**: at most ``max_actions_per_hour`` real actions in
+  any sliding hour, counting both cordons and releases;
+- **quarantine budget**: never more than ``max_quarantined_nodes``
+  simultaneously quarantined BY US — a policy bug (or a fabric-wide event
+  that makes every node look suspect) must not cordon a whole pool. Nodes
+  found already carrying our taint (e.g. applied before a watcher restart)
+  count against the budget.
+
+The reference has no counterpart (its notify path was read-only and
+disabled, SURVEY.md §2.8); this is net-new TPU-ops capability.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import threading
+import time
+from typing import Any, Deque, Dict, List, Optional
+
+from k8s_watcher_tpu.config.schema import VALID_TAINT_EFFECTS
+from k8s_watcher_tpu.k8s.client import K8sApiError, K8sNotFoundError
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class ActionRecord:
+    """One quarantine/release decision — applied, simulated, or refused."""
+
+    node: str
+    action: str  # "quarantine" | "release"
+    ok: bool  # the action was applied (or would be, in dry-run)
+    dry_run: bool
+    reason: str  # why the policy asked for it / why the actuator refused
+    applied: bool = False  # a real PATCH landed on the apiserver
+    error: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+class NodeActuator:
+    """Cordon + taint suspect nodes, inside hard safety fences."""
+
+    def __init__(
+        self,
+        client,
+        *,
+        dry_run: bool = True,
+        cordon: bool = True,
+        taint_key: str = "k8s-watcher-tpu/ici-fault",
+        taint_value: str = "suspect",
+        taint_effect: str = "NoSchedule",
+        cooldown_seconds: float = 3600.0,
+        max_actions_per_hour: int = 4,
+        max_quarantined_nodes: int = 2,
+        metrics=None,
+        clock=time.monotonic,
+    ):
+        if taint_effect not in VALID_TAINT_EFFECTS:
+            raise ValueError(f"taint_effect must be one of {VALID_TAINT_EFFECTS}, got {taint_effect!r}")
+        self.client = client
+        self.dry_run = dry_run
+        self.cordon = cordon
+        self.taint_key = taint_key
+        self.taint_value = taint_value
+        self.taint_effect = taint_effect
+        self.cooldown_seconds = cooldown_seconds
+        self.max_actions_per_hour = max_actions_per_hour
+        self.max_quarantined_nodes = max_quarantined_nodes
+        self.metrics = metrics
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._last_action: Dict[str, float] = {}  # node -> last action ts
+        self._action_times: Deque[float] = collections.deque()
+        self._quarantined: set = set()  # nodes quarantined by us (this process)
+
+    # -- fences ------------------------------------------------------------
+
+    def _refuse(self, node: str, action: str, reason: str) -> ActionRecord:
+        logger.warning("Remediation refused for node %s (%s): %s", node, action, reason)
+        if self.metrics is not None:
+            self.metrics.counter("remediation_refusals").inc()
+        return ActionRecord(node=node, action=action, ok=False, dry_run=self.dry_run, reason=reason)
+
+    def _reconcile_quarantined_locked(self) -> None:
+        """Drop budget entries that no longer hold, so the budget reflects
+        reality rather than this process's memory. Called (lock held) only
+        when the budget is about to refuse — the slow path.
+
+        Real mode: an operator releasing a node out-of-band
+        (``remediate_ctl.py release``, or plain ``kubectl uncordon`` +
+        ``kubectl taint ... -``) removes our taint on the apiserver; a GET
+        per remembered node notices and frees the slot — otherwise external
+        releases would never free budget and the actuator would refuse
+        forever after ``max_quarantined_nodes`` lifetime quarantines.
+
+        Dry-run mode: nothing was ever written, so there is no cluster
+        state to consult; decisions age out after ``cooldown_seconds`` so a
+        week of review-mode traffic keeps showing fresh would-quarantine
+        decisions instead of degenerating into budget refusals.
+        """
+        if self.dry_run:
+            now = self._clock()
+            expired = {
+                n for n in self._quarantined
+                if now - self._last_action.get(n, now) >= self.cooldown_seconds
+            }
+        else:
+            expired = set()
+            for n in list(self._quarantined):
+                try:
+                    spec = (self.client.get_node(n) or {}).get("spec") or {}
+                except K8sNotFoundError:
+                    expired.add(n)  # the node itself is gone
+                    continue
+                except K8sApiError:
+                    continue  # can't verify: keep the conservative entry
+                if not any(t.get("key") == self.taint_key for t in spec.get("taints") or []):
+                    expired.add(n)
+        if expired:
+            logger.info("Remediation budget reconciled: %s no longer quarantined", sorted(expired))
+            self._quarantined -= expired
+
+    def _fence_check(self, node: str, action: str) -> Optional[str]:
+        """The refusal reason, or None when the action may proceed.
+        Call with the lock held."""
+        now = self._clock()
+        last = self._last_action.get(node)
+        if last is not None and now - last < self.cooldown_seconds:
+            return (
+                f"cooldown: last action on {node} was {now - last:.0f}s ago "
+                f"(cooldown {self.cooldown_seconds:.0f}s)"
+            )
+        while self._action_times and self._action_times[0] <= now - 3600.0:
+            self._action_times.popleft()
+        if len(self._action_times) >= self.max_actions_per_hour:
+            return f"rate limit: {len(self._action_times)} actions in the last hour (max {self.max_actions_per_hour})"
+        if action == "quarantine" and node not in self._quarantined and len(self._quarantined) >= self.max_quarantined_nodes:
+            self._reconcile_quarantined_locked()
+        if action == "quarantine" and node not in self._quarantined and len(self._quarantined) >= self.max_quarantined_nodes:
+            return (
+                f"quarantine budget exhausted: {sorted(self._quarantined)} already "
+                f"quarantined (max {self.max_quarantined_nodes}) — a fleet-wide "
+                "signal needs a human, not more cordons"
+            )
+        return None
+
+    def _consume(self, node: str) -> None:
+        """Record an allowed action against the fences (lock held)."""
+        now = self._clock()
+        self._last_action[node] = now
+        self._action_times.append(now)
+
+    # -- actions -----------------------------------------------------------
+
+    def _our_taint(self) -> Dict[str, str]:
+        return {"key": self.taint_key, "value": self.taint_value, "effect": self.taint_effect}
+
+    def quarantine(self, node: str, reason: str) -> ActionRecord:
+        """Cordon + taint ``node``; returns what happened and why.
+
+        Idempotent: a node already carrying our taint (and cordoned, when
+        cordoning is on) reports ok without a write — and is adopted into
+        the budget set, so pre-restart quarantines still count against
+        ``max_quarantined_nodes``.
+        """
+        with self._lock:
+            refusal = self._fence_check(node, "quarantine")
+            if refusal:
+                return self._refuse(node, "quarantine", refusal)
+            # consume fences inside the lock; the PATCH itself runs outside
+            # (a slow apiserver must not serialize every other decision)
+            prior_last_action = self._last_action.get(node)
+            self._consume(node)
+            self._quarantined.add(node)
+        record = self._apply_quarantine(node, reason)
+        with self._lock:
+            if not record.ok:
+                # a transient GET/PATCH failure must not burn the fences: a
+                # consumed cooldown would lock a CONFIRMED-faulty node out
+                # of quarantine for cooldown_seconds over an apiserver blip
+                self._quarantined.discard(node)
+                if prior_last_action is None:
+                    self._last_action.pop(node, None)
+                else:
+                    self._last_action[node] = prior_last_action
+                if self._action_times:
+                    self._action_times.pop()
+            elif record.reason.startswith("already quarantined"):
+                # adoption wrote nothing: refund the hourly rate slot so
+                # no-op confirmations can't starve real actions (the
+                # per-node cooldown stays consumed — it is what stops the
+                # policy re-GETting the node every probe cycle)
+                if self._action_times:
+                    self._action_times.pop()
+            n_quarantined = len(self._quarantined)
+        if self.metrics is not None and record.ok:
+            self.metrics.counter("remediation_actions").inc()
+            self.metrics.gauge("remediation_quarantined_nodes").set(n_quarantined)
+        return record
+
+    def _apply_quarantine(self, node: str, reason: str) -> ActionRecord:
+        try:
+            current = self.client.get_node(node)
+        except K8sNotFoundError:
+            return ActionRecord(
+                node=node, action="quarantine", ok=False, dry_run=self.dry_run,
+                reason=reason, error=f"node {node} not found",
+            )
+        except K8sApiError as exc:
+            return ActionRecord(
+                node=node, action="quarantine", ok=False, dry_run=self.dry_run,
+                reason=reason, error=f"get_node failed: {exc}",
+            )
+        spec = current.get("spec") or {}
+        taints: List[Dict[str, Any]] = list(spec.get("taints") or [])
+        have_taint = any(t.get("key") == self.taint_key for t in taints)
+        cordoned = bool(spec.get("unschedulable"))
+        if have_taint and (cordoned or not self.cordon):
+            logger.info("Node %s already quarantined (adopting): %s", node, reason)
+            return ActionRecord(
+                node=node, action="quarantine", ok=True, dry_run=self.dry_run,
+                reason=f"already quarantined; {reason}",
+            )
+        if not have_taint:
+            taints.append(self._our_taint())
+        patch: Dict[str, Any] = {"spec": {"taints": taints}}
+        if self.cordon:
+            patch["spec"]["unschedulable"] = True
+        if self.dry_run:
+            logger.warning(
+                "[DRY-RUN] would quarantine node %s (cordon=%s, taint %s=%s:%s): %s",
+                node, self.cordon, self.taint_key, self.taint_value, self.taint_effect, reason,
+            )
+            return ActionRecord(node=node, action="quarantine", ok=True, dry_run=True, reason=reason)
+        try:
+            self.client.patch_node(node, patch)
+        except K8sApiError as exc:
+            return ActionRecord(
+                node=node, action="quarantine", ok=False, dry_run=False,
+                reason=reason, error=f"patch_node failed: {exc}",
+            )
+        logger.warning(
+            "QUARANTINED node %s (cordon=%s, taint %s=%s:%s): %s",
+            node, self.cordon, self.taint_key, self.taint_value, self.taint_effect, reason,
+        )
+        return ActionRecord(node=node, action="quarantine", ok=True, dry_run=False, reason=reason, applied=True)
+
+    def release(self, node: str, reason: str = "operator release") -> ActionRecord:
+        """Uncordon + remove OUR taint (other taints are preserved).
+
+        The inverse of ``quarantine``, for the operator path (RUNBOOK) once
+        the hardware is cleared or swapped. Subject to the rate limit but
+        not the cooldown (releasing a node we just cordoned by mistake must
+        not wait an hour).
+        """
+        with self._lock:
+            now = self._clock()
+            while self._action_times and self._action_times[0] <= now - 3600.0:
+                self._action_times.popleft()
+            if len(self._action_times) >= self.max_actions_per_hour:
+                return self._refuse(
+                    node, "release",
+                    f"rate limit: {len(self._action_times)} actions in the last hour (max {self.max_actions_per_hour})",
+                )
+            prior_last_action = self._last_action.get(node)
+            self._action_times.append(now)
+            self._last_action[node] = now
+        record = self._apply_release(node, reason)
+        with self._lock:
+            if record.ok:
+                self._quarantined.discard(node)
+            else:
+                # refund on failure, as in quarantine(): a transient error
+                # must not rate-starve or cooldown-lock the retry
+                if prior_last_action is None:
+                    self._last_action.pop(node, None)
+                else:
+                    self._last_action[node] = prior_last_action
+                if self._action_times:
+                    self._action_times.pop()
+            n_quarantined = len(self._quarantined)
+        if record.ok and self.metrics is not None:
+            self.metrics.counter("remediation_actions").inc()
+            self.metrics.gauge("remediation_quarantined_nodes").set(n_quarantined)
+        return record
+
+    def _apply_release(self, node: str, reason: str) -> ActionRecord:
+        try:
+            current = self.client.get_node(node)
+        except (K8sNotFoundError, K8sApiError) as exc:
+            return ActionRecord(
+                node=node, action="release", ok=False, dry_run=self.dry_run,
+                reason=reason, error=str(exc),
+            )
+        taints = [
+            t for t in (current.get("spec") or {}).get("taints") or []
+            if t.get("key") != self.taint_key
+        ]
+        patch = {"spec": {"taints": taints or None, "unschedulable": None}}
+        if self.dry_run:
+            logger.warning("[DRY-RUN] would release node %s: %s", node, reason)
+            return ActionRecord(node=node, action="release", ok=True, dry_run=True, reason=reason)
+        try:
+            self.client.patch_node(node, patch)
+        except K8sApiError as exc:
+            return ActionRecord(
+                node=node, action="release", ok=False, dry_run=False,
+                reason=reason, error=f"patch_node failed: {exc}",
+            )
+        logger.warning("RELEASED node %s (uncordoned, taint %s removed): %s", node, self.taint_key, reason)
+        return ActionRecord(node=node, action="release", ok=True, dry_run=False, reason=reason, applied=True)
+
+    def quarantined_nodes(self) -> List[str]:
+        with self._lock:
+            return sorted(self._quarantined)

@@ -1,0 +1,174 @@
+"""Remediation policy: when is a probe finding actionable?
+
+The probe plane emits per-cycle findings (suspect devices from the link
+walk, dead local chips from the liveness check). A single cycle is not
+grounds to cordon a node — ARCHITECTURE.md documents real per-cycle noise,
+and the link prober's own docstring warns that one suspect link implicates
+the link, not a chip. ``ProbeRemediationPolicy`` requires the SAME node to
+be implicated in ``confirm_cycles`` **consecutive** probe reports before
+asking the actuator to quarantine it; one clean cycle resets the count
+(a transient congestion event that clears is exactly what must not cordon).
+
+Node mapping: a suspect device id resolves to its ``process_index`` through
+the report's device inventory, then to a k8s node through the report's
+``hosts`` identity map (probe/device.py:host_identity_map — the
+``NODE_NAME`` downward-API join). A suspect whose process has no
+``node_name`` is counted and logged but never acted on: guessing a node to
+cordon is worse than paging a human.
+
+Multi-controller: only process 0 evaluates policy (it is also the process
+that reports for the slice, probe/agent.py:_report) — N hosts racing to
+cordon the same node would multiply every fence's accounting by N.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+from k8s_watcher_tpu.remediate.actuator import ActionRecord, NodeActuator
+
+logger = logging.getLogger(__name__)
+
+
+class ProbeRemediationPolicy:
+    def __init__(
+        self,
+        actuator: NodeActuator,
+        *,
+        confirm_cycles: int = 3,
+        sink: Optional[Callable[[Dict[str, Any]], None]] = None,
+        metrics=None,
+        environment: str = "",
+    ):
+        if confirm_cycles < 1:
+            raise ValueError("confirm_cycles must be >= 1")
+        self.actuator = actuator
+        self.confirm_cycles = confirm_cycles
+        self.sink = sink
+        self.metrics = metrics
+        self.environment = environment
+        self._lock = threading.Lock()
+        self._streaks: Dict[str, int] = {}  # node -> consecutive implicated cycles
+        self._reasons: Dict[str, List[str]] = {}  # node -> last cycle's evidence
+
+    # -- evidence extraction ----------------------------------------------
+
+    @staticmethod
+    def _implicated(report) -> Dict[str, List[str]]:
+        """``node_name -> [evidence, ...]`` for this report. Pure function
+        of the report payload shape (probe/report.py)."""
+        devices = (report.devices or {}).get("devices") or []
+        id_to_process = {d.get("id"): d.get("process_index") for d in devices}
+        hosts = report.hosts or {}
+
+        def node_of(process_index) -> Optional[str]:
+            identity = hosts.get(str(process_index)) or {}
+            return identity.get("node_name")
+
+        out: Dict[str, List[str]] = {}
+        unmapped: List[str] = []
+
+        def implicate(process_index, evidence: str) -> None:
+            node = node_of(process_index)
+            if node:
+                out.setdefault(node, []).append(evidence)
+            else:
+                unmapped.append(evidence)
+
+        links = report.links
+        if links is not None and links.error is None:
+            for device_id in links.suspect_devices:
+                implicate(
+                    id_to_process.get(device_id),
+                    f"link probe: device {device_id} is the common endpoint of >=2 suspect links",
+                )
+        for entry in devices:
+            if entry.get("alive") is False:
+                implicate(
+                    entry.get("process_index"),
+                    f"device probe: chip {entry.get('id')} failed its liveness computation",
+                )
+        if unmapped:
+            logger.warning(
+                "Probe implicates hardware on processes with no node_name "
+                "(NODE_NAME downward-API env missing?) — cannot remediate: %s",
+                unmapped,
+            )
+        if unmapped and not out:
+            out["__unmapped__"] = unmapped  # visible in notifications, never acted on
+        return out
+
+    # -- the per-cycle fold ------------------------------------------------
+
+    def observe_report(self, report) -> List[ActionRecord]:
+        """Fold one probe report; returns the actions taken (possibly [])."""
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            return []
+        implicated = self._implicated(report)
+        actionable = {n: ev for n, ev in implicated.items() if n != "__unmapped__"}
+        records: List[ActionRecord] = []
+        with self._lock:
+            for node in list(self._streaks):
+                if node not in actionable:
+                    # one clean cycle resets: transient events must not
+                    # accumulate toward a cordon across hours
+                    del self._streaks[node]
+                    self._reasons.pop(node, None)
+            confirmed: List[str] = []
+            for node, evidence in actionable.items():
+                self._streaks[node] = self._streaks.get(node, 0) + 1
+                self._reasons[node] = evidence
+                if self._streaks[node] >= self.confirm_cycles:
+                    confirmed.append(node)
+        for node in confirmed:
+            reason = (
+                f"implicated in {self.confirm_cycles}+ consecutive probe cycles: "
+                + "; ".join(self._reasons.get(node, []))[:400]
+            )
+            records.append(self.actuator.quarantine(node, reason))
+            with self._lock:
+                # restart the streak either way: an applied quarantine needs
+                # no repeat, and a refused one (cooldown/rate/budget) must
+                # re-earn confirmation rather than hammer the fences every
+                # subsequent cycle
+                self._streaks.pop(node, None)
+        if self.metrics is not None and implicated.get("__unmapped__"):
+            self.metrics.counter("remediation_unmappable").inc()
+        if records or implicated:
+            self._notify(implicated, records)
+        return records
+
+    def _notify(self, implicated: Dict[str, List[str]], records: List[ActionRecord]) -> None:
+        if self.sink is None:
+            return
+        from datetime import datetime, timezone
+
+        payload = {
+            "event_type": "TPU_REMEDIATION",
+            "environment": self.environment,
+            "dry_run": self.actuator.dry_run,
+            "implicated": implicated,
+            "streaks": dict(self._streaks),
+            "confirm_cycles": self.confirm_cycles,
+            "actions": [r.to_dict() for r in records],
+            "quarantined_nodes": self.actuator.quarantined_nodes(),
+            "event_timestamp": datetime.now(timezone.utc).isoformat(),
+        }
+        try:
+            self.sink(payload)
+        except Exception as exc:  # noqa: BLE001 — reporting must not kill the probe loop
+            logger.error("Remediation notification failed: %s", exc)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Debug-endpoint view of the policy state."""
+        with self._lock:
+            return {
+                "streaks": dict(self._streaks),
+                "confirm_cycles": self.confirm_cycles,
+                "dry_run": self.actuator.dry_run,
+                "quarantined_nodes": self.actuator.quarantined_nodes(),
+            }

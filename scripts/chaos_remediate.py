@@ -1,0 +1,204 @@
+#!/usr/bin/env python3
+"""Chaos drill: injected ICI fault -> localization -> node quarantine.
+
+The full remediation loop end-to-end, hardware-free: a watcher runs against
+the in-repo mock apiserver (holding a TPU node), its remediation plane
+armed; an ICI fault is injected into REAL link-probe runs on a virtual CPU
+mesh; the policy confirms the suspect across consecutive cycles and the
+actuator cordons + taints the node over real HTTP, while the
+TPU_REMEDIATION notification flows through the dispatcher to a live HTTP
+sink. Asserts every stage:
+
+1. the link walk fingers exactly the injected device;
+2. cycle 1 alone does NOT act (confirmation discipline);
+3. after confirm_cycles the mock node is unschedulable + tainted;
+4. the sink received a TPU_REMEDIATION payload with the applied action;
+5. `release` restores the node.
+
+Usage: python scripts/chaos_remediate.py [--cpu-mesh N] [--slow-device D]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+NODE = "drill-tpu-node-0"
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--cpu-mesh", type=int, default=8, metavar="N",
+                        help="virtual CPU mesh size (default 8)")
+    parser.add_argument("--slow-device", type=int, default=3, help="device id to make slow")
+    parser.add_argument("--slow-iters", type=int, default=800, help="injected delay (chained matmuls)")
+    parser.add_argument("--confirm-cycles", type=int, default=2)
+    args = parser.parse_args()
+
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={args.cpu_mesh}"
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # authoritative over pinned plugins
+
+    from k8s_watcher_tpu.faults.ici import IciFaultSpec
+    from k8s_watcher_tpu.k8s.client import K8sClient
+    from k8s_watcher_tpu.k8s.kubeconfig import K8sConnection
+    from k8s_watcher_tpu.k8s.mock_server import MockApiServer, MockCluster
+    from k8s_watcher_tpu.probe.device import enumerate_devices
+    from k8s_watcher_tpu.probe.links import run_link_probe
+    from k8s_watcher_tpu.probe.report import ProbeReport
+    from k8s_watcher_tpu.remediate import NodeActuator, ProbeRemediationPolicy
+
+    result = {"injected_device": args.slow_device, "n_devices": args.cpu_mesh}
+    failures = []
+
+    # -- a live HTTP sink standing in for clusterapi -----------------------
+    received = []
+    received_lock = threading.Lock()
+
+    class Sink(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        disable_nagle_algorithm = True
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            with received_lock:
+                received.append(json.loads(body))
+            out = b'{"ok": true}'
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+    sink_server = ThreadingHTTPServer(("127.0.0.1", 0), Sink)
+    sink_server.daemon_threads = True
+    threading.Thread(target=sink_server.serve_forever, daemon=True).start()
+
+    # -- mock apiserver holding the drill node -----------------------------
+    cluster = MockCluster()
+    cluster.add_node({
+        "metadata": {"name": NODE, "labels": {"cloud.google.com/gke-tpu-accelerator": "tpu-v5p"}},
+        "spec": {},
+        "status": {"conditions": [{"type": "Ready", "status": "True"}]},
+    })
+
+    with MockApiServer(cluster) as api:
+        client = K8sClient(K8sConnection(server=api.url), request_timeout=5.0)
+
+        from k8s_watcher_tpu.notify.client import ClusterApiClient
+        from k8s_watcher_tpu.notify.dispatcher import Dispatcher
+        from k8s_watcher_tpu.pipeline.pipeline import Notification
+
+        notifier = ClusterApiClient(f"http://127.0.0.1:{sink_server.server_address[1]}", None, 5.0)
+        dispatcher = Dispatcher(notifier.update_pod_status, capacity=64, workers=1)
+        dispatcher.start()
+
+        actuator = NodeActuator(
+            client, dry_run=False, cooldown_seconds=0.0,
+            max_actions_per_hour=100, max_quarantined_nodes=2,
+        )
+        policy = ProbeRemediationPolicy(
+            actuator,
+            confirm_cycles=args.confirm_cycles,
+            sink=lambda payload: dispatcher.submit(
+                Notification(payload, time.monotonic(), kind="remediation")
+            ),
+            environment="drill",
+        )
+
+        # -- real probe cycles with the injected fault ---------------------
+        fault = IciFaultSpec(slow_device_id=args.slow_device, slow_iters=args.slow_iters)
+        devices = enumerate_devices(expected_platform=None)
+        # single-controller CPU drill: every device is process 0; the
+        # downward-API join the DaemonSet provides is stood in here
+        hosts = {"0": {"hostname": "drill-host", "process_index": 0, "node_name": NODE}}
+
+        def cycle():
+            links = run_link_probe(iters=3, inner_iters=4, fault=fault)
+            return links, ProbeReport(environment="drill", devices=devices, links=links, hosts=hosts)
+
+        links1, report1 = cycle()
+        result["links_cycle1"] = {
+            "suspect_devices": links1.suspect_devices,
+            "suspect_links": [s["name"] for s in links1.suspect_links],
+        }
+        if sorted(links1.suspect_devices) != [args.slow_device]:
+            failures.append(f"link walk mislocalized: {links1.suspect_devices} != [{args.slow_device}]")
+
+        actions1 = policy.observe_report(report1)
+        if actions1:
+            failures.append(f"acted on cycle 1 of {args.confirm_cycles} — confirmation discipline broken")
+        node_mid = cluster.get_node(NODE)
+        if (node_mid.get("spec") or {}).get("unschedulable"):
+            failures.append("node cordoned before confirmation")
+
+        all_actions = list(actions1)
+        for _ in range(args.confirm_cycles - 1):
+            _, report_n = cycle()
+            all_actions += policy.observe_report(report_n)
+
+        applied = [a for a in all_actions if a.ok and a.applied]
+        result["actions"] = [a.to_dict() for a in all_actions]
+        if not applied or applied[0].node != NODE:
+            failures.append(f"no applied quarantine for {NODE}: {[a.to_dict() for a in all_actions]}")
+
+        node_after = cluster.get_node(NODE)
+        spec = node_after.get("spec") or {}
+        cordoned = bool(spec.get("unschedulable"))
+        tainted = any(t.get("key") == "k8s-watcher-tpu/ici-fault" for t in spec.get("taints") or [])
+        result["node_after"] = {"unschedulable": cordoned, "tainted": tainted}
+        if not (cordoned and tainted):
+            failures.append(f"node not quarantined on the apiserver: {spec}")
+
+        deadline = time.monotonic() + 10
+        remediation_payloads = []
+        while time.monotonic() < deadline:
+            with received_lock:
+                remediation_payloads = [
+                    p for p in received
+                    if p.get("event_type") == "TPU_REMEDIATION" and p.get("actions")
+                ]
+            if remediation_payloads:
+                break
+            time.sleep(0.05)
+        result["sink_remediation_payloads"] = len(remediation_payloads)
+        if not remediation_payloads:
+            failures.append("no TPU_REMEDIATION notification reached the HTTP sink")
+
+        release = actuator.release(NODE, "drill cleanup")
+        spec_released = (cluster.get_node(NODE).get("spec")) or {}
+        result["released"] = {
+            "ok": release.ok,
+            "unschedulable": bool(spec_released.get("unschedulable")),
+            "taints": spec_released.get("taints") or [],
+        }
+        if not release.ok or spec_released.get("unschedulable") or spec_released.get("taints"):
+            failures.append(f"release did not restore the node: {spec_released}")
+
+        dispatcher.stop()
+    sink_server.shutdown()
+    sink_server.server_close()
+
+    result["failures"] = failures
+    print(json.dumps(result, indent=2))
+    print(f"\nremediation drill: {'PASS — fault quarantined end-to-end' if not failures else 'FAIL'}")
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Operator CLI for the remediation plane (RUNBOOK.md "Remediation").
+
+Usage:
+    python scripts/remediate_ctl.py [environment] status
+    python scripts/remediate_ctl.py [environment] quarantine NODE [--reason=TEXT] [--no-dry-run]
+    python scripts/remediate_ctl.py [environment] release NODE [--no-dry-run]
+
+``status`` lists nodes carrying the configured remediation taint and/or a
+cordon. ``quarantine``/``release`` drive the same NodeActuator the watcher
+uses, with the same config-derived taint — dry-run unless ``--no-dry-run``
+is given explicitly (CLI actions are subject to the same review discipline
+as automated ones). Manual actions bypass confirm_cycles by design: the
+operator IS the confirmation.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from k8s_watcher_tpu.config.loader import load_config, resolve_environment
+from k8s_watcher_tpu.k8s.client import K8sClient
+from k8s_watcher_tpu.k8s.kubeconfig import load_connection
+from k8s_watcher_tpu.logging_setup import setup_logging
+from k8s_watcher_tpu.remediate import NodeActuator
+
+
+def main() -> int:
+    flags = [a for a in sys.argv[1:] if a.startswith("--")]
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    known_envs = ("development", "staging", "production")
+    env_args = args[:1] if args and args[0] in known_envs else []
+    rest = args[len(env_args):]
+    if not rest or rest[0] not in ("status", "quarantine", "release"):
+        print(__doc__)
+        return 2
+    command, *rest = rest
+
+    environment = resolve_environment(env_args)
+    config = load_config(environment)
+    setup_logging(environment, config.watcher.log_level)
+    connection = load_connection(
+        use_incluster=config.kubernetes.use_incluster_config,
+        config_file=config.kubernetes.config_file,
+        verify_tls=config.kubernetes.verify_tls,
+    )
+    client = K8sClient(connection, request_timeout=config.kubernetes.request_timeout)
+    t = config.tpu
+
+    if command == "status":
+        nodes = client.list_nodes().get("items", [])
+        out = []
+        for node in nodes:
+            name = (node.get("metadata") or {}).get("name", "")
+            spec = node.get("spec") or {}
+            taints = [x for x in spec.get("taints") or [] if x.get("key") == t.remediation_taint_key]
+            if taints or spec.get("unschedulable"):
+                out.append({
+                    "node": name,
+                    "unschedulable": bool(spec.get("unschedulable")),
+                    "remediation_taints": taints,
+                })
+        print(json.dumps({"taint_key": t.remediation_taint_key, "quarantined": out}, indent=2))
+        return 0
+
+    if not rest:
+        print(f"{command}: NODE argument required", file=sys.stderr)
+        return 2
+    node = rest[0]
+    reason = "manual CLI action"
+    for flag in flags:
+        if flag.startswith("--reason="):
+            reason = flag[len("--reason="):]
+    actuator = NodeActuator(
+        client,
+        dry_run="--no-dry-run" not in flags,
+        cordon=t.remediation_cordon,
+        taint_key=t.remediation_taint_key,
+        taint_value=t.remediation_taint_value,
+        taint_effect=t.remediation_taint_effect,
+        # the operator is the rate limiter for manual actions
+        cooldown_seconds=0.0,
+        max_actions_per_hour=1000,
+        max_quarantined_nodes=10_000,
+    )
+    record = actuator.quarantine(node, reason) if command == "quarantine" else actuator.release(node, reason)
+    print(json.dumps(record.to_dict(), indent=2))
+    return 0 if record.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

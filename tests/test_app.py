@@ -199,6 +199,78 @@ class TestRestartResume:
             assert not survivor_payloads, survivor_payloads
 
 
+class TestRemediationWiring:
+    """The leader arms the remediation plane against the real watch-source
+    client: a confirmed probe finding cordons + taints the node on the mock
+    apiserver and a TPU_REMEDIATION notification flows to the notifier."""
+
+    def test_confirmed_finding_cordons_node_end_to_end(self, tmp_path):
+        import dataclasses
+
+        from k8s_watcher_tpu.k8s.mock_server import MockApiServer
+        from test_remediate import probe_report
+
+        with MockApiServer() as server:
+            server.cluster.add_node({
+                "metadata": {"name": "tpu-node-1"},
+                "spec": {},
+                "status": {"conditions": [{"type": "Ready", "status": "True"}]},
+            })
+            base = TestRestartResume()._config(tmp_path, server.url)
+            config = dataclasses.replace(
+                base,
+                tpu=dataclasses.replace(
+                    base.tpu,
+                    probe_enabled=True,
+                    probe_interval_seconds=60.0,  # cycles driven by hand below
+                    probe_hbm_bytes=0,
+                    probe_matmul_size=64,
+                    probe_payload_bytes=1024,
+                    remediation_enabled=True,
+                    remediation_dry_run=False,
+                    remediation_confirm_cycles=2,
+                    remediation_cooldown_seconds=0.0,
+                ),
+            )
+            notifier = RecordingNotifier()
+            app = WatcherApp(config, notifier=notifier)
+            thread = threading.Thread(target=app.run, daemon=True)
+            thread.start()
+            deadline = time.monotonic() + 10
+            while app.remediation is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert app.remediation is not None, "remediation plane never armed"
+            assert app._probe_agent.report_observer is not None
+
+            # two consecutive implicating reports = confirmation
+            report = probe_report(suspect_devices=[2])  # process 1 -> tpu-node-1
+            app._probe_agent.report_observer(report)
+            app._probe_agent.report_observer(report)
+
+            node = server.cluster.get_node("tpu-node-1")
+            assert node["spec"].get("unschedulable") is True
+            assert any(
+                t["key"] == "k8s-watcher-tpu/ici-fault"
+                for t in node["spec"].get("taints", [])
+            )
+
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                with notifier.lock:
+                    actions = [
+                        p for p in notifier.payloads
+                        if p.get("event_type") == "TPU_REMEDIATION" and p.get("actions")
+                    ]
+                if actions:
+                    break
+                time.sleep(0.05)
+            assert actions, "no TPU_REMEDIATION notification with actions arrived"
+            assert actions[-1]["actions"][0]["node"] == "tpu-node-1"
+            assert actions[-1]["dry_run"] is False
+            app.stop()
+            thread.join(timeout=10)
+
+
 class TestChurnLoad:
     """1 k+ events through the full pipeline with faulty notifier — the
     CPU-scale shape of acceptance config #5."""

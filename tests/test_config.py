@@ -176,3 +176,26 @@ class TestStrictSchema:
         d = self._write(tmp_path, "tpu:\n  backend: gpu\n")
         cfg = load_config("development", d, env={})
         assert cfg.tpu.resource_key == "nvidia.com/gpu"
+
+    def test_remediation_keys_parsed(self, tmp_path):
+        d = self._write(
+            tmp_path,
+            "tpu:\n  remediation:\n    enabled: true\n    dry_run: false\n"
+            "    confirm_cycles: 5\n    taint_effect: PreferNoSchedule\n",
+        )
+        cfg = load_config("development", d, env={})
+        assert cfg.tpu.remediation_enabled is True
+        assert cfg.tpu.remediation_dry_run is False
+        assert cfg.tpu.remediation_confirm_cycles == 5
+        assert cfg.tpu.remediation_taint_effect == "PreferNoSchedule"
+
+    def test_remediation_bad_values_rejected(self, tmp_path):
+        d = self._write(tmp_path, "tpu:\n  remediation:\n    taint_effect: EvictEverything\n")
+        with pytest.raises(ConfigError, match="taint_effect"):
+            load_config("development", d, env={})
+        d = self._write(tmp_path, "tpu:\n  remediation:\n    cooldown_seconds: -10\n")
+        with pytest.raises(ConfigError, match="cooldown_seconds"):
+            load_config("development", d, env={})
+        d = self._write(tmp_path, "tpu:\n  remediation:\n    confirm_cycles: 0\n")
+        with pytest.raises(ConfigError, match="confirm_cycles"):
+            load_config("development", d, env={})

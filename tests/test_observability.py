@@ -278,6 +278,35 @@ class TestAuditRing:
         finally:
             server.stop()
 
+    def test_debug_remediation_endpoint(self):
+        import requests
+
+        from k8s_watcher_tpu.metrics import MetricsRegistry
+        from k8s_watcher_tpu.metrics.server import Liveness, StatusServer
+
+        state = {"value": None}
+        server = StatusServer(
+            MetricsRegistry(), Liveness(), remediation=lambda: state["value"]
+        ).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}/debug/remediation"
+            body = requests.get(url, timeout=5).json()
+            assert body["remediation"] is None and "not armed" in body["note"]
+            state["value"] = {"streaks": {"n0": 2}, "quarantined_nodes": []}
+            body = requests.get(url, timeout=5).json()
+            assert body["remediation"]["streaks"] == {"n0": 2}
+        finally:
+            server.stop()
+
+        # not configured at all -> 404, matching the other debug routes
+        server = StatusServer(MetricsRegistry(), Liveness()).start()
+        try:
+            assert requests.get(
+                f"http://127.0.0.1:{server.port}/debug/remediation", timeout=5
+            ).status_code == 404
+        finally:
+            server.stop()
+
     def test_debug_events_404_when_disabled(self):
         import requests
 

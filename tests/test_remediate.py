@@ -1,0 +1,382 @@
+"""Remediation-plane tests: the node actuator's safety fences against the
+in-repo mock apiserver, the confirmation policy's streak logic, and the
+end-to-end probe-report -> cordon+taint path."""
+
+from typing import List, Optional
+
+import pytest
+
+from k8s_watcher_tpu.k8s.client import K8sClient
+from k8s_watcher_tpu.k8s.kubeconfig import K8sConnection
+from k8s_watcher_tpu.k8s.mock_server import MockApiServer, MockCluster
+from k8s_watcher_tpu.metrics import MetricsRegistry
+from k8s_watcher_tpu.probe.report import ProbeReport
+from k8s_watcher_tpu.remediate import NodeActuator, ProbeRemediationPolicy
+
+TAINT_KEY = "k8s-watcher-tpu/ici-fault"
+
+
+@pytest.fixture()
+def mock_api():
+    cluster = MockCluster()
+    for name in ("tpu-node-0", "tpu-node-1", "tpu-node-2"):
+        cluster.add_node({
+            "metadata": {"name": name, "labels": {"cloud.google.com/gke-tpu-accelerator": "tpu-v5p"}},
+            "spec": {},
+            "status": {"conditions": [{"type": "Ready", "status": "True"}]},
+        })
+    with MockApiServer(cluster) as server:
+        yield server
+
+
+def make_client(server: MockApiServer) -> K8sClient:
+    return K8sClient(K8sConnection(server=server.url), request_timeout=5.0)
+
+
+def make_actuator(server: MockApiServer, **kwargs) -> NodeActuator:
+    kwargs.setdefault("dry_run", False)
+    kwargs.setdefault("cooldown_seconds", 0.0)
+    return NodeActuator(make_client(server), **kwargs)
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self) -> float:
+        return self.now
+
+
+class TestMockNodePatch:
+    def test_get_node(self, mock_api):
+        client = make_client(mock_api)
+        node = client.get_node("tpu-node-0")
+        assert node["metadata"]["name"] == "tpu-node-0"
+
+    def test_get_missing_node_404(self, mock_api):
+        from k8s_watcher_tpu.k8s.client import K8sNotFoundError
+
+        with pytest.raises(K8sNotFoundError):
+            make_client(mock_api).get_node("nope")
+
+    def test_merge_patch_sets_and_deletes(self, mock_api):
+        client = make_client(mock_api)
+        client.patch_node("tpu-node-0", {"spec": {"unschedulable": True, "taints": [{"key": "k"}]}})
+        node = client.get_node("tpu-node-0")
+        assert node["spec"]["unschedulable"] is True
+        assert node["spec"]["taints"] == [{"key": "k"}]
+        # RFC 7386: null deletes the key
+        client.patch_node("tpu-node-0", {"spec": {"unschedulable": None}})
+        assert "unschedulable" not in client.get_node("tpu-node-0")["spec"]
+
+    def test_patch_journals_modified_node_event(self, mock_api):
+        rv_before = mock_api.cluster.latest_rv()
+        make_client(mock_api).patch_node("tpu-node-1", {"spec": {"unschedulable": True}})
+        events = mock_api.cluster.events_since(rv_before, 0.0, collection="nodes")
+        assert any(
+            e["type"] == "MODIFIED" and e["object"]["metadata"]["name"] == "tpu-node-1"
+            for e in events
+        )
+
+
+class TestActuator:
+    def test_quarantine_cordons_and_taints(self, mock_api):
+        actuator = make_actuator(mock_api)
+        record = actuator.quarantine("tpu-node-0", "test evidence")
+        assert record.ok and record.applied and not record.dry_run
+        node = make_client(mock_api).get_node("tpu-node-0")
+        assert node["spec"]["unschedulable"] is True
+        taints = node["spec"]["taints"]
+        assert any(t["key"] == TAINT_KEY and t["effect"] == "NoSchedule" for t in taints)
+        assert actuator.quarantined_nodes() == ["tpu-node-0"]
+
+    def test_quarantine_preserves_existing_taints(self, mock_api):
+        make_client(mock_api).patch_node(
+            "tpu-node-0", {"spec": {"taints": [{"key": "other", "effect": "NoExecute"}]}}
+        )
+        make_actuator(mock_api).quarantine("tpu-node-0", "x")
+        taints = make_client(mock_api).get_node("tpu-node-0")["spec"]["taints"]
+        assert {t["key"] for t in taints} == {"other", TAINT_KEY}
+
+    def test_dry_run_touches_nothing(self, mock_api):
+        actuator = make_actuator(mock_api, dry_run=True)
+        record = actuator.quarantine("tpu-node-0", "dry")
+        assert record.ok and record.dry_run and not record.applied
+        node = make_client(mock_api).get_node("tpu-node-0")
+        assert "unschedulable" not in node["spec"]
+        assert not node["spec"].get("taints")
+
+    def test_idempotent_adoption(self, mock_api):
+        actuator = make_actuator(mock_api)
+        actuator.quarantine("tpu-node-0", "first")
+        # a second actuator (fresh process) adopts the existing quarantine
+        fresh = make_actuator(mock_api)
+        record = fresh.quarantine("tpu-node-0", "again")
+        assert record.ok and not record.applied
+        assert "already quarantined" in record.reason
+        assert fresh.quarantined_nodes() == ["tpu-node-0"]
+        # adoption counts against the budget: with budget=1, a second node
+        # is refused even though this process never wrote anything
+        tight = make_actuator(mock_api, max_quarantined_nodes=1)
+        tight.quarantine("tpu-node-0", "adopt")
+        blocked = tight.quarantine("tpu-node-1", "x")
+        assert blocked.ok is False and "budget" in blocked.reason
+
+    def test_cooldown_refuses_repeat(self, mock_api):
+        clock = FakeClock()
+        actuator = make_actuator(mock_api, cooldown_seconds=600.0, clock=clock)
+        assert actuator.quarantine("tpu-node-0", "x").ok
+        again = actuator.quarantine("tpu-node-0", "y")
+        assert not again.ok and "cooldown" in again.reason
+        clock.now += 601.0
+        assert actuator.quarantine("tpu-node-0", "z").ok  # adoption path, still ok
+
+    def test_rate_limit(self, mock_api):
+        clock = FakeClock()
+        actuator = make_actuator(mock_api, max_actions_per_hour=2, max_quarantined_nodes=10, clock=clock)
+        assert actuator.quarantine("tpu-node-0", "a").ok
+        assert actuator.quarantine("tpu-node-1", "b").ok
+        third = actuator.quarantine("tpu-node-2", "c")
+        assert not third.ok and "rate limit" in third.reason
+        clock.now += 3601.0
+        assert actuator.quarantine("tpu-node-2", "c").ok
+
+    def test_budget_cap(self, mock_api):
+        actuator = make_actuator(mock_api, max_quarantined_nodes=2, max_actions_per_hour=100)
+        assert actuator.quarantine("tpu-node-0", "a").ok
+        assert actuator.quarantine("tpu-node-1", "b").ok
+        blocked = actuator.quarantine("tpu-node-2", "c")
+        assert not blocked.ok and "budget" in blocked.reason
+        # releasing one frees a budget slot
+        assert actuator.release("tpu-node-0").ok
+        assert actuator.quarantine("tpu-node-2", "c").ok
+
+    def test_release_uncordons_and_removes_only_our_taint(self, mock_api):
+        make_client(mock_api).patch_node(
+            "tpu-node-0", {"spec": {"taints": [{"key": "other", "effect": "NoSchedule"}]}}
+        )
+        actuator = make_actuator(mock_api)
+        actuator.quarantine("tpu-node-0", "x")
+        record = actuator.release("tpu-node-0", "hardware cleared")
+        assert record.ok and record.applied
+        node = make_client(mock_api).get_node("tpu-node-0")
+        assert "unschedulable" not in node["spec"]
+        assert [t["key"] for t in node["spec"].get("taints", [])] == ["other"]
+        assert actuator.quarantined_nodes() == []
+
+    def test_external_release_frees_budget(self, mock_api):
+        """An operator uncordoning out-of-band (kubectl / remediate_ctl in
+        another process) must free the budget slot: the actuator reconciles
+        its memory against the apiserver before refusing."""
+        actuator = make_actuator(mock_api, max_quarantined_nodes=2, max_actions_per_hour=100)
+        assert actuator.quarantine("tpu-node-0", "a").ok
+        assert actuator.quarantine("tpu-node-1", "b").ok
+        # out-of-band release of node-0 (no taint, uncordoned)
+        make_client(mock_api).patch_node("tpu-node-0", {"spec": {"taints": None, "unschedulable": None}})
+        record = actuator.quarantine("tpu-node-2", "c")
+        assert record.ok, record.reason
+        assert "tpu-node-0" not in actuator.quarantined_nodes()
+
+    def test_dry_run_budget_decisions_age_out(self, mock_api):
+        """Dry-run writes nothing, so its budget entries expire after the
+        cooldown — a week of review mode keeps showing fresh decisions
+        instead of degenerating into refusals."""
+        clock = FakeClock()
+        actuator = make_actuator(
+            mock_api, dry_run=True, max_quarantined_nodes=2,
+            max_actions_per_hour=100, cooldown_seconds=600.0, clock=clock,
+        )
+        assert actuator.quarantine("tpu-node-0", "a").ok
+        assert actuator.quarantine("tpu-node-1", "b").ok
+        blocked = actuator.quarantine("tpu-node-2", "c")
+        assert not blocked.ok and "budget" in blocked.reason
+        clock.now += 601.0
+        assert actuator.quarantine("tpu-node-2", "c").ok
+
+    def test_transient_failure_refunds_fences(self, mock_api):
+        """An apiserver blip during the apply must not burn the cooldown or
+        a rate slot: the immediate retry goes through."""
+        clock = FakeClock()
+        actuator = make_actuator(
+            mock_api, cooldown_seconds=3600.0, max_actions_per_hour=2, clock=clock,
+        )
+        mock_api.cluster.fail_next(1, status=500)  # fail the apply's GET
+        failed = actuator.quarantine("tpu-node-0", "x")
+        assert not failed.ok and failed.error
+        # no cooldown refusal, no burned rate slot: the retry succeeds and
+        # one real rate slot remains for another node
+        assert actuator.quarantine("tpu-node-0", "x").ok
+        assert actuator.quarantine("tpu-node-1", "y").ok
+
+    def test_missing_node_errors_cleanly(self, mock_api):
+        record = make_actuator(mock_api).quarantine("no-such-node", "x")
+        assert not record.ok and "not found" in record.error
+        # the failed node does not occupy a budget slot
+        assert record.node not in make_actuator(mock_api).quarantined_nodes()
+
+    def test_metrics_counters(self, mock_api):
+        metrics = MetricsRegistry()
+        actuator = make_actuator(mock_api, metrics=metrics, max_actions_per_hour=1)
+        actuator.quarantine("tpu-node-0", "x")
+        actuator.quarantine("tpu-node-1", "y")  # rate-limited
+        assert metrics.counter("remediation_actions").value == 1
+        assert metrics.counter("remediation_refusals").value == 1
+
+    def test_invalid_taint_effect_rejected(self, mock_api):
+        with pytest.raises(ValueError):
+            make_actuator(mock_api, taint_effect="EvictEverything")
+
+
+def probe_report(
+    *,
+    suspect_devices: List[int] = (),
+    dead_devices: List[int] = (),
+    hosts: Optional[dict] = None,
+    n_devices: int = 4,
+) -> ProbeReport:
+    """A minimal report shaped like probe/agent.py builds (4 chips, 2 hosts,
+    2 chips per host: device i lives on process i // 2)."""
+    devices = {
+        "process_index": 0,
+        "process_count": 2,
+        "visible_devices": n_devices,
+        "local_devices": n_devices // 2,
+        "healthy_devices": n_devices - len(dead_devices),
+        "devices": [
+            {"id": i, "process_index": i // 2, "alive": False if i in dead_devices else True}
+            for i in range(n_devices)
+        ],
+    }
+    links = None
+    if suspect_devices:
+        from k8s_watcher_tpu.probe.links import LinkProbeResult
+
+        links = LinkProbeResult(
+            ok=False, n_links=4, n_observed=4, median_rtt_ms=0.1, links=[],
+            suspect_links=[{"name": "x", "device_ids": list(suspect_devices), "reason": "slow", "rtt_ms": 9.0}],
+            suspect_devices=list(suspect_devices), compile_ms=0.0,
+        )
+    if hosts is None:
+        hosts = {
+            "0": {"hostname": "h0", "process_index": 0, "node_name": "tpu-node-0"},
+            "1": {"hostname": "h1", "process_index": 1, "node_name": "tpu-node-1"},
+        }
+    return ProbeReport(environment="test", devices=devices, links=links, hosts=hosts)
+
+
+class TestPolicy:
+    def make_policy(self, mock_api, confirm_cycles=3, sink=None, **kwargs):
+        actuator = make_actuator(mock_api, **kwargs)
+        return ProbeRemediationPolicy(actuator, confirm_cycles=confirm_cycles, sink=sink), actuator
+
+    def test_confirmation_requires_consecutive_cycles(self, mock_api):
+        policy, actuator = self.make_policy(mock_api, confirm_cycles=3)
+        report = probe_report(suspect_devices=[2])  # device 2 -> process 1 -> tpu-node-1
+        assert policy.observe_report(report) == []
+        assert policy.observe_report(report) == []
+        records = policy.observe_report(report)
+        assert len(records) == 1 and records[0].node == "tpu-node-1" and records[0].ok
+        node = make_client(mock_api).get_node("tpu-node-1")
+        assert node["spec"]["unschedulable"] is True
+
+    def test_clean_cycle_resets_streak(self, mock_api):
+        policy, actuator = self.make_policy(mock_api, confirm_cycles=2)
+        bad = probe_report(suspect_devices=[0])
+        clean = probe_report()
+        policy.observe_report(bad)
+        policy.observe_report(clean)  # resets
+        assert policy.observe_report(bad) == []  # streak restarted at 1
+        records = policy.observe_report(bad)
+        assert len(records) == 1 and records[0].node == "tpu-node-0"
+
+    def test_dead_local_chip_implicates_its_node(self, mock_api):
+        policy, _ = self.make_policy(mock_api, confirm_cycles=1)
+        records = policy.observe_report(probe_report(dead_devices=[3]))
+        assert len(records) == 1 and records[0].node == "tpu-node-1"
+        assert "liveness" in records[0].reason
+
+    def test_unmapped_process_never_acts(self, mock_api):
+        policy, actuator = self.make_policy(mock_api, confirm_cycles=1)
+        hosts = {"0": {"hostname": "h0", "process_index": 0}}  # no node_name anywhere
+        records = policy.observe_report(probe_report(suspect_devices=[0], hosts=hosts))
+        assert records == []
+        assert actuator.quarantined_nodes() == []
+
+    def test_notifications_carry_evidence_and_actions(self, mock_api):
+        sent = []
+        policy, _ = self.make_policy(mock_api, confirm_cycles=1, sink=sent.append)
+        policy.observe_report(probe_report(suspect_devices=[2]))
+        assert len(sent) == 1
+        payload = sent[0]
+        assert payload["event_type"] == "TPU_REMEDIATION"
+        assert "tpu-node-1" in payload["implicated"]
+        assert payload["actions"] and payload["actions"][0]["node"] == "tpu-node-1"
+        assert payload["quarantined_nodes"] == ["tpu-node-1"]
+
+    def test_healthy_report_emits_nothing(self, mock_api):
+        sent = []
+        policy, _ = self.make_policy(mock_api, confirm_cycles=1, sink=sent.append)
+        assert policy.observe_report(probe_report()) == []
+        assert sent == []
+
+    def test_refused_action_restarts_streak(self, mock_api):
+        clock = FakeClock()
+        policy, actuator = self.make_policy(
+            mock_api, confirm_cycles=2, max_actions_per_hour=1, max_quarantined_nodes=10, clock=clock
+        )
+        a = probe_report(suspect_devices=[0])
+        b = probe_report(suspect_devices=[2])
+        # burn the hourly budget on node-0
+        policy.observe_report(a)
+        assert policy.observe_report(a)[0].ok
+        # node-1 confirms but is rate-limited; the streak must restart
+        # rather than hammer the fence every cycle
+        policy.observe_report(b)
+        records = policy.observe_report(b)
+        assert len(records) == 1 and not records[0].ok and "rate limit" in records[0].reason
+        assert policy.observe_report(b) == []  # re-earning confirmation
+
+    def test_snapshot_shape(self, mock_api):
+        policy, _ = self.make_policy(mock_api, confirm_cycles=3)
+        policy.observe_report(probe_report(suspect_devices=[0]))
+        snap = policy.snapshot()
+        assert snap["streaks"] == {"tpu-node-0": 1}
+        assert snap["confirm_cycles"] == 3
+        assert snap["quarantined_nodes"] == []
+
+
+class TestAgentWiring:
+    def test_report_observer_sees_agent_cycles(self, mock_api):
+        """End-to-end on the virtual mesh: a real agent cycle flows into the
+        policy (no suspects on a healthy CPU mesh -> no action, no crash)."""
+        from k8s_watcher_tpu.config.schema import TpuConfig
+        from k8s_watcher_tpu.probe.agent import ProbeAgent
+
+        seen = []
+        agent = ProbeAgent(
+            TpuConfig(probe_hbm_bytes=0, probe_matmul_size=64, probe_payload_bytes=1024),
+            environment="test",
+            sink=lambda n: None,
+            expected_platform=None,
+        )
+        policy, actuator = TestPolicy().make_policy(mock_api, confirm_cycles=1)
+        agent.report_observer = lambda r: seen.append(policy.observe_report(r))
+        report = agent.run_once()
+        assert len(seen) == 1
+        assert seen[0] == []  # healthy mesh: no actions
+        assert actuator.quarantined_nodes() == []
+
+    def test_observer_exception_does_not_kill_cycle(self):
+        from k8s_watcher_tpu.config.schema import TpuConfig
+        from k8s_watcher_tpu.probe.agent import ProbeAgent
+
+        agent = ProbeAgent(
+            TpuConfig(probe_hbm_bytes=0, probe_matmul_size=64, probe_payload_bytes=1024),
+            environment="test",
+            sink=lambda n: None,
+            expected_platform=None,
+        )
+        agent.report_observer = lambda r: 1 / 0
+        report = agent.run_once()  # must not raise
+        assert report is not None
+        assert agent.metrics.counter("probe_observer_errors").value == 1
