@@ -25,6 +25,74 @@ from k8s_watcher_tpu.parallel.mesh import initialize_multihost
 from k8s_watcher_tpu.probe.agent import ProbeAgent
 
 
+def _arm_remediation(agent, config, environment: str, dispatcher) -> None:
+    """Wire the remediation plane into the standalone agent
+    (tpu.remediation.enabled) — the DaemonSet deployment, where the watcher
+    never sees probe reports, so the agent itself must close the loop.
+
+    Only process 0 evaluates policy (the policy enforces this too), so one
+    actuator acts per slice: the safety fences — including
+    ``max_quarantined_nodes`` — are therefore PER SLICE AGENT here, not
+    cluster-wide (RUNBOOK.md). Needs get/patch on nodes via the pod's
+    ServiceAccount (deploy/rbac.yaml); without credentials the agent logs
+    and probes on, remediation-free.
+    """
+    import logging
+    import time as _time
+
+    if not config.tpu.remediation_enabled:
+        return
+    import jax
+
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        return
+    logger = logging.getLogger("probe_agent")
+    try:
+        from k8s_watcher_tpu.k8s.client import K8sClient
+        from k8s_watcher_tpu.k8s.kubeconfig import load_connection
+
+        connection = load_connection(
+            use_incluster=config.kubernetes.use_incluster_config,
+            config_file=config.kubernetes.config_file,
+            verify_tls=config.kubernetes.verify_tls,
+        )
+        client = K8sClient(connection, request_timeout=config.kubernetes.request_timeout)
+        client.get_api_version()  # fail fast: no cluster -> no remediation
+    except Exception as exc:  # noqa: BLE001 — probing must survive without a cluster
+        logger.warning("tpu.remediation enabled but no usable k8s credentials (%s); probing without remediation", exc)
+        return
+
+    from k8s_watcher_tpu.pipeline.pipeline import Notification
+    from k8s_watcher_tpu.remediate import NodeActuator, ProbeRemediationPolicy
+
+    t = config.tpu
+    actuator = NodeActuator(
+        client,
+        dry_run=t.remediation_dry_run,
+        cordon=t.remediation_cordon,
+        taint_key=t.remediation_taint_key,
+        taint_value=t.remediation_taint_value,
+        taint_effect=t.remediation_taint_effect,
+        cooldown_seconds=t.remediation_cooldown_seconds,
+        max_actions_per_hour=t.remediation_max_actions_per_hour,
+        max_quarantined_nodes=t.remediation_max_quarantined_nodes,
+        metrics=agent.metrics,
+    )
+    agent.report_observer = ProbeRemediationPolicy(
+        actuator,
+        confirm_cycles=t.remediation_confirm_cycles,
+        sink=lambda payload: dispatcher.submit(
+            Notification(payload, _time.monotonic(), kind="remediation")
+        ),
+        metrics=agent.metrics,
+        environment=environment,
+    ).observe_report
+    logger.info(
+        "Remediation armed on the slice agent (dry_run=%s, confirm_cycles=%d)",
+        t.remediation_dry_run, t.remediation_confirm_cycles,
+    )
+
+
 def main() -> int:
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     once = "--once" in sys.argv
@@ -67,6 +135,7 @@ def main() -> int:
         config.tpu, environment=environment, sink=dispatcher.submit,
         heartbeat=liveness.beat if liveness is not None else None,
     )
+    _arm_remediation(agent, config, environment, dispatcher)
     if liveness is not None:
         status_server = StatusServer(
             agent.metrics,

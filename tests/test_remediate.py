@@ -345,6 +345,98 @@ class TestPolicy:
         assert snap["quarantined_nodes"] == []
 
 
+class TestStandaloneAgentArming:
+    """scripts/probe_agent.py arms the same policy on slice agents
+    (DaemonSet mode) — with credentials it quarantines; without, it probes
+    on remediation-free."""
+
+    @staticmethod
+    def _load_script():
+        import importlib.util
+        from pathlib import Path
+
+        path = Path(__file__).resolve().parent.parent / "scripts" / "probe_agent.py"
+        spec = importlib.util.spec_from_file_location("probe_agent_script", path)
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        return module
+
+    @staticmethod
+    def _config(tmp_path, server_url=None, **tpu_overrides):
+        import dataclasses
+        import json as _json
+
+        from conftest import CONFIG_DIR
+        from k8s_watcher_tpu.config.loader import load_config
+
+        config = load_config("development", CONFIG_DIR, env={})
+        kubernetes = config.kubernetes
+        if server_url is not None:
+            kc = tmp_path / "kubeconfig.json"
+            kc.write_text(_json.dumps({
+                "apiVersion": "v1", "kind": "Config",
+                "clusters": [{"name": "m", "cluster": {"server": server_url}}],
+                "contexts": [{"name": "m", "context": {"cluster": "m", "user": "m"}}],
+                "current-context": "m",
+                "users": [{"name": "m", "user": {"token": "t"}}],
+            }))
+            kubernetes = dataclasses.replace(kubernetes, use_mock=False, config_file=str(kc))
+        tpu = dataclasses.replace(
+            config.tpu,
+            remediation_enabled=True,
+            remediation_dry_run=False,
+            remediation_confirm_cycles=1,
+            remediation_cooldown_seconds=0.0,
+            **tpu_overrides,
+        )
+        return dataclasses.replace(config, kubernetes=kubernetes, tpu=tpu)
+
+    def _agent(self):
+        from k8s_watcher_tpu.config.schema import TpuConfig
+        from k8s_watcher_tpu.probe.agent import ProbeAgent
+
+        return ProbeAgent(
+            TpuConfig(probe_hbm_bytes=0, probe_matmul_size=64, probe_payload_bytes=1024),
+            environment="test", sink=lambda n: None, expected_platform=None,
+        )
+
+    def test_arms_and_quarantines_with_credentials(self, mock_api, tmp_path):
+        script = self._load_script()
+        config = self._config(tmp_path, mock_api.url)
+        agent = self._agent()
+        sent = []
+
+        class FakeDispatcher:
+            def submit(self, notification):
+                sent.append(notification)
+
+        script._arm_remediation(agent, config, "test", FakeDispatcher())
+        assert agent.report_observer is not None
+        agent.report_observer(probe_report(suspect_devices=[2]))
+        node = make_client(mock_api).get_node("tpu-node-1")
+        assert node["spec"].get("unschedulable") is True
+        assert sent and sent[0].kind == "remediation"
+
+    def test_no_credentials_probes_on(self, tmp_path):
+        script = self._load_script()
+        config = self._config(tmp_path, "http://127.0.0.1:1")  # nothing listens
+        agent = self._agent()
+        script._arm_remediation(agent, config, "test", None)  # must not raise
+        assert agent.report_observer is None
+
+    def test_disabled_is_a_noop(self, tmp_path):
+        import dataclasses
+
+        script = self._load_script()
+        config = self._config(tmp_path)
+        config = dataclasses.replace(
+            config, tpu=dataclasses.replace(config.tpu, remediation_enabled=False)
+        )
+        agent = self._agent()
+        script._arm_remediation(agent, config, "test", None)
+        assert agent.report_observer is None
+
+
 class TestAgentWiring:
     def test_report_observer_sees_agent_cycles(self, mock_api):
         """End-to-end on the virtual mesh: a real agent cycle flows into the
