@@ -393,6 +393,10 @@ class TpuConfig:
     # cross-slice DCN aggregation probe (probe/multislice.py)
     probe_multislice_enabled: bool = False
     probe_multislice_slices: int = 0  # 0 = infer from Device.slice_index
+    # per-pair DCN walk: O(n_slices^2) small programs localizing WHICH
+    # slice's DCN path is degraded (the slice-level analogue of the link
+    # walk); cheap at realistic slice counts
+    probe_multislice_pair_localization: bool = True
     # SURVEY.md §5 tracing substitute: when set, each probe cycle is wrapped
     # in jax.profiler.trace(dir) producing a TensorBoard-loadable trace
     probe_profile_dir: Optional[str] = None
@@ -475,7 +479,8 @@ class TpuConfig:
             ("enabled", "interval_seconds", "status_port", "payload_bytes", "rtt_warn_ms", "matmul_size",
              "hbm_bytes", "hbm_write_enabled", "expected_chips_per_host", "links_enabled",
              "link_rtt_factor", "link_rtt_floor_ms", "multislice_enabled",
-             "multislice_slices", "profile_dir", "trend_enabled", "trend_window",
+             "multislice_slices", "multislice_pair_localization",
+             "profile_dir", "trend_enabled", "trend_window",
              "trend_recent", "trend_drop_factor", "trend_rise_factor",
              "trend_min_history"),
             "tpu.probe",
@@ -536,6 +541,9 @@ class TpuConfig:
             probe_trend_min_history=trend_min_history,
             probe_multislice_enabled=_opt_bool(probe, "multislice_enabled", "tpu.probe", False),
             probe_multislice_slices=_opt_int(probe, "multislice_slices", "tpu.probe", 0),
+            probe_multislice_pair_localization=_opt_bool(
+                probe, "multislice_pair_localization", "tpu.probe", True
+            ),
             probe_profile_dir=_opt_str(probe, "profile_dir", "tpu.probe", None),
             node_watch_enabled=_opt_bool(node_watch, "enabled", "tpu.node_watch", False),
             node_watch_label_selector=_opt_str(node_watch, "label_selector", "tpu.node_watch", None),

@@ -17,6 +17,7 @@ import time
 from typing import Any, Callable, Optional
 
 import jax
+import numpy as np
 
 from k8s_watcher_tpu.config.schema import TpuConfig
 from k8s_watcher_tpu.metrics import MetricsRegistry
@@ -122,7 +123,8 @@ class ProbeAgent:
             # the hybrid mesh has its own (slices, hosts, chips) shape —
             # built from the runtime topology, not from self.mesh
             multislice = run_multislice_probe(
-                n_slices=self.config.probe_multislice_slices or None
+                n_slices=self.config.probe_multislice_slices or None,
+                pair_localization=self.config.probe_multislice_pair_localization,
             )
         hbm = None
         hbm_write = None
@@ -152,7 +154,7 @@ class ProbeAgent:
         # "healthy" anchor — an agent started during congestion would
         # otherwise freeze the congested readings in as the baseline
         report.trend_alerts = self._fold_trends(
-            ici, mxu, hbm, hbm_write, links, cycle_healthy=report.healthy
+            ici, mxu, hbm, hbm_write, links, multislice, cycle_healthy=report.healthy
         )
         self.metrics.counter("probe_runs").inc()
         if ici.psum_rtt_ms >= 0:
@@ -182,7 +184,9 @@ class ProbeAgent:
     # of None means the sub-probe errored or doesn't apply THIS cycle: its
     # gauge is cleared (a frozen last-healthy value would show dashboards a
     # healthy chip while it is dead) and no trend sample is folded.
-    def _fold_trends(self, ici, mxu, hbm, hbm_write, links, *, cycle_healthy: bool = True) -> list:
+    def _fold_trends(
+        self, ici, mxu, hbm, hbm_write, links, multislice=None, *, cycle_healthy: bool = True
+    ) -> list:
         # gate on the SAME ok fields ProbeReport.healthy uses — an
         # integrity-failed or non-finite probe has no 'error' string but its
         # readings describe a broken chip and must neither stay on a gauge
@@ -199,6 +203,13 @@ class ProbeAgent:
         # suspect) links it doesn't own — its inter-host edges record on
         # the lower-indexed peer, leaving n_links == 0 on valid walks
         links_ok = links is not None and links.error is None and links.n_observed > 0
+        # multislice DCN readings: like links, a walk that FOUND suspects is
+        # a valid reading; an errored or unreliable-timing one is not. The
+        # pair median trends the typical inter-slice route; dcn_overhead_ms
+        # is the aggregated DCN cost a fabric event inflates first.
+        ms_ok = multislice is not None and multislice.error is None and not multislice.timing_unreliable
+        pair_valid = [p["rtt_ms"] for p in multislice.pair_rtts if p["rtt_ms"] >= 0] if ms_ok else []
+        pair_median = float(np.median(pair_valid)) if pair_valid else None
         readings = [
             ("psum_rtt_median_ms", ici.psum_rtt_median_ms if ici_ok else None, False),
             ("allreduce_bus_gbps_median", ici.bandwidth_gbps_median if ici_ok else None, True),
@@ -206,6 +217,8 @@ class ProbeAgent:
             ("hbm_read_gbps", hbm.get("read_gbps", 0.0) if hbm_ok else None, True),
             ("hbm_write_gbps", hbm_write.get("write_gbps", 0.0) if hbm_w_ok else None, True),
             ("link_median_rtt_ms", links.median_rtt_ms if links_ok else None, False),
+            ("dcn_pair_median_rtt_ms", pair_median, False),
+            ("dcn_overhead_ms", multislice.dcn_overhead_ms if ms_ok and multislice.n_slices > 1 else None, False),
         ]
         if links_ok:
             self.metrics.gauge("probe_link_suspects").set(len(links.suspect_links))

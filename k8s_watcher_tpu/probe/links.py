@@ -142,9 +142,20 @@ def enumerate_links(mesh) -> List[Tuple[str, str, jax.Device, jax.Device]]:
 
 
 def classify_links(
-    observed: List[LinkResult], rtt_factor: float, rtt_floor_ms: float
+    observed: List[LinkResult],
+    rtt_factor: float,
+    rtt_floor_ms: float,
+    baseline_stat: str = "median",
 ) -> Tuple[List[Dict[str, Any]], List[int]]:
     """Pure suspect classification: ``(suspect_links, suspect_devices)``.
+
+    ``baseline_stat`` picks the healthy-baseline estimator for populations
+    of >=3: ``"median"`` (default — robust to jitter when a bad endpoint
+    taints a small FRACTION of links, as in the torus walk where a chip
+    touches ~2 of O(hosts*chips) edges) or ``"min"`` (for walks where one
+    bad endpoint contaminates a large fraction — the slice-pair DCN walk's
+    bad slice taints 2/n of ALL pairs, 50% at n=4, which drags the median
+    past any factor; the min anchors the healthiest route instead).
 
     A link is suspect when it errored, failed its payload checksum
     ("corrupt"), or its RTT exceeds ``max(rtt_floor_ms, rtt_factor *
@@ -166,13 +177,15 @@ def classify_links(
     and only the floor applies. A device is suspect when it is an endpoint
     of >=2 suspect links (one bad link implicates the link, not a chip).
     """
+    if baseline_stat not in ("median", "min"):
+        raise ValueError(f"baseline_stat must be 'median' or 'min', got {baseline_stat!r}")
     thresholds: Dict[str, float] = {}
     for axis in {r.axis for r in observed}:
         population = [r.rtt_ms for r in observed if r.axis == axis and r.rtt_ms >= 0]
         if not population:
             base = 0.0
         elif len(population) >= 3:
-            base = float(np.median(population))
+            base = float(np.median(population)) if baseline_stat == "median" else min(population)
         elif len(population) == 2:
             base = min(population)
         else:

@@ -10,10 +10,19 @@ slice, **DCN** across slices. This prober runs over the hybrid
 - chained subset-axis psums that time the ICI-only path and the full
   ICI+DCN path separately, so ``dcn_overhead_ms = t(all) - t(ici)`` is the
   cross-slice fabric's own cost — the number that blows up when DCN (not
-  ICI) is degraded.
+  ICI) is degraded;
+- a **per-pair DCN walk** (the slice-level analogue of probe/links.py):
+  for every slice pair (i, j) a ``slices``-axis-only chained psum over the
+  2-slice submesh times exactly the DCN path between those slices. A slow
+  SLICE endpoint (its DCN NIC/path) stretches every pair it touches — the
+  common endpoint of ≥2 suspect pairs is the suspect slice; a degraded
+  single route stretches only its own pair; corruption fails the pair's
+  checksum. Classification reuses the link prober's per-axis
+  median/min-threshold discipline (probe/links.py:classify_links) with
+  axis ``"dcn"``.
 
 Single-slice deployments degenerate cleanly: one slice, no DCN hop,
-``dcn_overhead_ms`` ~ 0.
+``dcn_overhead_ms`` ~ 0, no pairs to walk.
 """
 
 from __future__ import annotations
@@ -35,6 +44,7 @@ from k8s_watcher_tpu.parallel.collectives import (
     psum_probe_input,
 )
 from k8s_watcher_tpu.parallel.mesh import hybrid_slice_mesh
+from k8s_watcher_tpu.probe.links import LinkResult, classify_links
 from k8s_watcher_tpu.probe.timing import fence_baseline_ms, timed_fenced
 
 logger = logging.getLogger(__name__)
@@ -54,9 +64,74 @@ class MultiSliceProbeResult:
     error: Optional[str] = None
     # True when fence noise swamps the timed ops (see probe/timing.py)
     timing_unreliable: bool = False
+    # per-pair DCN walk (module docstring): one record per slice pair,
+    # classified with the link prober's outlier discipline
+    pair_rtts: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    suspect_pairs: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    # slice indices implicated by >=2 suspect pairs (their DCN endpoint)
+    dcn_suspect_slices: List[int] = dataclasses.field(default_factory=list)
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
+
+
+def _slice_pair_submesh(mesh, i: int, j: int):
+    """The ``(2, hosts, chips)`` submesh of slices ``i`` and ``j``."""
+    from jax.sharding import Mesh
+
+    grid = np.asarray(mesh.devices)
+    return Mesh(np.stack([grid[i], grid[j]], axis=0), mesh.axis_names)
+
+
+def _walk_slice_pairs(
+    mesh,
+    *,
+    iters: int,
+    inner_iters: int,
+    baseline_ms: float,
+    fault: Optional[IciFaultSpec],
+) -> tuple:
+    """Time the DCN path between every slice pair; returns
+    ``(records, compile_s, any_unreliable)``.
+
+    The probed program is a ``slices``-axis-only chained psum over the
+    2-slice submesh: each (host, chip) position exchanges with its
+    counterpart in the other slice, so the traffic rides exactly the
+    inter-slice DCN route — ICI never enters the timing. Per-pair
+    containment mirrors the link walk: one failing pair becomes an error
+    record, the walk continues.
+    """
+    n_sl = mesh.shape["slices"]
+    records: List[LinkResult] = []
+    compile_s = 0.0
+    any_unreliable = False
+    for i in range(n_sl):
+        for j in range(i + 1, n_sl):
+            name = f"slice{i}-slice{j}"
+            try:
+                sub = _slice_pair_submesh(mesh, i, j)
+                fn = make_subaxis_psum_probe(sub, ("slices",), inner_iters, fault)
+                x = psum_probe_input(sub)
+                t0 = time.perf_counter()
+                out = np.asarray(jax.block_until_ready(fn(x)))  # warmup + checksum
+                compile_s += time.perf_counter() - t0
+                expected = np.arange(1.0, sub.size + 1.0, dtype=np.float32).reshape(2, -1).mean(axis=0)
+                correct = bool(np.allclose(out.ravel(), expected, rtol=1e-3, atol=1e-3))
+                stats = timed_fenced(fn, x, iters, baseline_ms)
+                any_unreliable = any_unreliable or stats.unreliable
+                records.append(LinkResult(
+                    axis="dcn", name=name, device_ids=(i, j),
+                    rtt_ms=1e3 * stats[0] / inner_iters,
+                    rtt_mean_ms=1e3 * stats[1] / inner_iters,
+                    correct=correct,
+                ))
+            except Exception as exc:  # noqa: BLE001 — per-pair containment
+                logger.warning("Slice-pair probe %s failed: %s", name, exc)
+                records.append(LinkResult(
+                    axis="dcn", name=name, device_ids=(i, j),
+                    rtt_ms=-1.0, rtt_mean_ms=-1.0, correct=False, error=str(exc),
+                ))
+    return records, compile_s, any_unreliable
 
 
 def run_multislice_probe(
@@ -66,6 +141,9 @@ def run_multislice_probe(
     iters: int = 5,
     inner_iters: int = 8,
     fault: Optional[IciFaultSpec] = None,
+    pair_localization: bool = True,
+    pair_rtt_factor: float = 3.0,
+    pair_rtt_floor_ms: float = 0.2,
 ) -> MultiSliceProbeResult:
     """Correctness + localization via the hierarchical psum, ICI vs DCN
     latency via subset-axis chained psums. ``mesh`` defaults to
@@ -106,13 +184,36 @@ def run_multislice_probe(
         ici_s = ici_stats[0] / inner_iters
         total_s = total_stats[0] / inner_iters
 
+        pair_records: List[LinkResult] = []
+        suspect_pairs: List[Dict[str, Any]] = []
+        dcn_suspect_slices: List[int] = []
+        pairs_unreliable = False
+        pair_compile_s = 0.0
+        if pair_localization and n_sl >= 2:
+            pair_records, pair_compile_s, pairs_unreliable = _walk_slice_pairs(
+                mesh, iters=iters, inner_iters=inner_iters,
+                baseline_ms=baseline_ms, fault=fault,
+            )
+            # min-baseline: a bad slice endpoint taints 2/n of ALL pairs
+            # (50% at n=4), which drags a median baseline past any factor —
+            # the healthiest route anchors the threshold instead
+            suspect_pairs, dcn_suspect_slices = classify_links(
+                pair_records, pair_rtt_factor, pair_rtt_floor_ms, baseline_stat="min"
+            )
+            if suspect_pairs:
+                logger.warning(
+                    "Slice-pair DCN walk: %d/%d suspect pairs: %s; suspect slices: %s",
+                    len(suspect_pairs), len(pair_records),
+                    [s["name"] for s in suspect_pairs], dcn_suspect_slices,
+                )
+
         if suspect:
             logger.warning(
                 "Multi-slice probe: per-slice sums %s deviate from %.1f in slices %s",
                 per_slice, expected, suspect,
             )
         return MultiSliceProbeResult(
-            ok=not suspect and global_ok,
+            ok=not suspect and global_ok and not suspect_pairs,
             n_slices=n_sl,
             devices_per_slice=per_slice_devices,
             per_slice_sums=per_slice,
@@ -120,8 +221,11 @@ def run_multislice_probe(
             ici_rtt_ms=1e3 * ici_s,
             total_rtt_ms=1e3 * total_s,
             dcn_overhead_ms=max(0.0, 1e3 * (total_s - ici_s)),
-            compile_ms=compile_ms,
-            timing_unreliable=ici_stats.unreliable or total_stats.unreliable,
+            compile_ms=compile_ms + 1e3 * pair_compile_s,
+            timing_unreliable=ici_stats.unreliable or total_stats.unreliable or pairs_unreliable,
+            pair_rtts=[dataclasses.asdict(r) for r in pair_records],
+            suspect_pairs=suspect_pairs,
+            dcn_suspect_slices=dcn_suspect_slices,
         )
     except Exception as exc:
         logger.error("Multi-slice probe failed: %s", exc)

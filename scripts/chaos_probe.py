@@ -92,17 +92,45 @@ def main() -> int:
             "suspect_slices": ms.suspect_slices,
             "per_slice_sums": ms.per_slice_sums,
             "dcn_overhead_ms": round(ms.dcn_overhead_ms, 4),
+            "suspect_pairs": [s["name"] for s in ms.suspect_pairs],
+            "dcn_suspect_slices": ms.dcn_suspect_slices,
         }
-        if args.corrupt_device is not None:
-            # a slow chip doesn't perturb checksums, so only corruption has
-            # a slice-level localization contract to grade
-            hmesh = hybrid_slice_mesh(n_slices=args.slices)
-            expected_slices = [
+        hmesh = hybrid_slice_mesh(n_slices=args.slices)
+
+        def slices_of(device_id):
+            return [
                 s for s in range(args.slices)
-                if args.corrupt_device in [d.id for d in hmesh.devices[s].flatten()]
+                if device_id in [d.id for d in hmesh.devices[s].flatten()]
             ]
+
+        if args.corrupt_device is not None:
+            # corruption perturbs checksums: the hierarchical sums name the
+            # slice; the pair walk corroborates — naming the slice when >= 3
+            # slices can triangulate, or at least flagging every pair that
+            # touches it when 2 slices leave only one pair (no third
+            # endpoint to vote with)
+            expected_slices = slices_of(args.corrupt_device)
             localized = ms.suspect_slices == expected_slices
+            if args.slices >= 3:
+                localized = localized and ms.dcn_suspect_slices == expected_slices
+            else:
+                touching = {
+                    s["name"] for s in ms.suspect_pairs if s["reason"] == "corrupt"
+                }
+                expected_pairs = {
+                    f"slice{min(i, s)}-slice{max(i, s)}"
+                    for s in expected_slices for i in range(args.slices) if i != s
+                }
+                localized = localized and touching == expected_pairs
             result["multislice"]["localized_correctly"] = localized
+            ok = ok and localized
+        if args.slow_device is not None and args.slices >= 3:
+            # a slow chip passes every checksum — only the pair walk can
+            # turn it into a slice verdict, and triangulation needs >= 3
+            # slices (2 slices = 1 pair = no relative baseline)
+            expected_slices = slices_of(args.slow_device)
+            localized = ms.dcn_suspect_slices == expected_slices
+            result["multislice"]["slow_localized_correctly"] = localized
             ok = ok and localized
 
     print(json.dumps(result, indent=2))
