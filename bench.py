@@ -19,6 +19,7 @@ Prints ONE JSON line:
 from __future__ import annotations
 
 import json
+import statistics
 import sys
 import threading
 import time
@@ -295,7 +296,13 @@ def _virtual_probes_child(n_devices: int) -> int:
     # generous floor: virtual-mesh links jitter with host scheduling; the
     # block reports collective-path health, not latency outliers
     links = run_link_probe(iters=3, inner_iters=4, rtt_floor_ms=5.0)
-    multi = run_multislice_probe(n_slices=2, iters=3, inner_iters=8)
+    # 4 slices so the per-pair DCN walk has real triangulation geometry
+    # (6 pairs); the generous floor mirrors the link walk's
+    multi = run_multislice_probe(
+        n_slices=4 if n_devices % 4 == 0 else 2, iters=3, inner_iters=8,
+        pair_rtt_floor_ms=5.0,
+    )
+    pair_valid = [p["rtt_ms"] for p in multi.pair_rtts if p["rtt_ms"] >= 0]
     out = {
         "virtual": True,  # CPU mesh: collective-path health, not ICI hardware
         "n_devices": n_devices,
@@ -311,6 +318,10 @@ def _virtual_probes_child(n_devices: int) -> int:
         "multislice_ok": multi.ok,
         "multislice_ici_rtt_ms": round(multi.ici_rtt_ms, 4),
         "multislice_dcn_overhead_ms": round(multi.dcn_overhead_ms, 4),
+        "multislice_timing_unreliable": multi.timing_unreliable,
+        "dcn_pair_count": len(multi.pair_rtts),
+        "dcn_pair_median_rtt_ms": round(float(statistics.median(pair_valid)), 4) if pair_valid else -1.0,
+        "dcn_pair_suspects": len(multi.suspect_pairs),
         "probe_ok": ici.ok and links.ok and multi.ok,
         "errors": _probe_errors(ici=ici.error, links=links.error, multislice=multi.error),
     }
