@@ -41,11 +41,11 @@ def _arm_remediation(agent, config, environment: str, dispatcher) -> None:
     import time as _time
 
     if not config.tpu.remediation_enabled:
-        return
+        return None
     import jax
 
     if jax.process_count() > 1 and jax.process_index() != 0:
-        return
+        return None
     logger = logging.getLogger("probe_agent")
     try:
         from k8s_watcher_tpu.k8s.client import K8sClient
@@ -60,7 +60,7 @@ def _arm_remediation(agent, config, environment: str, dispatcher) -> None:
         client.get_api_version()  # fail fast: no cluster -> no remediation
     except Exception as exc:  # noqa: BLE001 — probing must survive without a cluster
         logger.warning("tpu.remediation enabled but no usable k8s credentials (%s); probing without remediation", exc)
-        return
+        return None
 
     from k8s_watcher_tpu.pipeline.pipeline import Notification
     from k8s_watcher_tpu.remediate import NodeActuator, ProbeRemediationPolicy
@@ -78,7 +78,7 @@ def _arm_remediation(agent, config, environment: str, dispatcher) -> None:
         max_quarantined_nodes=t.remediation_max_quarantined_nodes,
         metrics=agent.metrics,
     )
-    agent.report_observer = ProbeRemediationPolicy(
+    policy = ProbeRemediationPolicy(
         actuator,
         confirm_cycles=t.remediation_confirm_cycles,
         sink=lambda payload: dispatcher.submit(
@@ -86,11 +86,13 @@ def _arm_remediation(agent, config, environment: str, dispatcher) -> None:
         ),
         metrics=agent.metrics,
         environment=environment,
-    ).observe_report
+    )
+    agent.report_observer = policy.observe_report
     logger.info(
         "Remediation armed on the slice agent (dry_run=%s, confirm_cycles=%d)",
         t.remediation_dry_run, t.remediation_confirm_cycles,
     )
+    return policy
 
 
 def main() -> int:
@@ -135,15 +137,19 @@ def main() -> int:
         config.tpu, environment=environment, sink=dispatcher.submit,
         heartbeat=liveness.beat if liveness is not None else None,
     )
-    _arm_remediation(agent, config, environment, dispatcher)
+    remediation = _arm_remediation(agent, config, environment, dispatcher)
     if liveness is not None:
         status_server = StatusServer(
             agent.metrics,
             liveness,
             port=config.tpu.probe_status_port,
             trend=agent.trend.snapshot if agent.trend is not None else None,
+            remediation=remediation.snapshot if remediation is not None else None,
         ).start()
-        print(f"probe status endpoint on :{status_server.port} (/metrics, /healthz, /debug/trend)")
+        routes = "/metrics, /healthz, /debug/trend" + (
+            ", /debug/remediation" if remediation is not None else ""
+        )
+        print(f"probe status endpoint on :{status_server.port} ({routes})")
 
     if once:
         report = agent.run_once()

@@ -383,6 +383,99 @@ class TestChurnLoad:
             f"injected device not localized: {report['links']['suspect_devices']}"
         )
 
+    def test_remediation_quarantines_during_churn(self, monkeypatch):
+        """The full acceptance shape with the loop CLOSED: pod churn keeps
+        flowing through the dispatcher while an injected ICI fault is
+        localized, confirmed across cycles, and quarantines the node on
+        the (mock) apiserver — detection AND actuation under load."""
+        import k8s_watcher_tpu.probe.links as links_mod
+        from k8s_watcher_tpu.config.schema import TpuConfig
+        from k8s_watcher_tpu.faults.ici import IciFaultSpec
+        from k8s_watcher_tpu.k8s.client import K8sClient
+        from k8s_watcher_tpu.k8s.kubeconfig import K8sConnection
+        from k8s_watcher_tpu.k8s.mock_server import MockApiServer, MockCluster
+        from k8s_watcher_tpu.probe.agent import ProbeAgent
+        from k8s_watcher_tpu.remediate import NodeActuator, ProbeRemediationPolicy
+
+        # the identity join the DaemonSet's downward API provides
+        monkeypatch.setenv("NODE_NAME", "churn-node-0")
+        # corrupt-device fault: device 3 fails the checksum of both links
+        # it touches — deterministic triangulation regardless of host load
+        real = links_mod.run_link_probe
+        monkeypatch.setattr(
+            links_mod, "run_link_probe",
+            lambda mesh=None, **kw: real(
+                mesh, **kw, fault=IciFaultSpec(corrupt_device_id=3)
+            ),
+        )
+
+        cluster = MockCluster()
+        cluster.add_node({"metadata": {"name": "churn-node-0"}, "spec": {},
+                          "status": {"conditions": [{"type": "Ready", "status": "True"}]}})
+        metrics = MetricsRegistry()
+        payloads = []
+        lock = threading.Lock()
+
+        def send(p):
+            with lock:
+                payloads.append(p)
+            return True
+
+        with MockApiServer(cluster) as api:
+            dispatcher = Dispatcher(send, capacity=4096, workers=2, metrics=metrics)
+            dispatcher.start()
+            pipeline = EventPipeline(
+                environment="production",
+                sink=dispatcher.submit,
+                slice_tracker=SliceTracker("production"),
+                metrics=metrics,
+                resource_filter=TpuResourceFilter("google.com/tpu"),
+            )
+            agent = ProbeAgent(
+                TpuConfig(probe_enabled=True, probe_interval_seconds=0.1,
+                          probe_payload_bytes=1 << 14, probe_matmul_size=64,
+                          probe_hbm_bytes=0, probe_links_enabled=True,
+                          probe_link_rtt_floor_ms=5.0, probe_rtt_warn_ms=10_000.0),
+                environment="production", sink=dispatcher.submit,
+                metrics=metrics, expected_platform="cpu",
+            )
+            actuator = NodeActuator(
+                K8sClient(K8sConnection(server=api.url), request_timeout=5.0),
+                dry_run=False, cooldown_seconds=0.0,
+            )
+            import time as _t
+            from k8s_watcher_tpu.pipeline.pipeline import Notification
+
+            agent.report_observer = ProbeRemediationPolicy(
+                actuator, confirm_cycles=2,
+                sink=lambda p: dispatcher.submit(Notification(p, _t.monotonic(), kind="remediation")),
+                environment="production",
+            ).observe_report
+            agent.start()
+            try:
+                for event in ChurnGenerator(n_slices=4, workers_per_slice=4, seed=11).events(400):
+                    pipeline.process(event)
+                deadline = time.monotonic() + 30
+                quarantined = False
+                while time.monotonic() < deadline and not quarantined:
+                    node = cluster.get_node("churn-node-0")
+                    quarantined = bool((node.get("spec") or {}).get("unschedulable"))
+                    time.sleep(0.1)
+            finally:
+                agent.stop()
+                dispatcher.drain(20.0)
+                dispatcher.stop()
+
+            assert quarantined, "confirmed fault never quarantined the node under churn"
+            with lock:
+                pod_payloads = [p for p in payloads if p.get("event_type") in
+                                ("ADDED", "MODIFIED", "DELETED")]
+                remediation_payloads = [p for p in payloads
+                                        if p.get("event_type") == "TPU_REMEDIATION" and p.get("actions")]
+            assert pod_payloads, "churn notifications stopped flowing"
+            assert remediation_payloads, "no TPU_REMEDIATION notification delivered"
+            assert remediation_payloads[-1]["actions"][0]["node"] == "churn-node-0"
+
     def test_slice_events_under_churn(self):
         got = []
         pipeline = EventPipeline(
