@@ -281,33 +281,20 @@ class WatcherApp:
         if client is None:
             logger.warning("tpu.remediation enabled but the watch source has no k8s client (mock/fake source); skipping")
             return
-        import time as _time
-
         from k8s_watcher_tpu.k8s.client import K8sClient
-        from k8s_watcher_tpu.pipeline.pipeline import Notification
-        from k8s_watcher_tpu.remediate import NodeActuator, ProbeRemediationPolicy
+        from k8s_watcher_tpu.remediate import build_actuator, build_policy
 
         t = self.config.tpu
-        actuator = NodeActuator(
-            # dedicated client: node PATCHes must not contend with the
-            # watch stream (one client carries at most one live watch)
-            K8sClient(client.connection, request_timeout=self.config.kubernetes.request_timeout),
-            dry_run=t.remediation_dry_run,
-            cordon=t.remediation_cordon,
-            taint_key=t.remediation_taint_key,
-            taint_value=t.remediation_taint_value,
-            taint_effect=t.remediation_taint_effect,
-            cooldown_seconds=t.remediation_cooldown_seconds,
-            max_actions_per_hour=t.remediation_max_actions_per_hour,
-            max_quarantined_nodes=t.remediation_max_quarantined_nodes,
-            metrics=self.metrics,
-        )
-        self.remediation = ProbeRemediationPolicy(
-            actuator,
-            confirm_cycles=t.remediation_confirm_cycles,
-            sink=lambda payload: self.dispatcher.submit(
-                Notification(payload, _time.monotonic(), kind="remediation")
+        self.remediation = build_policy(
+            build_actuator(
+                # dedicated client: node PATCHes must not contend with the
+                # watch stream (one client carries at most one live watch)
+                K8sClient(client.connection, request_timeout=self.config.kubernetes.request_timeout),
+                t,
+                metrics=self.metrics,
             ),
+            t,
+            dispatcher=self.dispatcher,
             metrics=self.metrics,
             environment=self.config.environment,
         )

@@ -144,7 +144,13 @@ class KubernetesWatchSource:
         }
 
     def known_pods(self) -> dict:
-        """JSON-serializable live-pod skeleton map for the checkpoint."""
+        """JSON-serializable live-pod skeleton map for the checkpoint.
+
+        A SHALLOW copy is sound only because entries are never mutated in
+        place after insertion — ``_track`` replaces whole entries and
+        ``_relist`` strips the legacy flag from a copy. Keep it that way:
+        a throttled CheckpointStore may hold this snapshot (and its shared
+        inner dicts) until a later flush."""
         return dict(self._known)
 
     def stop(self) -> None:
@@ -182,7 +188,17 @@ class KubernetesWatchSource:
             yield WatchEvent(type=EventType.ADDED, pod=pod, resource_version=rv)
         for uid in [u for u in self._known if u not in listed_uids]:
             tombstone = self._known.pop(uid)
-            legacy = bool(tombstone.pop("legacy_tombstone", False))
+            legacy = bool(tombstone.get("legacy_tombstone", False))
+            if legacy:
+                # strip the marker from a COPY — a pending throttled
+                # checkpoint snapshot (known_pods() is a shallow copy) may
+                # still reference this entry, and popping in place would
+                # persist it flag-less: after a crash the restart would
+                # re-synthesize this DELETED without the flag, the
+                # accelerator filter would drop it, and the pod would leak
+                # in the phase/slice trackers — the exact leak the flag
+                # exists to prevent
+                tombstone = {k: v for k, v in tombstone.items() if k != "legacy_tombstone"}
             meta = tombstone.get("metadata") or {}
             logger.info(
                 "Relist: pod %s/%s vanished during disconnect; emitting DELETED",

@@ -2,7 +2,67 @@
 reference, whose notify path was read-only and disabled —
 clusterapi_client.py via SURVEY.md §2.8)."""
 
+from typing import Any, Callable, Dict, Optional
+
 from k8s_watcher_tpu.remediate.actuator import ActionRecord, NodeActuator
 from k8s_watcher_tpu.remediate.policy import ProbeRemediationPolicy
 
-__all__ = ["ActionRecord", "NodeActuator", "ProbeRemediationPolicy"]
+__all__ = [
+    "ActionRecord",
+    "NodeActuator",
+    "ProbeRemediationPolicy",
+    "build_actuator",
+    "build_policy",
+]
+
+
+def build_actuator(client, tpu_config, *, metrics=None, **overrides) -> NodeActuator:
+    """The one place ``tpu.remediation.*`` config maps onto NodeActuator
+    kwargs — the watcher (app.py), the standalone slice agent
+    (scripts/probe_agent.py), and the operator CLI (scripts/remediate_ctl.py)
+    all build through here so a new knob can't silently diverge between
+    them. ``overrides`` replace individual fields (the CLI relaxes the
+    fences: the operator is the rate limiter for manual actions)."""
+    kwargs: Dict[str, Any] = dict(
+        dry_run=tpu_config.remediation_dry_run,
+        cordon=tpu_config.remediation_cordon,
+        taint_key=tpu_config.remediation_taint_key,
+        taint_value=tpu_config.remediation_taint_value,
+        taint_effect=tpu_config.remediation_taint_effect,
+        cooldown_seconds=tpu_config.remediation_cooldown_seconds,
+        max_actions_per_hour=tpu_config.remediation_max_actions_per_hour,
+        max_quarantined_nodes=tpu_config.remediation_max_quarantined_nodes,
+    )
+    kwargs.update(overrides)
+    return NodeActuator(client, metrics=metrics, **kwargs)
+
+
+def build_policy(
+    actuator: NodeActuator,
+    tpu_config,
+    *,
+    dispatcher=None,
+    sink: Optional[Callable[[Dict[str, Any]], None]] = None,
+    metrics=None,
+    environment: str = "",
+) -> ProbeRemediationPolicy:
+    """Policy from config. Pass ``dispatcher`` to notify through the async
+    dispatch queue (the standard path: payloads become ``kind="remediation"``
+    notifications), or a raw ``sink`` callable for custom delivery."""
+    if dispatcher is not None:
+        if sink is not None:
+            raise ValueError("pass dispatcher or sink, not both")
+        import time
+
+        from k8s_watcher_tpu.pipeline.pipeline import Notification
+
+        def sink(payload, _submit=dispatcher.submit):  # noqa: F811 — the derived sink
+            _submit(Notification(payload, time.monotonic(), kind="remediation"))
+
+    return ProbeRemediationPolicy(
+        actuator,
+        confirm_cycles=tpu_config.remediation_confirm_cycles,
+        sink=sink,
+        metrics=metrics,
+        environment=environment,
+    )

@@ -102,10 +102,12 @@ class NodeActuator:
             self.metrics.counter("remediation_refusals").inc()
         return ActionRecord(node=node, action=action, ok=False, dry_run=self.dry_run, reason=reason)
 
-    def _reconcile_quarantined_locked(self) -> None:
+    _BUDGET_REFUSAL = "quarantine budget exhausted"
+
+    def _reconcile_quarantined(self) -> None:
         """Drop budget entries that no longer hold, so the budget reflects
-        reality rather than this process's memory. Called (lock held) only
-        when the budget is about to refuse — the slow path.
+        reality rather than this process's memory. Called only when the
+        budget is about to refuse — the slow path.
 
         Real mode: an operator releasing a node out-of-band
         (``remediate_ctl.py release``, or plain ``kubectl uncordon`` +
@@ -113,33 +115,46 @@ class NodeActuator:
         per remembered node notices and frees the slot — otherwise external
         releases would never free budget and the actuator would refuse
         forever after ``max_quarantined_nodes`` lifetime quarantines.
+        The GETs run OUTSIDE the lock (each can take a full request
+        timeout; holding the lock through them would block every other
+        decision, /debug snapshot, and notify path for their duration) —
+        membership is snapshotted first and expirations re-intersected
+        against the live set when applied.
 
         Dry-run mode: nothing was ever written, so there is no cluster
         state to consult; decisions age out after ``cooldown_seconds`` so a
         week of review-mode traffic keeps showing fresh would-quarantine
         decisions instead of degenerating into budget refusals.
         """
-        if self.dry_run:
-            now = self._clock()
-            expired = {
-                n for n in self._quarantined
-                if now - self._last_action.get(n, now) >= self.cooldown_seconds
-            }
-        else:
-            expired = set()
-            for n in list(self._quarantined):
-                try:
-                    spec = (self.client.get_node(n) or {}).get("spec") or {}
-                except K8sNotFoundError:
-                    expired.add(n)  # the node itself is gone
-                    continue
-                except K8sApiError:
-                    continue  # can't verify: keep the conservative entry
-                if not any(t.get("key") == self.taint_key for t in spec.get("taints") or []):
-                    expired.add(n)
+        with self._lock:
+            members = list(self._quarantined)
+            if self.dry_run:
+                now = self._clock()
+                expired = {
+                    n for n in members
+                    if now - self._last_action.get(n, now) >= self.cooldown_seconds
+                }
+                if expired:
+                    logger.info(
+                        "Remediation budget reconciled: %s aged out (dry-run)", sorted(expired)
+                    )
+                    self._quarantined -= expired
+                return
+        expired = set()
+        for n in members:  # network I/O — deliberately outside the lock
+            try:
+                spec = (self.client.get_node(n) or {}).get("spec") or {}
+            except K8sNotFoundError:
+                expired.add(n)  # the node itself is gone
+                continue
+            except K8sApiError:
+                continue  # can't verify: keep the conservative entry
+            if not any(t.get("key") == self.taint_key for t in spec.get("taints") or []):
+                expired.add(n)
         if expired:
             logger.info("Remediation budget reconciled: %s no longer quarantined", sorted(expired))
-            self._quarantined -= expired
+            with self._lock:
+                self._quarantined -= expired
 
     def _fence_check(self, node: str, action: str) -> Optional[str]:
         """The refusal reason, or None when the action may proceed.
@@ -156,10 +171,8 @@ class NodeActuator:
         if len(self._action_times) >= self.max_actions_per_hour:
             return f"rate limit: {len(self._action_times)} actions in the last hour (max {self.max_actions_per_hour})"
         if action == "quarantine" and node not in self._quarantined and len(self._quarantined) >= self.max_quarantined_nodes:
-            self._reconcile_quarantined_locked()
-        if action == "quarantine" and node not in self._quarantined and len(self._quarantined) >= self.max_quarantined_nodes:
             return (
-                f"quarantine budget exhausted: {sorted(self._quarantined)} already "
+                f"{self._BUDGET_REFUSAL}: {sorted(self._quarantined)} already "
                 f"quarantined (max {self.max_quarantined_nodes}) — a fleet-wide "
                 "signal needs a human, not more cordons"
             )
@@ -184,22 +197,42 @@ class NodeActuator:
         the budget set, so pre-restart quarantines still count against
         ``max_quarantined_nodes``.
         """
-        with self._lock:
-            refusal = self._fence_check(node, "quarantine")
-            if refusal:
-                return self._refuse(node, "quarantine", refusal)
-            # consume fences inside the lock; the PATCH itself runs outside
-            # (a slow apiserver must not serialize every other decision)
-            prior_last_action = self._last_action.get(node)
-            self._consume(node)
-            self._quarantined.add(node)
+        def check_and_consume():
+            """Atomically pass the fences and consume them; returns
+            ``(refusal, prior_last_action, was_quarantined)``."""
+            with self._lock:
+                refusal = self._fence_check(node, "quarantine")
+                if refusal:
+                    return refusal, None, False
+                # consume fences inside the lock; the PATCH itself runs
+                # outside (a slow apiserver must not serialize every other
+                # decision)
+                prior = self._last_action.get(node)
+                was = node in self._quarantined
+                self._consume(node)
+                self._quarantined.add(node)
+                return None, prior, was
+
+        refusal, prior_last_action, was_quarantined = check_and_consume()
+        if refusal is not None and refusal.startswith(self._BUDGET_REFUSAL):
+            # the budget may be stale (out-of-band releases, aged dry-run
+            # decisions): reconcile against reality — outside any lock —
+            # and re-run the fences once
+            self._reconcile_quarantined()
+            refusal, prior_last_action, was_quarantined = check_and_consume()
+        if refusal is not None:
+            return self._refuse(node, "quarantine", refusal)
         record = self._apply_quarantine(node, reason)
         with self._lock:
             if not record.ok:
                 # a transient GET/PATCH failure must not burn the fences: a
                 # consumed cooldown would lock a CONFIRMED-faulty node out
-                # of quarantine for cooldown_seconds over an apiserver blip
-                self._quarantined.discard(node)
+                # of quarantine for cooldown_seconds over an apiserver blip.
+                # Only evict the node from the budget if THIS call added it
+                # — a failed re-quarantine of a node that is already
+                # genuinely cordoned must keep occupying its slot
+                if not was_quarantined:
+                    self._quarantined.discard(node)
                 if prior_last_action is None:
                     self._last_action.pop(node, None)
                 else:

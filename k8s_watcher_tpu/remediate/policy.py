@@ -81,11 +81,25 @@ class ProbeRemediationPolicy:
 
         links = report.links
         if links is not None and links.error is None:
-            for device_id in links.suspect_devices:
-                implicate(
-                    id_to_process.get(device_id),
-                    f"link probe: device {device_id} is the common endpoint of >=2 suspect links",
-                )
+            # Re-triangulate from MEASURED defects only (slow RTT, corrupt
+            # checksum). links.suspect_devices also counts error/"skipped"
+            # records — right for reporting, wrong for actuation: when one
+            # process fails preparation, EVERY cross-process link on every
+            # process becomes an error-suspect, and acting on those would
+            # cordon healthy peers' nodes over an agent-infrastructure
+            # failure no probe ever measured.
+            endpoint_counts: Dict[Any, int] = {}
+            for s in links.suspect_links:
+                if s.get("reason") in ("slow", "corrupt"):
+                    for device_id in s.get("device_ids", ()):
+                        endpoint_counts[device_id] = endpoint_counts.get(device_id, 0) + 1
+            for device_id, count in sorted(endpoint_counts.items()):
+                if count >= 2:
+                    implicate(
+                        id_to_process.get(device_id),
+                        f"link probe: device {device_id} is the common endpoint of "
+                        f"{count} measured-suspect links",
+                    )
         for entry in devices:
             if entry.get("alive") is False:
                 implicate(

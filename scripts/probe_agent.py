@@ -38,7 +38,6 @@ def _arm_remediation(agent, config, environment: str, dispatcher) -> None:
     and probes on, remediation-free.
     """
     import logging
-    import time as _time
 
     if not config.tpu.remediation_enabled:
         return None
@@ -62,28 +61,13 @@ def _arm_remediation(agent, config, environment: str, dispatcher) -> None:
         logger.warning("tpu.remediation enabled but no usable k8s credentials (%s); probing without remediation", exc)
         return None
 
-    from k8s_watcher_tpu.pipeline.pipeline import Notification
-    from k8s_watcher_tpu.remediate import NodeActuator, ProbeRemediationPolicy
+    from k8s_watcher_tpu.remediate import build_actuator, build_policy
 
     t = config.tpu
-    actuator = NodeActuator(
-        client,
-        dry_run=t.remediation_dry_run,
-        cordon=t.remediation_cordon,
-        taint_key=t.remediation_taint_key,
-        taint_value=t.remediation_taint_value,
-        taint_effect=t.remediation_taint_effect,
-        cooldown_seconds=t.remediation_cooldown_seconds,
-        max_actions_per_hour=t.remediation_max_actions_per_hour,
-        max_quarantined_nodes=t.remediation_max_quarantined_nodes,
-        metrics=agent.metrics,
-    )
-    policy = ProbeRemediationPolicy(
-        actuator,
-        confirm_cycles=t.remediation_confirm_cycles,
-        sink=lambda payload: dispatcher.submit(
-            Notification(payload, _time.monotonic(), kind="remediation")
-        ),
+    policy = build_policy(
+        build_actuator(client, t, metrics=agent.metrics),
+        t,
+        dispatcher=dispatcher,
         metrics=agent.metrics,
         environment=environment,
     )

@@ -26,7 +26,7 @@ from k8s_watcher_tpu.config.loader import load_config, resolve_environment
 from k8s_watcher_tpu.k8s.client import K8sClient
 from k8s_watcher_tpu.k8s.kubeconfig import load_connection
 from k8s_watcher_tpu.logging_setup import setup_logging
-from k8s_watcher_tpu.remediate import NodeActuator
+from k8s_watcher_tpu.remediate import build_actuator
 
 
 def main() -> int:
@@ -75,13 +75,10 @@ def main() -> int:
     for flag in flags:
         if flag.startswith("--reason="):
             reason = flag[len("--reason="):]
-    actuator = NodeActuator(
+    actuator = build_actuator(
         client,
+        t,
         dry_run="--no-dry-run" not in flags,
-        cordon=t.remediation_cordon,
-        taint_key=t.remediation_taint_key,
-        taint_value=t.remediation_taint_value,
-        taint_effect=t.remediation_taint_effect,
         # the operator is the rate limiter for manual actions
         cooldown_seconds=0.0,
         max_actions_per_hour=1000,

@@ -623,6 +623,35 @@ class TestKubernetesWatchSource:
         assert got[0].legacy_tombstone
         assert TpuResourceFilter("google.com/tpu")(got[0])
 
+    def test_relist_does_not_mutate_pending_snapshot_entries(self, mock_api, tmp_path):
+        # known_pods() is a SHALLOW copy; a throttled checkpoint can hold
+        # that snapshot until a later flush. The relist must strip the
+        # legacy flag from a COPY — mutating the shared entry would persist
+        # it flag-less, and after a crash the re-synthesized DELETED would
+        # be dropped by the accelerator filter (the leak the flag prevents)
+        from k8s_watcher_tpu.state.checkpoint import CheckpointStore
+
+        ckpt = CheckpointStore(tmp_path / "ck.json", interval_seconds=0.0)
+        ckpt.put("known_pods", {"uid-old": ["ghost", "default", "Running"]})
+        ckpt.update_resource_version("1")
+        source = KubernetesWatchSource(
+            make_client(mock_api), watch_timeout_seconds=2, checkpoint=ckpt,
+            retry=RetryPolicy(max_attempts=5, delay_seconds=0.05, backoff_multiplier=1.0),
+        )
+        snapshot = source.known_pods()  # app-style snapshot, pre-relist
+        assert snapshot["uid-old"]["legacy_tombstone"] is True
+        mock_api.cluster.add_pod(build_pod("transient", uid="uid-tr"))
+        mock_api.cluster.delete_pod("default", "transient")
+        mock_api.cluster.compact()
+        got, done, t = self.collect(source, 1)
+        assert done.wait(10)
+        source.stop()
+        assert got[0].legacy_tombstone
+        # the event's pod must NOT carry the internal marker, and the
+        # earlier snapshot's entry must still carry it
+        assert "legacy_tombstone" not in got[0].pod
+        assert snapshot["uid-old"]["legacy_tombstone"] is True
+
     def test_malformed_legacy_entries_discarded_not_invented(self, mock_api, tmp_path):
         # null/number/STRING entries (strings iterate into characters!)
         # must be discarded, not turned into garbage tombstones

@@ -208,6 +208,22 @@ class TestActuator:
         assert actuator.quarantine("tpu-node-0", "x").ok
         assert actuator.quarantine("tpu-node-1", "y").ok
 
+    def test_failed_requarantine_keeps_budget_slot(self, mock_api):
+        """A transient failure while re-quarantining a node that is ALREADY
+        genuinely cordoned must not evict it from the budget set — that
+        would let max_quarantined_nodes be exceeded."""
+        actuator = make_actuator(mock_api, max_quarantined_nodes=2, max_actions_per_hour=100)
+        assert actuator.quarantine("tpu-node-0", "a").ok
+        assert actuator.quarantine("tpu-node-1", "b").ok
+        mock_api.cluster.fail_next(1, status=500)
+        failed = actuator.quarantine("tpu-node-0", "re-confirm")
+        assert not failed.ok
+        # node-0 is still cordoned on the apiserver and still occupies its
+        # slot; a third node must be refused
+        assert actuator.quarantined_nodes() == ["tpu-node-0", "tpu-node-1"]
+        blocked = actuator.quarantine("tpu-node-2", "c")
+        assert not blocked.ok and "budget" in blocked.reason
+
     def test_missing_node_errors_cleanly(self, mock_api):
         record = make_actuator(mock_api).quarantine("no-such-node", "x")
         assert not record.ok and "not found" in record.error
@@ -251,9 +267,19 @@ def probe_report(
     if suspect_devices:
         from k8s_watcher_tpu.probe.links import LinkProbeResult
 
+        # two MEASURED suspect links per device, like a real triangulation
+        # (the policy re-derives suspects from measured slow/corrupt links
+        # and requires >= 2 per device)
+        suspect_links = []
+        for d in suspect_devices:
+            for k, other in enumerate(((d + 1) % n_devices, (d - 1) % n_devices)):
+                suspect_links.append({
+                    "name": f"link{d}-{k}", "device_ids": [d, other],
+                    "reason": "slow", "rtt_ms": 9.0,
+                })
         links = LinkProbeResult(
             ok=False, n_links=4, n_observed=4, median_rtt_ms=0.1, links=[],
-            suspect_links=[{"name": "x", "device_ids": list(suspect_devices), "reason": "slow", "rtt_ms": 9.0}],
+            suspect_links=suspect_links,
             suspect_devices=list(suspect_devices), compile_ms=0.0,
         )
     if hosts is None:
@@ -294,6 +320,28 @@ class TestPolicy:
         records = policy.observe_report(probe_report(dead_devices=[3]))
         assert len(records) == 1 and records[0].node == "tpu-node-1"
         assert "liveness" in records[0].reason
+
+    def test_error_suspects_never_actuate(self, mock_api):
+        """Error/'skipped' link records implicate infrastructure, not
+        measured hardware: when one process fails preparation every
+        cross-process link becomes an error-suspect on every process —
+        acting on those would cordon healthy peers' nodes."""
+        from k8s_watcher_tpu.probe.links import LinkProbeResult
+
+        links = LinkProbeResult(
+            ok=False, n_links=4, n_observed=4, median_rtt_ms=0.1, links=[],
+            suspect_links=[
+                {"name": "a", "device_ids": [2, 3], "reason": "error", "rtt_ms": -1.0},
+                {"name": "b", "device_ids": [2, 1], "reason": "error", "rtt_ms": -1.0},
+            ],
+            suspect_devices=[2],  # the reporting view still names it
+            compile_ms=0.0,
+        )
+        report = probe_report()
+        report.links = links
+        policy, actuator = self.make_policy(mock_api, confirm_cycles=1)
+        assert policy.observe_report(report) == []
+        assert actuator.quarantined_nodes() == []
 
     def test_unmapped_process_never_acts(self, mock_api):
         policy, actuator = self.make_policy(mock_api, confirm_cycles=1)
