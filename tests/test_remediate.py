@@ -384,6 +384,21 @@ class TestPolicy:
         assert len(records) == 1 and not records[0].ok and "rate limit" in records[0].reason
         assert policy.observe_report(b) == []  # re-earning confirmation
 
+    def test_only_process_zero_acts(self, mock_api, monkeypatch):
+        """In multi-controller mode every process sees the report, but N
+        hosts racing to cordon the same node would multiply every fence's
+        accounting by N — only process 0 evaluates policy."""
+        import k8s_watcher_tpu.remediate.policy as policy_mod
+
+        policy, actuator = self.make_policy(mock_api, confirm_cycles=1)
+        monkeypatch.setattr(policy_mod.jax, "process_count", lambda: 4)
+        monkeypatch.setattr(policy_mod.jax, "process_index", lambda: 2)
+        assert policy.observe_report(probe_report(suspect_devices=[2])) == []
+        assert actuator.quarantined_nodes() == []
+        monkeypatch.setattr(policy_mod.jax, "process_index", lambda: 0)
+        records = policy.observe_report(probe_report(suspect_devices=[2]))
+        assert len(records) == 1 and records[0].ok
+
     def test_snapshot_shape(self, mock_api):
         policy, _ = self.make_policy(mock_api, confirm_cycles=3)
         policy.observe_report(probe_report(suspect_devices=[0]))
