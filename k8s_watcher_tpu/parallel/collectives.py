@@ -83,11 +83,20 @@ def make_allreduce_bandwidth_probe(
 
 
 def psum_probe_input(mesh: Mesh) -> jax.Array:
-    """A tiny per-device vector laid out for ``make_psum_probe``."""
+    """A tiny per-device vector laid out for ``make_psum_probe``.
+
+    On a mesh spanning processes (multi-controller: the global (hosts,
+    chips) mesh, or a 2-slice pair submesh) the global array is assembled
+    from per-process addressable shards — the explicitly supported
+    construction — rather than relying on ``device_put`` accepting a
+    partially-addressable sharding."""
     n = mesh.size
     axes = _mesh_axes(mesh)
-    x = jnp.arange(1.0, n + 1.0, dtype=jnp.float32)
-    return jax.device_put(x, NamedSharding(mesh, P(axes)))
+    sharding = NamedSharding(mesh, P(axes))
+    if any(d.process_index != jax.process_index() for d in mesh.devices.flat):
+        x = np.arange(1.0, n + 1.0, dtype=np.float32)
+        return jax.make_array_from_callback((n,), sharding, lambda idx: x[idx])
+    return jax.device_put(jnp.arange(1.0, n + 1.0, dtype=jnp.float32), sharding)
 
 
 def bandwidth_probe_input(mesh: Mesh, payload_bytes: int) -> jax.Array:
@@ -160,23 +169,86 @@ def make_hierarchical_probe(
     hybrid_slice_mesh) returns ``(per_slice_sums, global_sum)`` of the
     per-device inputs. Per-slice sums localize a deviating contribution to
     its slice; the global sum is the DCN-aggregated health scalar.
+
+    BOTH outputs are fully replicated: every process must be able to read
+    the whole per-slice vector locally (multi-controller mode — one
+    process per host — cannot fetch a slices-sharded array, and every
+    process's suspect classification needs every slice's sum). The
+    replication itself rides the same DCN hop being probed: the per-slice
+    scalars are scattered into one-hot vectors and psum'd over ``slices``.
     """
     all_axes = _mesh_axes(mesh)
     if all_axes[0] != "slices" or len(all_axes) < 2:
         raise ValueError(f"hierarchical probe wants ('slices', ...) axes, got {all_axes}")
     ici_axes = all_axes[1:]
+    n_slices = mesh.shape["slices"]
     device_ids = mesh_device_ids(mesh)
 
     def probe(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
         x = apply_fault(x, fault, device_ids, _linear_index(mesh))
         per_slice = jax.lax.psum(x, ici_axes)  # ICI: invariant within a slice
+        # scatter my slice's sum into a one-hot vector; the slices-psum
+        # assembles the replicated full vector (the DCN hop)
+        slice_idx = jax.lax.axis_index("slices")
+        vec = jnp.zeros((n_slices,), dtype=x.dtype).at[slice_idx].set(per_slice[0])
+        all_sums = jax.lax.psum(vec, "slices")
         global_ = jax.lax.psum(per_slice, "slices")  # DCN hop
-        return per_slice, global_
+        return all_sums, global_
 
     shard = jax.shard_map(
-        probe, mesh=mesh, in_specs=P(all_axes), out_specs=(P("slices"), P())
+        probe, mesh=mesh, in_specs=P(all_axes), out_specs=(P(), P())
     )
     return jax.jit(shard)
+
+
+@functools.lru_cache(maxsize=1024)
+def make_slice_pair_probe(
+    mesh: Mesh, inner_iters: int = 1, fault: Optional[IciFaultSpec] = None
+) -> Tuple[Callable[[jax.Array], jax.Array], float]:
+    """Chained ``slices``-axis psum over a 2-slice pair submesh, closed
+    with ONE full-mesh psum so the output is a replicated scalar.
+
+    The slices-only chain is the timed quantity — each round exchanges
+    every (host, chip) position with its counterpart in the other slice,
+    pure inter-slice DCN traffic. The single trailing full-mesh reduction
+    exists so every member process holds the result locally: in
+    multi-controller mode the completion fence (host scalar readback)
+    must not require a remote shard, and its constant cost cancels in the
+    pair-vs-pair outlier comparison.
+
+    Returns ``(jitted_fn, expected)``: with input ``psum_probe_input``
+    (1..n), each position's chained value converges to its cross-slice
+    mean, and the closing sum counts every device's copy — so the scalar
+    equals ``n(n+1)/2`` exactly; any deviation means a member corrupted
+    the payload. Cached like the other builders (per-cycle re-walks must
+    not re-trace).
+    """
+    all_axes = _mesh_axes(mesh)
+    if all_axes[0] != "slices" or mesh.shape["slices"] != 2:
+        raise ValueError(f"slice-pair probe wants a ('slices'=2, ...) mesh, got {dict(mesh.shape)}")
+    if inner_iters < 1:
+        raise ValueError("inner_iters must be >= 1")
+    device_ids = mesh_device_ids(mesh)
+    _to_varying = (
+        (lambda v: jax.lax.pcast(v, ("slices",), to="varying")) if hasattr(jax.lax, "pcast")
+        else (lambda v: jax.lax.pvary(v, ("slices",)))
+    )
+
+    def probe(x: jax.Array) -> jax.Array:
+        x = apply_fault(x, fault, device_ids, _linear_index(mesh))
+
+        def body(_, carry):
+            return _to_varying(jax.lax.psum(carry, ("slices",)) / 2.0)
+
+        y = jax.lax.fori_loop(0, inner_iters - 1, body, x) if inner_iters > 1 else x
+        # cast back to varying: the closing all-axes psum reduces over
+        # 'slices' too, and a slices-invariant operand would be rejected
+        y = _to_varying(jax.lax.psum(y, ("slices",)) / 2.0)
+        return jax.lax.psum(y, all_axes)
+
+    shard = jax.shard_map(probe, mesh=mesh, in_specs=P(all_axes), out_specs=P())
+    n = mesh.size
+    return jax.jit(shard), n * (n + 1) / 2.0
 
 
 @functools.lru_cache(maxsize=4096)

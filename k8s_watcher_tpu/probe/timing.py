@@ -28,9 +28,18 @@ import jax.numpy as jnp
 
 def fetch_scalar(out: Any) -> float:
     """Read one element of (the first leaf of) ``out`` back to the host —
-    the only reliable completion fence on tunneled platforms."""
+    the only reliable completion fence on tunneled platforms.
+
+    On arrays spanning processes (multi-controller probes over sharded
+    outputs) element 0 may live on a remote host; any ADDRESSABLE shard is
+    an equally valid completion fence — the local device must have
+    finished its part of the program before its shard is readable."""
     leaf = jax.tree_util.tree_leaves(out)[0]
-    return float(jnp.reshape(leaf, (-1,))[0])
+    if getattr(leaf, "is_fully_addressable", True):
+        return float(jnp.reshape(leaf, (-1,))[0])
+    import numpy as np
+
+    return float(np.asarray(leaf.addressable_shards[0].data).ravel()[0])
 
 
 def fence_baseline_ms(device: Optional[jax.Device] = None, samples: int = 3) -> float:

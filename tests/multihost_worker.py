@@ -94,9 +94,48 @@ def main() -> None:
         mesh, iters=2, inner_iters=4, rtt_floor_ms=250.0, fault=fault
     )
 
+    # cross-slice DCN pair walk in true multi-controller mode: each process
+    # is one "slice" (contiguous grouping over the global device list), so
+    # every pair program spans two processes and the walk's
+    # participate-only-in-my-pairs / lower-process-owns contract is
+    # exercised for real (opt-in: adds per-pair compiles to the fixture)
+    multislice = None
+    if os.environ.get("MULTIHOST_MULTISLICE") == "1":
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from k8s_watcher_tpu.probe.multislice import run_multislice_probe
+
+        # build the (slices, hosts, chips) mesh explicitly: gloo CPU
+        # devices all report slice_index 0, so hybrid_slice_mesh's
+        # runtime-truth guard (correctly) refuses to carve them into fake
+        # slices — here the carve IS the simulation, one process per slice
+        devs = jax.devices()
+        per = len(devs) // num_procs
+        grid = np.stack(
+            [np.array(devs[k * per:(k + 1) * per]).reshape(1, per) for k in range(num_procs)],
+            axis=0,
+        )
+        assert all(
+            d.process_index == k for k in range(num_procs) for d in grid[k].flat
+        ), "device order does not group by process"
+        ms = run_multislice_probe(
+            Mesh(grid, ("slices", "hosts", "chips")), iters=2, inner_iters=4,
+            pair_rtt_floor_ms=250.0,  # CI gloo/TCP jitter must not flip flags
+        )
+        multislice = {
+            "ok": ms.ok,
+            "error": ms.error,
+            "n_slices": ms.n_slices,
+            "per_slice_sums": ms.per_slice_sums,
+            "pairs": ms.pair_rtts,
+            "suspect_pairs": [s["name"] for s in ms.suspect_pairs],
+        }
+
     result = {
         "pid": pid,
         "initialized": initialized,
+        "multislice": multislice,
         "process_count": jax.process_count(),
         "process_index": jax.process_index(),
         "local_devices": jax.local_device_count(),

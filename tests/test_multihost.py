@@ -223,6 +223,50 @@ def test_three_host_ring_links_localized(ring_results):
     assert sorted(wrap_owned) == ["chip0/host2-host0", "chip1/host2-host0"]
 
 
+@pytest.fixture(scope="module")
+def multislice_results(tmp_path_factory):
+    # 3 processes = 3 one-host "slices": every DCN pair program spans two
+    # processes, and every process has a pair it does NOT belong to — the
+    # participate-only-in-my-pairs path that single-process tests can't hit
+    return _run_cluster(
+        tmp_path_factory.mktemp("multihost_multislice"),
+        extra_env={"MULTIHOST_MULTISLICE": "1"},
+        n_procs=3,
+    )
+
+
+def test_multislice_pair_walk_across_processes(multislice_results):
+    """The cross-slice DCN pair walk in true multi-controller mode: each
+    process runs exactly the pair programs touching its own slice (in the
+    same global order — overlapping 2-process rendezvous, so finishing at
+    all proves no deadlock), checksums read back process-locally from the
+    replicated scalar, and the lower-indexed member owns each record so a
+    host-level merge counts every pair once."""
+    for pid, r in multislice_results.items():
+        ms = r["multislice"]
+        assert ms is not None and ms["error"] is None
+        assert ms["ok"], ms
+        assert ms["n_slices"] == 3
+        # slice k's members are exactly process k's chips, so per-slice
+        # sums of ones are the 2 chips each
+        assert ms["per_slice_sums"] == [2.0, 2.0, 2.0]
+        names = sorted(p["name"] for p in ms["pairs"])
+        expected = sorted(
+            f"slice{min(pid, other)}-slice{max(pid, other)}"
+            for other in range(3) if other != pid
+        )
+        assert names == expected, f"proc {pid} walked the wrong pairs"
+        for p in ms["pairs"]:
+            i, j = p["device_ids"]
+            assert p["error"] is None and p["correct"] and p["rtt_ms"] > 0
+            assert p["owner"] == (pid == min(i, j)), p
+    owned = sorted(
+        p["name"] for r in multislice_results.values()
+        for p in r["multislice"]["pairs"] if p["owner"]
+    )
+    assert owned == ["slice0-slice1", "slice0-slice2", "slice1-slice2"]
+
+
 def test_prep_failure_skips_all_cross_process_links(prep_fail_results):
     """When ONE process fails preparation of ONE cross-process link, the
     agreement round must make EVERY process skip EVERY cross-process pair
