@@ -1,11 +1,13 @@
 """Benchmark: pod-event→notify p50 latency through the full framework.
 
-Headline metric (BASELINE.md north star): p50 latency from pod event receipt
-to completed clusterapi notification, measured end-to-end — churn-generated
-slice-pod events through filters, phase-delta, slice aggregation, payload
-extraction, async dispatch, and a real HTTP POST to a local sink server.
-Target: < 1 s on v5p-128-scale churn (1 k events/min); the bench drives the
-pipeline at 6x and 30x that event rate (p50 must hold as load grows).
+Headline metric (BASELINE.md north star): p50 latency from pod event to
+completed clusterapi notification, measured TRULY end-to-end — the clock
+starts before the apiserver journal write, and stops when the sink server
+has parsed the POST: apiserver -> chunked HTTP watch frame -> native
+prefilter + decode -> filters/phase-delta/slice aggregation/extraction ->
+async dispatch -> HTTP POST. Target: < 1 s on v5p-128-scale churn
+(1 k events/min); the details also drive the pipeline at 6x and 30x that
+event rate (p50 must hold as load grows).
 
 Also measured (details): sustained ingest throughput, ICI psum RTT and MXU
 matmul TFLOP/s on the real attached accelerator (single chip here; the same
@@ -127,6 +129,139 @@ def bench_watch_pipeline(n_events: int = 3000, events_per_sec: float = 100.0) ->
         "sustained_events_per_sec": round(n_events / ingest_seconds, 1),
         "slice_notifications": count("slice_notifications_enqueued"),
     }
+
+
+def bench_e2e_apiserver(n_events: int = 600, events_per_sec: float = 100.0) -> dict:
+    """TRUE end-to-end latency: the clock starts BEFORE the apiserver write.
+
+    apiserver journal write -> chunked HTTP watch frame -> native
+    prefilter + JSON decode -> filters/phase-delta/extraction -> async
+    dispatch -> HTTP POST parsed by the sink. Unlike ``bench_watch_pipeline``
+    (which clocks from pipeline ingest of an in-process event), this number
+    includes the real watch transport and decode — the full distance a pod
+    event travels in production, minus only real-network RTTs.
+    """
+    try:
+        from k8s_watcher_tpu.k8s.client import K8sClient
+        from k8s_watcher_tpu.k8s.kubeconfig import K8sConnection
+        from k8s_watcher_tpu.k8s.mock_server import MockApiServer
+        from k8s_watcher_tpu.k8s.watch import KubernetesWatchSource
+        from k8s_watcher_tpu.metrics import MetricsRegistry
+        from k8s_watcher_tpu.native.scanner import make_scanner
+        from k8s_watcher_tpu.notify.client import ClusterApiClient
+        from k8s_watcher_tpu.notify.dispatcher import Dispatcher
+        from k8s_watcher_tpu.pipeline.filters import TpuResourceFilter
+        from k8s_watcher_tpu.pipeline.pipeline import EventPipeline
+        from k8s_watcher_tpu.slices.tracker import SliceTracker
+        from k8s_watcher_tpu.watch.fake import build_pod
+
+        t_start: dict = {}
+        t_done: dict = {}
+        done_lock = threading.Lock()
+        all_done = threading.Event()
+
+        class E2ESink(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+            disable_nagle_algorithm = True
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                now = time.monotonic()
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length) or b"{}")
+                name = payload.get("name", "")
+                if name.startswith("e2e-pod-"):
+                    with done_lock:
+                        t_done.setdefault(name, now)
+                        if len(t_done) >= n_events:
+                            all_done.set()
+                body = b'{"ok":true}'
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+        sink = ThreadingHTTPServer(("127.0.0.1", 0), E2ESink)
+        sink.daemon_threads = True
+        threading.Thread(target=sink.serve_forever, daemon=True).start()
+
+        with MockApiServer() as api:
+            client = ClusterApiClient(
+                f"http://127.0.0.1:{sink.server_address[1]}", api_key="bench", timeout=5.0
+            )
+            metrics = MetricsRegistry()
+            dispatcher = Dispatcher(client.update_pod_status, capacity=8192, workers=4, metrics=metrics)
+            dispatcher.start()
+            pipeline = EventPipeline(
+                environment="production",
+                sink=dispatcher.submit,
+                slice_tracker=SliceTracker("production"),
+                resource_filter=TpuResourceFilter("google.com/tpu"),
+                metrics=metrics,
+            )
+            source = KubernetesWatchSource(
+                K8sClient(K8sConnection(server=api.url), request_timeout=10.0),
+                watch_timeout_seconds=30,
+                scanner=make_scanner("google.com/tpu"),
+            )
+
+            def consume():
+                for event in source.events():
+                    pipeline.process(event)
+
+            consumer = threading.Thread(target=consume, daemon=True)
+            consumer.start()
+            time.sleep(0.3)  # let the watch connect so frames stream live
+
+            interval = 1.0 / events_per_sec
+            t0 = time.monotonic()
+            for i in range(n_events):
+                target = t0 + i * interval
+                delay = target - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                name = f"e2e-pod-{i}"
+                t_start[name] = time.monotonic()
+                api.cluster.add_pod(build_pod(
+                    name, uid=f"uid-e2e-{i}", phase="Running", tpu_chips=4,
+                ))
+            all_done.wait(30.0)
+            source.stop()
+            consumer.join(timeout=10.0)
+            dispatcher.drain(30.0)
+            dispatcher.stop()
+        sink.shutdown()
+        sink.server_close()
+
+        with done_lock:
+            latencies = sorted(
+                1e3 * (t_done[n] - t_start[n]) for n in t_done if n in t_start
+            )
+        if not latencies:
+            return {"error": "no end-to-end notification completed"}
+
+        def pct(p: float) -> float:
+            return latencies[min(len(latencies) - 1, int(p * len(latencies)))]
+
+        return {
+            "p50_ms": round(statistics.median(latencies), 3),
+            "p90_ms": round(pct(0.90), 3),
+            "p99_ms": round(pct(0.99), 3),
+            "max_ms": round(latencies[-1], 3),
+            "completed": len(latencies),
+            "offered": n_events,
+            "offered_events_per_sec": events_per_sec,
+        }
+    except Exception as exc:  # the bench must still report the other numbers
+        return {"error": str(exc)}
 
 
 def bench_burst_drain(n_events: int = 1000) -> dict:
@@ -380,6 +515,7 @@ def bench_probe() -> dict:
 
 
 def main() -> int:
+    e2e_stats = bench_e2e_apiserver(n_events=600, events_per_sec=100.0)
     pipeline_stats = bench_watch_pipeline(n_events=2000, events_per_sec=100.0)
     # the same path at 30x the 1k/min acceptance rate: p50 must hold, not
     # degrade with offered load (queueing would show here first)
@@ -388,13 +524,17 @@ def main() -> int:
     scan_stats = bench_frame_scan()
     virtual_stats = bench_virtual_probes()
     probe_stats = bench_probe()
-    p50 = pipeline_stats["p50_ms"]
+    # headline: the TRUE end-to-end number (clock starts before the
+    # apiserver write, includes watch transport + decode); fall back to
+    # the pipeline-ingest number only if the e2e tier errored
+    p50 = e2e_stats.get("p50_ms") or pipeline_stats["p50_ms"]
     result = {
         "metric": "pod-event->notify p50 latency",
         "value": round(p50, 3),
         "unit": "ms",
         "vs_baseline": round(BASELINE_TARGET_MS / p50, 1) if p50 > 0 else 0.0,
         "details": {
+            "e2e_apiserver": e2e_stats,
             "pipeline": pipeline_stats,
             "pipeline_500eps": pipeline_500,
             "burst": burst_stats,

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import random
 import time
-from typing import Any, Callable, Dict, Iterator, List, Optional
+from typing import Any, Callable, Dict, Iterator, Optional
 
 from k8s_watcher_tpu.watch.fake import build_pod
 from k8s_watcher_tpu.watch.source import EventType, WatchEvent

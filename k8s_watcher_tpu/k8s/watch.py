@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 from typing import Iterator, Optional
 
 from k8s_watcher_tpu.config.schema import RetryPolicy

@@ -20,7 +20,7 @@ import time
 from typing import Optional
 
 from k8s_watcher_tpu.config.schema import RetryPolicy
-from k8s_watcher_tpu.k8s.client import K8sApiError, K8sClient, K8sGoneError
+from k8s_watcher_tpu.k8s.client import K8sClient, K8sGoneError
 from k8s_watcher_tpu.nodes.tracker import NodeTracker
 from k8s_watcher_tpu.pipeline.pipeline import Notification
 

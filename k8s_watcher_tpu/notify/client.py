@@ -32,7 +32,6 @@ import logging
 import socket
 import ssl
 import threading
-import time
 from typing import Any, Dict, Optional, Tuple
 from urllib.parse import urlsplit
 

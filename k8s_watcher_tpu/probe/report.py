@@ -4,7 +4,6 @@ notifier (north star: "reports chip/link status through clusterapi")."""
 from __future__ import annotations
 
 import dataclasses
-import time
 from datetime import datetime, timezone
 from typing import Any, Dict, List, Optional
 
