@@ -46,9 +46,21 @@ class ChurnGenerator:
         # worker state: (slice_idx, worker_idx) -> phase or None (deleted)
         self._phase: Dict[tuple, Optional[str]] = {}
 
-    def _pod(self, s: int, w: int, phase: str) -> Dict[str, Any]:
+    def _pod(self, s: int, w: int, phase: str, *, preempted: bool = False) -> Dict[str, Any]:
         self._rv += 1
         topology_chips = self.workers_per_slice * self.chips_per_worker
+        conditions = None
+        if preempted:
+            # what a real spot/preemptible TPU worker carries on its way
+            # out: the scheduler's status.reason plus the k8s >=1.26
+            # DisruptionTarget condition — downstream payloads classify
+            # this into the `disruption` block (pipeline/extract.py)
+            conditions = [{
+                "type": "DisruptionTarget",
+                "status": "True",
+                "reason": "PreemptionByScheduler",
+                "message": "preempted by higher-priority workload",
+            }]
         return build_pod(
             f"slice{s}-worker-{w}",
             self.namespace,
@@ -64,6 +76,8 @@ class ChurnGenerator:
             },
             container_statuses=[{"name": "main", "ready": phase == "Running", "restartCount": 0}],
             resource_version=str(self._rv),
+            status_reason="Preempted" if preempted else None,
+            conditions=conditions,
         )
 
     def events(self, n_events: int) -> Iterator[WatchEvent]:
@@ -76,6 +90,7 @@ class ChurnGenerator:
             phase = self._phase.get(key)
             roll = self.rng.random()
 
+            preempted = False
             if phase is None:  # (re)create
                 new_phase, etype = "Pending", EventType.ADDED
             elif phase == "Pending":
@@ -85,13 +100,18 @@ class ChurnGenerator:
                     new_phase, etype = "Failed", EventType.MODIFIED
                 elif roll < self.fail_prob + self.preempt_prob:
                     new_phase, etype = None, EventType.DELETED  # preemption
+                    preempted = True
                 else:
                     new_phase, etype = "Running", EventType.MODIFIED  # status noise
             else:  # Failed -> controller deletes, then recreated later
                 new_phase, etype = None, EventType.DELETED
 
             pod_phase = new_phase if new_phase is not None else (phase or "Running")
-            event = WatchEvent(type=etype, pod=self._pod(s, w, pod_phase), resource_version=str(self._rv))
+            event = WatchEvent(
+                type=etype,
+                pod=self._pod(s, w, pod_phase, preempted=preempted),
+                resource_version=str(self._rv),
+            )
             self._phase[key] = new_phase
             emitted += 1
             yield event

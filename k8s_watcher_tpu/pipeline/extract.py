@@ -8,8 +8,12 @@ creation_timestamp; event_timestamp. ``event_type`` is stamped by the
 pipeline, as the reference did at pod_watcher.py:233.
 
 Net-new: a ``tpu`` block (chip count, accelerator/topology labels, slice
-membership) and a ``phase_transition`` block (the delta that triggered the
-notification), both required by the north star.
+membership), a ``phase_transition`` block (the delta that triggered the
+notification), and a ``disruption`` block classifying WHY a pod is going
+away (preemption / eviction / node shutdown — from ``status.reason`` and
+the ``DisruptionTarget`` condition), all required by the north star: a
+v5p slice losing a worker to spot preemption must read differently from
+one whose job completed.
 """
 
 from __future__ import annotations
@@ -42,6 +46,47 @@ def _container_state_string(state: Optional[Dict[str, Any]]) -> Optional[str]:
                 bits.append(f"exit_code={detail['exitCode']}")
             return f"{key}({', '.join(bits)})" if bits else key
     return None
+
+
+# status.reason values that mean the pod was disrupted rather than ran to
+# completion (kubelet/scheduler-stamped; GKE spot/preemptible TPU nodes
+# produce Shutdown via graceful node shutdown and Preempted/Evicted via
+# the scheduler and eviction API)
+_DISRUPTION_STATUS_REASONS = ("Preempted", "Evicted", "Shutdown", "NodeShutdown", "Terminated")
+
+
+def extract_disruption(pod: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Classify an involuntary disruption, or None for ordinary lifecycle.
+
+    Two authoritative signals, both surfaced when present:
+    - ``status.reason`` — kubelet/scheduler one-word cause;
+    - the ``DisruptionTarget`` pod condition (k8s >= 1.26) — its ``reason``
+      names the actor (``PreemptionByScheduler``,
+      ``DeletionByTaintManager``, ``EvictionByEvictionAPI``,
+      ``TerminationByKubelet``).
+    """
+    status = pod.get("status") or {}
+    out: Dict[str, Any] = {}
+    reason = status.get("reason")
+    if reason in _DISRUPTION_STATUS_REASONS:
+        out["reason"] = reason
+        if status.get("message"):
+            out["message"] = str(status["message"])[:300]
+    for c in status.get("conditions") or []:
+        if c.get("type") == "DisruptionTarget" and c.get("status") == "True":
+            out["target_reason"] = c.get("reason")
+            if c.get("message"):
+                out.setdefault("message", str(c["message"])[:300])
+            break
+    if not out:
+        return None
+    out["kind"] = (
+        "preemption" if "Preempt" in (out.get("reason") or "") + (out.get("target_reason") or "")
+        else "eviction" if "Evict" in (out.get("reason") or "") + (out.get("target_reason") or "")
+        else "node-shutdown" if "Shutdown" in (out.get("reason") or "")
+        else "disruption"
+    )
+    return out
 
 
 def extract_pod_data(
@@ -121,4 +166,7 @@ def extract_pod_data(
             "readiness_changed": delta.readiness_changed,
             "deleted": delta.deleted,
         }
+    disruption = extract_disruption(pod)
+    if disruption is not None:
+        data["disruption"] = disruption
     return data

@@ -22,6 +22,7 @@ import logging
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
+from k8s_watcher_tpu.pipeline.extract import extract_disruption
 from k8s_watcher_tpu.pipeline.phase import PhaseDelta, pod_ready, pod_restarts
 from k8s_watcher_tpu.slices.topology import SliceIdentity, infer_slice_identity
 from k8s_watcher_tpu.watch.source import EventType, WatchEvent
@@ -59,6 +60,11 @@ class SliceState:
     phase: str = SlicePhase.FORMING
     ever_ready: bool = False
     ever_had_members: bool = False
+    # why the slice last lost a member involuntarily (preemption/eviction/
+    # node shutdown — pipeline/extract.py:extract_disruption); a Degraded
+    # slice whose worker was PREEMPTED reads differently from one whose
+    # worker crashed
+    last_disruption: Optional[Dict[str, Any]] = None
 
     def aggregate_phase(self) -> str:
         if not self.members:
@@ -104,6 +110,7 @@ class SliceState:
                 1 for m in self.members.values() if m.phase == "Running" and m.ready and m.node_ready
             ),
             "phase": self.phase,
+            "last_disruption": self.last_disruption,
             "workers": [
                 {
                     "name": m.name,
@@ -214,6 +221,9 @@ class SliceTracker:
             removed = state.members.pop(uid, None)
             if removed is not None:
                 self._node_ref_delta_locked(removed.node_name, -1)
+                disruption = extract_disruption(event.pod)
+                if disruption is not None:
+                    state.last_disruption = {"worker": removed.name, **disruption}
             if not state.ever_had_members:
                 # DELETED for a slice we never saw alive: nothing to report
                 self._slices.pop(identity.key, None)

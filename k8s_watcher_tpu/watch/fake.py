@@ -37,6 +37,7 @@ def build_pod(
     conditions: Optional[List[Dict[str, Any]]] = None,
     container_statuses: Optional[List[Dict[str, Any]]] = None,
     creation_timestamp: str = "2026-01-01T00:00:00Z",
+    status_reason: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Build a pod dict in k8s REST JSON shape.
 
@@ -89,6 +90,8 @@ def build_pod(
             "containerStatuses": container_statuses or [],
         },
     }
+    if status_reason:
+        pod["status"]["reason"] = status_reason
     if node_selector:
         pod["spec"]["nodeSelector"] = node_selector
     return pod

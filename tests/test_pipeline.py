@@ -177,6 +177,47 @@ class TestExtract:
         s = extract_pod_data(pod, "dev")["status"]["container_statuses"][0]["state"]
         assert s == "terminated(reason=OOMKilled, exit_code=137)"
 
+    def test_disruption_preemption_via_status_reason(self):
+        pod = build_pod("p", status_reason="Preempted")
+        d = extract_pod_data(pod, "dev")["disruption"]
+        assert d["kind"] == "preemption" and d["reason"] == "Preempted"
+
+    def test_disruption_target_condition(self):
+        pod = build_pod("p", conditions=[{
+            "type": "DisruptionTarget", "status": "True",
+            "reason": "DeletionByTaintManager", "message": "node is shutting down",
+        }])
+        d = extract_pod_data(pod, "dev")["disruption"]
+        assert d["target_reason"] == "DeletionByTaintManager"
+        assert d["message"] == "node is shutting down"
+        assert d["kind"] == "disruption"
+
+    def test_disruption_eviction_kind(self):
+        pod = build_pod("p", conditions=[{
+            "type": "DisruptionTarget", "status": "True",
+            "reason": "EvictionByEvictionAPI",
+        }])
+        assert extract_pod_data(pod, "dev")["disruption"]["kind"] == "eviction"
+
+    def test_no_disruption_for_ordinary_pod(self):
+        assert "disruption" not in extract_pod_data(build_pod("p", phase="Succeeded"), "dev")
+        # a False DisruptionTarget condition is not a disruption
+        pod = build_pod("p", conditions=[{
+            "type": "DisruptionTarget", "status": "False", "reason": "PreemptionByScheduler",
+        }])
+        assert "disruption" not in extract_pod_data(pod, "dev")
+
+    def test_churn_generator_preemptions_carry_disruption(self):
+        from k8s_watcher_tpu.faults.injection import ChurnGenerator
+        from k8s_watcher_tpu.pipeline.extract import extract_disruption
+        from k8s_watcher_tpu.watch.source import EventType
+
+        churn = ChurnGenerator(n_slices=2, workers_per_slice=2, seed=5, preempt_prob=0.3)
+        deleted = [e for e in churn.events(400) if e.type == EventType.DELETED]
+        disruptions = [d for d in map(extract_disruption, (e.pod for e in deleted)) if d]
+        assert disruptions, "no preemption produced in 400 churn events"
+        assert all(d["kind"] == "preemption" for d in disruptions)
+
 
 class RecordingSink:
     def __init__(self):

@@ -7,7 +7,7 @@ from k8s_watcher_tpu.watch.fake import build_pod
 from k8s_watcher_tpu.watch.source import EventType, WatchEvent
 
 
-def slice_pod(worker, phase="Running", ready=None, n_workers=4, name="train", uid=None):
+def slice_pod(worker, phase="Running", ready=None, n_workers=4, name="train", uid=None, **pod_kwargs):
     ready = (phase == "Running") if ready is None else ready
     return build_pod(
         f"{name}-{worker}",
@@ -21,6 +21,7 @@ def slice_pod(worker, phase="Running", ready=None, n_workers=4, name="train", ui
             "batch.kubernetes.io/job-completion-index": worker,
         },
         container_statuses=[{"name": "main", "ready": ready, "restartCount": 0}],
+        **pod_kwargs,
     )
 
 
@@ -111,6 +112,28 @@ class TestSliceTracker:
             tracker.observe(ev(slice_pod(w)), None)
         _, notes = tracker.observe(ev(slice_pod(2), EventType.DELETED), None)
         assert tracker.get("default/train").phase == SlicePhase.DEGRADED
+
+    def test_preemption_cause_recorded_on_slice(self):
+        """A Degraded slice whose worker was PREEMPTED must say so: the
+        SLICE_PHASE_CHANGE notification and every later summary carry the
+        classified disruption of the departed worker."""
+        tracker = SliceTracker("development")
+        for w in range(4):
+            tracker.observe(ev(slice_pod(w)), None)
+        preempted = slice_pod(
+            2, status_reason="Preempted",
+            conditions=[{"type": "DisruptionTarget", "status": "True",
+                         "reason": "PreemptionByScheduler"}],
+        )
+        _, notes = tracker.observe(ev(preempted, EventType.DELETED), None)
+        assert notes and notes[0]["phase_transition"]["to"] == SlicePhase.DEGRADED
+        d = notes[0]["last_disruption"]
+        assert d["kind"] == "preemption"
+        assert d["worker"] == "train-2"
+        assert d["target_reason"] == "PreemptionByScheduler"
+        # an ordinary (non-disrupted) deletion does not overwrite the cause
+        _, _ = tracker.observe(ev(slice_pod(3), EventType.DELETED), None)
+        assert tracker.get("default/train").summary()["last_disruption"]["worker"] == "train-2"
 
     def test_all_deleted_terminates_and_cleans_up(self):
         tracker = SliceTracker("development")
