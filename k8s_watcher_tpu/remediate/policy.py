@@ -16,9 +16,13 @@ the report's device inventory, then to a k8s node through the report's
 ``node_name`` is counted and logged but never acted on: guessing a node to
 cordon is worse than paging a human.
 
-Multi-controller: only process 0 evaluates policy (it is also the process
-that reports for the slice, probe/agent.py:_report) — N hosts racing to
-cordon the same node would multiply every fence's accounting by N.
+Multi-controller: process 0 acts on the full picture; every OTHER process
+acts only on findings naming its OWN node. The split follows visibility:
+local-chip findings (liveness, MXU/HBM integrity) and a host's intra-host
+links exist only in that host's report — gating them on process 0 would
+silently drop remote hardware faults — while cross-host findings appear
+in multiple reports, and N processes racing to cordon the same node would
+multiply every fence's accounting by N.
 """
 
 from __future__ import annotations
@@ -106,6 +110,23 @@ class ProbeRemediationPolicy:
                     entry.get("process_index"),
                     f"device probe: chip {entry.get('id')} failed its liveness computation",
                 )
+        # single-chip integrity findings implicate the REPORTING process's
+        # own node: the MXU/HBM probes run on this process's local chip
+        local = (report.devices or {}).get("process_index")
+        mxu = report.mxu
+        if mxu is not None and mxu.get("error") is None and mxu.get("finite") is False:
+            implicate(local, "mxu probe: matmul produced non-finite values")
+        for label, probe in (("hbm read", report.hbm), ("hbm write", report.hbm_write)):
+            if probe is None or probe.get("error") is not None:
+                continue
+            bad = probe.get("bad_blocks")
+            if bad:
+                implicate(
+                    local,
+                    f"{label} probe: {len(bad)} HBM block(s) failed pattern readback",
+                )
+            elif probe.get("integrity_ok") is False:
+                implicate(local, f"{label} probe: checksum integrity failed")
         if unmapped:
             logger.warning(
                 "Probe implicates hardware on processes with no node_name "
@@ -120,9 +141,19 @@ class ProbeRemediationPolicy:
 
     def observe_report(self, report) -> List[ActionRecord]:
         """Fold one probe report; returns the actions taken (possibly [])."""
-        if jax.process_count() > 1 and jax.process_index() != 0:
-            return []
         implicated = self._implicated(report)
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            # non-0 processes act ONLY on findings naming their OWN node:
+            # a dead chip or failed HBM block is visible only in the local
+            # process's report (probe/device.py probes local chips; probe 0
+            # sees alive=None for remote ones), so gating everything on
+            # process 0 would silently drop exactly those faults. Slice-wide
+            # findings (the link walk) stay process-0-only — N processes
+            # racing to cordon the SAME node would multiply the fences by N;
+            # own-node findings have one natural actor.
+            hosts = report.hosts or {}
+            own = (hosts.get(str(jax.process_index())) or {}).get("node_name")
+            implicated = {n: ev for n, ev in implicated.items() if own and n == own}
         actionable = {n: ev for n, ev in implicated.items() if n != "__unmapped__"}
         records: List[ActionRecord] = []
         with self._lock:

@@ -384,20 +384,54 @@ class TestPolicy:
         assert len(records) == 1 and not records[0].ok and "rate limit" in records[0].reason
         assert policy.observe_report(b) == []  # re-earning confirmation
 
-    def test_only_process_zero_acts(self, mock_api, monkeypatch):
-        """In multi-controller mode every process sees the report, but N
-        hosts racing to cordon the same node would multiply every fence's
-        accounting by N — only process 0 evaluates policy."""
+    def test_only_process_zero_acts_on_remote_findings(self, mock_api, monkeypatch):
+        """In multi-controller mode a non-0 process must not act on
+        findings naming ANOTHER host's node (N processes racing to cordon
+        the same node would multiply every fence's accounting by N)."""
         import k8s_watcher_tpu.remediate.policy as policy_mod
 
         policy, actuator = self.make_policy(mock_api, confirm_cycles=1)
         monkeypatch.setattr(policy_mod.jax, "process_count", lambda: 4)
+        # device 2 -> process 1 -> tpu-node-1; process 2 is NOT its host
         monkeypatch.setattr(policy_mod.jax, "process_index", lambda: 2)
         assert policy.observe_report(probe_report(suspect_devices=[2])) == []
         assert actuator.quarantined_nodes() == []
         monkeypatch.setattr(policy_mod.jax, "process_index", lambda: 0)
         records = policy.observe_report(probe_report(suspect_devices=[2]))
         assert len(records) == 1 and records[0].ok
+
+    def test_non_zero_process_acts_on_its_own_node(self, mock_api, monkeypatch):
+        """A dead chip is visible ONLY in its own host's report (process 0
+        sees alive=None for remote chips) — that host must be able to
+        quarantine its own node or remote chip deaths never remediate."""
+        import k8s_watcher_tpu.remediate.policy as policy_mod
+
+        policy, actuator = self.make_policy(mock_api, confirm_cycles=1)
+        monkeypatch.setattr(policy_mod.jax, "process_count", lambda: 2)
+        monkeypatch.setattr(policy_mod.jax, "process_index", lambda: 1)
+        # process 1's own report: its local chip 3 failed liveness
+        records = policy.observe_report(probe_report(dead_devices=[3]))
+        assert len(records) == 1 and records[0].node == "tpu-node-1" and records[0].ok
+
+    def test_hbm_bad_blocks_implicate_local_node(self, mock_api):
+        report = probe_report()
+        report.hbm_write = {
+            "ok": False, "integrity_ok": False, "error": None,
+            "bad_blocks": [{"block": 7, "byte_offset": 7 << 19}],
+        }
+        policy, _ = self.make_policy(mock_api, confirm_cycles=1)
+        records = policy.observe_report(report)
+        # reporting process is 0 -> tpu-node-0
+        assert len(records) == 1 and records[0].node == "tpu-node-0"
+        assert "HBM block" in records[0].reason
+
+    def test_mxu_nonfinite_implicates_local_node(self, mock_api):
+        report = probe_report()
+        report.mxu = {"ok": False, "finite": False, "error": None}
+        policy, _ = self.make_policy(mock_api, confirm_cycles=1)
+        records = policy.observe_report(report)
+        assert len(records) == 1 and records[0].node == "tpu-node-0"
+        assert "non-finite" in records[0].reason
 
     def test_snapshot_shape(self, mock_api):
         policy, _ = self.make_policy(mock_api, confirm_cycles=3)
