@@ -191,9 +191,10 @@ def make_hierarchical_probe(
         # assembles the replicated full vector (the DCN hop)
         slice_idx = jax.lax.axis_index("slices")
         vec = jnp.zeros((n_slices,), dtype=x.dtype).at[slice_idx].set(per_slice[0])
-        all_sums = jax.lax.psum(vec, "slices")
-        global_ = jax.lax.psum(per_slice, "slices")  # DCN hop
-        return all_sums, global_
+        all_sums = jax.lax.psum(vec, "slices")  # the ONE DCN hop
+        # the global sum is a free local reduction of the replicated vector
+        # — a second slices-psum would add a whole DCN round-trip per cycle
+        return all_sums, jnp.sum(all_sums)
 
     shard = jax.shard_map(
         probe, mesh=mesh, in_specs=P(all_axes), out_specs=(P(), P())

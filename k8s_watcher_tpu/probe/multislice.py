@@ -122,7 +122,7 @@ def _walk_slice_pairs(
     for i in range(n_sl):
         for j in range(i + 1, n_sl):
             name = f"slice{i}-slice{j}"
-            owner = True
+            owner = None  # resolved once membership is known
             try:
                 sub = _slice_pair_submesh(mesh, i, j)
                 member_procs = sorted({d.process_index for d in sub.devices.flat})
@@ -148,6 +148,13 @@ def _walk_slice_pairs(
                 ))
             except Exception as exc:  # noqa: BLE001 — per-pair containment
                 logger.warning("Slice-pair probe %s failed: %s", name, exc)
+                if owner is None:
+                    # failed before membership resolved: EVERY process is
+                    # here (the computation was pure mesh math, identical
+                    # everywhere), so process 0 is the fallback canonical
+                    # recorder — owner=True on all N would make a merge
+                    # count one failed pair N times
+                    owner = (not multi) or pid == 0
                 records.append(LinkResult(
                     axis="dcn", name=name, device_ids=(i, j),
                     rtt_ms=-1.0, rtt_mean_ms=-1.0, correct=False, owner=owner,

@@ -63,8 +63,13 @@ class ProbeRemediationPolicy:
 
     @staticmethod
     def _implicated(report) -> Dict[str, List[str]]:
-        """``node_name -> [evidence, ...]`` for this report. Pure function
-        of the report payload shape (probe/report.py)."""
+        """``node_name -> [(scope, evidence), ...]`` for this report, where
+        scope is ``"slice"`` (cross-host findings like the link walk, which
+        appear in MULTIPLE processes' reports) or ``"local"`` (findings
+        only this process's report can contain: its own chips' liveness,
+        MXU/HBM integrity). Pure function of the report payload shape
+        (probe/report.py); the scope drives the multi-controller actor
+        split in ``observe_report``."""
         devices = (report.devices or {}).get("devices") or []
         id_to_process = {d.get("id"): d.get("process_index") for d in devices}
         hosts = report.hosts or {}
@@ -73,13 +78,13 @@ class ProbeRemediationPolicy:
             identity = hosts.get(str(process_index)) or {}
             return identity.get("node_name")
 
-        out: Dict[str, List[str]] = {}
+        out: Dict[str, List] = {}
         unmapped: List[str] = []
 
-        def implicate(process_index, evidence: str) -> None:
+        def implicate(process_index, evidence: str, scope: str = "slice") -> None:
             node = node_of(process_index)
             if node:
-                out.setdefault(node, []).append(evidence)
+                out.setdefault(node, []).append((scope, evidence))
             else:
                 unmapped.append(evidence)
 
@@ -106,16 +111,19 @@ class ProbeRemediationPolicy:
                     )
         for entry in devices:
             if entry.get("alive") is False:
+                # liveness only runs on the reporting process's OWN chips
+                # (remote chips are alive=None), so this is a local finding
                 implicate(
                     entry.get("process_index"),
                     f"device probe: chip {entry.get('id')} failed its liveness computation",
+                    scope="local",
                 )
         # single-chip integrity findings implicate the REPORTING process's
         # own node: the MXU/HBM probes run on this process's local chip
         local = (report.devices or {}).get("process_index")
         mxu = report.mxu
         if mxu is not None and mxu.get("error") is None and mxu.get("finite") is False:
-            implicate(local, "mxu probe: matmul produced non-finite values")
+            implicate(local, "mxu probe: matmul produced non-finite values", scope="local")
         for label, probe in (("hbm read", report.hbm), ("hbm write", report.hbm_write)):
             if probe is None or probe.get("error") is not None:
                 continue
@@ -124,9 +132,10 @@ class ProbeRemediationPolicy:
                 implicate(
                     local,
                     f"{label} probe: {len(bad)} HBM block(s) failed pattern readback",
+                    scope="local",
                 )
             elif probe.get("integrity_ok") is False:
-                implicate(local, f"{label} probe: checksum integrity failed")
+                implicate(local, f"{label} probe: checksum integrity failed", scope="local")
         if unmapped:
             logger.warning(
                 "Probe implicates hardware on processes with no node_name "
@@ -141,19 +150,31 @@ class ProbeRemediationPolicy:
 
     def observe_report(self, report) -> List[ActionRecord]:
         """Fold one probe report; returns the actions taken (possibly [])."""
-        implicated = self._implicated(report)
+        scoped = self._implicated(report)
         if jax.process_count() > 1 and jax.process_index() != 0:
-            # non-0 processes act ONLY on findings naming their OWN node:
-            # a dead chip or failed HBM block is visible only in the local
-            # process's report (probe/device.py probes local chips; probe 0
-            # sees alive=None for remote ones), so gating everything on
-            # process 0 would silently drop exactly those faults. Slice-wide
-            # findings (the link walk) stay process-0-only — N processes
-            # racing to cordon the SAME node would multiply the fences by N;
-            # own-node findings have one natural actor.
+            # non-0 processes act ONLY on LOCAL-scope findings naming their
+            # OWN node: a dead chip or failed HBM block is visible only in
+            # the local process's report (probe/device.py probes local
+            # chips; process 0 sees alive=None for remote ones), so gating
+            # everything on process 0 would silently drop those faults.
+            # Slice-scope findings (the link walk) stay process-0-only even
+            # when they name this node — cross-host links are OBSERVED by
+            # both endpoint processes, and two actuators confirming the
+            # same node would double every fence's accounting.
             hosts = report.hosts or {}
             own = (hosts.get(str(jax.process_index())) or {}).get("node_name")
-            implicated = {n: ev for n, ev in implicated.items() if own and n == own}
+            filtered: Dict[str, List] = {}
+            if own and own in scoped:
+                kept = [e for e in scoped[own] if e[0] == "local"]
+                if kept:
+                    filtered[own] = kept
+            scoped = filtered
+        # strip scopes: downstream (streaks, reasons, notifications) wants
+        # plain evidence strings
+        implicated = {
+            n: (ev if n == "__unmapped__" else [e[1] for e in ev])
+            for n, ev in scoped.items()
+        }
         actionable = {n: ev for n, ev in implicated.items() if n != "__unmapped__"}
         records: List[ActionRecord] = []
         with self._lock:

@@ -413,6 +413,21 @@ class TestPolicy:
         records = policy.observe_report(probe_report(dead_devices=[3]))
         assert len(records) == 1 and records[0].node == "tpu-node-1" and records[0].ok
 
+    def test_non_zero_process_ignores_link_findings_even_for_own_node(self, mock_api, monkeypatch):
+        """Cross-host link findings are observed by BOTH endpoint
+        processes; if the non-0 endpoint also acted on its own node, two
+        actuators would confirm the same node and double every fence's
+        accounting. Slice-scope findings stay process-0-only."""
+        import k8s_watcher_tpu.remediate.policy as policy_mod
+
+        policy, actuator = self.make_policy(mock_api, confirm_cycles=1)
+        monkeypatch.setattr(policy_mod.jax, "process_count", lambda: 2)
+        monkeypatch.setattr(policy_mod.jax, "process_index", lambda: 1)
+        # device 2 -> process 1 -> tpu-node-1: process 1's OWN node, but
+        # the evidence is the (slice-scope) link walk
+        assert policy.observe_report(probe_report(suspect_devices=[2])) == []
+        assert actuator.quarantined_nodes() == []
+
     def test_hbm_bad_blocks_implicate_local_node(self, mock_api):
         report = probe_report()
         report.hbm_write = {
