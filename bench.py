@@ -44,15 +44,21 @@ class _SinkHandler(BaseHTTPRequestHandler):
     def log_message(self, *a):
         pass
 
-    def do_POST(self):
+    def _read_payload(self) -> dict:
         length = int(self.headers.get("Content-Length", 0))
-        json.loads(self.rfile.read(length) or b"{}")  # parse like a real API
+        return json.loads(self.rfile.read(length) or b"{}")  # parse like a real API
+
+    def _respond_ok(self) -> None:
         body = b'{"ok":true}'
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def do_POST(self):
+        self._read_payload()
+        self._respond_ok()
 
     def do_GET(self):
         self.send_response(200)
@@ -160,34 +166,16 @@ def bench_e2e_apiserver(n_events: int = 600, events_per_sec: float = 100.0) -> d
         done_lock = threading.Lock()
         all_done = threading.Event()
 
-        class E2ESink(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-            disable_nagle_algorithm = True
-
-            def log_message(self, *a):
-                pass
-
+        class E2ESink(_SinkHandler):
             def do_POST(self):
                 now = time.monotonic()
-                length = int(self.headers.get("Content-Length", 0))
-                payload = json.loads(self.rfile.read(length) or b"{}")
-                name = payload.get("name", "")
+                name = self._read_payload().get("name", "")
                 if name.startswith("e2e-pod-"):
                     with done_lock:
                         t_done.setdefault(name, now)
                         if len(t_done) >= n_events:
                             all_done.set()
-                body = b'{"ok":true}'
-                self.send_response(200)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def do_GET(self):
-                self.send_response(200)
-                self.send_header("Content-Length", "2")
-                self.end_headers()
-                self.wfile.write(b"{}")
+                self._respond_ok()
 
         sink = ThreadingHTTPServer(("127.0.0.1", 0), E2ESink)
         sink.daemon_threads = True

@@ -52,6 +52,7 @@ class ActionRecord:
     dry_run: bool
     reason: str  # why the policy asked for it / why the actuator refused
     applied: bool = False  # a real PATCH landed on the apiserver
+    adopted: bool = False  # node was already quarantined; nothing written
     error: Optional[str] = None
 
     def to_dict(self) -> Dict[str, Any]:
@@ -184,6 +185,18 @@ class NodeActuator:
         self._last_action[node] = now
         self._action_times.append(now)
 
+    def _refund_locked(self, node: str, prior_last_action: Optional[float]) -> None:
+        """Undo one `_consume` (lock held): a transient GET/PATCH failure
+        must not burn the fences — a consumed cooldown would lock a
+        CONFIRMED-faulty node out of remediation for cooldown_seconds over
+        an apiserver blip, and a burned rate slot would starve retries."""
+        if prior_last_action is None:
+            self._last_action.pop(node, None)
+        else:
+            self._last_action[node] = prior_last_action
+        if self._action_times:
+            self._action_times.pop()
+
     # -- actions -----------------------------------------------------------
 
     def _our_taint(self) -> Dict[str, str]:
@@ -225,21 +238,13 @@ class NodeActuator:
         record = self._apply_quarantine(node, reason)
         with self._lock:
             if not record.ok:
-                # a transient GET/PATCH failure must not burn the fences: a
-                # consumed cooldown would lock a CONFIRMED-faulty node out
-                # of quarantine for cooldown_seconds over an apiserver blip.
                 # Only evict the node from the budget if THIS call added it
                 # — a failed re-quarantine of a node that is already
                 # genuinely cordoned must keep occupying its slot
                 if not was_quarantined:
                     self._quarantined.discard(node)
-                if prior_last_action is None:
-                    self._last_action.pop(node, None)
-                else:
-                    self._last_action[node] = prior_last_action
-                if self._action_times:
-                    self._action_times.pop()
-            elif record.reason.startswith("already quarantined"):
+                self._refund_locked(node, prior_last_action)
+            elif record.adopted:
                 # adoption wrote nothing: refund the hourly rate slot so
                 # no-op confirmations can't starve real actions (the
                 # per-node cooldown stays consumed — it is what stops the
@@ -273,7 +278,7 @@ class NodeActuator:
             logger.info("Node %s already quarantined (adopting): %s", node, reason)
             return ActionRecord(
                 node=node, action="quarantine", ok=True, dry_run=self.dry_run,
-                reason=f"already quarantined; {reason}",
+                reason=f"already quarantined; {reason}", adopted=True,
             )
         if not have_taint:
             taints.append(self._our_taint())
@@ -324,14 +329,7 @@ class NodeActuator:
             if record.ok:
                 self._quarantined.discard(node)
             else:
-                # refund on failure, as in quarantine(): a transient error
-                # must not rate-starve or cooldown-lock the retry
-                if prior_last_action is None:
-                    self._last_action.pop(node, None)
-                else:
-                    self._last_action[node] = prior_last_action
-                if self._action_times:
-                    self._action_times.pop()
+                self._refund_locked(node, prior_last_action)
             n_quarantined = len(self._quarantined)
         if record.ok and self.metrics is not None:
             self.metrics.counter("remediation_actions").inc()
