@@ -274,6 +274,11 @@ class KubernetesWatchSource:
                 need_list = True
 
             except K8sApiError as exc:
+                if self._stop.is_set():
+                    # the abort_watch() teardown path surfaces as a stream
+                    # error; a clean shutdown must not log a scary
+                    # "reconnecting" warning on every SIGTERM
+                    return
                 reconnects += 1
                 if self.max_reconnects is not None and reconnects > self.max_reconnects:
                     logger.error("Watch failed after %d reconnect attempts: %s", reconnects - 1, exc)
