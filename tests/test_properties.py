@@ -1,0 +1,217 @@
+"""Property-based tests (hypothesis) for the pure-logic cores.
+
+Example-based tests pin known scenarios; these pin INVARIANTS across
+generated inputs — the claims the modules' docstrings make must hold for
+every input in the domain, not just the examples we thought of:
+
+- config merge/substitution (the reference contract, SURVEY.md §2.3);
+- link classification (probe/links.py:classify_links — the decision rule
+  every localization verdict and remediation action rests on);
+- trend tracking (probe/trend.py — anchor purity and alert monotonicity);
+- the mock apiserver's RFC 7386 merge-patch (what the remediation
+  actuator's cordon/taint writes are tested against).
+
+All CPU-pure: no jax, no servers.
+"""
+
+from hypothesis import given, settings, strategies as st
+
+from k8s_watcher_tpu.config.loader import deep_merge, substitute_env_vars
+from k8s_watcher_tpu.k8s.mock_server import MockCluster
+from k8s_watcher_tpu.probe.links import LinkResult, classify_links
+from k8s_watcher_tpu.probe.trend import TrendTracker
+
+# -- strategies -------------------------------------------------------------
+
+scalars = st.one_of(st.none(), st.booleans(), st.integers(-999, 999), st.text(max_size=8))
+json_like = st.recursive(
+    scalars,
+    lambda children: st.one_of(
+        st.lists(children, max_size=3),
+        st.dictionaries(st.text(max_size=6), children, max_size=3),
+    ),
+    max_leaves=12,
+)
+config_dicts = st.dictionaries(st.text(min_size=1, max_size=6), json_like, max_size=4)
+
+
+def link(name, a, b, rtt, *, axis="chips", correct=True, error=None):
+    return LinkResult(
+        axis=axis, name=name, device_ids=(a, b), rtt_ms=rtt, rtt_mean_ms=rtt,
+        correct=correct, error=error,
+    )
+
+
+# -- config contract --------------------------------------------------------
+
+
+class TestConfigProperties:
+    @given(config_dicts, config_dicts)
+    def test_merge_override_always_wins_on_leaves(self, base, override):
+        merged = deep_merge(base, override)
+        for key, value in override.items():
+            if isinstance(value, dict) and isinstance(base.get(key), dict):
+                continue  # recursed — checked at the next level by induction
+            assert merged[key] == value
+
+    @given(config_dicts, config_dicts)
+    def test_merge_preserves_untouched_base_keys(self, base, override):
+        merged = deep_merge(base, override)
+        for key, value in base.items():
+            if key not in override:
+                assert merged[key] == value
+
+    @given(config_dicts)
+    def test_merge_identity(self, d):
+        assert deep_merge(d, {}) == d
+        assert deep_merge({}, d) == d
+
+    @given(json_like)
+    def test_substitution_without_tokens_is_identity(self, obj):
+        # no string in the tree is a ${...} token -> structure unchanged
+        def has_token(o):
+            if isinstance(o, dict):
+                return any(has_token(v) for v in o.values())
+            if isinstance(o, list):
+                return any(has_token(v) for v in o)
+            return isinstance(o, str) and o.startswith("${") and o.endswith("}")
+
+        if not has_token(obj):
+            assert substitute_env_vars(obj, env={}) == obj
+
+    @given(st.text(min_size=1, max_size=8).filter(lambda s: ":-" not in s and "}" not in s),
+           st.text(max_size=8).filter(lambda s: "}" not in s))
+    def test_substitution_default_contract(self, var, default):
+        # unset with default -> default; unset without -> ""; set -> value
+        assert substitute_env_vars("${" + var + ":-" + default + "}", env={}) == default
+        assert substitute_env_vars("${" + var + "}", env={}) == ""
+        assert substitute_env_vars("${" + var + "}", env={var: "v"}) == "v"
+
+
+# -- link classification ----------------------------------------------------
+
+
+class TestClassifyProperties:
+    @given(st.lists(st.floats(0.5, 1.5), min_size=3, max_size=24),
+           st.floats(0.001, 10.0))
+    def test_uniform_population_never_suspect(self, jitter, scale):
+        """A healthy walk (every RTT within 1.5x of the floor of the
+        population) yields no suspects at the default 3x factor, at ANY
+        absolute scale — the classifier is relative, not absolute."""
+        links = [
+            link(f"l{i}", i, i + 1, scale * r) for i, r in enumerate(jitter)
+        ]
+        suspects, devices = classify_links(links, 3.0, 0.0)
+        assert suspects == [] and devices == []
+
+    @given(st.lists(st.floats(0.5, 1.5), min_size=4, max_size=24),
+           st.floats(0.01, 100.0))
+    def test_scale_invariance(self, rtts, c):
+        """Multiplying every RTT by the same constant changes no verdict
+        (with the absolute floor disabled)."""
+        base_links = [link(f"l{i}", i, i + 1, r) for i, r in enumerate(rtts)]
+        scaled = [link(f"l{i}", i, i + 1, c * r) for i, r in enumerate(rtts)]
+        s1, d1 = classify_links(base_links, 3.0, 0.0)
+        s2, d2 = classify_links(scaled, 3.0, 0.0)
+        assert [s["name"] for s in s1] == [s["name"] for s in s2]
+        assert d1 == d2
+
+    @given(st.lists(st.floats(0.9, 1.1), min_size=5, max_size=20),
+           st.integers(0, 4))
+    def test_corrupt_always_suspect_regardless_of_rtt(self, rtts, bad_idx):
+        links = [
+            link(f"l{i}", i, i + 1, r, correct=(i != bad_idx))
+            for i, r in enumerate(rtts)
+        ]
+        suspects, _ = classify_links(links, 3.0, 0.0)
+        assert any(s["reason"] == "corrupt" and s["name"] == f"l{bad_idx}" for s in suspects)
+
+    @given(st.lists(st.floats(0.9, 1.1), min_size=6, max_size=20))
+    def test_device_needs_two_suspect_links(self, rtts):
+        """One suspect link implicates the LINK, never a device."""
+        links = [link(f"l{i}", 2 * i, 2 * i + 1, r) for i, r in enumerate(rtts)]
+        links[0] = link("l0", 0, 1, 100.0)  # one massive outlier, endpoints 0 and 1
+        suspects, devices = classify_links(links, 3.0, 0.0)
+        assert [s["name"] for s in suspects] == ["l0"]
+        assert devices == []  # endpoints appear in only one suspect link each
+
+    @given(st.floats(2.0, 50.0))
+    def test_min_baseline_catches_majority_contamination(self, factor_bad):
+        """The min-anchored baseline (DCN pair walk) flags a slice whose
+        EVERY pair is slow by factor_bad > the threshold factor, even when
+        those pairs are 50% of the population — the case that defeats the
+        median baseline (probe/multislice.py rationale)."""
+        healthy = [link("h01", 0, 1, 1.0, axis="dcn"), link("h02", 0, 2, 1.0, axis="dcn"),
+                   link("h12", 1, 2, 1.0, axis="dcn")]
+        bad = [link(f"b{i}", 3, i, factor_bad, axis="dcn") for i in range(3)]
+        suspects, devices = classify_links(healthy + bad, 1.9, 0.0, baseline_stat="min")
+        if factor_bad > 1.9:
+            assert devices == [3]
+        else:
+            assert devices == []
+
+
+# -- trend tracking ---------------------------------------------------------
+
+
+class TestTrendProperties:
+    @given(st.floats(0.5, 500.0), st.integers(10, 40))
+    def test_constant_series_never_alerts(self, value, n):
+        t = TrendTracker(window=8, recent=3, min_history=4)
+        for _ in range(n):
+            assert t.observe("m", value, higher_is_better=True) is None
+            assert t.observe("lat", value, higher_is_better=False) is None
+
+    @given(st.floats(1.0, 100.0), st.floats(0.05, 0.6))
+    def test_sustained_drop_eventually_alerts_and_keeps_alerting(self, healthy, ratio):
+        """A throughput drop below drop_factor persists -> alerts fire and
+        never stop while the degradation lasts (frozen anchor contract)."""
+        t = TrendTracker(window=8, recent=3, drop_factor=0.75, min_history=4)
+        for _ in range(8):
+            t.observe("m", healthy, higher_is_better=True)
+        alerts = [t.observe("m", healthy * ratio, higher_is_better=True) for _ in range(6)]
+        assert alerts[2] is not None  # by the time the recent window fills
+        assert all(a is not None for a in alerts[2:])
+        assert alerts[-1].baseline == healthy  # the anchor never decayed
+
+    @given(st.floats(1.0, 100.0))
+    def test_alerting_samples_never_poison_the_anchor(self, healthy):
+        """Degradation starting mid-forming must not freeze into the
+        baseline: after recovery, the anchor reflects the healthy value."""
+        t = TrendTracker(window=8, recent=3, drop_factor=0.75, min_history=4)
+        for _ in range(5):
+            t.observe("m", healthy, higher_is_better=True)
+        for _ in range(4):  # degraded cycles while still forming
+            t.observe("m", healthy * 0.1, higher_is_better=True)
+        for _ in range(10):  # recovery: anchor freezes from healthy samples
+            t.observe("m", healthy, higher_is_better=True)
+        snap = t.snapshot()["m"]
+        assert snap["anchor"] is not None
+        assert snap["anchor"] >= healthy * 0.9
+
+
+# -- mock apiserver merge patch (RFC 7386) ----------------------------------
+
+
+class TestMergePatchProperties:
+    @given(config_dicts, config_dicts)
+    @settings(max_examples=50)
+    def test_patch_result_contains_patch_non_null_leaves(self, doc, patch):
+        merged = MockCluster._merge_patch(dict(doc), patch)
+        for key, value in patch.items():
+            if value is None:
+                assert key not in merged
+            elif isinstance(value, dict) and isinstance(doc.get(key), dict):
+                continue  # recursed — same property one level down
+            else:
+                assert merged[key] == value
+
+    @given(config_dicts, st.dictionaries(
+        st.text(min_size=1, max_size=6),
+        st.one_of(st.integers(-99, 99), st.text(max_size=6), st.booleans()),
+        max_size=4,
+    ))
+    def test_patch_idempotent(self, doc, patch):
+        once = MockCluster._merge_patch(dict(doc), patch)
+        twice = MockCluster._merge_patch(dict(once), patch)
+        assert once == twice
