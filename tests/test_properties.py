@@ -92,10 +92,12 @@ class TestConfigProperties:
 
 
 class TestClassifyProperties:
-    @given(st.lists(st.floats(0.5, 1.5), min_size=3, max_size=24),
+    # jitter spread tops out at 2.8x (< the 3x factor with margin, so a
+    # 1-ulp float effect at the threshold boundary can't flake the test)
+    @given(st.lists(st.floats(0.5, 1.4), min_size=3, max_size=24),
            st.floats(0.001, 10.0))
     def test_uniform_population_never_suspect(self, jitter, scale):
-        """A healthy walk (every RTT within 1.5x of the floor of the
+        """A healthy walk (every RTT within ~3x of the floor of the
         population) yields no suspects at the default 3x factor, at ANY
         absolute scale — the classifier is relative, not absolute."""
         links = [
@@ -104,7 +106,7 @@ class TestClassifyProperties:
         suspects, devices = classify_links(links, 3.0, 0.0)
         assert suspects == [] and devices == []
 
-    @given(st.lists(st.floats(0.5, 1.5), min_size=4, max_size=24),
+    @given(st.lists(st.floats(0.5, 1.4), min_size=4, max_size=24),
            st.floats(0.01, 100.0))
     def test_scale_invariance(self, rtts, c):
         """Multiplying every RTT by the same constant changes no verdict
