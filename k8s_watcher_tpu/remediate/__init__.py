@@ -34,7 +34,11 @@ def build_actuator(client, tpu_config, *, metrics=None, **overrides) -> NodeActu
         max_quarantined_nodes=tpu_config.remediation_max_quarantined_nodes,
     )
     kwargs.update(overrides)
-    return NodeActuator(client, metrics=metrics, **kwargs)
+    actuator = NodeActuator(client, metrics=metrics, **kwargs)
+    # restart continuity: nodes already carrying our taint occupy budget
+    # slots from the first cycle (no-op in dry-run; see adopt_existing)
+    actuator.adopt_existing()
+    return actuator
 
 
 def build_policy(

@@ -365,3 +365,37 @@ class NodeActuator:
     def quarantined_nodes(self) -> List[str]:
         with self._lock:
             return sorted(self._quarantined)
+
+    def adopt_existing(self) -> List[str]:
+        """Seed the budget set from the cluster: every node already carrying
+        our taint counts as quarantined-by-us. Call once at arming time —
+        a restarted actuator otherwise starts with empty memory, and the
+        ``max_quarantined_nodes`` fence would not count pre-restart
+        quarantines until each happened to be re-confirmed, letting the
+        fleet exceed the budget across restarts. Dry-run mode writes
+        nothing, so there is nothing to adopt. Best-effort: an unreachable
+        apiserver leaves memory empty (the conservative reconcile path
+        still adopts lazily on re-confirmation)."""
+        if self.dry_run:
+            return []
+        try:
+            nodes = self.client.list_nodes().get("items", [])
+        except K8sApiError as exc:
+            logger.warning("Could not adopt pre-existing quarantines: %s", exc)
+            return []
+        adopted = [
+            (node.get("metadata") or {}).get("name", "")
+            for node in nodes
+            if any(
+                t.get("key") == self.taint_key
+                for t in ((node.get("spec") or {}).get("taints") or [])
+            )
+        ]
+        adopted = [n for n in adopted if n]
+        if adopted:
+            logger.info("Adopting pre-existing quarantines into the budget: %s", sorted(adopted))
+            with self._lock:
+                self._quarantined.update(adopted)
+            if self.metrics is not None:
+                self.metrics.gauge("remediation_quarantined_nodes").set(len(self._quarantined))
+        return sorted(adopted)

@@ -164,6 +164,27 @@ class TestActuator:
         assert [t["key"] for t in node["spec"].get("taints", [])] == ["other"]
         assert actuator.quarantined_nodes() == []
 
+    def test_restart_adopts_existing_quarantines_into_budget(self, mock_api):
+        """A restarted actuator must count pre-restart quarantines against
+        max_quarantined_nodes from the FIRST cycle — empty memory would let
+        the fleet exceed the budget across restarts."""
+        from k8s_watcher_tpu.config.schema import TpuConfig
+        from k8s_watcher_tpu.remediate import build_actuator
+
+        first = make_actuator(mock_api, max_quarantined_nodes=2, max_actions_per_hour=100)
+        assert first.quarantine("tpu-node-0", "a").ok
+        assert first.quarantine("tpu-node-1", "b").ok
+        # "restart": a fresh actuator built the production way (the factory
+        # adopts existing quarantines)
+        fresh = build_actuator(
+            make_client(mock_api), TpuConfig(),
+            dry_run=False, cooldown_seconds=0.0,
+            max_actions_per_hour=100, max_quarantined_nodes=2,
+        )
+        assert fresh.quarantined_nodes() == ["tpu-node-0", "tpu-node-1"]
+        blocked = fresh.quarantine("tpu-node-2", "c")
+        assert not blocked.ok and "budget" in blocked.reason
+
     def test_external_release_frees_budget(self, mock_api):
         """An operator uncordoning out-of-band (kubectl / remediate_ctl in
         another process) must free the budget slot: the actuator reconciles
