@@ -102,12 +102,22 @@ class ProbeRemediationPolicy:
                 if s.get("reason") in ("slow", "corrupt"):
                     for device_id in s.get("device_ids", ()):
                         endpoint_counts[device_id] = endpoint_counts.get(device_id, 0) + 1
+            reporting_pidx = (report.devices or {}).get("process_index")
             for device_id, count in sorted(endpoint_counts.items()):
                 if count >= 2:
+                    owner_pidx = id_to_process.get(device_id)
+                    # Triangulating device d needs >=2 of d's links in ONE
+                    # walk, and only d's OWN process observes more than one
+                    # (a peer shares at most one torus edge with d) — so a
+                    # triangulation of MY device is a local-visibility
+                    # finding its host must act on itself; a remote-device
+                    # triangulation (single-controller walks, exotic
+                    # topologies) is slice-scope for process 0.
                     implicate(
-                        id_to_process.get(device_id),
+                        owner_pidx,
                         f"link probe: device {device_id} is the common endpoint of "
                         f"{count} measured-suspect links",
+                        scope="local" if owner_pidx == reporting_pidx else "slice",
                     )
         for entry in devices:
             if entry.get("alive") is False:

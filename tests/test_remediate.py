@@ -270,11 +270,13 @@ def probe_report(
     dead_devices: List[int] = (),
     hosts: Optional[dict] = None,
     n_devices: int = 4,
+    reporting_process: int = 0,
 ) -> ProbeReport:
     """A minimal report shaped like probe/agent.py builds (4 chips, 2 hosts,
-    2 chips per host: device i lives on process i // 2)."""
+    2 chips per host: device i lives on process i // 2).
+    ``reporting_process`` is whose view this report is."""
     devices = {
-        "process_index": 0,
+        "process_index": reporting_process,
         "process_count": 2,
         "visible_devices": n_devices,
         "local_devices": n_devices // 2,
@@ -434,20 +436,34 @@ class TestPolicy:
         records = policy.observe_report(probe_report(dead_devices=[3]))
         assert len(records) == 1 and records[0].node == "tpu-node-1" and records[0].ok
 
-    def test_non_zero_process_ignores_link_findings_even_for_own_node(self, mock_api, monkeypatch):
-        """Cross-host link findings are observed by BOTH endpoint
-        processes; if the non-0 endpoint also acted on its own node, two
-        actuators would confirm the same node and double every fence's
-        accounting. Slice-scope findings stay process-0-only."""
+    def test_non_zero_process_ignores_remote_device_link_findings(self, mock_api, monkeypatch):
+        """A link triangulation of ANOTHER process's device (possible in
+        this fabricated process-0 view) is slice-scope: a non-0 process
+        must not act on it even when it names its own node — only one
+        actor per finding."""
         import k8s_watcher_tpu.remediate.policy as policy_mod
 
         policy, actuator = self.make_policy(mock_api, confirm_cycles=1)
         monkeypatch.setattr(policy_mod.jax, "process_count", lambda: 2)
         monkeypatch.setattr(policy_mod.jax, "process_index", lambda: 1)
-        # device 2 -> process 1 -> tpu-node-1: process 1's OWN node, but
-        # the evidence is the (slice-scope) link walk
+        # a process-0 view (reporting_process=0) triangulating device 2
+        # (process 1's chip): slice scope from process 1's perspective
         assert policy.observe_report(probe_report(suspect_devices=[2])) == []
         assert actuator.quarantined_nodes() == []
+
+    def test_non_zero_process_acts_on_its_own_triangulated_chip(self, mock_api, monkeypatch):
+        """Only a chip's OWN host can triangulate it (no peer observes >=2
+        of its links), so that host must act itself — process-0-only
+        gating would mean link-localized remote chips NEVER remediate."""
+        import k8s_watcher_tpu.remediate.policy as policy_mod
+
+        policy, actuator = self.make_policy(mock_api, confirm_cycles=1)
+        monkeypatch.setattr(policy_mod.jax, "process_count", lambda: 2)
+        monkeypatch.setattr(policy_mod.jax, "process_index", lambda: 1)
+        # process 1's OWN report triangulating its own device 2
+        report = probe_report(suspect_devices=[2], reporting_process=1)
+        records = policy.observe_report(report)
+        assert len(records) == 1 and records[0].node == "tpu-node-1" and records[0].ok
 
     def test_hbm_bad_blocks_implicate_local_node(self, mock_api):
         report = probe_report()
