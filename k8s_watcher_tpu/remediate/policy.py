@@ -17,12 +17,13 @@ the report's device inventory, then to a k8s node through the report's
 cordon is worse than paging a human.
 
 Multi-controller: process 0 acts on the full picture; every OTHER process
-acts only on findings naming its OWN node. The split follows visibility:
-local-chip findings (liveness, MXU/HBM integrity) and a host's intra-host
-links exist only in that host's report — gating them on process 0 would
-silently drop remote hardware faults — while cross-host findings appear
-in multiple reports, and N processes racing to cordon the same node would
-multiply every fence's accounting by N.
+acts only on LOCAL-visibility findings naming its OWN node. The split
+follows who can see what: chip liveness, MXU/HBM integrity, and link
+triangulations of a process's own chips (only the owner observes >=2 of a
+chip's links) exist solely in that host's report — gating them on
+process 0 would silently drop remote hardware faults — while findings
+multiple processes could derive stay process-0-only, so no two actuators
+ever confirm the same node and multiply the fences.
 """
 
 from __future__ import annotations
