@@ -132,10 +132,39 @@ def main() -> None:
             "suspect_pairs": [s["name"] for s in ms.suspect_pairs],
         }
 
+    # remediation in true multi-controller mode: each process runs its own
+    # policy against the parent's mock apiserver — only the corrupt chip's
+    # OWN host can triangulate it (local-visibility scoping), so only that
+    # process's actuator must act, on ITS node
+    remediation = None
+    remediate_url = os.environ.get("MULTIHOST_REMEDIATE")
+    if remediate_url:
+        from k8s_watcher_tpu.k8s.client import K8sClient
+        from k8s_watcher_tpu.k8s.kubeconfig import K8sConnection
+        from k8s_watcher_tpu.probe.report import ProbeReport
+        from k8s_watcher_tpu.remediate import NodeActuator, ProbeRemediationPolicy
+
+        actuator = NodeActuator(
+            K8sClient(K8sConnection(server=remediate_url), request_timeout=5.0),
+            dry_run=False, cooldown_seconds=0.0,
+        )
+        policy = ProbeRemediationPolicy(actuator, confirm_cycles=1)
+        actions = policy.observe_report(ProbeReport(
+            environment="multihost-test",
+            devices=report.devices,
+            links=link_report,
+            hosts=report.hosts,
+        ))
+        remediation = {
+            "actions": [a.to_dict() for a in actions],
+            "quarantined": actuator.quarantined_nodes(),
+        }
+
     result = {
         "pid": pid,
         "initialized": initialized,
         "multislice": multislice,
+        "remediation": remediation,
         "process_count": jax.process_count(),
         "process_index": jax.process_index(),
         "local_devices": jax.local_device_count(),

@@ -223,6 +223,45 @@ def test_three_host_ring_links_localized(ring_results):
     assert sorted(wrap_owned) == ["chip0/host2-host0", "chip1/host2-host0"]
 
 
+def test_remediation_across_processes(tmp_path_factory):
+    """The full multi-controller remediation contract against a live mock
+    apiserver: the corrupt chip (process 1, global id 2048) is triangulated
+    only by ITS host's walk (intra + inter links), so process 1's actuator
+    — and only process 1's — cordons+taints test-node-1, while process 0
+    (which observes just one of the chip's links) takes no action and
+    test-node-0 stays schedulable."""
+    from k8s_watcher_tpu.k8s.mock_server import MockApiServer, MockCluster
+
+    cluster = MockCluster()
+    for pid in range(N_PROCS):
+        cluster.add_node({
+            "metadata": {"name": f"test-node-{pid}"},
+            "spec": {},
+            "status": {"conditions": [{"type": "Ready", "status": "True"}]},
+        })
+    with MockApiServer(cluster) as api:
+        results = _run_cluster(
+            tmp_path_factory.mktemp("multihost_remediate"),
+            extra_env={
+                "MULTIHOST_CORRUPT_DEVICE": "2048",
+                "MULTIHOST_REMEDIATE": api.url,
+            },
+        )
+        r0, r1 = results[0]["remediation"], results[1]["remediation"]
+        assert r0 is not None and r1 is not None
+        assert r0["actions"] == [] and r0["quarantined"] == []
+        assert len(r1["actions"]) == 1, r1
+        action = r1["actions"][0]
+        assert action["node"] == "test-node-1" and action["ok"] and action["applied"]
+        assert "2048" in action["reason"]
+
+        node1 = cluster.get_node("test-node-1")
+        assert node1["spec"].get("unschedulable") is True
+        assert any(t["key"] == "k8s-watcher-tpu/ici-fault" for t in node1["spec"]["taints"])
+        node0 = cluster.get_node("test-node-0")
+        assert "unschedulable" not in node0["spec"] and not node0["spec"].get("taints")
+
+
 @pytest.fixture(scope="module")
 def multislice_results(tmp_path_factory):
     # 3 processes = 3 one-host "slices": every DCN pair program spans two
