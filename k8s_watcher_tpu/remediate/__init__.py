@@ -16,13 +16,22 @@ __all__ = [
 ]
 
 
-def build_actuator(client, tpu_config, *, metrics=None, **overrides) -> NodeActuator:
+def build_actuator(client, tpu_config, *, metrics=None, adopt: bool = True, **overrides) -> NodeActuator:
     """The one place ``tpu.remediation.*`` config maps onto NodeActuator
     kwargs — the watcher (app.py), the standalone slice agent
     (scripts/probe_agent.py), and the operator CLI (scripts/remediate_ctl.py)
     all build through here so a new knob can't silently diverge between
     them. ``overrides`` replace individual fields (the CLI relaxes the
-    fences: the operator is the rate limiter for manual actions)."""
+    fences: the operator is the rate limiter for manual actions).
+
+    ``adopt`` seeds the budget from nodes already carrying our taint
+    (restart continuity; see ``NodeActuator.adopt_existing``). Pass False
+    when this actuator is NOT the cluster's sole remediation actor — a
+    multi-controller slice agent adopting taints that OTHER actors applied
+    would fill its per-agent budget with foreign quarantines and refuse
+    its own local findings — or for one-shot CLI invocations, where a
+    cluster-wide node LIST buys nothing.
+    """
     kwargs: Dict[str, Any] = dict(
         dry_run=tpu_config.remediation_dry_run,
         cordon=tpu_config.remediation_cordon,
@@ -35,9 +44,8 @@ def build_actuator(client, tpu_config, *, metrics=None, **overrides) -> NodeActu
     )
     kwargs.update(overrides)
     actuator = NodeActuator(client, metrics=metrics, **kwargs)
-    # restart continuity: nodes already carrying our taint occupy budget
-    # slots from the first cycle (no-op in dry-run; see adopt_existing)
-    actuator.adopt_existing()
+    if adopt:
+        actuator.adopt_existing()
     return actuator
 
 

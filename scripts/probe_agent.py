@@ -65,7 +65,12 @@ def _arm_remediation(agent, config, environment: str, dispatcher) -> None:
 
     t = config.tpu
     policy = build_policy(
-        build_actuator(client, t, metrics=agent.metrics),
+        # single-process agents are the sole actor -> adopt pre-restart
+        # quarantines; in multi-controller mode EVERY process has an
+        # actuator for its local findings, and adopting taints that other
+        # actors applied would fill this agent's per-agent budget with
+        # foreign quarantines and refuse its own
+        build_actuator(client, t, metrics=agent.metrics, adopt=jax.process_count() == 1),
         t,
         dispatcher=dispatcher,
         metrics=agent.metrics,

@@ -79,6 +79,8 @@ def main() -> int:
         client,
         t,
         dry_run="--no-dry-run" not in flags,
+        # one-shot invocation: no budget to seed, skip the node LIST
+        adopt=False,
         # the operator is the rate limiter for manual actions
         cooldown_seconds=0.0,
         max_actions_per_hour=1000,

@@ -137,20 +137,18 @@ class TestClassifyProperties:
         assert [s["name"] for s in suspects] == ["l0"]
         assert devices == []  # endpoints appear in only one suspect link each
 
-    @given(st.floats(2.0, 50.0))
+    @given(st.one_of(st.floats(2.0, 50.0), st.floats(1.0, 1.8)))
     def test_min_baseline_catches_majority_contamination(self, factor_bad):
         """The min-anchored baseline (DCN pair walk) flags a slice whose
         EVERY pair is slow by factor_bad > the threshold factor, even when
         those pairs are 50% of the population — the case that defeats the
-        median baseline (probe/multislice.py rationale)."""
+        median baseline (probe/multislice.py rationale). Below the factor
+        (with margin), nothing is implicated."""
         healthy = [link("h01", 0, 1, 1.0, axis="dcn"), link("h02", 0, 2, 1.0, axis="dcn"),
                    link("h12", 1, 2, 1.0, axis="dcn")]
         bad = [link(f"b{i}", 3, i, factor_bad, axis="dcn") for i in range(3)]
         suspects, devices = classify_links(healthy + bad, 1.9, 0.0, baseline_stat="min")
-        if factor_bad > 1.9:
-            assert devices == [3]
-        else:
-            assert devices == []
+        assert devices == ([3] if factor_bad >= 2.0 else [])
 
 
 # -- trend tracking ---------------------------------------------------------
