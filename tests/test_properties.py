@@ -190,6 +190,91 @@ class TestTrendProperties:
         assert snap["anchor"] >= healthy * 0.9
 
 
+# -- slice aggregation state machine ----------------------------------------
+
+
+member_strategy = st.builds(
+    dict,
+    phase=st.sampled_from(["Pending", "Running", "Succeeded", "Failed", "Unknown"]),
+    ready=st.booleans(),
+    node_ready=st.booleans(),
+)
+
+
+def make_state(members, *, ever_ready=False, expected=None):
+    from k8s_watcher_tpu.slices.topology import SliceIdentity
+    from k8s_watcher_tpu.slices.tracker import SliceState, _Member
+
+    identity = SliceIdentity(
+        namespace="default", name="prop", topology=None, accelerator=None,
+        chips_per_worker=4, expected_workers=expected, worker_index=None,
+    )
+    state = SliceState(identity=identity)
+    for i, m in enumerate(members):
+        state.members[f"u{i}"] = _Member(
+            uid=f"u{i}", name=f"w{i}", worker_index=i,
+            phase=m["phase"], ready=m["ready"], node_ready=m["node_ready"],
+        )
+    state.ever_had_members = bool(members)
+    state.ever_ready = ever_ready
+    return state
+
+
+class TestSliceAggregationProperties:
+    @given(st.lists(member_strategy, min_size=1, max_size=8), st.booleans())
+    def test_any_failed_member_always_degrades(self, members, ever_ready):
+        """A Failed/Unknown member degrades the slice no matter what every
+        other member looks like — the north-star signal must never be
+        masked by healthy peers."""
+        members[0]["phase"] = "Failed"
+        state = make_state(members, ever_ready=ever_ready)
+        from k8s_watcher_tpu.slices.tracker import SlicePhase
+
+        assert state.aggregate_phase() == SlicePhase.DEGRADED
+
+    @given(st.lists(member_strategy, min_size=1, max_size=8))
+    def test_dead_node_under_live_member_degrades(self, members):
+        """A NotReady node under any non-terminal member degrades NOW —
+        minutes before eviction would surface it via the pod stream."""
+        members[0].update(phase="Running", node_ready=False)
+        state = make_state(members)
+        from k8s_watcher_tpu.slices.tracker import SlicePhase
+
+        assert state.aggregate_phase() == SlicePhase.DEGRADED
+
+    @given(st.integers(1, 8))
+    def test_all_succeeded_is_completed_never_degraded(self, n):
+        state = make_state(
+            [{"phase": "Succeeded", "ready": False, "node_ready": True}] * n,
+            ever_ready=True,
+        )
+        from k8s_watcher_tpu.slices.tracker import SlicePhase
+
+        assert state.aggregate_phase() == SlicePhase.COMPLETED
+
+    @given(st.lists(member_strategy, min_size=0, max_size=8))
+    def test_verdict_is_total_and_valid(self, members):
+        """aggregate_phase never raises and always lands in the enum, for
+        ANY member combination."""
+        from k8s_watcher_tpu.slices.tracker import SlicePhase
+
+        state = make_state(members)
+        assert state.aggregate_phase() in (
+            SlicePhase.FORMING, SlicePhase.READY, SlicePhase.DEGRADED,
+            SlicePhase.COMPLETED, SlicePhase.TERMINATED,
+        )
+
+    @given(st.integers(2, 8))
+    def test_lost_worker_after_ready_degrades(self, expected):
+        """expected_workers known, slice was whole, one worker short now:
+        Degraded (the preemption signature), not quietly Ready."""
+        members = [{"phase": "Running", "ready": True, "node_ready": True}] * (expected - 1)
+        state = make_state(members, ever_ready=True, expected=expected)
+        from k8s_watcher_tpu.slices.tracker import SlicePhase
+
+        assert state.aggregate_phase() == SlicePhase.DEGRADED
+
+
 # -- mock apiserver merge patch (RFC 7386) ----------------------------------
 
 
