@@ -275,6 +275,67 @@ class TestSliceAggregationProperties:
         assert state.aggregate_phase() == SlicePhase.DEGRADED
 
 
+# -- phase-delta detection ---------------------------------------------------
+
+
+phases = st.sampled_from(["Pending", "Running", "Succeeded", "Failed", "Unknown"])
+
+
+class TestPhaseTrackerProperties:
+    @staticmethod
+    def _event(uid, phase, etype="MODIFIED", ready=True):
+        from k8s_watcher_tpu.watch.fake import build_pod
+        from k8s_watcher_tpu.watch.source import WatchEvent
+
+        pod = build_pod(
+            f"p-{uid}", uid=uid, phase=phase,
+            container_statuses=[{"name": "c", "ready": ready, "restartCount": 0}],
+        )
+        return WatchEvent(type=etype, pod=pod)
+
+    @given(st.lists(phases, min_size=2, max_size=12))
+    def test_duplicate_observations_are_never_significant(self, seq):
+        """Re-observing the same (phase, readiness) — status-write noise,
+        relist re-ADDs — must never notify: the <1s p50 metric counts
+        phase CHANGES, and noise would spam the notifier."""
+        from k8s_watcher_tpu.pipeline.phase import PhaseTracker
+
+        t = PhaseTracker()
+        for phase in seq:
+            first = t.observe(self._event("u", phase))
+            dup = t.observe(self._event("u", phase))
+            assert not dup.significant, (phase, dup)
+            assert first.phase_changed == (first.old_phase != phase or first.old_phase is None)
+
+    @given(st.lists(phases, min_size=1, max_size=12), phases)
+    def test_deleted_is_always_significant_and_forgets(self, seq, final):
+        from k8s_watcher_tpu.pipeline.phase import PhaseTracker
+
+        t = PhaseTracker()
+        for phase in seq:
+            t.observe(self._event("u", phase))
+        delta = t.observe(self._event("u", final, etype="DELETED"))
+        assert delta.significant and delta.deleted
+        assert len(t) == 0
+        # the next sighting after deletion is a fresh first-sight
+        again = t.observe(self._event("u", final))
+        assert again.old_phase is None and again.significant
+
+    @given(phases, phases)
+    def test_checkpoint_roundtrip_preserves_phase_semantics(self, before, after):
+        """Restore keeps phase comparisons exact while readiness (unknown
+        across the checkpoint) never fires spuriously."""
+        from k8s_watcher_tpu.pipeline.phase import PhaseTracker
+
+        t = PhaseTracker()
+        t.observe(self._event("u", before))
+        restored = PhaseTracker()
+        restored.restore(t.snapshot())
+        delta = restored.observe(self._event("u", after, ready=False))
+        assert delta.phase_changed == (before != after)
+        assert delta.readiness_changed is False
+
+
 # -- mock apiserver merge patch (RFC 7386) ----------------------------------
 
 
