@@ -229,11 +229,28 @@ class MockCluster:
     def patch_node(self, name: str, patch: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
         """(status, body) for ``PATCH /api/v1/nodes/{name}`` with
         merge-patch semantics; journals a MODIFIED node event, so the
-        node-plane watch observes cordons the remediation plane applies."""
+        node-plane watch observes cordons the remediation plane applies.
+
+        A patch carrying ``metadata.resourceVersion`` is an optimistic-
+        concurrency write (same apiserver contract the lease path honors):
+        stale rv -> 409 Conflict, so read-modify-write callers (the
+        remediation actuator's taint edits) can detect a concurrent editor
+        instead of clobbering it."""
         with self._lock:
             node = self._nodes.get(name)
             if node is None:
                 return 404, {"kind": "Status", "code": 404, "message": f"nodes \"{name}\" not found"}
+            sent_rv = (patch.get("metadata") or {}).get("resourceVersion")
+            if sent_rv is not None and sent_rv != node["metadata"]["resourceVersion"]:
+                return 409, {
+                    "kind": "Status", "code": 409,
+                    "message": f"Operation cannot be fulfilled on nodes \"{name}\": "
+                               "the object has been modified",
+                }
+            # the server owns resourceVersion: never merge a client-sent one
+            patch = json.loads(json.dumps(patch))
+            if "metadata" in patch and isinstance(patch["metadata"], dict):
+                patch["metadata"].pop("resourceVersion", None)
             self._merge_patch(node, patch)
             self.modify_node(node)
             return 200, json.loads(json.dumps(node))

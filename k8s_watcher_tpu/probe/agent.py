@@ -166,7 +166,9 @@ class ProbeAgent:
         # Deliberately NOT stamped at cycle start or on a raised cycle: a
         # crash-looping or mid-cycle-hung probe must read as dead. The
         # steady-state threshold must therefore bound cycle_duration +
-        # interval (scripts/probe_agent.py sizes it accordingly).
+        # interval + the observer's I/O below (it runs on this thread and
+        # delays the NEXT beat; scripts/probe_agent.py sizes the threshold
+        # and caps the observer's k8s request timeout accordingly).
         self.heartbeat()
         observer = self.report_observer
         if observer is not None:

@@ -37,7 +37,7 @@ import time
 from typing import Any, Deque, Dict, List, Optional
 
 from k8s_watcher_tpu.config.schema import VALID_TAINT_EFFECTS
-from k8s_watcher_tpu.k8s.client import K8sApiError, K8sNotFoundError
+from k8s_watcher_tpu.k8s.client import K8sApiError, K8sConflictError, K8sNotFoundError
 
 logger = logging.getLogger(__name__)
 
@@ -179,13 +179,25 @@ class NodeActuator:
             )
         return None
 
-    def _consume(self, node: str) -> None:
-        """Record an allowed action against the fences (lock held)."""
+    def _consume(self, node: str) -> float:
+        """Record an allowed action against the fences (lock held);
+        returns the timestamp recorded, for exact refund."""
         now = self._clock()
         self._last_action[node] = now
         self._action_times.append(now)
+        return now
 
-    def _refund_locked(self, node: str, prior_last_action: Optional[float]) -> None:
+    def _drop_rate_slot_locked(self, consumed_ts: float) -> None:
+        """Remove exactly the rate-window entry recorded by this call's
+        `_consume` (lock held) — popping the tail instead could evict a
+        DIFFERENT in-flight action's timestamp under concurrency, leaving
+        the older one in the sliding-hour window and skewing accounting."""
+        try:
+            self._action_times.remove(consumed_ts)
+        except ValueError:
+            pass  # already expired out of the hour window
+
+    def _refund_locked(self, node: str, prior_last_action: Optional[float], consumed_ts: float) -> None:
         """Undo one `_consume` (lock held): a transient GET/PATCH failure
         must not burn the fences — a consumed cooldown would lock a
         CONFIRMED-faulty node out of remediation for cooldown_seconds over
@@ -194,8 +206,7 @@ class NodeActuator:
             self._last_action.pop(node, None)
         else:
             self._last_action[node] = prior_last_action
-        if self._action_times:
-            self._action_times.pop()
+        self._drop_rate_slot_locked(consumed_ts)
 
     # -- actions -----------------------------------------------------------
 
@@ -212,27 +223,27 @@ class NodeActuator:
         """
         def check_and_consume():
             """Atomically pass the fences and consume them; returns
-            ``(refusal, prior_last_action, was_quarantined)``."""
+            ``(refusal, prior_last_action, consumed_ts, was_quarantined)``."""
             with self._lock:
                 refusal = self._fence_check(node, "quarantine")
                 if refusal:
-                    return refusal, None, False
+                    return refusal, None, 0.0, False
                 # consume fences inside the lock; the PATCH itself runs
                 # outside (a slow apiserver must not serialize every other
                 # decision)
                 prior = self._last_action.get(node)
                 was = node in self._quarantined
-                self._consume(node)
+                ts = self._consume(node)
                 self._quarantined.add(node)
-                return None, prior, was
+                return None, prior, ts, was
 
-        refusal, prior_last_action, was_quarantined = check_and_consume()
+        refusal, prior_last_action, consumed_ts, was_quarantined = check_and_consume()
         if refusal is not None and refusal.startswith(self._BUDGET_REFUSAL):
             # the budget may be stale (out-of-band releases, aged dry-run
             # decisions): reconcile against reality — outside any lock —
             # and re-run the fences once
             self._reconcile_quarantined()
-            refusal, prior_last_action, was_quarantined = check_and_consume()
+            refusal, prior_last_action, consumed_ts, was_quarantined = check_and_consume()
         if refusal is not None:
             return self._refuse(node, "quarantine", refusal)
         record = self._apply_quarantine(node, reason)
@@ -243,66 +254,87 @@ class NodeActuator:
                 # genuinely cordoned must keep occupying its slot
                 if not was_quarantined:
                     self._quarantined.discard(node)
-                self._refund_locked(node, prior_last_action)
+                self._refund_locked(node, prior_last_action, consumed_ts)
             elif record.adopted:
                 # adoption wrote nothing: refund the hourly rate slot so
                 # no-op confirmations can't starve real actions (the
                 # per-node cooldown stays consumed — it is what stops the
                 # policy re-GETting the node every probe cycle)
-                if self._action_times:
-                    self._action_times.pop()
+                self._drop_rate_slot_locked(consumed_ts)
             n_quarantined = len(self._quarantined)
         if self.metrics is not None and record.ok:
             self.metrics.counter("remediation_actions").inc()
             self.metrics.gauge("remediation_quarantined_nodes").set(n_quarantined)
         return record
 
+    # Taint edits are read-modify-write over the WHOLE spec.taints list (a
+    # JSON merge-patch replaces the list wholesale), so every write carries
+    # the read's metadata.resourceVersion — the apiserver rejects a stale
+    # write with 409 instead of silently clobbering a taint another
+    # controller added between our GET and PATCH — and the RMW retries on
+    # conflict with a fresh read.
+    _RMW_ATTEMPTS = 3
+
     def _apply_quarantine(self, node: str, reason: str) -> ActionRecord:
-        try:
-            current = self.client.get_node(node)
-        except K8sNotFoundError:
-            return ActionRecord(
-                node=node, action="quarantine", ok=False, dry_run=self.dry_run,
-                reason=reason, error=f"node {node} not found",
-            )
-        except K8sApiError as exc:
-            return ActionRecord(
-                node=node, action="quarantine", ok=False, dry_run=self.dry_run,
-                reason=reason, error=f"get_node failed: {exc}",
-            )
-        spec = current.get("spec") or {}
-        taints: List[Dict[str, Any]] = list(spec.get("taints") or [])
-        have_taint = any(t.get("key") == self.taint_key for t in taints)
-        cordoned = bool(spec.get("unschedulable"))
-        if have_taint and (cordoned or not self.cordon):
-            logger.info("Node %s already quarantined (adopting): %s", node, reason)
-            return ActionRecord(
-                node=node, action="quarantine", ok=True, dry_run=self.dry_run,
-                reason=f"already quarantined; {reason}", adopted=True,
-            )
-        if not have_taint:
-            taints.append(self._our_taint())
-        patch: Dict[str, Any] = {"spec": {"taints": taints}}
-        if self.cordon:
-            patch["spec"]["unschedulable"] = True
-        if self.dry_run:
+        for attempt in range(self._RMW_ATTEMPTS):
+            try:
+                current = self.client.get_node(node)
+            except K8sNotFoundError:
+                return ActionRecord(
+                    node=node, action="quarantine", ok=False, dry_run=self.dry_run,
+                    reason=reason, error=f"node {node} not found",
+                )
+            except K8sApiError as exc:
+                return ActionRecord(
+                    node=node, action="quarantine", ok=False, dry_run=self.dry_run,
+                    reason=reason, error=f"get_node failed: {exc}",
+                )
+            spec = current.get("spec") or {}
+            taints: List[Dict[str, Any]] = list(spec.get("taints") or [])
+            have_taint = any(t.get("key") == self.taint_key for t in taints)
+            cordoned = bool(spec.get("unschedulable"))
+            if have_taint and (cordoned or not self.cordon):
+                logger.info("Node %s already quarantined (adopting): %s", node, reason)
+                return ActionRecord(
+                    node=node, action="quarantine", ok=True, dry_run=self.dry_run,
+                    reason=f"already quarantined; {reason}", adopted=True,
+                )
+            if not have_taint:
+                taints.append(self._our_taint())
+            patch: Dict[str, Any] = {"spec": {"taints": taints}}
+            rv = (current.get("metadata") or {}).get("resourceVersion")
+            if rv:
+                patch["metadata"] = {"resourceVersion": rv}
+            if self.cordon:
+                patch["spec"]["unschedulable"] = True
+            if self.dry_run:
+                logger.warning(
+                    "[DRY-RUN] would quarantine node %s (cordon=%s, taint %s=%s:%s): %s",
+                    node, self.cordon, self.taint_key, self.taint_value, self.taint_effect, reason,
+                )
+                return ActionRecord(node=node, action="quarantine", ok=True, dry_run=True, reason=reason)
+            try:
+                self.client.patch_node(node, patch)
+            except K8sConflictError:
+                logger.info(
+                    "Node %s changed between read and write (attempt %d/%d); re-reading",
+                    node, attempt + 1, self._RMW_ATTEMPTS,
+                )
+                continue
+            except K8sApiError as exc:
+                return ActionRecord(
+                    node=node, action="quarantine", ok=False, dry_run=False,
+                    reason=reason, error=f"patch_node failed: {exc}",
+                )
             logger.warning(
-                "[DRY-RUN] would quarantine node %s (cordon=%s, taint %s=%s:%s): %s",
+                "QUARANTINED node %s (cordon=%s, taint %s=%s:%s): %s",
                 node, self.cordon, self.taint_key, self.taint_value, self.taint_effect, reason,
             )
-            return ActionRecord(node=node, action="quarantine", ok=True, dry_run=True, reason=reason)
-        try:
-            self.client.patch_node(node, patch)
-        except K8sApiError as exc:
-            return ActionRecord(
-                node=node, action="quarantine", ok=False, dry_run=False,
-                reason=reason, error=f"patch_node failed: {exc}",
-            )
-        logger.warning(
-            "QUARANTINED node %s (cordon=%s, taint %s=%s:%s): %s",
-            node, self.cordon, self.taint_key, self.taint_value, self.taint_effect, reason,
+            return ActionRecord(node=node, action="quarantine", ok=True, dry_run=False, reason=reason, applied=True)
+        return ActionRecord(
+            node=node, action="quarantine", ok=False, dry_run=False, reason=reason,
+            error=f"patch_node conflicted {self._RMW_ATTEMPTS} times (node spec churning)",
         )
-        return ActionRecord(node=node, action="quarantine", ok=True, dry_run=False, reason=reason, applied=True)
 
     def release(self, node: str, reason: str = "operator release") -> ActionRecord:
         """Uncordon + remove OUR taint (other taints are preserved).
@@ -322,45 +354,91 @@ class NodeActuator:
                     f"rate limit: {len(self._action_times)} actions in the last hour (max {self.max_actions_per_hour})",
                 )
             prior_last_action = self._last_action.get(node)
-            self._action_times.append(now)
-            self._last_action[node] = now
-        record = self._apply_release(node, reason)
+            consumed_ts = self._consume(node)
+            ours = node in self._quarantined
+        record = self._apply_release(node, reason, quarantined_by_us=ours)
         with self._lock:
             if record.ok:
                 self._quarantined.discard(node)
+                if record.adopted:
+                    # no-op release (nothing to untaint or uncordon) wrote
+                    # nothing: refund the hourly rate slot, mirroring the
+                    # quarantine adoption path
+                    self._drop_rate_slot_locked(consumed_ts)
             else:
-                self._refund_locked(node, prior_last_action)
+                self._refund_locked(node, prior_last_action, consumed_ts)
             n_quarantined = len(self._quarantined)
         if record.ok and self.metrics is not None:
-            self.metrics.counter("remediation_actions").inc()
+            if not record.adopted:  # a no-op release is not an action...
+                self.metrics.counter("remediation_actions").inc()
+            # ...but it can still shrink _quarantined (out-of-band cleanup
+            # noticed here), so the gauge must always track the set
             self.metrics.gauge("remediation_quarantined_nodes").set(n_quarantined)
         return record
 
-    def _apply_release(self, node: str, reason: str) -> ActionRecord:
-        try:
-            current = self.client.get_node(node)
-        except (K8sNotFoundError, K8sApiError) as exc:
-            return ActionRecord(
-                node=node, action="release", ok=False, dry_run=self.dry_run,
-                reason=reason, error=str(exc),
+    def _apply_release(self, node: str, reason: str, *, quarantined_by_us: bool = False) -> ActionRecord:
+        for attempt in range(self._RMW_ATTEMPTS):
+            try:
+                current = self.client.get_node(node)
+            except (K8sNotFoundError, K8sApiError) as exc:
+                return ActionRecord(
+                    node=node, action="release", ok=False, dry_run=self.dry_run,
+                    reason=reason, error=str(exc),
+                )
+            spec = current.get("spec") or {}
+            all_taints = spec.get("taints") or []
+            had_our_taint = any(t.get("key") == self.taint_key for t in all_taints)
+            taints = [t for t in all_taints if t.get("key") != self.taint_key]
+            # Only undo a cordon WE are responsible for (our taint present,
+            # or the node is in this actuator's quarantined set). A node an
+            # operator cordoned for unrelated maintenance — no remediation
+            # taint — must stay cordoned: releasing it would silently undo
+            # the operator's work.
+            uncordon = (had_our_taint or quarantined_by_us) and bool(spec.get("unschedulable"))
+            if not had_our_taint and not uncordon:
+                # nothing to untaint, nothing to uncordon: a semantically
+                # empty PATCH would still burn a rate slot, bump the node's
+                # rv, and wake the node-plane watch — mirror quarantine's
+                # adoption early-return instead (the caller refunds the slot)
+                logger.info(
+                    "Release of node %s: no %s taint and no cordon of ours; "
+                    "nothing to do", node, self.taint_key,
+                )
+                return ActionRecord(
+                    node=node, action="release", ok=True, dry_run=self.dry_run,
+                    reason=f"nothing to release; {reason}", adopted=True,
+                )
+            patch: Dict[str, Any] = {"spec": {"taints": taints or None}}
+            rv = (current.get("metadata") or {}).get("resourceVersion")
+            if rv:
+                patch["metadata"] = {"resourceVersion": rv}
+            if uncordon:
+                patch["spec"]["unschedulable"] = None
+            if self.dry_run:
+                logger.warning("[DRY-RUN] would release node %s (uncordon=%s): %s", node, uncordon, reason)
+                return ActionRecord(node=node, action="release", ok=True, dry_run=True, reason=reason)
+            try:
+                self.client.patch_node(node, patch)
+            except K8sConflictError:
+                logger.info(
+                    "Node %s changed between read and write (attempt %d/%d); re-reading",
+                    node, attempt + 1, self._RMW_ATTEMPTS,
+                )
+                continue
+            except K8sApiError as exc:
+                return ActionRecord(
+                    node=node, action="release", ok=False, dry_run=False,
+                    reason=reason, error=f"patch_node failed: {exc}",
+                )
+            logger.warning(
+                "RELEASED node %s (taint %s removed%s): %s",
+                node, self.taint_key, ", uncordoned" if uncordon else ", cordon left alone", reason,
             )
-        taints = [
-            t for t in (current.get("spec") or {}).get("taints") or []
-            if t.get("key") != self.taint_key
-        ]
-        patch = {"spec": {"taints": taints or None, "unschedulable": None}}
-        if self.dry_run:
-            logger.warning("[DRY-RUN] would release node %s: %s", node, reason)
-            return ActionRecord(node=node, action="release", ok=True, dry_run=True, reason=reason)
-        try:
-            self.client.patch_node(node, patch)
-        except K8sApiError as exc:
-            return ActionRecord(
-                node=node, action="release", ok=False, dry_run=False,
-                reason=reason, error=f"patch_node failed: {exc}",
-            )
-        logger.warning("RELEASED node %s (uncordoned, taint %s removed): %s", node, self.taint_key, reason)
-        return ActionRecord(node=node, action="release", ok=True, dry_run=False, reason=reason, applied=True)
+            return ActionRecord(node=node, action="release", ok=True, dry_run=False, reason=reason, applied=True)
+        return ActionRecord(
+            node=node, action="release", ok=False, dry_run=False, reason=reason,
+            error=f"patch_node conflicted {self._RMW_ATTEMPTS} times (node spec churning)",
+        )
 
     def quarantined_nodes(self) -> List[str]:
         with self._lock:

@@ -30,21 +30,22 @@ def _arm_remediation(agent, config, environment: str, dispatcher) -> None:
     (tpu.remediation.enabled) — the DaemonSet deployment, where the watcher
     never sees probe reports, so the agent itself must close the loop.
 
-    Only process 0 evaluates policy (the policy enforces this too), so one
-    actuator acts per slice: the safety fences — including
-    ``max_quarantined_nodes`` — are therefore PER SLICE AGENT here, not
-    cluster-wide (RUNBOOK.md). Needs get/patch on nodes via the pod's
-    ServiceAccount (deploy/rbac.yaml); without credentials the agent logs
-    and probes on, remediation-free.
+    EVERY process arms a policy: the policy's own actor split
+    (remediate/policy.py) has process 0 act on slice-scope findings while
+    each non-0 process acts only on LOCAL-scope findings naming its own
+    node (its chips' liveness/integrity) — gating arming on process 0
+    would silently drop remote hardware faults in the DaemonSet
+    deployment. The safety fences — including ``max_quarantined_nodes``
+    — are therefore PER SLICE AGENT here, not cluster-wide (RUNBOOK.md).
+    Needs get/patch on nodes via the pod's ServiceAccount
+    (deploy/rbac.yaml); without credentials the agent logs and probes
+    on, remediation-free.
     """
     import logging
 
     if not config.tpu.remediation_enabled:
         return None
     import jax
-
-    if jax.process_count() > 1 and jax.process_index() != 0:
-        return None
     logger = logging.getLogger("probe_agent")
     try:
         from k8s_watcher_tpu.k8s.client import K8sClient
@@ -55,7 +56,15 @@ def _arm_remediation(agent, config, environment: str, dispatcher) -> None:
             config_file=config.kubernetes.config_file,
             verify_tls=config.kubernetes.verify_tls,
         )
-        client = K8sClient(connection, request_timeout=config.kubernetes.request_timeout)
+        # The policy's observe_report runs SYNCHRONOUSLY on the probe
+        # thread after heartbeat(): a confirmed node costs GET+PATCH, and a
+        # budget refusal one GET per remembered node. Cap this client's
+        # per-request timeout so an unresponsive apiserver bounds the
+        # observer at a handful of requests x 10 s — well inside the
+        # liveness stale_after floor (300 s) — instead of stalling probe
+        # cycles for minutes on the full kubernetes.request_timeout.
+        remediation_timeout = min(float(config.kubernetes.request_timeout), 10.0)
+        client = K8sClient(connection, request_timeout=remediation_timeout)
         client.get_api_version()  # fail fast: no cluster -> no remediation
     except Exception as exc:  # noqa: BLE001 — probing must survive without a cluster
         logger.warning("tpu.remediation enabled but no usable k8s credentials (%s); probing without remediation", exc)
@@ -113,8 +122,12 @@ def main() -> int:
 
         # beats land at cycle END only (a crash-looping or mid-cycle-hung
         # probe must read as dead), so the steady-state inter-beat gap is
-        # cycle_duration + interval; the threshold leaves room for cycles
-        # several intervals long (large-slice walks with tracing on)
+        # cycle_duration + interval PLUS the report observer's I/O (the
+        # remediation policy runs synchronously after the beat; its k8s
+        # client timeout is capped at 10 s/request in _arm_remediation, so
+        # its worst case stays well under the 300 s floor below); the
+        # threshold leaves room for cycles several intervals long
+        # (large-slice walks with tracing on)
         liveness = Liveness(
             stale_after_seconds=max(300.0, 5 * config.tpu.probe_interval_seconds),
             # the first cycle pays every jit compile (+ the multi-host mesh

@@ -263,6 +263,103 @@ class TestActuator:
         with pytest.raises(ValueError):
             make_actuator(mock_api, taint_effect="EvictEverything")
 
+    def test_mock_patch_node_stale_rv_conflicts(self, mock_api):
+        """The mock honors the apiserver's optimistic-concurrency contract:
+        a patch carrying a stale metadata.resourceVersion gets 409."""
+        from k8s_watcher_tpu.k8s.client import K8sConflictError
+
+        client = make_client(mock_api)
+        stale_rv = client.get_node("tpu-node-0")["metadata"]["resourceVersion"]
+        client.patch_node("tpu-node-0", {"spec": {"unschedulable": True}})  # rv moves
+        with pytest.raises(K8sConflictError):
+            client.patch_node(
+                "tpu-node-0",
+                {"metadata": {"resourceVersion": stale_rv}, "spec": {"taints": []}},
+            )
+        # fresh rv goes through, and the server keeps ownership of rv
+        fresh = client.get_node("tpu-node-0")["metadata"]["resourceVersion"]
+        out = client.patch_node(
+            "tpu-node-0",
+            {"metadata": {"resourceVersion": fresh}, "spec": {"taints": []}},
+        )
+        assert out["metadata"]["resourceVersion"] != fresh
+
+    def test_concurrent_taint_edit_is_not_clobbered(self, mock_api):
+        """A taint another controller adds between the actuator's GET and
+        PATCH must survive: the rv-guarded write 409s and the RMW retries
+        with a fresh read that includes the concurrent taint."""
+        real = make_client(mock_api)
+
+        class RacingClient:
+            """First get_node triggers a concurrent out-of-band taint edit
+            AFTER the read returns — exactly the RMW race window."""
+
+            def __init__(self):
+                self.raced = False
+
+            def get_node(self, name):
+                current = real.get_node(name)
+                if not self.raced:
+                    self.raced = True
+                    real.patch_node(name, {"spec": {"taints": [
+                        {"key": "node.kubernetes.io/unreachable", "effect": "NoExecute"}
+                    ]}})
+                return current
+
+            def __getattr__(self, attr):
+                return getattr(real, attr)
+
+        actuator = NodeActuator(RacingClient(), dry_run=False, cooldown_seconds=0.0)
+        record = actuator.quarantine("tpu-node-0", "evidence")
+        assert record.ok and record.applied
+        taints = {t["key"] for t in real.get_node("tpu-node-0")["spec"]["taints"]}
+        assert taints == {"node.kubernetes.io/unreachable", TAINT_KEY}
+
+    def test_release_leaves_operator_cordon_alone(self, mock_api):
+        """release() on a node an operator cordoned for unrelated
+        maintenance (no remediation taint, not quarantined by us) must NOT
+        uncordon it — that would silently undo the operator's work."""
+        client = make_client(mock_api)
+        client.patch_node("tpu-node-0", {"spec": {"unschedulable": True}})  # operator cordon
+        rv_before = client.get_node("tpu-node-0")["metadata"]["resourceVersion"]
+        actuator = make_actuator(mock_api, max_actions_per_hour=4)
+        record = actuator.release("tpu-node-0", "operator release")
+        assert record.ok and not record.applied and record.adopted
+        node = client.get_node("tpu-node-0")
+        assert node["spec"].get("unschedulable") is True  # cordon intact
+        # the no-op wrote nothing (rv unmoved) and refunded its rate slot
+        assert node["metadata"]["resourceVersion"] == rv_before
+        with actuator._lock:
+            assert len(actuator._action_times) == 0
+
+    def test_release_uncordons_when_our_taint_present(self, mock_api):
+        """The inverse guard: a node WE quarantined (taint present) is
+        fully released even by a fresh actuator with empty memory."""
+        make_actuator(mock_api).quarantine("tpu-node-0", "x")
+        record = make_actuator(mock_api).release("tpu-node-0", "cleared")
+        assert record.ok and record.applied
+        node = make_client(mock_api).get_node("tpu-node-0")
+        assert "unschedulable" not in node["spec"]
+        assert not any(
+            t["key"] == TAINT_KEY for t in node["spec"].get("taints") or []
+        )
+
+    def test_refund_removes_this_calls_rate_slot(self, mock_api):
+        """_refund_locked must remove the exact timestamp this call
+        consumed, not whatever happens to be newest — popping the tail
+        would evict a concurrent action's slot and leave the older one
+        skewing the sliding-hour window."""
+        clock = FakeClock()
+        actuator = make_actuator(mock_api, clock=clock, max_actions_per_hour=10)
+        with actuator._lock:
+            ts_a = actuator._consume("tpu-node-0")
+            clock.now += 10.0
+            ts_b = actuator._consume("tpu-node-1")
+            actuator._refund_locked("tpu-node-0", None, ts_a)
+            assert list(actuator._action_times) == [ts_b]
+            assert "tpu-node-0" not in actuator._last_action
+            assert actuator._last_action["tpu-node-1"] == ts_b
+
 
 def probe_report(
     *,
