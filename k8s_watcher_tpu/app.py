@@ -88,6 +88,7 @@ def build_source(
         heartbeat=heartbeat,
         scanner=scanner,
         metrics=metrics,
+        list_page_size=config.watcher.list_page_size,
     )
 
 

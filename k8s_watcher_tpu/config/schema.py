@@ -221,6 +221,10 @@ class WatcherConfig:
     leader_election: LeaderElectionConfig = dataclasses.field(default_factory=LeaderElectionConfig)
     # last-N pipeline decisions served at /debug/events (0 disables)
     audit_ring_size: int = 256
+    # LIST pagination (limit+continue) page size for the initial list and
+    # every relist — bounds apiserver response size and watcher peak memory
+    # on large clusters (client-go's default is 500)
+    list_page_size: int = 500
 
     @classmethod
     def from_raw(cls, raw: Mapping[str, Any]) -> "WatcherConfig":
@@ -228,7 +232,7 @@ class WatcherConfig:
             raw,
             ("watch_interval", "log_level", "namespaces", "retry", "alerts",
              "status_port", "liveness_stale_seconds", "label_selector", "leader_election",
-             "audit_ring_size"),
+             "audit_ring_size", "list_page_size"),
             "watcher",
         )
         namespaces = raw.get("namespaces") or ()
@@ -242,6 +246,11 @@ class WatcherConfig:
         level = _expect(raw.get("log_level", "INFO"), (str,), "watcher.log_level").upper()
         if level not in ("DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"):
             raise SchemaError(f"config key 'watcher.log_level': invalid level {level!r}")
+        page_size = _opt_int(raw, "list_page_size", "watcher", 500)
+        if page_size < 1:
+            raise SchemaError(
+                f"config key 'watcher.list_page_size': must be >= 1, got {page_size}"
+            )
         return cls(
             watch_interval=_opt_num(raw, "watch_interval", "watcher", 1.0),
             log_level=level,
@@ -253,6 +262,7 @@ class WatcherConfig:
             label_selector=_opt_str(raw, "label_selector", "watcher", None),
             leader_election=LeaderElectionConfig.from_raw(raw.get("leader_election") or {}),
             audit_ring_size=_opt_int(raw, "audit_ring_size", "watcher", 256),
+            list_page_size=page_size,
         )
 
 

@@ -207,15 +207,72 @@ class K8sClient:
         *,
         limit: Optional[int] = None,
         label_selector: Optional[str] = None,
+        continue_token: Optional[str] = None,
     ) -> Dict[str, Any]:
         """One page of pods; returns the raw PodList body (items +
-        metadata.resourceVersion, the resume point for a subsequent watch)."""
+        metadata.resourceVersion, the resume point for a subsequent watch,
+        + metadata.continue when more pages remain). Pass the previous
+        page's ``metadata.continue`` as ``continue_token`` to fetch the
+        next page; an expired token raises K8sGoneError (410) and the
+        caller must restart the list (see ``list_pods_paged``)."""
         params: Dict[str, Any] = {}
         if limit:
             params["limit"] = limit
         if label_selector:
             params["labelSelector"] = label_selector
+        if continue_token:
+            params["continue"] = continue_token
         return self._get(self._pods_path(namespace), params).json()
+
+    def list_pods_paged(
+        self,
+        namespace: Optional[str] = None,
+        *,
+        page_size: int = 500,
+        label_selector: Optional[str] = None,
+        max_restarts: int = 2,
+    ):
+        """Stream a large LIST in bounded pages (``limit``+``continue`` —
+        the SDK-provided behavior at reference pod_watcher.py:264 that the
+        from-scratch client must supply itself; without it every relist of
+        a large cluster is one unbounded response).
+
+        Yields ``(attempt, page_body)``. ``attempt`` increments when an
+        expired continue token (410 mid-pagination: the snapshot was
+        compacted away under us) forces the list to restart from scratch —
+        the consumer must then RESET anything accumulated from earlier
+        pages of the aborted attempt, because the new attempt is a new
+        snapshot at a new resourceVersion (k8s/watch.py resets its
+        listed-uid set; acting on a mixed-snapshot union would synthesize
+        wrong tombstones). Pages within one attempt share their snapshot's
+        resourceVersion. Raises K8sGoneError after ``max_restarts``
+        restarts (a pathologically churning cluster needs operator eyes,
+        not an infinite list loop)."""
+        attempt = 0
+        while True:
+            token: Optional[str] = None
+            try:
+                while True:
+                    page = self.list_pods(
+                        namespace,
+                        limit=page_size,
+                        label_selector=label_selector,
+                        continue_token=token,
+                    )
+                    yield attempt, page
+                    token = (page.get("metadata") or {}).get("continue")
+                    if not token:
+                        return
+            except K8sGoneError:
+                if token is None:
+                    raise  # the FIRST page 410'd: not an expired token
+                attempt += 1
+                if attempt > max_restarts:
+                    raise
+                logger.warning(
+                    "LIST continue token expired (410) mid-pagination; "
+                    "restarting the list (attempt %d/%d)", attempt, max_restarts,
+                )
 
     def list_nodes(self, *, label_selector: Optional[str] = None) -> Dict[str, Any]:
         """One page of nodes; raw NodeList body (items + resourceVersion)."""

@@ -20,6 +20,7 @@ Test hooks: ``MockCluster.add/modify/delete_pod`` drive the event stream;
 
 from __future__ import annotations
 
+import base64
 import json
 import threading
 import time
@@ -300,21 +301,66 @@ class MockCluster:
         namespace: Optional[str],
         limit: Optional[int],
         label_selector: Optional[str] = None,
-    ) -> Dict[str, Any]:
+        continue_token: Optional[str] = None,
+    ) -> Tuple[int, Dict[str, Any]]:
+        """(status, body) for ``GET .../pods`` with ``limit``+``continue``
+        pagination (the apiserver contract the paged client consumes):
+
+        - every page of one list reports the resourceVersion of the
+          snapshot the list STARTED at (the client's watch-resume point),
+          not the rv at page-serve time;
+        - ``metadata.continue`` is an opaque cursor (snapshot rv + last
+          key served); compaction past that rv expires it -> 410 Gone,
+          exactly how etcd compaction expires real continue tokens.
+
+        Pages after the first are served from the CURRENT pod map at the
+        cursor key — the mock doesn't retain historical snapshots — which
+        matches the observable client contract: anything that changes
+        between pages is journaled at rv > snapshot and arrives via the
+        resumed watch."""
         selector = _parse_label_selector(label_selector)
+        after: Tuple[str, str] = ("", "")
+        snapshot_rv: Optional[str] = None
+        if continue_token:
+            try:
+                decoded = json.loads(base64.b64decode(continue_token.encode()).decode())
+                # validate the full shape HERE: a decodable token with a
+                # non-int rv or non-string keys must 400, not 500 later
+                snapshot_rv = str(int(decoded["rv"]))
+                after = (decoded["ns"], decoded["name"])
+                if not (isinstance(after[0], str) and isinstance(after[1], str)):
+                    raise TypeError("cursor keys must be strings")
+            except (ValueError, KeyError, TypeError):
+                return 400, {"kind": "Status", "code": 400, "message": "malformed continue token"}
         with self._lock:
-            items = [
-                json.loads(json.dumps(pod))
-                for (ns, _name), pod in sorted(self._pods.items())
-                if (namespace is None or ns == namespace) and _matches_selector(pod, selector)
+            if snapshot_rv is not None and int(snapshot_rv) < self._oldest_rv:
+                return 410, {
+                    "kind": "Status", "code": 410, "reason": "Expired",
+                    "message": "The provided continue parameter is too old",
+                }
+            matches = [
+                (key, pod)
+                for key, pod in sorted(self._pods.items())
+                if (namespace is None or key[0] == namespace)
+                and _matches_selector(pod, selector)
+                and key > after
             ]
-            rv = str(self._rv)
-        if limit:
-            items = items[:limit]
-        return {
+            rv = snapshot_rv if snapshot_rv is not None else str(self._rv)
+            next_token = None
+            if limit and len(matches) > limit:
+                matches = matches[:limit]
+                last_ns, last_name = matches[-1][0]
+                next_token = base64.b64encode(
+                    json.dumps({"rv": int(rv), "ns": last_ns, "name": last_name}).encode()
+                ).decode()
+            items = [json.loads(json.dumps(pod)) for _key, pod in matches]
+        metadata: Dict[str, Any] = {"resourceVersion": rv}
+        if next_token:
+            metadata["continue"] = next_token
+        return 200, {
             "kind": "PodList",
             "apiVersion": "v1",
-            "metadata": {"resourceVersion": rv},
+            "metadata": metadata,
             "items": items,
         }
 
@@ -480,7 +526,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._serve_watch(namespace, params)
         else:
             limit = int(params["limit"]) if "limit" in params else None
-            self._json(200, self.cluster.list_pods(namespace, limit, params.get("labelSelector")))
+            status, body = self.cluster.list_pods(
+                namespace, limit, params.get("labelSelector"), params.get("continue")
+            )
+            self._json(status, body)
 
     def _read_body(self) -> Optional[Dict[str, Any]]:
         try:

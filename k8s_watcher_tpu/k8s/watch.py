@@ -45,10 +45,12 @@ class KubernetesWatchSource:
         heartbeat=None,  # Callable[[], None]: stamped on any apiserver contact
         scanner=None,  # native.scanner.FrameScanner: skip-parse prefilter
         metrics=None,  # metrics.MetricsRegistry, optional
+        list_page_size: int = 500,  # LIST pagination (limit+continue)
     ):
         self.client = client
         self.namespace = namespace
         self.label_selector = label_selector
+        self.list_page_size = list_page_size
         self.retry = retry or RetryPolicy()
         self.watch_timeout_seconds = watch_timeout_seconds
         self.resource_version = resource_version
@@ -177,14 +179,36 @@ class KubernetesWatchSource:
 
     def _relist(self) -> Iterator[WatchEvent]:
         """LIST current pods: ADDED for each, synthetic DELETED for pods
-        that vanished during the disconnect gap, then set the resume version."""
-        body = self.client.list_pods(self.namespace, label_selector=self.label_selector)
-        rv = (body.get("metadata") or {}).get("resourceVersion")
-        listed_uids = set()
-        for pod in body.get("items", []):
-            listed_uids.add((pod.get("metadata") or {}).get("uid"))
-            self._track(EventType.ADDED, pod)
-            yield WatchEvent(type=EventType.ADDED, pod=pod, resource_version=rv)
+        that vanished during the disconnect gap, then set the resume version.
+
+        The LIST is paged (``limit``+``continue``, page size
+        ``list_page_size``) so a relist of a large cluster streams bounded
+        responses instead of one unbounded PodList — each page's events are
+        yielded before the next page is fetched, so peak memory is one page
+        plus the skeleton map. Tombstone synthesis runs only after the LAST
+        page: only then is "absent from the list" meaningful. When an
+        expired continue token forces the paged client to restart (new
+        snapshot, new rv), the listed-uid set resets with it — a union
+        across two snapshots would suppress tombstones for pods that
+        vanished between them (re-ADDs of pods from the aborted attempt
+        are harmless: downstream phase tracking dedupes, same as any
+        relist)."""
+        rv = None
+        listed_uids: set = set()
+        last_attempt = 0
+        for attempt, body in self.client.list_pods_paged(
+            self.namespace,
+            page_size=self.list_page_size,
+            label_selector=self.label_selector,
+        ):
+            if attempt != last_attempt:
+                listed_uids.clear()
+                last_attempt = attempt
+            rv = (body.get("metadata") or {}).get("resourceVersion") or rv
+            for pod in body.get("items", []):
+                listed_uids.add((pod.get("metadata") or {}).get("uid"))
+                self._track(EventType.ADDED, pod)
+                yield WatchEvent(type=EventType.ADDED, pod=pod, resource_version=rv)
         for uid in [u for u in self._known if u not in listed_uids]:
             tombstone = self._known.pop(uid)
             legacy = bool(tombstone.get("legacy_tombstone", False))
@@ -220,13 +244,49 @@ class KubernetesWatchSource:
                 logger.info("Resuming watch from checkpointed resourceVersion %s", self.resource_version)
 
         need_list = self.resource_version is None
+
+        def backoff_or_raise(exc, what: str) -> bool:
+            """Count one failure against max_reconnects (raising ``exc`` on
+            exhaustion), back off, and return True when stop() interrupted
+            the wait."""
+            nonlocal backoff, reconnects
+            reconnects += 1
+            if self.max_reconnects is not None and reconnects > self.max_reconnects:
+                logger.error("%s failed after %d attempts: %s", what, reconnects - 1, exc)
+                raise exc
+            logger.warning(
+                "%s error (%s); retrying in %.1fs (attempt %d)", what, exc, backoff, reconnects
+            )
+            stopped = self._stop.wait(backoff)
+            backoff = min(backoff * self.retry.backoff_multiplier, self.retry.max_delay_seconds)
+            return stopped
+
         while not self._stop.is_set():
-            try:
-                if need_list:
+            # The LIST phase has its OWN handlers, outside the watch try
+            # below: a K8sGoneError escaping the paged LIST means the
+            # continue tokens kept expiring max_restarts times (churning
+            # cluster) — letting the watch-phase 410 handler catch it
+            # would relist IMMEDIATELY in a tight full-LIST loop against
+            # an already-stressed apiserver, and nothing would ever bound
+            # it. Both list failure modes back off and count against
+            # max_reconnects instead.
+            if need_list:
+                try:
                     yield from self._relist()
                     need_list = False
                     self.heartbeat()
+                except (K8sGoneError, K8sApiError) as exc:
+                    if self._stop.is_set():
+                        return
+                    what = (
+                        "Paged LIST (continue tokens kept expiring)"
+                        if isinstance(exc, K8sGoneError) else "LIST"
+                    )
+                    if backoff_or_raise(exc, what):
+                        return
+                    continue
 
+            try:
                 for raw in self.client.watch_pods(
                     self.namespace,
                     resource_version=self.resource_version,
@@ -279,13 +339,5 @@ class KubernetesWatchSource:
                     # error; a clean shutdown must not log a scary
                     # "reconnecting" warning on every SIGTERM
                     return
-                reconnects += 1
-                if self.max_reconnects is not None and reconnects > self.max_reconnects:
-                    logger.error("Watch failed after %d reconnect attempts: %s", reconnects - 1, exc)
-                    raise
-                logger.warning(
-                    "Watch stream error (%s); reconnecting in %.1fs (attempt %d)", exc, backoff, reconnects
-                )
-                if self._stop.wait(backoff):
+                if backoff_or_raise(exc, "Watch stream"):
                     return
-                backoff = min(backoff * self.retry.backoff_multiplier, self.retry.max_delay_seconds)

@@ -423,6 +423,135 @@ class TestK8sClient:
         t.join(timeout=6)
         assert [e["object"]["metadata"]["name"] for e in got] == ["trainer"]
 
+class CountingClient(K8sClient):
+    """K8sClient that records every LIST page's item count and can run a
+    hook after the Nth page — the paged generator calls ``list_pods`` on
+    ``self``, so overriding here observes real pagination traffic."""
+
+    def __init__(self, server, timeout: float = 10.0):
+        super().__init__(K8sConnection(server=server.url), request_timeout=timeout)
+        self.page_sizes = []
+        self.after_page = None  # Callable[[int], None], arg = pages so far
+
+    def list_pods(self, *args, **kwargs):
+        body = super().list_pods(*args, **kwargs)
+        self.page_sizes.append(len(body.get("items", [])))
+        if self.after_page is not None:
+            self.after_page(len(self.page_sizes))
+        return body
+
+
+class TestListPagination:
+    """limit+continue paging: the SDK-provided large-list behavior
+    (reference pod_watcher.py:264 via kubernetes==33.1.0) the from-scratch
+    client supplies itself."""
+
+    def test_pages_cover_all_pods_with_stable_rv(self, mock_api):
+        for i in range(25):
+            mock_api.cluster.add_pod(build_pod(f"p{i:03d}"))
+        client = make_client(mock_api)
+        page1 = client.list_pods(limit=10)
+        token1 = page1["metadata"]["continue"]
+        page2 = client.list_pods(limit=10, continue_token=token1)
+        token2 = page2["metadata"]["continue"]
+        page3 = client.list_pods(limit=10, continue_token=token2)
+        assert [len(p["items"]) for p in (page1, page2, page3)] == [10, 10, 5]
+        # the LAST page carries no continue token
+        assert "continue" not in page3["metadata"]
+        # every page of one list reports the SAME snapshot rv (the
+        # watch-resume point), even if the cluster changed between pages
+        mock_api.cluster.add_pod(build_pod("later"))
+        page2b = client.list_pods(limit=10, continue_token=token1)
+        assert page2b["metadata"]["resourceVersion"] == page1["metadata"]["resourceVersion"]
+        names = {
+            p["metadata"]["name"]
+            for page in (page1, page2, page3)
+            for p in page["items"]
+        }
+        assert names == {f"p{i:03d}" for i in range(25)}
+
+    def test_exact_multiple_has_no_dangling_page(self, mock_api):
+        for i in range(20):
+            mock_api.cluster.add_pod(build_pod(f"p{i:03d}"))
+        client = make_client(mock_api)
+        page1 = client.list_pods(limit=10)
+        page2 = client.list_pods(limit=10, continue_token=page1["metadata"]["continue"])
+        assert len(page2["items"]) == 10
+        assert "continue" not in page2["metadata"]
+
+    def test_expired_continue_token_raises_gone(self, mock_api):
+        for i in range(15):
+            mock_api.cluster.add_pod(build_pod(f"p{i:03d}"))
+        client = make_client(mock_api)
+        token = client.list_pods(limit=10)["metadata"]["continue"]
+        # rv advances past the token's snapshot, then compaction expires it
+        mock_api.cluster.add_pod(build_pod("bump"))
+        mock_api.cluster.compact()
+        with pytest.raises(K8sGoneError):
+            client.list_pods(limit=10, continue_token=token)
+
+    def test_malformed_continue_token_rejected(self, mock_api):
+        import base64 as b64
+        import json as jsonlib
+
+        mock_api.cluster.add_pod(build_pod("p0"))
+        client = make_client(mock_api)
+        bad_tokens = [
+            "not-a-token",
+            # decodable JSON but wrong shapes must 400, not 500
+            b64.b64encode(jsonlib.dumps({"rv": "x", "ns": "", "name": ""}).encode()).decode(),
+            b64.b64encode(jsonlib.dumps({"rv": 1, "ns": None, "name": 2}).encode()).decode(),
+        ]
+        for token in bad_tokens:
+            with pytest.raises(K8sApiError) as exc_info:
+                client.list_pods(limit=10, continue_token=token)
+            assert not isinstance(exc_info.value, K8sGoneError), token
+
+    def test_paged_iterator_streams_all_pages(self, mock_api):
+        for i in range(23):
+            mock_api.cluster.add_pod(build_pod(f"p{i:03d}"))
+        client = CountingClient(mock_api)
+        pages = list(client.list_pods_paged(page_size=10))
+        assert [a for a, _ in pages] == [0, 0, 0]  # one attempt, no restarts
+        assert client.page_sizes == [10, 10, 3]
+        names = {p["metadata"]["name"] for _, body in pages for p in body["items"]}
+        assert len(names) == 23
+
+    def test_paged_iterator_restarts_on_expired_token(self, mock_api):
+        for i in range(30):
+            mock_api.cluster.add_pod(build_pod(f"p{i:03d}"))
+        client = CountingClient(mock_api)
+
+        def expire_after_first_page(pages_so_far):
+            if pages_so_far == 1:
+                # the snapshot is compacted away under the pagination
+                mock_api.cluster.add_pod(build_pod("bump"))
+                mock_api.cluster.compact()
+
+        client.after_page = expire_after_first_page
+        pages = list(client.list_pods_paged(page_size=10))
+        attempts = [a for a, _ in pages]
+        assert attempts[0] == 0 and attempts[-1] == 1  # restarted once
+        # the restarted attempt covers the whole (current) cluster
+        final_names = {
+            p["metadata"]["name"] for a, body in pages if a == 1 for p in body["items"]
+        }
+        assert final_names == {f"p{i:03d}" for i in range(30)} | {"bump"}
+
+    def test_paged_iterator_bounds_restarts(self, mock_api):
+        for i in range(30):
+            mock_api.cluster.add_pod(build_pod(f"p{i:03d}"))
+        client = CountingClient(mock_api)
+
+        def always_expire(_pages_so_far):
+            mock_api.cluster.add_pod(build_pod(f"churn-{_pages_so_far}"))
+            mock_api.cluster.compact()
+
+        client.after_page = always_expire
+        with pytest.raises(K8sGoneError):
+            list(client.list_pods_paged(page_size=10, max_restarts=2))
+
+
 class TestKubernetesWatchSource:
     def collect(self, source, n, timeout=10.0):
         got = []
@@ -760,6 +889,87 @@ class TestKubernetesWatchSource:
         assert done2.wait(5)
         source2.stop()
         assert [e.name for e in got2] == ["w0", "w1"]  # replayed + new, no relist
+
+    def test_exhausted_paged_list_backs_off_and_raises(self, mock_api):
+        """When the paged LIST itself keeps expiring (churning cluster,
+        every continue token compacted away), events() must back off and
+        give up after max_reconnects — NOT fall into the outer 410
+        handler's immediate relist, which would hammer the apiserver with
+        full LISTs in a tight loop forever."""
+        for i in range(30):
+            mock_api.cluster.add_pod(build_pod(f"p{i:03d}", uid=f"uid-{i:03d}"))
+        client = CountingClient(mock_api)
+
+        def always_expire(_pages_so_far):
+            mock_api.cluster.add_pod(build_pod(f"churn-{_pages_so_far}"))
+            mock_api.cluster.compact()
+
+        client.after_page = always_expire
+        retry = RetryPolicy(max_attempts=5, delay_seconds=0.05, backoff_multiplier=1.0)
+        source = KubernetesWatchSource(
+            client, list_page_size=10, retry=retry, max_reconnects=2,
+        )
+        with pytest.raises(K8sGoneError):
+            for _ in source.events():
+                pass
+        # bounded traffic: (max_reconnects + 1) relists x (max_restarts + 1)
+        # paging attempts x 1 page each — not an unbounded loop
+        assert len(client.page_sizes) <= 9
+
+    def test_relist_pages_10k_pods_with_tombstones(self, mock_api):
+        """The relist path streams bounded pages at cluster scale: 10k
+        pods arrive in list_page_size chunks (never one unbounded
+        PodList), and tombstone synthesis — only meaningful after the
+        LAST page — still fires for pods that vanished between relists."""
+        n = 10_000
+        for i in range(n):
+            mock_api.cluster.add_pod(build_pod(f"p{i:05d}", uid=f"uid-{i:05d}"))
+        client = CountingClient(mock_api, timeout=60.0)
+        source = KubernetesWatchSource(client, list_page_size=500)
+        added = list(source._relist())
+        assert len(added) == n and all(e.type == "ADDED" for e in added)
+        assert len(client.page_sizes) == n // 500  # 20 bounded requests...
+        assert max(client.page_sizes) == 500  # ...none exceeding the page size
+        assert len(source._known) == n
+
+        # three pods vanish while "disconnected"; the next relist pages
+        # through the survivors and synthesizes exactly their tombstones
+        for name in ("p00000", "p04999", "p09999"):
+            mock_api.cluster.delete_pod("default", name)
+        client.page_sizes.clear()
+        events = list(source._relist())
+        deleted = [e for e in events if e.type == "DELETED"]
+        assert {e.name for e in deleted} == {"p00000", "p04999", "p09999"}
+        assert len([e for e in events if e.type == "ADDED"]) == n - 3
+        assert max(client.page_sizes) == 500
+        assert len(source._known) == n - 3
+
+    def test_relist_restart_mid_pagination_keeps_tombstones_correct(self, mock_api):
+        """A continue token expiring MID-relist restarts the list from a
+        new snapshot; the listed-uid set must reset with it — a pod that
+        vanished between the two snapshots still gets its tombstone, and
+        pods double-listed across attempts never produce a spurious one."""
+        for i in range(30):
+            mock_api.cluster.add_pod(build_pod(f"p{i:03d}", uid=f"uid-{i:03d}"))
+        client = CountingClient(mock_api)
+        source = KubernetesWatchSource(client, list_page_size=10)
+        assert len(list(source._relist())) == 30  # populate _known
+
+        def expire_after_first_page(pages_so_far):
+            if pages_so_far == 1:
+                # p005 was ALREADY listed (and tracked) in page 1 of this
+                # attempt; it vanishes before the restart's new snapshot
+                mock_api.cluster.delete_pod("default", "p005")
+                mock_api.cluster.compact()
+
+        client.page_sizes.clear()
+        client.after_page = expire_after_first_page
+        events = list(source._relist())
+        deleted = [e for e in events if e.type == "DELETED"]
+        assert {e.name for e in deleted} == {"p005"}
+        assert "uid-005" not in source._known
+        # the restart re-listed everything: more than one attempt ran
+        assert sum(client.page_sizes) > 30
 
 
 class TestCheckpointStore:
