@@ -71,6 +71,9 @@ class MultiSliceProbeResult:
     suspect_pairs: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
     # slice indices implicated by >=2 suspect pairs (their DCN endpoint)
     dcn_suspect_slices: List[int] = dataclasses.field(default_factory=list)
+    # slice index -> member process indices (the node-mapping join for the
+    # remediation policy: slice -> processes -> hosts identity map -> nodes)
+    slice_processes: List[List[int]] = dataclasses.field(default_factory=list)
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -108,10 +111,20 @@ def _walk_slice_pairs(
     2-slice program is an SPMD computation all member processes must
     execute in lockstep, while non-members own no shard of it. The
     lowest-indexed member process owns the canonical record (host-level
-    merge counts each pair once). A process belonging to the slow slice
-    sees only its own (uniformly slow) pairs, so ITS min-anchored
-    classification may stay quiet — the healthy slices' processes see the
-    contrast and flag the pair, so detection survives the merge.
+    merge counts each pair once).
+
+    Returns ``(records, merged, compile_s, any_unreliable)``. ``records``
+    is this process's OWNED records (dedup-free to merge across hosts);
+    ``merged`` is the full pair population, all-gathered across processes
+    — classification MUST run over ``merged``: a process in the slow
+    slice observes only its own (uniformly slow) pairs, so its local
+    min-anchored baseline is itself slow and flags nothing, while a
+    healthy slice's process observes exactly ONE suspect pair per faulty
+    peer — below the >=2-pair endpoint threshold. Only the union has
+    both the healthy anchor and the full suspect count, and every
+    process classifying the same union keeps the verdict replicated (the
+    policy's process-0 actor needs to see what any process saw).
+    ``any_unreliable`` is likewise OR-merged across processes.
     """
     n_sl = mesh.shape["slices"]
     pid = jax.process_index()
@@ -160,7 +173,52 @@ def _walk_slice_pairs(
                     rtt_ms=-1.0, rtt_mean_ms=-1.0, correct=False, owner=owner,
                     error=str(exc),
                 ))
-    return records, compile_s, any_unreliable
+    merged = records
+    if multi:
+        # All-gather the owner-encoded rows so every process classifies
+        # the FULL pair population (docstring: neither a faulty slice's
+        # process nor a healthy one's can classify from its local view).
+        # One row per pair in the deterministic (i, j) order; exactly one
+        # process owns each pair, non-owners hold the -2 sentinel.
+        # Columns: [rtt_ms, rtt_mean_ms, correct]; an owned ERROR record
+        # travels as rtt_ms=-1 (its text stays local). The trailing row is
+        # EVERY process's local unreliable flag — it must not ride the
+        # owner rows, because a process that owns no pair (the highest
+        # slice's, in one-process-per-slice deployments) would have its
+        # flag silently dropped and the OR-merge would diverge across
+        # processes.
+        from jax.experimental import multihost_utils
+
+        pair_order = [(i, j) for i in range(n_sl) for j in range(i + 1, n_sl)]
+        pair_pos = {pair: k for k, pair in enumerate(pair_order)}
+        buf = np.full((len(pair_order) + 1, 3), -2.0, dtype=np.float32)
+        for r in records:
+            if r.owner:
+                buf[pair_pos[tuple(r.device_ids)]] = (
+                    r.rtt_ms, r.rtt_mean_ms, 1.0 if r.correct else 0.0,
+                )
+        buf[-1] = (1.0 if any_unreliable else 0.0, 0.0, 0.0)
+        gathered = np.asarray(multihost_utils.process_allgather(buf))
+        any_unreliable = bool(np.any(gathered[:, -1, 0] > 0.5))
+        merged = []
+        for k, (i, j) in enumerate(pair_order):
+            rows = gathered[:, k, :]
+            owned = rows[rows[:, 0] > -1.5]
+            if owned.shape[0] == 0:
+                merged.append(LinkResult(
+                    axis="dcn", name=f"slice{i}-slice{j}", device_ids=(i, j),
+                    rtt_ms=-1.0, rtt_mean_ms=-1.0, correct=False, owner=False,
+                    error="pair probe failed on its owner process",
+                ))
+                continue
+            row = owned[0]
+            merged.append(LinkResult(
+                axis="dcn", name=f"slice{i}-slice{j}", device_ids=(i, j),
+                rtt_ms=float(row[0]), rtt_mean_ms=float(row[1]),
+                correct=bool(row[2] > 0.5), owner=False,
+                error=None if row[0] >= 0.0 else "pair probe failed on its owner process",
+            ))
+    return records, merged, compile_s, any_unreliable
 
 
 def run_multislice_probe(
@@ -183,6 +241,10 @@ def run_multislice_probe(
             mesh = hybrid_slice_mesh(n_slices=n_slices)
         n_sl = mesh.shape["slices"]
         per_slice_devices = mesh.size // n_sl
+        grid = np.asarray(mesh.devices)
+        slice_processes = [
+            sorted({d.process_index for d in grid[i].flat}) for i in range(n_sl)
+        ]
 
         t0 = time.perf_counter()
         hier = make_hierarchical_probe(mesh, fault)
@@ -223,15 +285,19 @@ def run_multislice_probe(
         pairs_unreliable = False
         pair_compile_s = 0.0
         if pair_localization and n_sl >= 2:
-            pair_records, pair_compile_s, pairs_unreliable = _walk_slice_pairs(
+            pair_records, merged_records, pair_compile_s, pairs_unreliable = _walk_slice_pairs(
                 mesh, iters=iters, inner_iters=inner_iters,
                 baseline_ms=baseline_ms, fault=fault,
             )
             # min-baseline: a bad slice endpoint taints 2/n of ALL pairs
             # (50% at n=4), which drags a median baseline past any factor —
-            # the healthiest route anchors the threshold instead
+            # the healthiest route anchors the threshold instead.
+            # Classified over the MERGED population (multi-controller: the
+            # local view has neither the healthy anchor nor the full
+            # suspect count — _walk_slice_pairs docstring), so the verdict
+            # is identical on every process.
             suspect_pairs, dcn_suspect_slices = classify_links(
-                pair_records, pair_rtt_factor, pair_rtt_floor_ms, baseline_stat="min"
+                merged_records, pair_rtt_factor, pair_rtt_floor_ms, baseline_stat="min"
             )
             if suspect_pairs:
                 logger.warning(
@@ -259,6 +325,7 @@ def run_multislice_probe(
             pair_rtts=[dataclasses.asdict(r) for r in pair_records],
             suspect_pairs=suspect_pairs,
             dcn_suspect_slices=dcn_suspect_slices,
+            slice_processes=slice_processes,
         )
     except Exception as exc:
         logger.error("Multi-slice probe failed: %s", exc)

@@ -120,6 +120,58 @@ class ProbeRemediationPolicy:
                         f"{count} measured-suspect links",
                         scope="local" if owner_pidx == reporting_pidx else "slice",
                     )
+        # DCN pair-walk suspects (probe/multislice.py): a slice implicated
+        # as the common endpoint of >=2 suspect DCN pairs maps to its
+        # MEMBER NODES via slice_processes -> hosts identity. Slice-scope:
+        # the pair walk is observed by every member process of each pair,
+        # so process 0 is the single actor (same rule as remote link
+        # findings). A whole-slice implication can name MANY nodes — the
+        # actuator's max_quarantined_nodes budget is the designed stop
+        # against mass cordons from one fabric event; in dry-run (the
+        # default) this yields would-quarantine decisions naming the
+        # slice's nodes (ARCHITECTURE.md "DCN remediation").
+        ms = report.multislice
+        if (
+            ms is not None
+            and getattr(ms, "error", None) is None
+            and not getattr(ms, "timing_unreliable", False)
+        ):
+            # Re-derive suspect slices from MEASURED defects only (slow
+            # RTT, corrupt checksum) — ms.dcn_suspect_slices also counts
+            # error records, and an agent-infrastructure failure that
+            # error-marks many pairs (a compile error under the per-pair
+            # containment) would otherwise implicate whole healthy slices
+            # over a failure no probe ever measured. Same discipline as
+            # the link-walk re-triangulation above.
+            pair_counts: Dict[int, int] = {}
+            for pair in getattr(ms, "suspect_pairs", None) or []:
+                if pair.get("reason") not in ("slow", "corrupt"):
+                    continue
+                # device_ids on the "dcn" axis are SLICE indices
+                for slice_idx in pair.get("device_ids", ()):
+                    pair_counts[slice_idx] = pair_counts.get(slice_idx, 0) + 1
+            slice_procs = getattr(ms, "slice_processes", None) or []
+            for slice_idx, count in sorted(pair_counts.items()):
+                if count < 2:
+                    # one suspect pair implicates the route, not a slice
+                    continue
+                members = (
+                    slice_procs[slice_idx] if slice_idx < len(slice_procs) else []
+                )
+                if not members:
+                    unmapped.append(
+                        f"dcn probe: slice {slice_idx} is the common endpoint of "
+                        f"{count} suspect DCN pairs, but the report carries no "
+                        "member-process map for it"
+                    )
+                    continue
+                for pidx in members:
+                    implicate(
+                        pidx,
+                        f"dcn probe: slice {slice_idx} (host process {pidx}) is the "
+                        f"common endpoint of {count} suspect DCN slice pairs",
+                        scope="slice",
+                    )
         for entry in devices:
             if entry.get("alive") is False:
                 # liveness only runs on the reporting process's OWN chips

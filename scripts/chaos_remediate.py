@@ -15,6 +15,19 @@ sink. Asserts every stage:
 4. the sink received a TPU_REMEDIATION payload with the applied action;
 5. `release` restores the node.
 
+Then the DCN stage closes the NEWEST localization loop: an injected DCN
+fault on one slice -> the per-pair DCN walk names that slice as the
+common endpoint of its suspect pairs -> the policy maps the slice to its
+member nodes (slice_processes -> hosts identity) and, after the same
+confirmation discipline, produces a CONFIRMED DRY-RUN quarantine
+decision naming those nodes (dry-run: whole-slice cordons are the
+operator-review case, ARCHITECTURE.md "DCN remediation"):
+
+6. the pair walk implicates exactly the injected slice;
+7. after confirm_cycles a dry-run decision names the slice's node, with
+   the slice index in its evidence, while the mock node stays untouched;
+8. the TPU_REMEDIATION notification for it reaches the HTTP sink.
+
 Usage: python scripts/chaos_remediate.py [--cpu-mesh N] [--slow-device D]
 """
 
@@ -41,6 +54,10 @@ def main() -> int:
     parser.add_argument("--slow-device", type=int, default=3, help="device id to make slow")
     parser.add_argument("--slow-iters", type=int, default=800, help="injected delay (chained matmuls)")
     parser.add_argument("--confirm-cycles", type=int, default=2)
+    parser.add_argument("--dcn-slices", type=int, default=4,
+                        help="slices for the DCN stage (must divide --cpu-mesh)")
+    parser.add_argument("--dcn-slice", type=int, default=3,
+                        help="slice index to inject the DCN fault into")
     args = parser.parse_args()
 
     os.environ["XLA_FLAGS"] = (
@@ -108,6 +125,23 @@ def main() -> int:
         dispatcher = Dispatcher(notifier.update_pod_status, capacity=64, workers=1)
         dispatcher.start()
 
+        def submit_remediation(payload):
+            dispatcher.submit(Notification(payload, time.monotonic(), kind="remediation"))
+
+        def wait_for_payloads(predicate, timeout=10.0):
+            """Poll the sink for TPU_REMEDIATION payloads matching
+            ``predicate`` until ``timeout``; returns the matches."""
+            deadline = time.monotonic() + timeout
+            while True:
+                with received_lock:
+                    matches = [
+                        p for p in received
+                        if p.get("event_type") == "TPU_REMEDIATION" and predicate(p)
+                    ]
+                if matches or time.monotonic() >= deadline:
+                    return matches
+                time.sleep(0.05)
+
         actuator = NodeActuator(
             client, dry_run=False, cooldown_seconds=0.0,
             max_actions_per_hour=100, max_quarantined_nodes=2,
@@ -115,9 +149,7 @@ def main() -> int:
         policy = ProbeRemediationPolicy(
             actuator,
             confirm_cycles=args.confirm_cycles,
-            sink=lambda payload: dispatcher.submit(
-                Notification(payload, time.monotonic(), kind="remediation")
-            ),
+            sink=submit_remediation,
             environment="drill",
         )
 
@@ -165,17 +197,7 @@ def main() -> int:
         if not (cordoned and tainted):
             failures.append(f"node not quarantined on the apiserver: {spec}")
 
-        deadline = time.monotonic() + 10
-        remediation_payloads = []
-        while time.monotonic() < deadline:
-            with received_lock:
-                remediation_payloads = [
-                    p for p in received
-                    if p.get("event_type") == "TPU_REMEDIATION" and p.get("actions")
-                ]
-            if remediation_payloads:
-                break
-            time.sleep(0.05)
+        remediation_payloads = wait_for_payloads(lambda p: p.get("actions"))
         result["sink_remediation_payloads"] = len(remediation_payloads)
         if not remediation_payloads:
             failures.append("no TPU_REMEDIATION notification reached the HTTP sink")
@@ -190,13 +212,88 @@ def main() -> int:
         if not release.ok or spec_released.get("unschedulable") or spec_released.get("taints"):
             failures.append(f"release did not restore the node: {spec_released}")
 
+        # -- DCN stage: injected slice fault -> pair walk -> dry-run decision
+        from k8s_watcher_tpu.probe.multislice import run_multislice_probe
+
+        if args.cpu_mesh % args.dcn_slices:
+            failures.append(f"--cpu-mesh {args.cpu_mesh} not divisible by --dcn-slices {args.dcn_slices}")
+        else:
+            per_slice = args.cpu_mesh // args.dcn_slices
+            # slow down a device INSIDE the target slice: every DCN pair
+            # touching that slice stretches, no other pair does
+            dcn_fault = IciFaultSpec(
+                slow_device_id=args.dcn_slice * per_slice,
+                slow_iters=args.slow_iters,
+            )
+            dry_actuator = NodeActuator(
+                client, dry_run=True, cooldown_seconds=0.0,
+                max_actions_per_hour=100, max_quarantined_nodes=8,
+            )
+            dcn_policy = ProbeRemediationPolicy(
+                dry_actuator,
+                confirm_cycles=args.confirm_cycles,
+                sink=submit_remediation,
+                environment="drill",
+            )
+
+            def dcn_cycle():
+                ms = run_multislice_probe(
+                    n_slices=args.dcn_slices, iters=3, inner_iters=4, fault=dcn_fault,
+                )
+                return ms, ProbeReport(
+                    environment="drill", devices=devices, multislice=ms, hosts=hosts,
+                )
+
+            ms1, dcn_report1 = dcn_cycle()
+            result["dcn_cycle1"] = {
+                "dcn_suspect_slices": ms1.dcn_suspect_slices,
+                "suspect_pairs": [s["name"] for s in ms1.suspect_pairs],
+                "timing_unreliable": ms1.timing_unreliable,
+            }
+            if ms1.dcn_suspect_slices != [args.dcn_slice]:
+                failures.append(
+                    f"DCN walk mislocalized: {ms1.dcn_suspect_slices} != [{args.dcn_slice}]"
+                )
+            dcn_actions = list(dcn_policy.observe_report(dcn_report1))
+            if dcn_actions:
+                failures.append("DCN stage acted on cycle 1 — confirmation discipline broken")
+            for _ in range(args.confirm_cycles - 1):
+                _, dcn_report_n = dcn_cycle()
+                dcn_actions += dcn_policy.observe_report(dcn_report_n)
+            decisions = [a for a in dcn_actions if a.ok and a.dry_run and not a.applied]
+            result["dcn_actions"] = [a.to_dict() for a in dcn_actions]
+            if not decisions:
+                failures.append(f"no confirmed dry-run DCN decision: {result['dcn_actions']}")
+            else:
+                decision = decisions[0]
+                if decision.node != NODE:
+                    failures.append(f"DCN decision names {decision.node}, not {NODE}")
+                if f"slice {args.dcn_slice}" not in decision.reason:
+                    failures.append(f"DCN decision evidence lacks the slice index: {decision.reason}")
+            spec_dcn = (cluster.get_node(NODE).get("spec")) or {}
+            if spec_dcn.get("unschedulable") or spec_dcn.get("taints"):
+                failures.append(f"dry-run DCN stage wrote to the cluster: {spec_dcn}")
+            dcn_payloads = wait_for_payloads(
+                lambda p: p.get("dry_run") is True and any(
+                    "dcn probe" in e
+                    for ev in (p.get("implicated") or {}).values() for e in ev
+                )
+            )
+            result["sink_dcn_payloads"] = len(dcn_payloads)
+            if not dcn_payloads:
+                failures.append("no DCN TPU_REMEDIATION notification reached the HTTP sink")
+
         dispatcher.stop()
     sink_server.shutdown()
     sink_server.server_close()
 
     result["failures"] = failures
     print(json.dumps(result, indent=2))
-    print(f"\nremediation drill: {'PASS — fault quarantined end-to-end' if not failures else 'FAIL'}")
+    print(
+        "\nremediation drill: "
+        + ("PASS — ICI fault quarantined, DCN fault localized to a dry-run decision"
+           if not failures else "FAIL")
+    )
     return 0 if not failures else 1
 
 

@@ -100,11 +100,23 @@ def main() -> None:
     # participate-only-in-my-pairs / lower-process-owns contract is
     # exercised for real (opt-in: adds per-pair compiles to the fixture)
     multislice = None
+    ms_obj = None
     if os.environ.get("MULTIHOST_MULTISLICE") == "1":
         import numpy as np
         from jax.sharding import Mesh
 
         from k8s_watcher_tpu.probe.multislice import run_multislice_probe
+
+        # DCN-fault injection: CORRUPT a device in one slice (corruption
+        # classifies with no RTT floor — deterministic under CI jitter),
+        # so every pair touching that slice fails its checksum and the
+        # merged classification must name the slice on EVERY process
+        dcn_fault = None
+        dcn_fault_device = os.environ.get("MULTIHOST_DCN_FAULT_DEVICE")
+        if dcn_fault_device is not None:
+            from k8s_watcher_tpu.faults.ici import IciFaultSpec
+
+            dcn_fault = IciFaultSpec(corrupt_device_id=int(dcn_fault_device))
 
         # build the (slices, hosts, chips) mesh explicitly: gloo CPU
         # devices all report slice_index 0, so hybrid_slice_mesh's
@@ -119,9 +131,10 @@ def main() -> None:
         assert all(
             d.process_index == k for k in range(num_procs) for d in grid[k].flat
         ), "device order does not group by process"
-        ms = run_multislice_probe(
+        ms_obj = ms = run_multislice_probe(
             Mesh(grid, ("slices", "hosts", "chips")), iters=2, inner_iters=4,
             pair_rtt_floor_ms=250.0,  # CI gloo/TCP jitter must not flip flags
+            fault=dcn_fault,
         )
         multislice = {
             "ok": ms.ok,
@@ -130,6 +143,10 @@ def main() -> None:
             "per_slice_sums": ms.per_slice_sums,
             "pairs": ms.pair_rtts,
             "suspect_pairs": [s["name"] for s in ms.suspect_pairs],
+            "suspect_pair_records": ms.suspect_pairs,
+            "dcn_suspect_slices": ms.dcn_suspect_slices,
+            "slice_processes": ms.slice_processes,
+            "timing_unreliable": ms.timing_unreliable,
         }
 
     # remediation in true multi-controller mode: each process runs its own
@@ -154,6 +171,9 @@ def main() -> None:
             devices=report.devices,
             links=link_report,
             hosts=report.hosts,
+            # when the multislice walk ran, its (merged, replicated) DCN
+            # verdicts ride the report — slice-scope, so process 0 acts
+            multislice=ms_obj,
         ))
         remediation = {
             "actions": [a.to_dict() for a in actions],

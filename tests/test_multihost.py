@@ -306,6 +306,64 @@ def test_multislice_pair_walk_across_processes(multislice_results):
     assert owned == ["slice0-slice1", "slice0-slice2", "slice1-slice2"]
 
 
+def test_dcn_fault_localized_and_remediated_across_processes(tmp_path_factory):
+    """The DCN loop in true multi-controller mode: a corrupt device in
+    slice 1 fails the checksum of BOTH pairs touching slice 1. No single
+    process's local records could classify this (slice 1's process sees
+    only its own pairs; the healthy slices each observe ONE bad pair) —
+    the merged, all-gathered classification must name slice 1 identically
+    on EVERY process, and the policy's slice-scope rule must have exactly
+    process 0 quarantine slice 1's node on the mock apiserver."""
+    from k8s_watcher_tpu.k8s.mock_server import MockApiServer, MockCluster
+
+    n_procs = 3
+    cluster = MockCluster()
+    for pid in range(n_procs):
+        cluster.add_node({
+            "metadata": {"name": f"test-node-{pid}"},
+            "spec": {},
+            "status": {"conditions": [{"type": "Ready", "status": "True"}]},
+        })
+    with MockApiServer(cluster) as api:
+        results = _run_cluster(
+            tmp_path_factory.mktemp("multihost_dcn"),
+            extra_env={
+                "MULTIHOST_MULTISLICE": "1",
+                # process 1's chip 0 (JAX CPU global id = pid * 2048)
+                "MULTIHOST_DCN_FAULT_DEVICE": "2048",
+                "MULTIHOST_REMEDIATE": api.url,
+            },
+            n_procs=n_procs,
+        )
+        for pid, r in results.items():
+            ms = r["multislice"]
+            assert ms is not None and ms["error"] is None
+            # the merged verdict is REPLICATED: every process, including
+            # slice 1's own (which observes only uniformly-bad pairs),
+            # names slice 1
+            assert ms["dcn_suspect_slices"] == [1], f"proc {pid}: {ms}"
+            assert ms["slice_processes"] == [[0], [1], [2]]
+            suspect_names = sorted(s["name"] for s in ms["suspect_pair_records"])
+            assert suspect_names == ["slice0-slice1", "slice1-slice2"], f"proc {pid}"
+            assert all(
+                s["reason"] == "corrupt" for s in ms["suspect_pair_records"]
+            ), f"proc {pid}: {ms['suspect_pair_records']}"
+        # slice-scope actor split: exactly process 0 acts, on slice 1's node
+        r0 = results[0]["remediation"]
+        assert r0 is not None and len(r0["actions"]) == 1, r0
+        action = r0["actions"][0]
+        assert action["node"] == "test-node-1" and action["ok"] and action["applied"]
+        assert "dcn probe" in action["reason"] and "slice 1" in action["reason"]
+        for pid in (1, 2):
+            r = results[pid]["remediation"]
+            assert r is not None and r["actions"] == [], f"proc {pid}: {r}"
+        node1 = cluster.get_node("test-node-1")
+        assert node1["spec"].get("unschedulable") is True
+        for pid in (0, 2):
+            node = cluster.get_node(f"test-node-{pid}")
+            assert "unschedulable" not in node["spec"] and not node["spec"].get("taints")
+
+
 def test_prep_failure_skips_all_cross_process_links(prep_fail_results):
     """When ONE process fails preparation of ONE cross-process link, the
     agreement round must make EVERY process skip EVERY cross-process pair

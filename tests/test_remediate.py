@@ -361,6 +361,49 @@ class TestActuator:
             assert actuator._last_action["tpu-node-1"] == ts_b
 
 
+def multislice_result(
+    *,
+    dcn_suspect_slices: List[int] = (),
+    suspect_pairs: Optional[List[dict]] = None,
+    slice_processes: Optional[List[List[int]]] = None,
+    timing_unreliable: bool = False,
+    error: Optional[str] = None,
+    pair_reason: str = "slow",
+):
+    """A MultiSliceProbeResult shaped like a 3-slice walk that implicated
+    ``dcn_suspect_slices``: each suspect slice is the common endpoint of
+    BOTH its pairs (the >=2 threshold the policy re-derives from measured
+    pairs). Default mapping: slices 0 and 2 live on process 0, slice 1 on
+    process 1 (matching probe_report's two hosts)."""
+    from k8s_watcher_tpu.probe.multislice import MultiSliceProbeResult
+
+    if suspect_pairs is None:
+        suspect_pairs = [
+            {"name": f"slice{min(s, o)}-slice{max(s, o)}",
+             "device_ids": [min(s, o), max(s, o)],
+             "reason": pair_reason, "rtt_ms": 9.0}
+            for s in dcn_suspect_slices
+            for o in range(3) if o != s
+        ]
+    return MultiSliceProbeResult(
+        ok=not dcn_suspect_slices,
+        n_slices=3,
+        devices_per_slice=2,
+        per_slice_sums=[2.0, 2.0, 2.0],
+        suspect_slices=[],
+        ici_rtt_ms=0.1,
+        total_rtt_ms=0.3,
+        dcn_overhead_ms=0.2,
+        compile_ms=1.0,
+        error=error,
+        timing_unreliable=timing_unreliable,
+        pair_rtts=[],
+        suspect_pairs=suspect_pairs,
+        dcn_suspect_slices=list(dcn_suspect_slices),
+        slice_processes=[[0], [1], [0]] if slice_processes is None else slice_processes,
+    )
+
+
 def probe_report(
     *,
     suspect_devices: List[int] = (),
@@ -368,6 +411,7 @@ def probe_report(
     hosts: Optional[dict] = None,
     n_devices: int = 4,
     reporting_process: int = 0,
+    multislice=None,
 ) -> ProbeReport:
     """A minimal report shaped like probe/agent.py builds (4 chips, 2 hosts,
     2 chips per host: device i lives on process i // 2).
@@ -407,7 +451,10 @@ def probe_report(
             "0": {"hostname": "h0", "process_index": 0, "node_name": "tpu-node-0"},
             "1": {"hostname": "h1", "process_index": 1, "node_name": "tpu-node-1"},
         }
-    return ProbeReport(environment="test", devices=devices, links=links, hosts=hosts)
+    return ProbeReport(
+        environment="test", devices=devices, links=links, hosts=hosts,
+        multislice=multislice,
+    )
 
 
 class TestPolicy:
@@ -559,6 +606,94 @@ class TestPolicy:
         monkeypatch.setattr(policy_mod.jax, "process_index", lambda: 1)
         # process 1's OWN report triangulating its own device 2
         report = probe_report(suspect_devices=[2], reporting_process=1)
+        records = policy.observe_report(report)
+        assert len(records) == 1 and records[0].node == "tpu-node-1" and records[0].ok
+
+    def test_dcn_suspect_slice_implicates_member_node(self, mock_api):
+        """The DCN pair walk's suspect slice maps through slice_processes
+        -> hosts identity to its member node, with the same confirmation
+        discipline as link findings."""
+        policy, _ = self.make_policy(mock_api, confirm_cycles=2)
+        report = probe_report(multislice=multislice_result(dcn_suspect_slices=[1]))
+        assert policy.observe_report(report) == []  # cycle 1 of 2
+        records = policy.observe_report(report)
+        assert len(records) == 1 and records[0].node == "tpu-node-1" and records[0].ok
+        assert "dcn probe" in records[0].reason and "slice 1" in records[0].reason
+
+    def test_dcn_multi_host_slice_implicates_every_member_node(self, mock_api):
+        """A suspect slice spanning several hosts names ALL member nodes —
+        the faulty DCN endpoint cannot be narrowed further; the budget
+        fence is the stop against mass cordons."""
+        policy, _ = self.make_policy(mock_api, confirm_cycles=1)
+        ms = multislice_result(dcn_suspect_slices=[0], slice_processes=[[0, 1], [], []])
+        records = policy.observe_report(probe_report(multislice=ms))
+        assert {r.node for r in records} == {"tpu-node-0", "tpu-node-1"}
+        assert all(r.ok for r in records)
+
+    def test_dcn_error_pairs_never_actuate(self, mock_api):
+        """Error-marked pairs (agent-infrastructure failures under the
+        per-pair containment) are not measurements — same discipline as
+        the link walk's measured-only re-triangulation."""
+        policy, actuator = self.make_policy(mock_api, confirm_cycles=1)
+        ms = multislice_result(dcn_suspect_slices=[1], pair_reason="error")
+        assert policy.observe_report(probe_report(multislice=ms)) == []
+        assert actuator.quarantined_nodes() == []
+
+    def test_dcn_single_suspect_pair_implicates_route_not_slice(self, mock_api):
+        """One suspect pair implicates the route between two slices, not
+        either endpoint — no node is implicated below the >=2 threshold."""
+        policy, actuator = self.make_policy(mock_api, confirm_cycles=1)
+        ms = multislice_result(
+            dcn_suspect_slices=[1],
+            suspect_pairs=[{"name": "slice0-slice1", "device_ids": [0, 1],
+                            "reason": "slow", "rtt_ms": 9.0}],
+        )
+        assert policy.observe_report(probe_report(multislice=ms)) == []
+        assert actuator.quarantined_nodes() == []
+
+    def test_dcn_unreliable_timing_never_actuates(self, mock_api):
+        """Fence noise swamping the timed pair ops means the suspects are
+        not trustworthy measurements — no streaks, no cordons."""
+        policy, actuator = self.make_policy(mock_api, confirm_cycles=1)
+        ms = multislice_result(dcn_suspect_slices=[1], timing_unreliable=True)
+        assert policy.observe_report(probe_report(multislice=ms)) == []
+        assert actuator.quarantined_nodes() == []
+        assert policy.snapshot()["streaks"] == {}
+
+    def test_dcn_errored_walk_never_actuates(self, mock_api):
+        policy, actuator = self.make_policy(mock_api, confirm_cycles=1)
+        ms = multislice_result(dcn_suspect_slices=[1], error="mesh construction failed")
+        assert policy.observe_report(probe_report(multislice=ms)) == []
+        assert actuator.quarantined_nodes() == []
+
+    def test_dcn_without_member_map_reports_unmapped(self, mock_api):
+        """No member-process map -> no node to cordon; the finding lands in
+        the notification's __unmapped__ evidence instead of being guessed."""
+        sent = []
+        policy, actuator = self.make_policy(mock_api, confirm_cycles=1, sink=sent.append)
+        ms = multislice_result(dcn_suspect_slices=[1], slice_processes=[[0], [], [0]])
+        assert policy.observe_report(probe_report(multislice=ms)) == []
+        assert actuator.quarantined_nodes() == []
+        assert sent and any(
+            "dcn probe" in e for e in sent[-1]["implicated"].get("__unmapped__", [])
+        )
+
+    def test_dcn_findings_are_slice_scope_process0_only(self, mock_api, monkeypatch):
+        """Every member process observes the pair walk, so only process 0
+        acts — a non-0 process must not act even when the suspect slice
+        names its OWN node."""
+        import k8s_watcher_tpu.remediate.policy as policy_mod
+
+        policy, actuator = self.make_policy(mock_api, confirm_cycles=1)
+        monkeypatch.setattr(policy_mod.jax, "process_count", lambda: 2)
+        monkeypatch.setattr(policy_mod.jax, "process_index", lambda: 1)
+        report = probe_report(
+            multislice=multislice_result(dcn_suspect_slices=[1]),
+            reporting_process=1,
+        )
+        assert policy.observe_report(report) == []
+        assert actuator.quarantined_nodes() == []
+        monkeypatch.setattr(policy_mod.jax, "process_index", lambda: 0)
         records = policy.observe_report(report)
         assert len(records) == 1 and records[0].node == "tpu-node-1" and records[0].ok
 
