@@ -1,15 +1,16 @@
 PY ?= python
 
-.PHONY: test check integration integration-kind integration-mock bench dryrun
+.PHONY: test check check-scale integration integration-kind integration-mock bench dryrun dryrun-128
 
 test:
 	$(PY) -m pytest tests/ -q
 
 # The pre-snapshot gate: full suite + a live link-probe run on the virtual
 # mesh (the exact path a half-finished refactor once shipped broken while
-# tests were skipped). Run before EVERY end-of-round commit; a red gate
-# invalidates every other claim in the round.
-check: test dryrun
+# tests were skipped) + the TARGET-SCALE dryrun (check-scale). Run before
+# EVERY end-of-round commit; a red gate invalidates every other claim in
+# the round.
+check: test dryrun check-scale
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	$(PY) -c "import jax; jax.config.update('jax_platforms', 'cpu'); \
 	from k8s_watcher_tpu.probe.links import run_link_probe; \
@@ -33,3 +34,20 @@ bench:
 
 dryrun:
 	$(PY) __graft_entry__.py 8
+
+# Target scale, re-proven EVERY session (not ad hoc): the v5p-128
+# acceptance shape (16 hosts x 8 chips, hosts>1 mesh factorizations —
+# the class of bug the 8-device dryrun can't see) plus a 64-device
+# 4-slice multislice walk.
+check-scale:
+	$(PY) __graft_entry__.py 128
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=64 \
+	$(PY) -c "import jax; jax.config.update('jax_platforms', 'cpu'); \
+	from k8s_watcher_tpu.probe.multislice import run_multislice_probe; \
+	r = run_multislice_probe(n_slices=4, iters=2, inner_iters=4, pair_rtt_floor_ms=5.0); \
+	ok = r.error is None and r.ok and len(r.pair_rtts) == 6 and r.n_slices == 4; \
+	print('check-scale: 64-dev 4-slice DCN walk OK (%d pairs, dcn overhead %.3f ms)' % (len(r.pair_rtts), r.dcn_overhead_ms) if ok else 'check-scale multislice FAILED'); \
+	raise SystemExit(0 if ok else repr(r))"
+
+dryrun-128:
+	$(PY) __graft_entry__.py 128

@@ -13,9 +13,12 @@ Also measured (details): sustained ingest throughput, ICI psum RTT and MXU
 matmul TFLOP/s on the real attached accelerator (single chip here; the same
 probe code scales to multi-host meshes).
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N, "details": {...}}
+Prints ONE compact JSON headline line (<= 1 KB, tail-capture-safe):
+  {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N, ...}
 ``vs_baseline`` = target_ms / measured_ms (>1.0 beats the 1 s target).
+The full detail blob (every tier's numbers) is written to
+``artifacts/bench_full.json`` — BENCH_r03's single giant line outgrew the
+driver's tail-capture window and the round artifact came back unparseable.
 """
 
 from __future__ import annotations
@@ -295,6 +298,175 @@ def bench_burst_drain(n_events: int = 1000) -> dict:
     }
 
 
+def bench_saturation(max_rate: float = 16000.0, seconds_per_step: float = 3.0) -> dict:
+    """Find the pipeline's breaking point: double the offered event rate
+    until sustained ingest falls short of offered (the ingest loop
+    saturates) or the dispatch queue overflows, and report the last rate
+    the pipeline sustained cleanly plus WHICH stage gave out first.
+
+    BENCH_r03 showed 500 ev/s sustained with zero drops — headroom
+    asserted, ceiling unknown. This ramp measures the ceiling."""
+    try:
+        return _saturation_ramp(max_rate, seconds_per_step)
+    except Exception as exc:  # one failed step must not sink the whole bench
+        return {"error": str(exc)}
+
+
+def _saturation_ramp(max_rate: float, seconds_per_step: float) -> dict:
+    from k8s_watcher_tpu.faults.injection import ChurnGenerator
+    from k8s_watcher_tpu.metrics import MetricsRegistry
+    from k8s_watcher_tpu.notify.client import ClusterApiClient
+    from k8s_watcher_tpu.notify.dispatcher import Dispatcher
+    from k8s_watcher_tpu.pipeline.pipeline import EventPipeline
+    from k8s_watcher_tpu.slices.tracker import SliceTracker
+
+    steps = []
+    rate = 1000.0
+    max_clean_rate = 0.0
+    first_saturating_stage = None
+    while rate <= max_rate:
+        n_events = int(rate * seconds_per_step)
+        server = ThreadingHTTPServer(("127.0.0.1", 0), _SinkHandler)
+        server.daemon_threads = True
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        metrics = MetricsRegistry()
+        client = ClusterApiClient(
+            f"http://127.0.0.1:{server.server_address[1]}", timeout=5.0
+        )
+        dispatcher = Dispatcher(client.update_pod_status, capacity=8192, workers=4, metrics=metrics)
+        dispatcher.start()
+        pipeline = EventPipeline(
+            environment="production", sink=dispatcher.submit,
+            slice_tracker=SliceTracker("production"), metrics=metrics,
+        )
+        churn = ChurnGenerator(n_slices=16, workers_per_slice=4, chips_per_worker=4, seed=42)
+        interval = 1.0 / rate
+        t0 = time.monotonic()
+        for i, event in enumerate(churn.events(n_events)):
+            target = t0 + i * interval
+            delay = target - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            event.received_monotonic = time.monotonic()
+            pipeline.process(event)
+        ingest_seconds = time.monotonic() - t0
+        dispatcher.drain(30.0)
+        dispatcher.stop()
+        server.shutdown()
+        server.server_close()
+
+        sustained = n_events / ingest_seconds
+        dump = metrics.dump()
+        overflow = dump.get("dispatch_dropped_overflow", {}).get("count", 0)
+        step = {
+            "offered_events_per_sec": rate,
+            "sustained_events_per_sec": round(sustained, 1),
+            "overflow_drops": overflow,
+        }
+        steps.append(step)
+        # the ingest loop saturates when it can't keep pace with the
+        # arrival schedule; the dispatch queue saturates when overflow
+        # drops appear (latest-wins coalescing absorbs same-object churn
+        # first, so overflow means even coalesced load outran the sink)
+        if overflow > 0:
+            first_saturating_stage = "dispatch_queue_overflow"
+        elif sustained < 0.95 * rate:
+            first_saturating_stage = "ingest_loop"
+        if first_saturating_stage:
+            break
+        max_clean_rate = sustained
+        rate *= 2.0
+    return {
+        "max_sustained_events_per_sec": round(max_clean_rate, 1),
+        # None = clean through max_rate: the ceiling is above what a
+        # paced single-producer ramp can offer on this host
+        "first_saturating_stage": first_saturating_stage,
+        "steps": steps,
+    }
+
+
+def bench_relist_scale(n_pods: int = 10_000, page_size: int = 500) -> dict:
+    """Paged relist at cluster scale: wall time + page shape to LIST
+    ``n_pods`` pods through the watch source's relist path (limit+continue
+    against the in-repo mock apiserver over real HTTP), with tombstone
+    bookkeeping live. The scale ceiling the pagination work bounds."""
+    try:
+        from k8s_watcher_tpu.k8s.client import K8sClient
+        from k8s_watcher_tpu.k8s.kubeconfig import K8sConnection
+        from k8s_watcher_tpu.k8s.mock_server import MockApiServer, MockCluster
+        from k8s_watcher_tpu.k8s.watch import KubernetesWatchSource
+        from k8s_watcher_tpu.watch.fake import build_pod
+
+        cluster = MockCluster()
+        for i in range(n_pods):
+            cluster.add_pod(build_pod(
+                f"bench-pod-{i:05d}", uid=f"uid-{i:05d}", phase="Running", tpu_chips=4,
+            ))
+        with MockApiServer(cluster) as api:
+            client = K8sClient(K8sConnection(server=api.url), request_timeout=60.0)
+            source = KubernetesWatchSource(client, list_page_size=page_size)
+            t0 = time.monotonic()
+            n_events = sum(1 for _ in source._relist())
+            relist_seconds = time.monotonic() - t0
+        return {
+            "n_pods": n_pods,
+            "page_size": page_size,
+            "pages": (n_pods + page_size - 1) // page_size,
+            "events": n_events,
+            "relist_ms": round(1e3 * relist_seconds, 1),
+            "pods_per_sec": round(n_pods / relist_seconds, 0),
+        }
+    except Exception as exc:
+        return {"error": str(exc)}
+
+
+def bench_checkpoint_scale(n_pods: int = 10_000) -> dict:
+    """Checkpoint cost at tracked-pod scale: file size and flush latency
+    with ``n_pods`` skeletons in known_pods (every flush rewrites the whole
+    JSON; VERDICT r03 flagged this as unmeasured at acceptance scale)."""
+    try:
+        import os
+        import tempfile
+
+        from k8s_watcher_tpu.k8s.watch import KubernetesWatchSource
+        from k8s_watcher_tpu.state.checkpoint import CheckpointStore
+        from k8s_watcher_tpu.watch.fake import build_pod
+
+        known = {
+            f"uid-{i:05d}": KubernetesWatchSource._skeleton(build_pod(
+                f"bench-pod-{i:05d}", uid=f"uid-{i:05d}", phase="Running", tpu_chips=4,
+                labels={"jobset.sigs.k8s.io/jobset-name": f"job-{i % 64}"},
+            ))
+            for i in range(n_pods)
+        }
+        with tempfile.TemporaryDirectory() as tmp:
+            path = os.path.join(tmp, "ckpt.json")
+            store = CheckpointStore(path, interval_seconds=0.0)
+            store.put("known_pods", known)
+            store.update_resource_version("12345")
+            t0 = time.perf_counter()
+            store.flush()
+            first_flush_s = time.perf_counter() - t0
+            size = os.path.getsize(path)
+            # steady-state: repeat flushes of the same state (what the
+            # throttled sweep pays each interval)
+            times = []
+            for _ in range(5):
+                store.put("known_pods", known)
+                t0 = time.perf_counter()
+                store.flush()
+                times.append(time.perf_counter() - t0)
+        return {
+            "n_pods": n_pods,
+            "file_bytes": size,
+            "file_mb": round(size / (1024 * 1024), 2),
+            "first_flush_ms": round(1e3 * first_flush_s, 1),
+            "flush_ms_median": round(1e3 * statistics.median(times), 1),
+        }
+    except Exception as exc:
+        return {"error": str(exc)}
+
+
 def bench_frame_scan(n_frames: int = 4000, tpu_fraction: float = 0.05) -> dict:
     """Watch-frame decode throughput: full json.loads on every frame vs the
     native prefilter path (scan, parse only frames that can matter). The
@@ -508,30 +680,76 @@ def main() -> int:
     # the same path at 30x the 1k/min acceptance rate: p50 must hold, not
     # degrade with offered load (queueing would show here first)
     pipeline_500 = bench_watch_pipeline(n_events=2500, events_per_sec=500.0)
+    saturation = bench_saturation()
     burst_stats = bench_burst_drain()
     scan_stats = bench_frame_scan()
+    relist_stats = bench_relist_scale()
+    checkpoint_stats = bench_checkpoint_scale()
     virtual_stats = bench_virtual_probes()
     probe_stats = bench_probe()
     # headline: the TRUE end-to-end number (clock starts before the
     # apiserver write, includes watch transport + decode); fall back to
     # the pipeline-ingest number only if the e2e tier errored
     p50 = e2e_stats.get("p50_ms") or pipeline_stats["p50_ms"]
-    result = {
+    details = {
+        "e2e_apiserver": e2e_stats,
+        "pipeline": pipeline_stats,
+        "pipeline_500eps": pipeline_500,
+        "saturation": saturation,
+        "burst": burst_stats,
+        "frame_scan": scan_stats,
+        "relist_10k": relist_stats,
+        "checkpoint_10k": checkpoint_stats,
+        "probe": probe_stats,
+        "probe_virtual_mesh": virtual_stats,
+    }
+    vs_baseline = round(BASELINE_TARGET_MS / p50, 1) if p50 > 0 else 0.0
+    # The full detail blob goes to a FILE; stdout's final line is a
+    # compact headline (<~1 KB) — BENCH_r03's one giant JSON line outgrew
+    # the driver's tail-capture window and the round artifact came back
+    # unparseable ("parsed": null). The file rides the repo, the line
+    # rides the driver.
+    import os
+
+    full = {
         "metric": "pod-event->notify p50 latency",
         "value": round(p50, 3),
         "unit": "ms",
-        "vs_baseline": round(BASELINE_TARGET_MS / p50, 1) if p50 > 0 else 0.0,
-        "details": {
-            "e2e_apiserver": e2e_stats,
-            "pipeline": pipeline_stats,
-            "pipeline_500eps": pipeline_500,
-            "burst": burst_stats,
-            "frame_scan": scan_stats,
-            "probe": probe_stats,
-            "probe_virtual_mesh": virtual_stats,
-        },
+        "vs_baseline": vs_baseline,
+        "details": details,
     }
-    print(json.dumps(result))
+    artifacts_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "artifacts")
+    os.makedirs(artifacts_dir, exist_ok=True)
+    full_path = os.path.join(artifacts_dir, "bench_full.json")
+    with open(full_path, "w") as f:
+        json.dump(full, f, indent=1)
+    headline = {
+        "metric": "pod-event->notify p50 latency",
+        "value": round(p50, 3),
+        "unit": "ms",
+        "vs_baseline": vs_baseline,
+        "e2e_completed": f"{e2e_stats.get('completed', 0)}/{e2e_stats.get('offered', 0)}",
+        "max_sustained_events_per_sec": saturation.get("max_sustained_events_per_sec"),
+        "saturating_stage": saturation.get("first_saturating_stage"),
+        "relist_10k_ms": relist_stats.get("relist_ms"),
+        "checkpoint_10k_flush_ms": checkpoint_stats.get("flush_ms_median"),
+        "checkpoint_10k_mb": checkpoint_stats.get("file_mb"),
+        "mxu_tflops": probe_stats.get("mxu_tflops"),
+        "hbm_read_gbps": probe_stats.get("hbm_read_gbps"),
+        "hbm_write_gbps": probe_stats.get("hbm_write_gbps"),
+        "probe_ok": probe_stats.get("probe_ok", False),
+        "virtual_probe_ok": virtual_stats.get("probe_ok", False),
+        "links": virtual_stats.get("link_count"),
+        "dcn_pairs": virtual_stats.get("dcn_pair_count"),
+        "detail_file": "artifacts/bench_full.json",
+    }
+    line = json.dumps(headline)
+    # NEVER crash after the measurements: print the line first, warn on
+    # stderr if it outgrew the tail-capture budget (an assert here would
+    # reproduce the exact unparseable-artifact failure this fixes)
+    print(line)
+    if len(line) > 1024:
+        print(f"WARNING: headline is {len(line)}B (>1024): trim fields", file=sys.stderr)
     return 0
 
 

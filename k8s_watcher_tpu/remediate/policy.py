@@ -151,9 +151,21 @@ class ProbeRemediationPolicy:
                 for slice_idx in pair.get("device_ids", ()):
                     pair_counts[slice_idx] = pair_counts.get(slice_idx, 0) + 1
             slice_procs = getattr(ms, "slice_processes", None) or []
+            n_sl = int(getattr(ms, "n_slices", 0) or 0)
             for slice_idx, count in sorted(pair_counts.items()):
-                if count < 2:
-                    # one suspect pair implicates the route, not a slice
+                # A faulty slice ENDPOINT (NIC/path) stretches or corrupts
+                # EVERY pair it touches, so the implication bar is ALL
+                # n_slices-1 of its pairs suspect, with at least 2. The
+                # link walk's plain >=2 rule cannot transfer here: the DCN
+                # pair graph is COMPLETE, so two degraded slices would put
+                # >=2 suspect pairs on every HEALTHY slice too (at n=4,
+                # slices 0+1 bad gives counts {0:3, 1:3, 2:2, 3:2}) and a
+                # >=2 bar would cordon the healthy ones' nodes. Requiring
+                # the full n-1 also keeps n=2 route-only (one pair cannot
+                # distinguish endpoint from route), and stays conservative
+                # when a pair errored on its owner (count can't reach n-1
+                # that cycle).
+                if count < max(2, n_sl - 1):
                     continue
                 members = (
                     slice_procs[slice_idx] if slice_idx < len(slice_procs) else []

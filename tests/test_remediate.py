@@ -651,6 +651,42 @@ class TestPolicy:
         assert policy.observe_report(probe_report(multislice=ms)) == []
         assert actuator.quarantined_nodes() == []
 
+    def test_dcn_two_degraded_slices_do_not_implicate_healthy_ones(self, mock_api):
+        """The DCN pair graph is complete: with slices 0 and 1 both slow
+        in a 4-slice walk, every HEALTHY slice also touches 2 suspect
+        pairs — the full-(n-1) bar must keep healthy slices' nodes out of
+        the streaks while still implicating both faulty endpoints."""
+        from k8s_watcher_tpu.probe.multislice import MultiSliceProbeResult
+
+        pairs = [(0, 1), (0, 2), (0, 3), (1, 2), (1, 3)]  # (2,3) healthy
+        ms = MultiSliceProbeResult(
+            ok=False, n_slices=4, devices_per_slice=2,
+            per_slice_sums=[2.0] * 4, suspect_slices=[],
+            ici_rtt_ms=0.1, total_rtt_ms=0.3, dcn_overhead_ms=0.2,
+            compile_ms=1.0,
+            suspect_pairs=[
+                {"name": f"slice{i}-slice{j}", "device_ids": [i, j],
+                 "reason": "slow", "rtt_ms": 9.0}
+                for i, j in pairs
+            ],
+            dcn_suspect_slices=[0, 1, 2, 3],
+            slice_processes=[[0], [1], [2], [2]],
+        )
+        policy, _ = self.make_policy(
+            mock_api, confirm_cycles=1, max_quarantined_nodes=8,
+            max_actions_per_hour=100,
+        )
+        hosts = {
+            "0": {"hostname": "h0", "process_index": 0, "node_name": "tpu-node-0"},
+            "1": {"hostname": "h1", "process_index": 1, "node_name": "tpu-node-1"},
+            "2": {"hostname": "h2", "process_index": 2, "node_name": "tpu-node-2"},
+        }
+        records = policy.observe_report(probe_report(multislice=ms, hosts=hosts))
+        # slices 0 (count 3) and 1 (count 3) implicate their nodes; the
+        # healthy slices 2 and 3 (count 2 < n-1=3, mapped to tpu-node-2)
+        # implicate nothing
+        assert {r.node for r in records} == {"tpu-node-0", "tpu-node-1"}
+
     def test_dcn_unreliable_timing_never_actuates(self, mock_api):
         """Fence noise swamping the timed pair ops means the suspects are
         not trustworthy measurements — no streaks, no cordons."""
