@@ -378,6 +378,10 @@ class TpuConfig:
     probe_payload_bytes: int = 4 * 1024 * 1024
     probe_rtt_warn_ms: float = 50.0
     probe_matmul_size: int = 1024
+    # dependent-matmul chain length per timed call: device time must dwarf
+    # the host fence (2*size^3*inner FLOPs; over a dev tunnel the fence is
+    # tens of ms, so soak/bench-grade fidelity wants size 4096 x inner 128)
+    probe_matmul_inner_iters: int = 8
     probe_hbm_bytes: int = 256 * 1024 * 1024  # 0 disables the HBM sweep
     # write-bandwidth + pattern-integrity pass (block-indexed pattern write,
     # per-block checksum readback localizing bad HBM address ranges)
@@ -487,6 +491,7 @@ class TpuConfig:
         _check_known(
             probe,
             ("enabled", "interval_seconds", "status_port", "payload_bytes", "rtt_warn_ms", "matmul_size",
+             "matmul_inner_iters",
              "hbm_bytes", "hbm_write_enabled", "expected_chips_per_host", "links_enabled",
              "link_rtt_factor", "link_rtt_floor_ms", "multislice_enabled",
              "multislice_slices", "multislice_pair_localization",
@@ -537,6 +542,7 @@ class TpuConfig:
             probe_payload_bytes=_opt_int(probe, "payload_bytes", "tpu.probe", 4 * 1024 * 1024),
             probe_rtt_warn_ms=_opt_num(probe, "rtt_warn_ms", "tpu.probe", 50.0),
             probe_matmul_size=_opt_int(probe, "matmul_size", "tpu.probe", 1024),
+            probe_matmul_inner_iters=_opt_int(probe, "matmul_inner_iters", "tpu.probe", 8),
             probe_hbm_bytes=_opt_int(probe, "hbm_bytes", "tpu.probe", 256 * 1024 * 1024),
             probe_hbm_write_enabled=_opt_bool(probe, "hbm_write_enabled", "tpu.probe", True),
             expected_chips_per_host=_opt_int(probe, "expected_chips_per_host", "tpu.probe", 0),

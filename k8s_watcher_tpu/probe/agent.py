@@ -106,7 +106,10 @@ class ProbeAgent:
             expected_platform=self.expected_platform,
         )
         ici = run_ici_probe(self.mesh, payload_bytes=self.config.probe_payload_bytes)
-        mxu = run_mxu_probe(self.config.probe_matmul_size)
+        mxu = run_mxu_probe(
+            self.config.probe_matmul_size,
+            inner_iters=self.config.probe_matmul_inner_iters,
+        )
         links = None
         if self.config.probe_links_enabled:
             from k8s_watcher_tpu.probe.links import run_link_probe
@@ -193,11 +196,29 @@ class ProbeAgent:
         # integrity-failed or non-finite probe has no 'error' string but its
         # readings describe a broken chip and must neither stay on a gauge
         # nor shape the trend anchor
-        ici_ok = ici is not None and ici.error is None and ici.ok
-        mxu_ok = mxu is not None and mxu.get("ok", False)
+        ici_ok = (
+            ici is not None and ici.error is None and ici.ok
+            and not ici.timing_unreliable
+        )
+        # timing-unreliable readings (fence noise swamped the timed op —
+        # probe/timing.py) are flagged measurements, not measurements:
+        # folding one into a gauge or trend window presents noise as a
+        # chip reading (an 11-min soak saw a single-cycle "1.8e10 TFLOPs"
+        # median from exactly this)
+        mxu_ok = (
+            mxu is not None and mxu.get("ok", False)
+            and not mxu.get("timing_unreliable", False)
+        )
         # interpreter-mode (non-TPU) bandwidth numbers are meaningless
-        hbm_ok = hbm is not None and hbm.get("ok", False) and not hbm.get("interpreted")
-        hbm_w_ok = hbm_write is not None and hbm_write.get("ok", False) and not hbm_write.get("interpreted")
+        hbm_ok = (
+            hbm is not None and hbm.get("ok", False) and not hbm.get("interpreted")
+            and not hbm.get("bandwidth_unreliable", False)
+        )
+        hbm_w_ok = (
+            hbm_write is not None and hbm_write.get("ok", False)
+            and not hbm_write.get("interpreted")
+            and not hbm_write.get("bandwidth_unreliable", False)
+        )
         # links: an errored walk withdraws the gauges, but a walk that FOUND
         # suspects is a valid reading — probe_link_suspects > 0 is exactly
         # what operators scrape for, so links.ok is deliberately not gated
@@ -212,27 +233,40 @@ class ProbeAgent:
         ms_ok = multislice is not None and multislice.error is None and not multislice.timing_unreliable
         pair_valid = [p["rtt_ms"] for p in multislice.pair_rtts if p["rtt_ms"] >= 0] if ms_ok else []
         pair_median = float(np.median(pair_valid)) if pair_valid else None
+        # On a SINGLE-device mesh the psum "RTT" and all-reduce "bandwidth"
+        # measure host dispatch latency (over a dev tunnel: network
+        # jitter), not any interconnect — there is no fabric to trend, and
+        # folding them raised 4-9x false rise-alerts in an 11-min
+        # real-chip soak (artifacts/probe_soak_real_tpu.json history)
+        # while MXU/HBM stayed inside a 0.6% band. The gauges still
+        # publish; only the trend fold is gated on a real multi-chip mesh.
+        ici_fabric = ici_ok and ici.n_devices > 1
+        # (name, value, higher_is_better, trend_eligible): value None
+        # clears the gauge; trend_eligible=False publishes the gauge but
+        # never folds a trend sample
         readings = [
-            ("psum_rtt_median_ms", ici.psum_rtt_median_ms if ici_ok else None, False),
-            ("allreduce_bus_gbps_median", ici.bandwidth_gbps_median if ici_ok else None, True),
-            ("mxu_tflops_median", mxu.get("tflops_median", 0.0) if mxu_ok else None, True),
-            ("hbm_read_gbps", hbm.get("read_gbps", 0.0) if hbm_ok else None, True),
-            ("hbm_write_gbps", hbm_write.get("write_gbps", 0.0) if hbm_w_ok else None, True),
-            ("link_median_rtt_ms", links.median_rtt_ms if links_ok else None, False),
-            ("dcn_pair_median_rtt_ms", pair_median, False),
-            ("dcn_overhead_ms", multislice.dcn_overhead_ms if ms_ok and multislice.n_slices > 1 else None, False),
+            ("psum_rtt_median_ms", ici.psum_rtt_median_ms if ici_ok else None, False, ici_fabric),
+            ("allreduce_bus_gbps_median", ici.bandwidth_gbps_median if ici_ok else None, True, ici_fabric),
+            ("mxu_tflops_median", mxu.get("tflops_median", 0.0) if mxu_ok else None, True, True),
+            ("hbm_read_gbps", hbm.get("read_gbps", 0.0) if hbm_ok else None, True, True),
+            ("hbm_write_gbps", hbm_write.get("write_gbps", 0.0) if hbm_w_ok else None, True, True),
+            ("link_median_rtt_ms", links.median_rtt_ms if links_ok else None, False, True),
+            ("dcn_pair_median_rtt_ms", pair_median, False, True),
+            ("dcn_overhead_ms", multislice.dcn_overhead_ms if ms_ok and multislice.n_slices > 1 else None, False, True),
         ]
         if links_ok:
             self.metrics.gauge("probe_link_suspects").set(len(links.suspect_links))
         elif links is not None:
             self.metrics.gauge("probe_link_suspects").clear()
         alerts = []
-        for name, value, higher_is_better in readings:
+        for name, value, higher_is_better, trend_eligible in readings:
             gauge = self.metrics.gauge(f"probe_{name}")
             if value is not None and value > 0:
                 gauge.set(value)
             else:
                 gauge.clear()
+                continue
+            if not trend_eligible:
                 continue
             if self.trend is not None:
                 alert = self.trend.observe(

@@ -10,6 +10,13 @@ records the outcome under ``artifacts/``:
   kind cluster from deploy/kind-config.yaml, run the tier INCLUDING the
   write path (real pod create/delete over REST through K8sClient — no
   kubectl needed), tear the cluster down.
+- ``--backend binary``: a REAL kube-apiserver without Docker — start
+  ``etcd`` + ``kube-apiserver`` binaries from PATH (throwaway certs/keys
+  generated with openssl, static token auth, AlwaysAllow), point the
+  kubeconfig at the live HTTPS endpoint, run the full tier including the
+  write path, tear everything down. The artifact's backend is a real
+  apiserver (``binary``), satisfying the "non-in-repo server" evidence
+  bar on any host where the two binaries exist.
 - ``--backend mock``: serve the in-repo mock apiserver
   (k8s_watcher_tpu/k8s/mock_server.py) over HTTP, point a generated
   kubeconfig at it, and run the FULL tier — including the write path
@@ -18,8 +25,14 @@ records the outcome under ``artifacts/``:
   the gated test path works end-to-end on hosts without Docker (the
   artifact is labelled with its backend).
 
+``auto`` prefers kind > binary > mock and, when it must fall back to the
+mock, records ``artifacts/integration_env_constraints.json`` documenting
+exactly which prerequisites (binaries, container runtime, egress) the
+host lacked — so a mock-only artifact is always accompanied by dated
+evidence of WHY the real tiers could not run.
+
 Usage:
-    python scripts/run_integration_tier.py [--backend kind|mock|auto]
+    python scripts/run_integration_tier.py [--backend kind|binary|mock|auto]
     make integration        # auto
     make integration-kind   # forces the real-cluster backend
 
@@ -112,6 +125,175 @@ def backend_kind() -> dict:
             subprocess.run(["kind", "delete", "cluster", "--name", CLUSTER_NAME], timeout=300)
 
 
+def _wait_http_ready(url: str, timeout_s: float = 60.0) -> bool:
+    import ssl
+    import time
+    import urllib.request
+
+    ctx = ssl.create_default_context()
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url, context=ctx, timeout=3):
+                return True
+        except Exception:
+            time.sleep(0.5)
+    return False
+
+
+def backend_binary() -> dict:
+    """A real kube-apiserver from PATH binaries: etcd + kube-apiserver +
+    openssl-generated throwaway PKI, no container runtime needed."""
+    import socket
+
+    for binary in ("etcd", "kube-apiserver", "openssl"):
+        if not shutil.which(binary):
+            raise RuntimeError(f"--backend binary needs `{binary}` on PATH")
+
+    def free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    procs: list = []
+    logs: list = []
+    tmp = Path(tempfile.mkdtemp(prefix="watcher-binary-apiserver-"))
+    try:
+        sa_key = tmp / "sa.key"
+        serving_key, serving_crt = tmp / "serving.key", tmp / "serving.crt"
+        subprocess.run(
+            ["openssl", "genrsa", "-out", str(sa_key), "2048"],
+            check=True, capture_output=True, timeout=60,
+        )
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(serving_key), "-out", str(serving_crt),
+             "-days", "1", "-subj", "/CN=127.0.0.1",
+             "-addext", "subjectAltName=IP:127.0.0.1"],
+            check=True, capture_output=True, timeout=60,
+        )
+        token = "watcher-integration-token"
+        token_file = tmp / "tokens.csv"
+        token_file.write_text(f"{token},watcher,watcher-uid,system:masters\n")
+
+        etcd_client_port, etcd_peer_port = free_port(), free_port()
+        api_port = free_port()
+        etcd_log = open(tmp / "etcd.log", "w")
+        logs.append(etcd_log)
+        procs.append(subprocess.Popen(
+            ["etcd",
+             "--data-dir", str(tmp / "etcd-data"),
+             "--listen-client-urls", f"http://127.0.0.1:{etcd_client_port}",
+             "--advertise-client-urls", f"http://127.0.0.1:{etcd_client_port}",
+             "--listen-peer-urls", f"http://127.0.0.1:{etcd_peer_port}"],
+            stdout=etcd_log, stderr=subprocess.STDOUT,
+        ))
+        if not _wait_http_ready(f"http://127.0.0.1:{etcd_client_port}/health", 30):
+            raise RuntimeError("etcd never became healthy")
+        api_log = open(tmp / "apiserver.log", "w")
+        logs.append(api_log)
+        procs.append(subprocess.Popen(
+            ["kube-apiserver",
+             "--etcd-servers", f"http://127.0.0.1:{etcd_client_port}",
+             "--bind-address", "127.0.0.1",
+             "--secure-port", str(api_port),
+             "--tls-cert-file", str(serving_crt),
+             "--tls-private-key-file", str(serving_key),
+             "--service-account-key-file", str(sa_key),
+             "--service-account-signing-key-file", str(sa_key),
+             "--service-account-issuer", "https://kubernetes.default.svc",
+             "--token-auth-file", str(token_file),
+             "--authorization-mode", "AlwaysAllow",
+             "--allow-privileged=false"],
+            stdout=api_log, stderr=subprocess.STDOUT,
+        ))
+        server = f"https://127.0.0.1:{api_port}"
+        if not _wait_http_ready(f"{server}/version", 90):
+            raise RuntimeError(
+                "kube-apiserver never became ready; see " + str(tmp / "apiserver.log")
+            )
+        kubeconfig = {
+            "apiVersion": "v1", "kind": "Config",
+            "clusters": [{"name": "binary", "cluster": {
+                "server": server, "insecure-skip-tls-verify": True,
+            }}],
+            "contexts": [{"name": "binary", "context": {"cluster": "binary", "user": "binary"}}],
+            "current-context": "binary",
+            "users": [{"name": "binary", "user": {"token": token}}],
+        }
+        path = _mkstemp_path("binary-kubeconfig-")
+        try:
+            path.write_text(json.dumps(kubeconfig))
+            result = run_pytest(str(path), write=True)
+        finally:
+            path.unlink(missing_ok=True)
+        result["backend"] = "binary"
+        result["write_tier"] = True
+        return result
+    finally:
+        for proc in reversed(procs):
+            proc.terminate()
+        for proc in reversed(procs):
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        for log in logs:
+            log.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def record_env_constraints() -> Path:
+    """Dated evidence of WHY only the mock tier could run on this host."""
+    import socket
+
+    def egress(host: str, port: int = 443) -> str:
+        try:
+            with socket.create_connection((host, port), timeout=3):
+                return "reachable"
+        except OSError as exc:
+            return f"unreachable ({exc})"
+
+    binaries = {
+        b: (shutil.which(b) or "absent")
+        for b in ("kind", "docker", "podman", "kube-apiserver", "etcd",
+                  "k3s", "minikube", "kubectl")
+    }
+    egress_state = {h: egress(h) for h in ("dl.k8s.io", "github.com")}
+    # the conclusion is COMPUTED from the probes above — a hardcoded
+    # sentence next to contradicting measurements would defeat the
+    # artifact's purpose as evidence
+    missing = sorted(b for b, path in binaries.items() if path == "absent")
+    present = sorted(b for b, path in binaries.items() if path != "absent")
+    reachable = sorted(h for h, s in egress_state.items() if s == "reachable")
+    parts = []
+    if missing:
+        parts.append(f"missing binaries: {', '.join(missing)}")
+    if present:
+        parts.append(f"present: {', '.join(present)}")
+    parts.append(
+        f"egress to {', '.join(reachable)} available" if reachable
+        else "no network egress to fetch any of them"
+    )
+    constraints = {
+        "timestamp_utc": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "binaries": binaries,
+        "egress": egress_state,
+        "conclusion": (
+            "The kind and binary backends could not run on this host ("
+            + "; ".join(parts)
+            + "). The mock artifact is the only tier runnable here; "
+            ".github/workflows/integration.yml produces the kind artifact in CI."
+        ),
+    }
+    ARTIFACTS.mkdir(exist_ok=True)
+    out = ARTIFACTS / "integration_env_constraints.json"
+    out.write_text(json.dumps(constraints, indent=2) + "\n")
+    return out
+
+
 def backend_mock() -> dict:
     sys.path.insert(0, str(REPO))
     from k8s_watcher_tpu.k8s.mock_server import MockApiServer
@@ -141,16 +323,25 @@ def backend_mock() -> dict:
 
 def main() -> int:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--backend", choices=["kind", "mock", "auto"], default="auto")
+    parser.add_argument("--backend", choices=["kind", "binary", "mock", "auto"], default="auto")
     args = parser.parse_args()
 
     backend = args.backend
     if backend == "auto":
-        backend = "kind" if shutil.which("kind") else "mock"
-        if backend == "mock":
-            print("kind not on PATH; falling back to the in-repo mock apiserver backend")
+        if shutil.which("kind"):
+            backend = "kind"
+        elif shutil.which("kube-apiserver") and shutil.which("etcd"):
+            backend = "binary"
+        else:
+            backend = "mock"
+            constraints = record_env_constraints()
+            print(
+                "kind/kube-apiserver not on PATH; falling back to the in-repo "
+                f"mock apiserver backend (host constraints recorded: {constraints})"
+            )
 
-    result = backend_kind() if backend == "kind" else backend_mock()
+    backends = {"kind": backend_kind, "binary": backend_binary, "mock": backend_mock}
+    result = backends[backend]()
     result["timestamp_utc"] = datetime.datetime.now(datetime.timezone.utc).isoformat()
     result["ok"] = result["rc"] == 0
 

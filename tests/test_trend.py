@@ -209,6 +209,64 @@ class TestAgentTrendWiring:
         text = agent.metrics.prometheus_text()
         assert "k8s_watcher_probe_mxu_tflops_median 90" in text
 
+    def test_single_device_ici_metrics_publish_but_never_trend(self, monkeypatch):
+        """On a 1-chip mesh the psum 'RTT' measures host dispatch (over a
+        dev tunnel: network jitter), not any interconnect — an 11-min
+        real-chip soak raised 19 false 4-9x rise alerts from exactly this
+        while MXU/HBM stayed inside a 0.6% band. The gauge must still
+        publish; the trend must never fold a sample from it."""
+        import k8s_watcher_tpu.probe.agent as agent_mod
+        from k8s_watcher_tpu.probe.ici import IciProbeResult
+
+        rtts = iter([0.05] * 5 + [0.5] * 3)  # 10x "degradation" = tunnel wobble
+
+        def fake_ici(*a, **kw):
+            v = next(rtts)
+            return IciProbeResult(
+                ok=True, n_devices=1, n_hosts=1,
+                psum_rtt_ms=v, psum_rtt_mean_ms=v, psum_rtt_max_ms=v,
+                psum_rtt_median_ms=v, psum_correct=True,
+                bandwidth_gbps=1.0, bandwidth_gbps_median=1.0,
+                payload_bytes=1 << 14, compile_ms=0.0,
+            )
+
+        monkeypatch.setattr(agent_mod, "run_ici_probe", fake_ici)
+        agent = self.make_agent(monkeypatch, [100.0] * 8)
+        for _ in range(8):
+            report = agent.run_once()
+            assert report.healthy
+            assert not report.trend_alerts
+        gauge = agent.metrics.gauge("probe_psum_rtt_median_ms")
+        assert gauge.has_value and gauge.value == 0.5  # published, not folded
+        assert agent.metrics.counter("probe_trend_alerts").value == 0
+
+    def test_multi_device_ici_rtt_still_trends(self, monkeypatch):
+        """The gate keys on fabric presence, not on the metric: the same
+        rise on a REAL multi-chip mesh must still alert."""
+        import k8s_watcher_tpu.probe.agent as agent_mod
+        from k8s_watcher_tpu.probe.ici import IciProbeResult
+
+        rtts = iter([0.05] * 5 + [0.5] * 3)
+
+        def fake_ici(*a, **kw):
+            v = next(rtts)
+            return IciProbeResult(
+                ok=True, n_devices=8, n_hosts=1,
+                psum_rtt_ms=v, psum_rtt_mean_ms=v, psum_rtt_max_ms=v,
+                psum_rtt_median_ms=v, psum_correct=True,
+                bandwidth_gbps=1.0, bandwidth_gbps_median=1.0,
+                payload_bytes=1 << 14, compile_ms=0.0,
+            )
+
+        monkeypatch.setattr(agent_mod, "run_ici_probe", fake_ici)
+        agent = self.make_agent(monkeypatch, [100.0] * 8)
+        alerts = []
+        for _ in range(8):
+            alerts.extend(agent.run_once().trend_alerts or [])
+        assert any(
+            a.metric == "psum_rtt_median_ms" and a.direction == "rise" for a in alerts
+        )
+
     def test_errored_probe_clears_its_gauge(self, monkeypatch):
         # a gauge frozen at its last healthy value would show dashboards a
         # healthy chip while it is dead — erroring must withdraw it
