@@ -337,6 +337,7 @@ class WatcherApp:
             retry=self.config.watcher.retry,
             watch_timeout_seconds=self.config.kubernetes.watch_timeout_seconds,
             metrics=self.metrics,
+            list_page_size=self.config.watcher.list_page_size,
         ).start()
         # pod events folded AFTER the node plane syncs get a live existence
         # answer, so a member landing on an already-deleted node starts

@@ -224,18 +224,8 @@ class K8sClient:
             params["continue"] = continue_token
         return self._get(self._pods_path(namespace), params).json()
 
-    def list_pods_paged(
-        self,
-        namespace: Optional[str] = None,
-        *,
-        page_size: int = 500,
-        label_selector: Optional[str] = None,
-        max_restarts: int = 2,
-    ):
-        """Stream a large LIST in bounded pages (``limit``+``continue`` —
-        the SDK-provided behavior at reference pod_watcher.py:264 that the
-        from-scratch client must supply itself; without it every relist of
-        a large cluster is one unbounded response).
+    def _list_paged(self, fetch_page, max_restarts: int):
+        """Shared pagination driver: ``fetch_page(continue_token) -> body``.
 
         Yields ``(attempt, page_body)``. ``attempt`` increments when an
         expired continue token (410 mid-pagination: the snapshot was
@@ -253,12 +243,7 @@ class K8sClient:
             token: Optional[str] = None
             try:
                 while True:
-                    page = self.list_pods(
-                        namespace,
-                        limit=page_size,
-                        label_selector=label_selector,
-                        continue_token=token,
-                    )
+                    page = fetch_page(token)
                     yield attempt, page
                     token = (page.get("metadata") or {}).get("continue")
                     if not token:
@@ -274,11 +259,67 @@ class K8sClient:
                     "restarting the list (attempt %d/%d)", attempt, max_restarts,
                 )
 
-    def list_nodes(self, *, label_selector: Optional[str] = None) -> Dict[str, Any]:
-        """One page of nodes; raw NodeList body (items + resourceVersion)."""
+    def list_pods_paged(
+        self,
+        namespace: Optional[str] = None,
+        *,
+        page_size: int = 500,
+        label_selector: Optional[str] = None,
+        max_restarts: int = 2,
+    ):
+        """Stream a large pod LIST in bounded pages (``limit``+``continue``
+        — the SDK-provided behavior at reference pod_watcher.py:264 that
+        the from-scratch client must supply itself; without it every
+        relist of a large cluster is one unbounded response). Contract:
+        see ``_list_paged``."""
+        return self._list_paged(
+            lambda token: self.list_pods(
+                namespace,
+                limit=page_size,
+                label_selector=label_selector,
+                continue_token=token,
+            ),
+            max_restarts,
+        )
+
+    def list_nodes_paged(
+        self,
+        *,
+        page_size: int = 500,
+        label_selector: Optional[str] = None,
+        max_restarts: int = 2,
+    ):
+        """Stream a node LIST in bounded pages — the node plane
+        (nodes/watcher.py) and the remediation budget adoption
+        (remediate/actuator.py) relist nodes too, and a several-thousand-
+        node cluster deserves the same memory bound as pods. Contract:
+        see ``_list_paged``."""
+        return self._list_paged(
+            lambda token: self.list_nodes(
+                limit=page_size,
+                label_selector=label_selector,
+                continue_token=token,
+            ),
+            max_restarts,
+        )
+
+    def list_nodes(
+        self,
+        *,
+        label_selector: Optional[str] = None,
+        limit: Optional[int] = None,
+        continue_token: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """One page of nodes; raw NodeList body (items + resourceVersion,
+        + metadata.continue when more pages remain — same paging contract
+        as ``list_pods``)."""
         params: Dict[str, Any] = {}
         if label_selector:
             params["labelSelector"] = label_selector
+        if limit:
+            params["limit"] = limit
+        if continue_token:
+            params["continue"] = continue_token
         return self._get("/api/v1/nodes", params).json()
 
     def get_node(self, name: str) -> Dict[str, Any]:

@@ -29,6 +29,37 @@ from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 
+def _decode_continue(token: Optional[str]) -> Tuple[Optional[str], Tuple[str, str]]:
+    """``(snapshot_rv, after_key)`` from an opaque continue token; raises
+    ValueError for any malformed shape (the caller maps it to 400)."""
+    if not token:
+        return None, ("", "")
+    try:
+        decoded = json.loads(base64.b64decode(token.encode()).decode())
+        # validate the full shape HERE: a decodable token with a non-int
+        # rv or non-string keys must 400, not 500 later
+        snapshot_rv = str(int(decoded["rv"]))
+        after = (decoded["ns"], decoded["name"])
+        if not (isinstance(after[0], str) and isinstance(after[1], str)):
+            raise TypeError("cursor keys must be strings")
+    except (ValueError, KeyError, TypeError) as exc:
+        raise ValueError(f"malformed continue token: {exc}") from exc
+    return snapshot_rv, after
+
+
+def _encode_continue(rv: int, ns: str, name: str) -> str:
+    return base64.b64encode(
+        json.dumps({"rv": rv, "ns": ns, "name": name}).encode()
+    ).decode()
+
+
+def _expired_continue_status() -> Tuple[int, Dict[str, Any]]:
+    return 410, {
+        "kind": "Status", "code": 410, "reason": "Expired",
+        "message": "The provided continue parameter is too old",
+    }
+
+
 def _parse_label_selector(selector: Optional[str]) -> List[Tuple[str, Optional[str]]]:
     """Equality-based selector subset: ``k=v``, ``k==v``, bare ``k``."""
     out: List[Tuple[str, Optional[str]]] = []
@@ -256,20 +287,53 @@ class MockCluster:
             self.modify_node(node)
             return 200, json.loads(json.dumps(node))
 
-    def list_nodes(self, label_selector: Optional[str] = None) -> Dict[str, Any]:
+    def list_nodes(
+        self,
+        label_selector: Optional[str] = None,
+        limit: Optional[int] = None,
+        continue_token: Optional[str] = None,
+    ) -> Tuple[int, Dict[str, Any]]:
+        """(status, body) for ``GET /api/v1/nodes`` with the same
+        limit+continue contract as ``list_pods`` (node keys have no
+        namespace; the cursor's ns field stays "")."""
         selector = _parse_label_selector(label_selector)
+        try:
+            snapshot_rv, after = _decode_continue(continue_token)
+        except ValueError:
+            return 400, {"kind": "Status", "code": 400, "message": "malformed continue token"}
         with self._lock:
-            items = [
-                json.loads(json.dumps(node))
-                for _name, node in sorted(self._nodes.items())
-                if _matches_selector(node, selector)
+            if snapshot_rv is not None and int(snapshot_rv) < self._oldest_rv:
+                return _expired_continue_status()
+            matches = [
+                (("", name), node)
+                for name, node in sorted(self._nodes.items())
+                if _matches_selector(node, selector) and ("", name) > after
             ]
-            rv = str(self._rv)
+            return 200, self._page_body("NodeList", matches, limit, snapshot_rv)
+
+    def _page_body(
+        self,
+        kind: str,
+        matches: list,
+        limit: Optional[int],
+        snapshot_rv: Optional[str],
+    ) -> Dict[str, Any]:
+        """One page + metadata (rv pinned to the list's snapshot, continue
+        token when more remain). Call under ``self._lock``."""
+        rv = snapshot_rv if snapshot_rv is not None else str(self._rv)
+        next_token = None
+        if limit and len(matches) > limit:
+            matches = matches[:limit]
+            last_ns, last_name = matches[-1][0]
+            next_token = _encode_continue(int(rv), last_ns, last_name)
+        metadata: Dict[str, Any] = {"resourceVersion": rv}
+        if next_token:
+            metadata["continue"] = next_token
         return {
-            "kind": "NodeList",
+            "kind": kind,
             "apiVersion": "v1",
-            "metadata": {"resourceVersion": rv},
-            "items": items,
+            "metadata": metadata,
+            "items": [json.loads(json.dumps(obj)) for _key, obj in matches],
         }
 
     def compact(self) -> None:
@@ -319,25 +383,13 @@ class MockCluster:
         between pages is journaled at rv > snapshot and arrives via the
         resumed watch."""
         selector = _parse_label_selector(label_selector)
-        after: Tuple[str, str] = ("", "")
-        snapshot_rv: Optional[str] = None
-        if continue_token:
-            try:
-                decoded = json.loads(base64.b64decode(continue_token.encode()).decode())
-                # validate the full shape HERE: a decodable token with a
-                # non-int rv or non-string keys must 400, not 500 later
-                snapshot_rv = str(int(decoded["rv"]))
-                after = (decoded["ns"], decoded["name"])
-                if not (isinstance(after[0], str) and isinstance(after[1], str)):
-                    raise TypeError("cursor keys must be strings")
-            except (ValueError, KeyError, TypeError):
-                return 400, {"kind": "Status", "code": 400, "message": "malformed continue token"}
+        try:
+            snapshot_rv, after = _decode_continue(continue_token)
+        except ValueError:
+            return 400, {"kind": "Status", "code": 400, "message": "malformed continue token"}
         with self._lock:
             if snapshot_rv is not None and int(snapshot_rv) < self._oldest_rv:
-                return 410, {
-                    "kind": "Status", "code": 410, "reason": "Expired",
-                    "message": "The provided continue parameter is too old",
-                }
+                return _expired_continue_status()
             matches = [
                 (key, pod)
                 for key, pod in sorted(self._pods.items())
@@ -345,24 +397,7 @@ class MockCluster:
                 and _matches_selector(pod, selector)
                 and key > after
             ]
-            rv = snapshot_rv if snapshot_rv is not None else str(self._rv)
-            next_token = None
-            if limit and len(matches) > limit:
-                matches = matches[:limit]
-                last_ns, last_name = matches[-1][0]
-                next_token = base64.b64encode(
-                    json.dumps({"rv": int(rv), "ns": last_ns, "name": last_name}).encode()
-                ).decode()
-            items = [json.loads(json.dumps(pod)) for _key, pod in matches]
-        metadata: Dict[str, Any] = {"resourceVersion": rv}
-        if next_token:
-            metadata["continue"] = next_token
-        return 200, {
-            "kind": "PodList",
-            "apiVersion": "v1",
-            "metadata": metadata,
-            "items": items,
-        }
+            return 200, self._page_body("PodList", matches, limit, snapshot_rv)
 
     def events_since(self, rv: int, deadline: float, collection: str = "pods") -> Optional[List[Dict[str, Any]]]:
         """Block until there are journal events > rv in ``collection`` or the
@@ -502,7 +537,11 @@ class _Handler(BaseHTTPRequestHandler):
             if params.get("watch") == "true":
                 self._serve_watch(None, params, collection="nodes")
             else:
-                self._json(200, self.cluster.list_nodes(params.get("labelSelector")))
+                limit = int(params["limit"]) if "limit" in params else None
+                status, body = self.cluster.list_nodes(
+                    params.get("labelSelector"), limit, params.get("continue")
+                )
+                self._json(status, body)
             return
         if path.startswith("/api/v1/nodes/"):
             name = path[len("/api/v1/nodes/"):]

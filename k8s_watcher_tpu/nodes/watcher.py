@@ -39,6 +39,7 @@ class NodeWatcher:
         retry: Optional[RetryPolicy] = None,
         watch_timeout_seconds: int = 300,
         metrics=None,
+        list_page_size: int = 500,  # LIST pagination (limit+continue)
     ):
         self.client = client
         self.tracker = tracker
@@ -47,6 +48,7 @@ class NodeWatcher:
         self.label_selector = label_selector
         self.retry = retry or RetryPolicy()
         self.watch_timeout_seconds = watch_timeout_seconds
+        self.list_page_size = list_page_size
         self.metrics = metrics
         self.resource_version: Optional[str] = None
         # set once the first node list has been folded: callers (and tests)
@@ -114,12 +116,24 @@ class NodeWatcher:
                 self.metrics.counter("slice_notifications_enqueued").inc()
 
     def _relist(self) -> None:
-        body = self.client.list_nodes(label_selector=self.label_selector)
+        """Paged node LIST (limit+continue, same contract as the pod
+        source's relist): bounded responses, and the listed-name set
+        resets when an expired continue token restarts the list from a
+        new snapshot — tombstones must come from ONE snapshot's view."""
         now = time.monotonic()
-        listed = set()
-        for node in body.get("items", []):
-            listed.add((node.get("metadata") or {}).get("name", ""))
-            self._emit("ADDED", node, now)
+        listed: set = set()
+        last_attempt = 0
+        rv = None
+        for attempt, body in self.client.list_nodes_paged(
+            page_size=self.list_page_size, label_selector=self.label_selector,
+        ):
+            if attempt != last_attempt:
+                listed.clear()
+                last_attempt = attempt
+            rv = (body.get("metadata") or {}).get("resourceVersion") or rv
+            for node in body.get("items", []):
+                listed.add((node.get("metadata") or {}).get("name", ""))
+                self._emit("ADDED", node, now)
         # nodes that vanished while we were disconnected
         for name in [n for n in self.tracker.known_nodes() if n not in listed]:
             self._emit("DELETED", {"metadata": {"name": name}}, now)
@@ -134,7 +148,7 @@ class NodeWatcher:
                 self.sink(Notification(slice_payload, now, kind="slice"))
                 if self.metrics is not None:
                     self.metrics.counter("slice_notifications_enqueued").inc()
-        self.resource_version = (body.get("metadata") or {}).get("resourceVersion")
+        self.resource_version = rv
         self.synced.set()
 
     def _run(self) -> None:
@@ -143,7 +157,24 @@ class NodeWatcher:
         while not self._stop.is_set():
             try:
                 if need_list:
-                    self._relist()
+                    try:
+                        self._relist()
+                    except K8sGoneError as exc:
+                        # the paged LIST's continue tokens kept expiring
+                        # (max_restarts exhausted on a churning cluster):
+                        # falling through to the watch-phase 410 handler
+                        # would relist IMMEDIATELY in a tight loop — back
+                        # off like any other error instead
+                        logger.warning(
+                            "Node LIST kept expiring (%s); backing off %.1fs", exc, backoff
+                        )
+                        if self._stop.wait(backoff):
+                            return
+                        backoff = min(
+                            backoff * self.retry.backoff_multiplier,
+                            self.retry.max_delay_seconds,
+                        )
+                        continue
                     need_list = False
                 for raw in self.client.watch_nodes(
                     resource_version=self.resource_version,

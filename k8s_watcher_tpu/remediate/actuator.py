@@ -104,6 +104,7 @@ class NodeActuator:
         return ActionRecord(node=node, action=action, ok=False, dry_run=self.dry_run, reason=reason)
 
     _BUDGET_REFUSAL = "quarantine budget exhausted"
+    _ADOPT_PAGE_SIZE = 500  # adoption taint-scan LIST page size
 
     def _reconcile_quarantined(self) -> None:
         """Drop budget entries that no longer hold, so the budget reflects
@@ -456,24 +457,29 @@ class NodeActuator:
         still adopts lazily on re-confirmation)."""
         if self.dry_run:
             return []
+        adopted = []
         try:
-            nodes = self.client.list_nodes().get("items", [])
+            # paged scan (limit+continue): only taint-carrying names are
+            # kept, so memory stays one page even on multi-thousand-node
+            # pools. A mid-scan snapshot restart (attempt bump) resets
+            # nothing — the union across attempts over-adopts at worst,
+            # and over-adoption only makes the budget more conservative.
+            for _attempt, body in self.client.list_nodes_paged(page_size=self._ADOPT_PAGE_SIZE):
+                for node in body.get("items", []):
+                    name = (node.get("metadata") or {}).get("name", "")
+                    if name and any(
+                        t.get("key") == self.taint_key
+                        for t in ((node.get("spec") or {}).get("taints") or [])
+                    ):
+                        adopted.append(name)
         except K8sApiError as exc:
             logger.warning("Could not adopt pre-existing quarantines: %s", exc)
             return []
-        adopted = [
-            (node.get("metadata") or {}).get("name", "")
-            for node in nodes
-            if any(
-                t.get("key") == self.taint_key
-                for t in ((node.get("spec") or {}).get("taints") or [])
-            )
-        ]
-        adopted = [n for n in adopted if n]
+        adopted = sorted(set(adopted))
         if adopted:
-            logger.info("Adopting pre-existing quarantines into the budget: %s", sorted(adopted))
+            logger.info("Adopting pre-existing quarantines into the budget: %s", adopted)
             with self._lock:
                 self._quarantined.update(adopted)
             if self.metrics is not None:
                 self.metrics.gauge("remediation_quarantined_nodes").set(len(self._quarantined))
-        return sorted(adopted)
+        return adopted
