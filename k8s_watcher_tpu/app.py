@@ -190,10 +190,16 @@ class WatcherApp:
                 slices=self.slice_tracker.debug_snapshot,
                 trend=agent_trend,
                 remediation=remediation_state,
+                probes=(
+                    self._probe_agent.recent_cycles
+                    if self._probe_agent is not None else None
+                ),
             ).start()
             routes = "/metrics, /healthz, /debug/slices" + (
                 ", /debug/events" if self.audit is not None else ""
             ) + (", /debug/trend" if agent_trend is not None else "") + (
+                ", /debug/probes" if self._probe_agent is not None else ""
+            ) + (
                 ", /debug/remediation" if remediation_state is not None else ""
             )
             logger.info("Status endpoint on :%d (%s)", self.status_server.port, routes)

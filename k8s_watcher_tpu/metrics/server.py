@@ -74,6 +74,8 @@ class _StatusHandler(BaseHTTPRequestHandler):
     # may return None while the plane is configured but not yet armed
     # (standby replica pre-campaign)
     remediation = None
+    # Callable[[int], list]: last-N probe cycle summaries (flight recorder)
+    probes = None
 
     def log_message(self, *a):
         pass
@@ -137,6 +139,17 @@ class _StatusHandler(BaseHTTPRequestHandler):
                 self._json(404, {"error": "trend tracking not wired (tpu.probe.trend_enabled)"})
                 return
             self._json(200, {"trend": self.trend()})
+        elif parsed.path == "/debug/probes":
+            if self.probes is None:
+                self._json(404, {"error": "probe agent not wired (tpu.probe.enabled)"})
+                return
+            params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+            try:
+                n = int(params.get("n", "20"))
+            except ValueError:
+                self._json(400, {"error": f"bad n={params.get('n')!r}"})
+                return
+            self._json(200, {"probes": self.probes(n)})
         elif parsed.path == "/debug/remediation":
             if self.remediation is None:
                 self._json(404, {"error": "remediation not wired (tpu.remediation.enabled)"})
@@ -162,6 +175,7 @@ class StatusServer:
         slices=None,  # Callable[[], dict] -> serves /debug/slices
         trend=None,  # Callable[[], dict] -> serves /debug/trend
         remediation=None,  # Callable[[], Optional[dict]] -> /debug/remediation
+        probes=None,  # Callable[[int], list] -> /debug/probes (cycle ring)
     ):
         handler = type(
             "BoundStatusHandler",
@@ -173,6 +187,7 @@ class StatusServer:
                 "slices": staticmethod(slices) if slices else None,
                 "trend": staticmethod(trend) if trend else None,
                 "remediation": staticmethod(remediation) if remediation else None,
+                "probes": staticmethod(probes) if probes else None,
             },
         )
         self._server = ThreadingHTTPServer((host, port), handler)

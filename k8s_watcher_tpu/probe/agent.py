@@ -11,6 +11,7 @@ process 0 does the reporting, all processes join the collectives.
 
 from __future__ import annotations
 
+import collections
 import logging
 import threading
 import time
@@ -57,6 +58,11 @@ class ProbeAgent:
         # optional per-report observer (remediate.ProbeRemediationPolicy):
         # sees every completed report, healthy or not, on the agent thread
         self.report_observer: Optional[Callable[..., Any]] = None
+        # flight recorder: last-N cycle summaries for /debug/probes — the
+        # trend endpoint shows anchors, this shows the raw recent history
+        # an operator diffs them against
+        self._cycles: collections.deque = collections.deque(maxlen=64)
+        self._cycles_lock = threading.Lock()
         self.trend: Optional[TrendTracker] = None
         if tpu_config.probe_trend_enabled:
             self.trend = TrendTracker(
@@ -173,6 +179,7 @@ class ProbeAgent:
         # delays the NEXT beat; scripts/probe_agent.py sizes the threshold
         # and caps the observer's k8s request timeout accordingly).
         self.heartbeat()
+        self._record_cycle(report)
         observer = self.report_observer
         if observer is not None:
             try:
@@ -292,6 +299,40 @@ class ProbeAgent:
         # on process 0 would detect that fault and then drop it.
         if jax.process_index() == 0 or not report.healthy:
             self.sink(Notification(report.to_payload(), time.monotonic(), kind="probe"))
+
+    def _record_cycle(self, report: ProbeReport) -> None:
+        """Fold one completed cycle into the flight-recorder ring."""
+        import datetime
+
+        entry = {
+            "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(timespec="seconds"),
+            "healthy": report.healthy,
+            "duration_ms": round(report.duration_ms, 1),
+            # None means "probe did not run" — a near-zero reading from a
+            # severely degraded chip must stay 0.0, not collapse to null
+            "psum_rtt_ms": round(report.ici.psum_rtt_median_ms, 4) if report.ici else None,
+            "mxu_tflops": round(report.mxu.get("tflops_median", 0.0), 2)
+            if report.mxu else None,
+            "hbm_read_gbps": round(report.hbm.get("read_gbps", 0.0), 1)
+            if report.hbm else None,
+            "hbm_write_gbps": round(report.hbm_write.get("write_gbps", 0.0), 1)
+            if report.hbm_write else None,
+            "link_suspects": len(report.links.suspect_links) if report.links else None,
+            "dcn_suspect_slices": list(report.multislice.dcn_suspect_slices)
+            if report.multislice else None,
+            "trend_alerts": [
+                {"metric": a.metric, "direction": a.direction, "ratio": round(a.ratio, 2)}
+                for a in (report.trend_alerts or [])
+            ],
+        }
+        with self._cycles_lock:
+            self._cycles.append(entry)
+
+    def recent_cycles(self, n: int = 20) -> list:
+        """Last-``n`` cycle summaries, newest first (/debug/probes)."""
+        with self._cycles_lock:
+            entries = list(self._cycles)
+        return entries[::-1][: max(0, n)]
 
     def _loop(self) -> None:
         while not self._stop.is_set():

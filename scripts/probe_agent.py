@@ -147,8 +147,9 @@ def main() -> int:
             port=config.tpu.probe_status_port,
             trend=agent.trend.snapshot if agent.trend is not None else None,
             remediation=remediation.snapshot if remediation is not None else None,
+            probes=agent.recent_cycles,
         ).start()
-        routes = "/metrics, /healthz, /debug/trend" + (
+        routes = "/metrics, /healthz, /debug/trend, /debug/probes" + (
             ", /debug/remediation" if remediation is not None else ""
         )
         print(f"probe status endpoint on :{status_server.port} ({routes})")

@@ -925,13 +925,21 @@ class TestKubernetesWatchSource:
         n = 10_000
         for i in range(n):
             mock_api.cluster.add_pod(build_pod(f"p{i:05d}", uid=f"uid-{i:05d}"))
+        from k8s_watcher_tpu.metrics import MetricsRegistry
+
+        metrics = MetricsRegistry()
         client = CountingClient(mock_api, timeout=60.0)
-        source = KubernetesWatchSource(client, list_page_size=500)
+        source = KubernetesWatchSource(client, list_page_size=500, metrics=metrics)
         added = list(source._relist())
         assert len(added) == n and all(e.type == "ADDED" for e in added)
         assert len(client.page_sizes) == n // 500  # 20 bounded requests...
         assert max(client.page_sizes) == 500  # ...none exceeding the page size
         assert len(source._known) == n
+        # operational metrics for the paged relist
+        assert metrics.counter("relists").value == 1
+        assert metrics.counter("relist_pages").value == n // 500
+        assert metrics.counter("relist_restarts").value == 0
+        assert metrics.histogram("relist_duration").summary().get("count") == 1
 
         # three pods vanish while "disconnected"; the next relist pages
         # through the survivors and synthesizes exactly their tombstones

@@ -158,14 +158,21 @@ class TestNodeListPagination:
     def test_node_watcher_relists_in_pages_with_tombstones(self, mock_api):
         """A paged relist still synthesizes DELETED for vanished nodes —
         only meaningful after the LAST page."""
+        from k8s_watcher_tpu.metrics import MetricsRegistry
+
+        metrics = MetricsRegistry()
         for i in range(25):
             mock_api.cluster.add_node(build_node(f"n{i:03d}"))
         watcher = NodeWatcher(
             make_client(mock_api), NodeTracker("development"), lambda n: None,
-            list_page_size=10,
+            list_page_size=10, metrics=metrics,
         )
         watcher._relist()
         assert len(watcher.tracker.known_nodes()) == 25
+        assert metrics.counter("node_relists").value == 1
+        assert metrics.counter("node_relist_pages").value == 3  # 10+10+5
+        assert metrics.counter("node_relist_restarts").value == 0
+        assert metrics.histogram("node_relist_duration").summary()["count"] == 1
         mock_api.cluster.delete_node("n007")
         mock_api.cluster.delete_node("n013")
         watcher._relist()

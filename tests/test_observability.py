@@ -307,6 +307,50 @@ class TestAuditRing:
         finally:
             server.stop()
 
+    def test_debug_probes_flight_recorder(self):
+        """The agent's cycle ring serves at /debug/probes: newest first,
+        bounded by ?n, 404 when no agent is wired."""
+        import requests
+
+        from k8s_watcher_tpu.config.schema import TpuConfig
+        from k8s_watcher_tpu.metrics import MetricsRegistry
+        from k8s_watcher_tpu.metrics.server import Liveness, StatusServer
+        from k8s_watcher_tpu.probe.agent import ProbeAgent
+
+        agent = ProbeAgent(
+            TpuConfig(
+                probe_enabled=True, probe_payload_bytes=1 << 14,
+                probe_matmul_size=64, probe_hbm_bytes=0,
+                probe_rtt_warn_ms=10_000.0,
+            ),
+            environment="test", sink=lambda n: None, expected_platform="cpu",
+        )
+        for _ in range(3):
+            agent.run_once()
+        server = StatusServer(
+            MetricsRegistry(), Liveness(), probes=agent.recent_cycles
+        ).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}/debug/probes"
+            body = requests.get(url, timeout=5).json()
+            assert len(body["probes"]) == 3
+            entry = body["probes"][0]
+            assert entry["healthy"] is True
+            assert entry["duration_ms"] > 0
+            assert "trend_alerts" in entry and entry["trend_alerts"] == []
+            assert len(requests.get(url + "?n=2", timeout=5).json()["probes"]) == 2
+            assert requests.get(url + "?n=x", timeout=5).status_code == 400
+        finally:
+            server.stop()
+
+        server = StatusServer(MetricsRegistry(), Liveness()).start()
+        try:
+            assert requests.get(
+                f"http://127.0.0.1:{server.port}/debug/probes", timeout=5
+            ).status_code == 404
+        finally:
+            server.stop()
+
     def test_debug_events_404_when_disabled(self):
         import requests
 

@@ -361,3 +361,70 @@ class TestMergePatchProperties:
         once = MockCluster._merge_patch(dict(doc), patch)
         twice = MockCluster._merge_patch(dict(once), patch)
         assert once == twice
+
+
+# -- LIST pagination (limit+continue) ---------------------------------------
+
+
+class TestPaginationProperties:
+    """The mock apiserver's paging contract, which the paged client and
+    both relist paths (pods: k8s/watch.py, nodes: nodes/watcher.py) build
+    their tombstone correctness on: for ANY population and page size, the
+    pages partition the keyspace — every object exactly once, in order,
+    no page over limit, one snapshot rv throughout, and the final page
+    carries no token."""
+
+    @staticmethod
+    def _drain(cluster, limit):
+        names, rvs, token, pages = [], [], None, 0
+        while True:
+            status, body = cluster.list_pods(None, limit, None, token)
+            assert status == 200
+            assert len(body["items"]) <= limit
+            names += [p["metadata"]["name"] for p in body["items"]]
+            rvs.append(body["metadata"]["resourceVersion"])
+            pages += 1
+            token = body["metadata"].get("continue")
+            if not token:
+                return names, rvs, pages
+
+    @given(st.integers(0, 40), st.integers(1, 17))
+    @settings(max_examples=40, deadline=None)
+    def test_pages_partition_the_keyspace(self, n_pods, limit):
+        from k8s_watcher_tpu.watch.fake import build_pod
+
+        cluster = MockCluster()
+        expected = sorted(f"p{i:03d}" for i in range(n_pods))
+        for name in expected:
+            cluster.add_pod(build_pod(name, uid=f"uid-{name}"))
+        names, rvs, pages = self._drain(cluster, limit)
+        assert names == expected          # every object exactly once, sorted
+        assert len(set(rvs)) == 1         # one snapshot rv across all pages
+        assert pages == max(1, -(-n_pods // limit))  # ceil, no dangling page
+
+    @given(st.integers(2, 30), st.integers(1, 7), st.integers(0, 29))
+    @settings(max_examples=40, deadline=None)
+    def test_churn_between_pages_never_duplicates(self, n_pods, limit, churn_idx):
+        """Deletes/creates between pages must never serve the same key
+        twice — the cursor strictly advances regardless of churn."""
+        from k8s_watcher_tpu.watch.fake import build_pod
+
+        cluster = MockCluster()
+        for i in range(n_pods):
+            cluster.add_pod(build_pod(f"p{i:03d}", uid=f"uid-{i:03d}"))
+        names, token, first_rv = [], None, None
+        while True:
+            status, body = cluster.list_pods(None, limit, None, token)
+            assert status == 200
+            if first_rv is None:
+                first_rv = body["metadata"]["resourceVersion"]
+            assert body["metadata"]["resourceVersion"] == first_rv
+            names += [p["metadata"]["name"] for p in body["items"]]
+            # churn mid-pagination: delete one key, add one new key
+            victim = f"p{churn_idx % n_pods:03d}"
+            cluster.delete_pod("default", victim)
+            cluster.add_pod(build_pod(f"q{churn_idx:03d}", uid=f"uid-q{churn_idx:03d}"))
+            token = body["metadata"].get("continue")
+            if not token:
+                break
+        assert len(names) == len(set(names)), f"duplicate keys served: {names}"
