@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: test check check-scale integration integration-kind integration-mock bench dryrun dryrun-128
+.PHONY: test check check-scale integration integration-kind integration-mock bench dryrun dryrun-128 accept
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -51,3 +51,9 @@ check-scale:
 
 dryrun-128:
 	$(PY) __graft_entry__.py 128
+
+# BASELINE.md acceptance rung #5: the v5p-128 SHAPE under combined load —
+# 1k+ events/min churn with preemption + an injected DCN fault + latency
+# tracers, all at once. Artifact: artifacts/acceptance_v5p128.json
+accept:
+	$(PY) scripts/acceptance_drill.py

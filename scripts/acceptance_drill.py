@@ -1,0 +1,354 @@
+#!/usr/bin/env python3
+"""Acceptance rung #5 (BASELINE.md): v5p-128-shape multi-slice churn —
+1k+ pod events/min with preemption AND fault injection, AT ONCE.
+
+The per-feature drills prove each plane alone; this one runs the
+DEPLOYMENT SHAPE under combined load on a 128-device virtual mesh:
+
+- a full WatcherApp (watch -> pipeline -> slice tracking -> dispatcher)
+  notifying a live HTTP sink, fed by a mock apiserver churning pod
+  lifecycles at >= 1k events/min with real preemption markers;
+- interleaved latency tracer pods timing the pod-event->notify path
+  end-to-end (clock starts before the apiserver write) WHILE everything
+  else runs — the <1s p50 target must hold under combined load, not on
+  an idle system;
+- concurrently, a DaemonSet-shape probe loop on the (4, hosts, chips)
+  hybrid mesh over 128 devices with an injected slow device in slice 3:
+  the DCN pair walk must localize slice 3 and the remediation policy
+  must produce a confirmed DRY-RUN decision naming its node — while the
+  churn flows.
+
+Asserts every stage; writes ``artifacts/acceptance_v5p128.json``.
+
+Usage: python scripts/acceptance_drill.py [--devices 128] [--seconds 75]
+                                          [--rate 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import datetime
+import json
+import statistics
+import sys
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+NODE = "accept-tpu-node-0"
+
+
+def tpu_pod(name, uid, phase, node="accept-node-0", chips=4):
+    from k8s_watcher_tpu.watch.fake import build_pod
+
+    return build_pod(
+        name, uid=uid, phase=phase, tpu_chips=chips, tpu_topology="2x2x1",
+        node_name=node,
+        gke_slice_fields={"jobset.sigs.k8s.io/jobset-name": name.rsplit("-", 1)[0],
+                          "batch.kubernetes.io/job-completion-index":
+                              int(name.rsplit("-", 1)[1])},
+    )
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--devices", type=int, default=128)
+    parser.add_argument("--slices", type=int, default=4)
+    parser.add_argument("--seconds", type=float, default=75.0)
+    parser.add_argument("--rate", type=float, default=20.0,
+                        help="offered apiserver writes per second (>= 16.7 = 1k/min)")
+    parser.add_argument("--confirm-cycles", type=int, default=2)
+    args = parser.parse_args()
+
+    from _drill_common import force_cpu_mesh, start_sink, tpu_node
+
+    force_cpu_mesh(args.devices)
+
+    from k8s_watcher_tpu.app import WatcherApp
+    from k8s_watcher_tpu.config.loader import load_config
+    from k8s_watcher_tpu.faults.ici import IciFaultSpec
+    from k8s_watcher_tpu.k8s.client import K8sClient
+    from k8s_watcher_tpu.k8s.kubeconfig import K8sConnection
+    from k8s_watcher_tpu.k8s.mock_server import MockApiServer, MockCluster
+    from k8s_watcher_tpu.parallel.mesh import hybrid_slice_mesh
+    from k8s_watcher_tpu.probe.device import enumerate_devices
+    from k8s_watcher_tpu.probe.multislice import run_multislice_probe
+    from k8s_watcher_tpu.probe.report import ProbeReport
+    from k8s_watcher_tpu.remediate import NodeActuator, ProbeRemediationPolicy
+
+    result: dict = {
+        "devices": args.devices, "slices": args.slices,
+        "offered_rate_per_sec": args.rate, "duration_seconds": args.seconds,
+    }
+    failures: list = []
+
+    # -- live HTTP sink with arrival timestamps ----------------------------
+    arrivals: dict = {}
+    payload_counts: dict = {}
+    disruption_kinds: set = set()
+    sink_lock = threading.Lock()
+
+    def on_payload(body: dict, now: float) -> None:
+        with sink_lock:
+            kind = body.get("event_type", "?")
+            payload_counts[kind] = payload_counts.get(kind, 0) + 1
+            name = body.get("name", "")
+            if name.startswith("tracer-"):
+                arrivals.setdefault(name, now)
+            if kind == "DELETED" and body.get("disruption"):
+                disruption_kinds.add(body["disruption"].get("kind"))
+
+    sink = start_sink(on_payload)
+
+    # -- mock apiserver + the full watcher app -----------------------------
+    cluster = MockCluster()
+    for i in range(4):
+        cluster.add_node(tpu_node(f"accept-node-{i}"))
+    cluster.add_node(tpu_node(NODE))
+
+    import tempfile
+
+    with MockApiServer(cluster) as api, tempfile.TemporaryDirectory() as tmp:
+        kc = Path(tmp) / "kubeconfig.json"
+        kc.write_text(json.dumps({
+            "apiVersion": "v1", "kind": "Config",
+            "clusters": [{"name": "m", "cluster": {"server": api.url}}],
+            "contexts": [{"name": "m", "context": {"cluster": "m", "user": "m"}}],
+            "current-context": "m",
+            "users": [{"name": "m", "user": {"token": "t"}}],
+        }))
+        config = load_config("development", REPO / "config", env={})
+        config = dataclasses.replace(
+            config,
+            kubernetes=dataclasses.replace(
+                config.kubernetes, use_mock=False, config_file=str(kc),
+                watch_timeout_seconds=10,
+            ),
+            clusterapi=dataclasses.replace(
+                config.clusterapi,
+                base_url=f"http://127.0.0.1:{sink.server_address[1]}",
+                api_key=None,
+            ),
+            watcher=dataclasses.replace(config.watcher, status_port=0),
+            tpu=dataclasses.replace(config.tpu, probe_enabled=False),
+            state=dataclasses.replace(
+                config.state, checkpoint_path=str(Path(tmp) / "ck.json"),
+            ),
+        )
+        app = WatcherApp(config)
+        app_thread = threading.Thread(target=app.run, daemon=True)
+        app_thread.start()
+        time.sleep(1.0)  # let the watch connect
+
+        # -- DaemonSet-shape probe loop with an injected DCN fault ---------
+        # CORRUPT a device in the last slice: every DCN pair touching that
+        # slice fails its checksum — deterministic under the drill's
+        # combined CPU load, where a timing fault's separation drowns in
+        # churn/compile noise and the intermittent detection would reset
+        # the policy's consecutive-cycle streak (the slow-path timing
+        # localization is drilled separately in chaos_remediate.py on a
+        # quiet mesh)
+        per_slice = args.devices // args.slices
+        fault = IciFaultSpec(corrupt_device_id=(args.slices - 1) * per_slice)
+        devices = enumerate_devices(expected_platform=None)
+        hosts = {"0": {"hostname": "accept-host", "process_index": 0, "node_name": NODE}}
+        actuator = NodeActuator(
+            K8sClient(K8sConnection(server=api.url), request_timeout=5.0),
+            dry_run=True, cooldown_seconds=0.0,
+            max_actions_per_hour=100, max_quarantined_nodes=8,
+        )
+        policy = ProbeRemediationPolicy(actuator, confirm_cycles=args.confirm_cycles)
+        probe_state = {"cycles": 0, "dcn_suspects": [], "decisions": [],
+                       "unreliable": 0, "stop": False}
+
+        def probe_loop():
+            mesh = hybrid_slice_mesh(n_slices=args.slices)
+            while not probe_state["stop"]:
+                ms = run_multislice_probe(
+                    mesh, n_slices=args.slices, iters=3, inner_iters=4, fault=fault,
+                )
+                probe_state["cycles"] += 1
+                probe_state["dcn_suspects"].append(list(ms.dcn_suspect_slices))
+                if ms.timing_unreliable:
+                    probe_state["unreliable"] += 1
+                report = ProbeReport(
+                    environment="accept", devices=devices, multislice=ms, hosts=hosts,
+                )
+                probe_state["decisions"] += policy.observe_report(report)
+                time.sleep(1.0)
+
+        prober = threading.Thread(target=probe_loop, daemon=True)
+        prober.start()
+
+        # -- churn at >= 1k events/min with preemption + latency tracers ---
+        # Explicit per-worker state: ALIVE workers flip phases, a periodic
+        # victim is preempted (real k8s markers + DELETED), and preempted
+        # workers RESCHEDULE (re-added with a fresh uid, like a controller
+        # would) a few ticks later — the full lifecycle, not just deletes.
+        n_jobsets = 8
+        workers = 4
+        alive: dict = {}
+        for j in range(n_jobsets):
+            for w in range(workers):
+                cluster.add_pod(tpu_pod(f"job{j}-{w}", f"uid-{j}-{w}", "Pending",
+                                        node=f"accept-node-{w % 4}"))
+                alive[(j, w)] = True
+        rv_start = cluster.latest_rv()
+        tracer_writes: dict = {}
+        preemptions = 0
+        reschedules = 0
+        interval = 1.0 / args.rate
+        t0 = time.monotonic()
+        deadline = t0 + args.seconds
+        i = 0
+        while time.monotonic() < deadline:
+            target = t0 + i * interval
+            delay = target - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            step = i % 10
+            preempted = [key for key, up in alive.items() if not up]
+            if step == 4:  # every 10th write: a unique latency tracer
+                name = f"tracer-{i}"
+                tracer_writes[name] = time.monotonic()
+                cluster.add_pod(tpu_pod(name, f"uid-{name}", "Running", chips=4))
+            elif step == 7 and len(preempted) < n_jobsets:
+                # preempt an alive worker: markers, then DELETED
+                j, w = next(key for key, up in sorted(alive.items()) if up)
+                victim = tpu_pod(f"job{j}-{w}", f"uid-{j}-{w}", "Failed",
+                                 node=f"accept-node-{w % 4}")
+                victim["status"]["reason"] = "Preempted"
+                victim["status"].setdefault("conditions", []).append({
+                    "type": "DisruptionTarget", "status": "True",
+                    "reason": "PreemptionByScheduler",
+                })
+                cluster.modify_pod(victim)
+                cluster.delete_pod("default", f"job{j}-{w}")
+                alive[(j, w)] = False
+                preemptions += 1
+            elif step == 8 and preempted:
+                # the OLDEST preempted worker reschedules on another node,
+                # with a fresh uid — exactly what its Job controller does
+                j, w = preempted[0]
+                cluster.add_pod(tpu_pod(f"job{j}-{w}", f"uid-{j}-{w}-r{i}", "Pending",
+                                        node=f"accept-node-{(w + 1) % 4}"))
+                alive[(j, w)] = True
+                reschedules += 1
+            else:
+                # phase flips spread round-robin over workers that
+                # actually EXIST — a set_phase on a deleted pod journals
+                # nothing and would inflate the offered count without
+                # generating any event
+                alive_list = [key for key, up in sorted(alive.items()) if up]
+                if alive_list:
+                    j, w = alive_list[i % len(alive_list)]
+                    phase = "Running" if (i // len(alive_list)) % 2 == 0 else "Pending"
+                    cluster.set_phase("default", f"job{j}-{w}", phase)
+            i += 1
+        churn_seconds = time.monotonic() - t0
+        # the gate counts REALIZED apiserver events (journal rv delta),
+        # not offered writes — a write that journals nothing is not churn
+        journaled = cluster.latest_rv() - rv_start
+        realized_per_min = 60.0 * journaled / churn_seconds
+        result["events_journaled"] = journaled
+        result["realized_events_per_min"] = round(realized_per_min, 1)
+        result["preemptions"] = preemptions
+        result["reschedules"] = reschedules
+        if realized_per_min < 1000.0:
+            failures.append(f"realized rate {realized_per_min:.0f}/min < 1000/min")
+        if not preemptions:
+            failures.append("no preemption ever injected")
+        if not reschedules:
+            failures.append("no preempted worker ever rescheduled")
+
+        # drain: tracers still in flight + probe confirmation cycles
+        drain_deadline = time.monotonic() + 60
+        while time.monotonic() < drain_deadline:
+            with sink_lock:
+                tracers_done = len(arrivals)
+            if (tracers_done >= len(tracer_writes)
+                    and len(probe_state["decisions"]) > 0
+                    and probe_state["cycles"] >= args.confirm_cycles):
+                break
+            time.sleep(0.5)
+        probe_state["stop"] = True
+
+        # -- latency under combined load -----------------------------------
+        with sink_lock:
+            latencies = sorted(
+                1e3 * (arrivals[n] - tracer_writes[n])
+                for n in arrivals if n in tracer_writes
+            )
+            result["notifications_by_kind"] = dict(sorted(payload_counts.items()))
+            result["disruption_kinds_seen"] = sorted(disruption_kinds)
+        result["tracers"] = {"offered": len(tracer_writes), "completed": len(latencies)}
+        if latencies:
+            # nearest-rank percentile: ceil(q*n)-1 (int(q*n) overshoots by
+            # one rank and reads the max when n is a multiple of 10)
+            p90_idx = max(0, -(-9 * len(latencies) // 10) - 1)
+            result["latency_ms"] = {
+                "p50": round(statistics.median(latencies), 2),
+                "p90": round(latencies[p90_idx], 2),
+                "max": round(latencies[-1], 2),
+            }
+            if result["latency_ms"]["p50"] >= 1000.0:
+                failures.append(f"p50 {result['latency_ms']['p50']}ms >= 1s under load")
+        else:
+            failures.append("no latency tracer completed")
+        if len(latencies) < 0.9 * len(tracer_writes):
+            failures.append(
+                f"only {len(latencies)}/{len(tracer_writes)} tracers notified"
+            )
+        if "preemption" not in disruption_kinds:
+            failures.append(f"no preemption-classified DELETED: {disruption_kinds}")
+        overflow = app.metrics.counter("dispatch_dropped_overflow").value
+        result["overflow_drops"] = overflow
+        if overflow:
+            failures.append(f"{overflow} notifications dropped (queue overflow)")
+
+        # -- fault localization + dry-run decision under the same load -----
+        target_slice = args.slices - 1
+        localized = [s for s in probe_state["dcn_suspects"] if s == [target_slice]]
+        result["probe"] = {
+            "cycles": probe_state["cycles"],
+            "dcn_suspects_per_cycle": probe_state["dcn_suspects"],
+            "timing_unreliable_cycles": probe_state["unreliable"],
+            "decisions": [d.to_dict() for d in probe_state["decisions"]],
+        }
+        if not localized:
+            failures.append(
+                f"DCN walk never localized slice {target_slice}: {probe_state['dcn_suspects']}"
+            )
+        decisions = [d for d in probe_state["decisions"] if d.ok and d.dry_run]
+        if not decisions:
+            failures.append("no confirmed dry-run remediation decision under load")
+        elif decisions[0].node != NODE or f"slice {target_slice}" not in decisions[0].reason:
+            failures.append(f"decision mismatch: {decisions[0].to_dict()}")
+        spec_after = (cluster.get_node(NODE) or {}).get("spec") or {}
+        if spec_after.get("unschedulable") or spec_after.get("taints"):
+            failures.append(f"dry-run drill wrote to the cluster: {spec_after}")
+
+        app.shutdown()
+        app_thread.join(timeout=10)
+    sink.shutdown()
+    sink.server_close()
+
+    result["failures"] = failures
+    result["ok"] = not failures
+    result["timestamp_utc"] = datetime.datetime.now(datetime.timezone.utc).isoformat()
+    artifact = REPO / "artifacts" / "acceptance_v5p128.json"
+    artifact.parent.mkdir(exist_ok=True)
+    artifact.write_text(json.dumps(result, indent=2) + "\n")
+    print(json.dumps({k: v for k, v in result.items()
+                      if k not in ("notifications_by_kind",)}, indent=2))
+    print(f"artifact: {artifact}")
+    print(f"acceptance drill: {'PASS' if not failures else 'FAIL'}")
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,11 +35,9 @@ from __future__ import annotations
 
 import argparse
 import json
-import os
 import sys
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
@@ -60,14 +58,9 @@ def main() -> int:
                         help="slice index to inject the DCN fault into")
     args = parser.parse_args()
 
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + f" --xla_force_host_platform_device_count={args.cpu_mesh}"
-    ).strip()
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
+    from _drill_common import force_cpu_mesh, start_sink, tpu_node
 
-    jax.config.update("jax_platforms", "cpu")  # authoritative over pinned plugins
+    force_cpu_mesh(args.cpu_mesh)
 
     from k8s_watcher_tpu.faults.ici import IciFaultSpec
     from k8s_watcher_tpu.k8s.client import K8sClient
@@ -85,34 +78,15 @@ def main() -> int:
     received = []
     received_lock = threading.Lock()
 
-    class Sink(BaseHTTPRequestHandler):
-        protocol_version = "HTTP/1.1"
-        disable_nagle_algorithm = True
+    def on_payload(body, _now):
+        with received_lock:
+            received.append(body)
 
-        def log_message(self, *a):
-            pass
-
-        def do_POST(self):
-            body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
-            with received_lock:
-                received.append(json.loads(body))
-            out = b'{"ok": true}'
-            self.send_response(200)
-            self.send_header("Content-Length", str(len(out)))
-            self.end_headers()
-            self.wfile.write(out)
-
-    sink_server = ThreadingHTTPServer(("127.0.0.1", 0), Sink)
-    sink_server.daemon_threads = True
-    threading.Thread(target=sink_server.serve_forever, daemon=True).start()
+    sink_server = start_sink(on_payload)
 
     # -- mock apiserver holding the drill node -----------------------------
     cluster = MockCluster()
-    cluster.add_node({
-        "metadata": {"name": NODE, "labels": {"cloud.google.com/gke-tpu-accelerator": "tpu-v5p"}},
-        "spec": {},
-        "status": {"conditions": [{"type": "Ready", "status": "True"}]},
-    })
+    cluster.add_node(tpu_node(NODE))
 
     with MockApiServer(cluster) as api:
         client = K8sClient(K8sConnection(server=api.url), request_timeout=5.0)
