@@ -282,6 +282,43 @@ class K8sClient:
             max_restarts,
         )
 
+    @staticmethod
+    def iter_list_pages(pages, *, metrics=None, metric_prefix: str = "relist"):
+        """Consume a ``_list_paged`` stream page by page, yielding
+        ``(rv, items, attempt_changed)`` while recording the shared relist
+        cost metrics (``<prefix>s``/``<prefix>_pages``/
+        ``<prefix>_restarts`` counters + the ``<prefix>_duration``
+        histogram). Duration records in ``finally`` — an ABORTED relist
+        (paging exhaustion) is the most expensive kind and must stay
+        visible in its own cost metrics. ``attempt_changed`` is True on
+        the first page of a RESTARTED attempt (new snapshot): consumers
+        must reset anything accumulated from the aborted attempt's pages
+        (both relist consumers reset their tombstone bookkeeping — the
+        invariants live HERE so the pod and node paths can't drift)."""
+        import time
+
+        t0 = time.monotonic()
+        if metrics is not None:
+            metrics.counter(f"{metric_prefix}s").inc()
+        last_attempt = 0
+        try:
+            for attempt, body in pages:
+                changed = attempt != last_attempt
+                if changed:
+                    last_attempt = attempt
+                    if metrics is not None:
+                        metrics.counter(f"{metric_prefix}_restarts").inc()
+                if metrics is not None:
+                    metrics.counter(f"{metric_prefix}_pages").inc()
+                yield (
+                    (body.get("metadata") or {}).get("resourceVersion"),
+                    body.get("items", []),
+                    changed,
+                )
+        finally:
+            if metrics is not None:
+                metrics.histogram(f"{metric_prefix}_duration").record(time.monotonic() - t0)
+
     def list_nodes_paged(
         self,
         *,

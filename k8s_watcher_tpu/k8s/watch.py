@@ -193,41 +193,23 @@ class KubernetesWatchSource:
         vanished between them (re-ADDs of pods from the aborted attempt
         are harmless: downstream phase tracking dedupes, same as any
         relist)."""
-        import time as _time
-
-        # operational visibility for the paged relist: page counts say how
-        # big the cluster view is, durations what a relist costs the watch
-        # loop, restarts that the snapshot churned mid-LIST. Pages count
-        # AS FETCHED and duration records in finally — a relist that
-        # aborts (paging exhaustion raising K8sGoneError) is the most
-        # expensive kind and must not be invisible in its own cost metrics
-        t0 = _time.monotonic()
-        if self.metrics is not None:
-            self.metrics.counter("relists").inc()
         rv = None
         listed_uids: set = set()
-        last_attempt = 0
-        try:
-            for attempt, body in self.client.list_pods_paged(
+        for page_rv, items, restarted in K8sClient.iter_list_pages(
+            self.client.list_pods_paged(
                 self.namespace,
                 page_size=self.list_page_size,
                 label_selector=self.label_selector,
-            ):
-                if attempt != last_attempt:
-                    listed_uids.clear()
-                    last_attempt = attempt
-                    if self.metrics is not None:
-                        self.metrics.counter("relist_restarts").inc()
-                if self.metrics is not None:
-                    self.metrics.counter("relist_pages").inc()
-                rv = (body.get("metadata") or {}).get("resourceVersion") or rv
-                for pod in body.get("items", []):
-                    listed_uids.add((pod.get("metadata") or {}).get("uid"))
-                    self._track(EventType.ADDED, pod)
-                    yield WatchEvent(type=EventType.ADDED, pod=pod, resource_version=rv)
-        finally:
-            if self.metrics is not None:
-                self.metrics.histogram("relist_duration").record(_time.monotonic() - t0)
+            ),
+            metrics=self.metrics,
+        ):
+            if restarted:
+                listed_uids.clear()
+            rv = page_rv or rv
+            for pod in items:
+                listed_uids.add((pod.get("metadata") or {}).get("uid"))
+                self._track(EventType.ADDED, pod)
+                yield WatchEvent(type=EventType.ADDED, pod=pod, resource_version=rv)
         for uid in [u for u in self._known if u not in listed_uids]:
             tombstone = self._known.pop(uid)
             legacy = bool(tombstone.get("legacy_tombstone", False))

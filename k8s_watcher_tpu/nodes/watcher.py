@@ -121,33 +121,24 @@ class NodeWatcher:
         resets when an expired continue token restarts the list from a
         new snapshot — tombstones must come from ONE snapshot's view."""
         now = time.monotonic()
-        # same cost metrics as the pod relist, distinct names (the node
-        # plane relists on every 410/reconnect too); pages count as
-        # fetched and duration records in finally so an aborted relist
-        # stays visible
-        if self.metrics is not None:
-            self.metrics.counter("node_relists").inc()
         listed: set = set()
-        last_attempt = 0
         rv = None
-        try:
-            for attempt, body in self.client.list_nodes_paged(
+        # shared consumption driver (K8sClient.iter_list_pages): same
+        # snapshot-reset/cost-metric invariants as the pod relist, node-
+        # prefixed metric names
+        for page_rv, items, restarted in K8sClient.iter_list_pages(
+            self.client.list_nodes_paged(
                 page_size=self.list_page_size, label_selector=self.label_selector,
-            ):
-                if attempt != last_attempt:
-                    listed.clear()
-                    last_attempt = attempt
-                    if self.metrics is not None:
-                        self.metrics.counter("node_relist_restarts").inc()
-                if self.metrics is not None:
-                    self.metrics.counter("node_relist_pages").inc()
-                rv = (body.get("metadata") or {}).get("resourceVersion") or rv
-                for node in body.get("items", []):
-                    listed.add((node.get("metadata") or {}).get("name", ""))
-                    self._emit("ADDED", node, now)
-        finally:
-            if self.metrics is not None:
-                self.metrics.histogram("node_relist_duration").record(time.monotonic() - now)
+            ),
+            metrics=self.metrics,
+            metric_prefix="node_relist",
+        ):
+            if restarted:
+                listed.clear()
+            rv = page_rv or rv
+            for node in items:
+                listed.add((node.get("metadata") or {}).get("name", ""))
+                self._emit("ADDED", node, now)
         # nodes that vanished while we were disconnected
         for name in [n for n in self.tracker.known_nodes() if n not in listed]:
             self._emit("DELETED", {"metadata": {"name": name}}, now)

@@ -264,7 +264,8 @@ class NodeActuator:
                 self._drop_rate_slot_locked(consumed_ts)
             n_quarantined = len(self._quarantined)
         if self.metrics is not None and record.ok:
-            self.metrics.counter("remediation_actions").inc()
+            if not record.adopted:  # adoption wrote nothing — not an action
+                self.metrics.counter("remediation_actions").inc()
             self.metrics.gauge("remediation_quarantined_nodes").set(n_quarantined)
         return record
 
@@ -363,9 +364,16 @@ class NodeActuator:
                 self._quarantined.discard(node)
                 if record.adopted:
                     # no-op release (nothing to untaint or uncordon) wrote
-                    # nothing: refund the hourly rate slot, mirroring the
-                    # quarantine adoption path
-                    self._drop_rate_slot_locked(consumed_ts)
+                    # nothing: refund the FULL consume — rate slot AND the
+                    # per-node last-action stamp. Unlike quarantine
+                    # adoption (where the kept cooldown stops the policy
+                    # re-GETting a genuinely-quarantined node every
+                    # cycle), a kept stamp here would make _fence_check
+                    # refuse a REAL quarantine of this node for
+                    # cooldown_seconds after an operator's harmless no-op
+                    # release — locking a confirmed-faulty node in service
+                    # over a write that never happened.
+                    self._refund_locked(node, prior_last_action, consumed_ts)
             else:
                 self._refund_locked(node, prior_last_action, consumed_ts)
             n_quarantined = len(self._quarantined)
@@ -473,8 +481,16 @@ class NodeActuator:
                     ):
                         adopted.append(name)
         except K8sApiError as exc:
-            logger.warning("Could not adopt pre-existing quarantines: %s", exc)
-            return []
+            # keep the PARTIAL set: names already scanned are genuinely
+            # quarantined, and discarding them would let the budget permit
+            # a full complement of NEW cordons on top of unseen existing
+            # ones — the exact cross-restart overrun adoption exists to
+            # prevent. Under-counting is the only unsafe direction here.
+            logger.warning(
+                "Quarantine adoption scan failed mid-pagination (%s); adopting "
+                "the %d node(s) scanned so far (the budget reconcile path "
+                "adopts stragglers lazily on re-confirmation)", exc, len(adopted),
+            )
         adopted = sorted(set(adopted))
         if adopted:
             logger.info("Adopting pre-existing quarantines into the budget: %s", adopted)

@@ -344,6 +344,65 @@ class TestActuator:
             t["key"] == TAINT_KEY for t in node["spec"].get("taints") or []
         )
 
+    def test_noop_release_does_not_consume_the_quarantine_cooldown(self, mock_api):
+        """A nothing-to-do release wrote nothing, so it must not charge
+        the per-node cooldown that gates QUARANTINE — an operator's
+        harmless no-op release would otherwise lock a subsequently
+        CONFIRMED-faulty node in service for cooldown_seconds."""
+        clock = FakeClock()
+        actuator = make_actuator(mock_api, cooldown_seconds=3600.0, clock=clock)
+        record = actuator.release("tpu-node-0", "operator cleanup")
+        assert record.ok and record.adopted  # nothing to release
+        clock.now += 5.0  # well inside the cooldown window
+        confirmed = actuator.quarantine("tpu-node-0", "probe confirmed fault")
+        assert confirmed.ok and confirmed.applied, confirmed.reason
+
+    def test_adoption_scan_failure_keeps_partial_set(self, mock_api):
+        """A mid-pagination failure of the adoption scan must keep the
+        names already scanned: discarding them would let the budget
+        permit a full complement of NEW cordons on top of unseen existing
+        quarantines — the exact overrun adoption exists to prevent."""
+        from k8s_watcher_tpu.remediate import NodeActuator
+
+        # node in page 1 carries our taint; the scan fails before page 2
+        make_actuator(mock_api).quarantine("tpu-node-0", "pre-existing")
+        actuator = NodeActuator(
+            make_client(mock_api), dry_run=False, cooldown_seconds=0.0,
+            max_quarantined_nodes=1, max_actions_per_hour=100,
+        )
+        actuator._ADOPT_PAGE_SIZE = 2
+        mock_api.cluster.fail_next(0)  # ensure clean first page
+        # fail the SECOND page of the scan (page 1 succeeds first)
+        real_list = actuator.client.list_nodes
+        calls = {"n": 0}
+
+        def flaky_list(**kw):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                from k8s_watcher_tpu.k8s.client import K8sApiError
+
+                raise K8sApiError("injected blip")
+            return real_list(**kw)
+
+        actuator.client.list_nodes = flaky_list
+        adopted = actuator.adopt_existing()
+        assert adopted == ["tpu-node-0"]  # partial set kept, not discarded
+        # the budget reflects it: a second quarantine is refused
+        blocked = actuator.quarantine("tpu-node-1", "x")
+        assert not blocked.ok and "budget" in blocked.reason
+
+    def test_adopted_quarantine_is_not_counted_as_an_action(self, mock_api):
+        """Adoption writes nothing — remediation_actions must mean writes
+        on BOTH paths (release already excludes adopted no-ops)."""
+        metrics = MetricsRegistry()
+        make_actuator(mock_api).quarantine("tpu-node-0", "first")
+        fresh = make_actuator(mock_api, metrics=metrics)
+        record = fresh.quarantine("tpu-node-0", "re-confirm")
+        assert record.ok and record.adopted
+        assert metrics.counter("remediation_actions").value == 0
+        # the gauge still tracks the set
+        assert metrics.gauge("remediation_quarantined_nodes").value == 1
+
     def test_refund_removes_this_calls_rate_slot(self, mock_api):
         """_refund_locked must remove the exact timestamp this call
         consumed, not whatever happens to be newest — popping the tail
