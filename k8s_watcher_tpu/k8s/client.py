@@ -37,7 +37,14 @@ class K8sApiError(Exception):
 
 
 class K8sGoneError(K8sApiError):
-    """resourceVersion too old (HTTP 410) — caller must relist."""
+    """resourceVersion too old (HTTP 410) — caller must relist.
+
+    ``token_expiry`` is True only when a paged LIST exhausted its restarts
+    on expired continue tokens; a 410 from a watch or from the FIRST page
+    of a list attempt (anomalous — no token was in play) leaves it False,
+    so callers' log lines don't misattribute the failure."""
+
+    token_expiry: bool = False
 
 
 class K8sConflictError(K8sApiError):
@@ -248,11 +255,17 @@ class K8sClient:
                     token = (page.get("metadata") or {}).get("continue")
                     if not token:
                         return
-            except K8sGoneError:
+            except K8sGoneError as exc:
                 if token is None:
-                    raise  # the FIRST page 410'd: not an expired token
+                    # the FIRST page 410'd: no continue token was in play,
+                    # so this is not token expiry (even on attempt > 0,
+                    # where restarts may well remain — a fresh unpaged LIST
+                    # 410ing needs operator eyes, not another restart)
+                    exc.token_expiry = False
+                    raise
                 attempt += 1
                 if attempt > max_restarts:
+                    exc.token_expiry = True
                     raise
                 logger.warning(
                     "LIST continue token expired (410) mid-pagination; "

@@ -472,6 +472,11 @@ def _parse_lease_path(path: str) -> Optional[Tuple[str, Optional[str]]]:
     return None
 
 
+# sentinel distinguishing "limit was malformed, 400 already sent" from a
+# legitimately absent limit (None)
+_BAD_LIMIT = object()
+
+
 class _Handler(BaseHTTPRequestHandler):
     # HTTP/1.1 with Transfer-Encoding: chunked on the watch stream — the
     # real kube-apiserver's framing, which is also what lets clients see
@@ -493,6 +498,18 @@ class _Handler(BaseHTTPRequestHandler):
                 {"Authorization": self.headers.get("Authorization"), "path": self.path}
             )
         return ok
+
+    def _parse_limit(self, params: Dict[str, str]):
+        """``limit`` as int, None when absent, or ``_BAD_LIMIT`` after
+        responding 400 — a non-integer limit gets the same Status body a
+        malformed continue token does, not a 500 traceback."""
+        if "limit" not in params:
+            return None
+        try:
+            return int(params["limit"])
+        except ValueError:
+            self._json(400, {"kind": "Status", "code": 400, "message": "malformed limit"})
+            return _BAD_LIMIT
 
     def _json(self, status: int, body: Dict[str, Any]) -> None:
         data = json.dumps(body).encode()
@@ -537,7 +554,9 @@ class _Handler(BaseHTTPRequestHandler):
             if params.get("watch") == "true":
                 self._serve_watch(None, params, collection="nodes")
             else:
-                limit = int(params["limit"]) if "limit" in params else None
+                limit = self._parse_limit(params)
+                if limit is _BAD_LIMIT:
+                    return
                 status, body = self.cluster.list_nodes(
                     params.get("labelSelector"), limit, params.get("continue")
                 )
@@ -564,7 +583,9 @@ class _Handler(BaseHTTPRequestHandler):
         if params.get("watch") == "true":
             self._serve_watch(namespace, params)
         else:
-            limit = int(params["limit"]) if "limit" in params else None
+            limit = self._parse_limit(params)
+            if limit is _BAD_LIMIT:
+                return
             status, body = self.cluster.list_pods(
                 namespace, limit, params.get("labelSelector"), params.get("continue")
             )

@@ -281,7 +281,8 @@ class KubernetesWatchSource:
                         return
                     what = (
                         "Paged LIST (continue tokens kept expiring)"
-                        if isinstance(exc, K8sGoneError) else "LIST"
+                        if getattr(exc, "token_expiry", False)
+                        else "LIST"
                     )
                     if backoff_or_raise(exc, what):
                         return

@@ -171,7 +171,12 @@ class NodeWatcher:
                         # would relist IMMEDIATELY in a tight loop — back
                         # off like any other error instead
                         logger.warning(
-                            "Node LIST kept expiring (%s); backing off %.1fs", exc, backoff
+                            "Node LIST failed (%s%s); backing off %.1fs",
+                            "continue tokens kept expiring: "
+                            if getattr(exc, "token_expiry", False)
+                            else "",
+                            exc,
+                            backoff,
                         )
                         if self._stop.wait(backoff):
                             return

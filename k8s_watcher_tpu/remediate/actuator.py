@@ -37,7 +37,12 @@ import time
 from typing import Any, Deque, Dict, List, Optional
 
 from k8s_watcher_tpu.config.schema import VALID_TAINT_EFFECTS
-from k8s_watcher_tpu.k8s.client import K8sApiError, K8sConflictError, K8sNotFoundError
+from k8s_watcher_tpu.k8s.client import (
+    K8sApiError,
+    K8sClient,
+    K8sConflictError,
+    K8sNotFoundError,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -467,13 +472,21 @@ class NodeActuator:
             return []
         adopted = []
         try:
-            # paged scan (limit+continue): only taint-carrying names are
-            # kept, so memory stays one page even on multi-thousand-node
-            # pools. A mid-scan snapshot restart (attempt bump) resets
-            # nothing — the union across attempts over-adopts at worst,
-            # and over-adoption only makes the budget more conservative.
-            for _attempt, body in self.client.list_nodes_paged(page_size=self._ADOPT_PAGE_SIZE):
-                for node in body.get("items", []):
+            # paged scan (limit+continue) through the shared consumption
+            # driver, so the adoption scan's cost (pages/restarts/duration)
+            # lands in metrics under its own prefix — a slow or
+            # restart-looping startup scan must be visible. Only
+            # taint-carrying names are kept, so memory stays one page even
+            # on multi-thousand-node pools. A mid-scan snapshot restart
+            # (attempt_changed) resets nothing — the union across attempts
+            # over-adopts at worst, and over-adoption only makes the
+            # budget more conservative.
+            for _rv, items, _attempt_changed in K8sClient.iter_list_pages(
+                self.client.list_nodes_paged(page_size=self._ADOPT_PAGE_SIZE),
+                metrics=self.metrics,
+                metric_prefix="adopt_scan",
+            ):
+                for node in items:
                     name = (node.get("metadata") or {}).get("name", "")
                     if name and any(
                         t.get("key") == self.taint_key

@@ -1,0 +1,46 @@
+"""Pin the driver-entry mesh factorization.
+
+VERDICT r4 #2: the driver's dryrun_multichip(8) artifact must exercise a
+real cross-host axis — a widest-chips factorization ran mesh=(1x8) and the
+"dp" psum axis had size 1 in the evidence meant to prove multi-chip
+correctness. The balanced factorization makes both axes real whenever the
+device count is composite.
+"""
+
+import importlib.util
+import os
+
+import pytest
+
+_SPEC = importlib.util.spec_from_file_location(
+    "graft_entry",
+    os.path.join(os.path.dirname(__file__), "..", "__graft_entry__.py"),
+)
+graft_entry = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(graft_entry)
+
+
+@pytest.mark.parametrize(
+    "n,expected",
+    [
+        (8, (2, 4)),  # the driver's dryrun shape: both probe axes real
+        (128, (8, 16)),  # the check-scale shape
+        (4, (2, 2)),
+        (6, (2, 3)),
+        (2, (1, 2)),  # minimum composite: hosts axis unavoidably 1
+        (7, (1, 7)),  # prime: no balanced split exists
+        (1, (1, 1)),
+    ],
+)
+def test_factor_mesh_balanced(n, expected):
+    assert graft_entry.factor_mesh(n) == expected
+
+
+@pytest.mark.parametrize("n", range(1, 130))
+def test_factor_mesh_invariants(n):
+    hosts, chips = graft_entry.factor_mesh(n)
+    assert hosts * chips == n
+    assert chips >= hosts  # chips stays the wider (MXU-facing) axis
+    # both axes real whenever any balanced split exists
+    if any(1 < d < n and n % d == 0 for d in range(2, n)):
+        assert hosts > 1, f"composite {n} degenerated to (1, {chips})"

@@ -549,8 +549,51 @@ class TestListPagination:
             mock_api.cluster.compact()
 
         client.after_page = always_expire
-        with pytest.raises(K8sGoneError):
+        with pytest.raises(K8sGoneError) as exc_info:
             list(client.list_pods_paged(page_size=10, max_restarts=2))
+        # restarts exhausted on expired tokens: the error says so, so the
+        # watch-loop log line attributes the failure correctly
+        assert exc_info.value.token_expiry
+
+    def test_first_page_410_is_not_token_expiry(self, mock_api):
+        """A 410 on the FIRST page of an attempt (no continue token in
+        play) must not be labelled token expiry — even on a restarted
+        attempt with restarts remaining (ADVICE r4)."""
+        for i in range(15):
+            mock_api.cluster.add_pod(build_pod(f"p{i:03d}"))
+        client = CountingClient(mock_api)
+
+        def gone_twice(pages_so_far):
+            if pages_so_far == 1:
+                # 410 the page-2 fetch (token in play -> restart), then
+                # 410 the restarted attempt's FIRST page (no token) too
+                mock_api.cluster.fail_next(n=2, status=410)
+
+        client.after_page = gone_twice
+        with pytest.raises(K8sGoneError) as exc_info:
+            list(client.list_pods_paged(page_size=10, max_restarts=5))
+        assert not exc_info.value.token_expiry
+
+    def test_watch_410_is_not_token_expiry(self, mock_api):
+        mock_api.cluster.add_pod(build_pod("p0"))
+        mock_api.cluster.compact()
+        client = make_client(mock_api)
+        with pytest.raises(K8sGoneError) as exc_info:
+            list(client.watch_pods(resource_version="0", timeout_seconds=1))
+        assert not exc_info.value.token_expiry
+
+    def test_malformed_limit_rejected_with_400(self, mock_api):
+        """Non-integer ``limit`` gets the same 400 Status a malformed
+        continue token does, on both collections (ADVICE r4) — not an
+        unhandled 500 traceback."""
+        mock_api.cluster.add_pod(build_pod("p0"))
+        client = make_client(mock_api)
+        for path in ("/api/v1/pods", "/api/v1/nodes"):
+            with pytest.raises(K8sApiError) as exc_info:
+                client._request("GET", path, params={"limit": "abc"})
+            assert exc_info.value.status == 400, path
+            assert not isinstance(exc_info.value, K8sGoneError)
+            assert "malformed limit" in str(exc_info.value)
 
 
 class TestKubernetesWatchSource:

@@ -403,6 +403,19 @@ class TestActuator:
         # the gauge still tracks the set
         assert metrics.gauge("remediation_quarantined_nodes").value == 1
 
+    def test_adoption_scan_records_cost_metrics(self, mock_api):
+        """The startup adoption scan goes through the shared page-
+        consumption driver, so its cost (scans/pages/duration) is visible
+        under its own prefix (ADVICE r4) — a slow or restart-looping
+        adoption scan must not be invisible in metrics."""
+        metrics = MetricsRegistry()
+        make_actuator(mock_api).quarantine("tpu-node-0", "pre-existing")
+        fresh = make_actuator(mock_api, metrics=metrics)
+        assert fresh.adopt_existing() == ["tpu-node-0"]
+        assert metrics.counter("adopt_scans").value == 1
+        assert metrics.counter("adopt_scan_pages").value >= 1
+        assert metrics.histogram("adopt_scan_duration").count == 1
+
     def test_refund_removes_this_calls_rate_slot(self, mock_api):
         """_refund_locked must remove the exact timestamp this call
         consumed, not whatever happens to be newest — popping the tail
