@@ -624,13 +624,98 @@ def _virtual_probes_child(n_devices: int) -> int:
     return 0
 
 
-def bench_probe() -> dict:
+def bench_probe(*, timeout_s: float = 300.0, retries: int = 1, backoff_s: float = 20.0) -> dict:
+    """Real-accelerator probe in a BOUNDED-TIME subprocess.
+
+    The round-4 outage proved backend init can *hang*, not just fail
+    (``jax.devices()`` on the tunneled backend sat >9 min without
+    returning) — run in-process, that hang takes the whole bench with it
+    and the round ships no artifact at all. The child gets ``timeout_s``
+    per attempt, one retry after ``backoff_s`` (tunnel blips recover),
+    and a final failure comes back CLASSIFIED (``skip_reason``:
+    backend_hang / backend_unavailable / probe_error) so the headline
+    explains itself instead of burying the cause in a detail file."""
+    import os
+    import subprocess
+    import time as _time
+
+    attempts: list = []
+    for attempt in range(1 + retries):
+        if attempt:
+            _time.sleep(backoff_s)
+        env = dict(os.environ)
+        # '' = auto-detect, so the tunnel plugin self-registers (the
+        # session default JAX_PLATFORMS=axon is NOT a registered backend
+        # name and fails); PYTHONPATH=<repo> must not leak in — the
+        # tunnel runtime's helper process would import the repo's
+        # ``config/`` as a shadow module, libtpu init fails, and JAX
+        # silently falls back to CPU with garbage "probe" numbers.
+        env["JAX_PLATFORMS"] = ""
+        env.pop("PYTHONPATH", None)
+        here = os.path.dirname(os.path.abspath(__file__))
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--real-probe"],
+                capture_output=True, text=True, timeout=timeout_s, env=env, cwd=here,
+            )
+        except subprocess.TimeoutExpired:
+            attempts.append(f"attempt {attempt + 1}: no result in {timeout_s:.0f}s (backend init hang?)")
+            continue
+        except Exception as exc:  # spawn failure — nothing to retry differently
+            attempts.append(f"attempt {attempt + 1}: spawn failed: {exc}")
+            continue
+        if proc.returncode != 0:
+            attempts.append(
+                f"attempt {attempt + 1}: rc={proc.returncode}: {(proc.stderr or '')[-300:].strip()}"
+            )
+            continue
+        try:
+            out = json.loads(proc.stdout.strip().splitlines()[-1])
+        except Exception as exc:
+            attempts.append(f"attempt {attempt + 1}: unparseable child output ({exc})")
+            continue
+        if out.get("error"):
+            attempts.append(f"attempt {attempt + 1}: {out['error']}")
+            continue
+        out["attempts"] = attempts + [f"attempt {attempt + 1}: ok"]
+        return out
+
+    joined = "; ".join(attempts)
+    if "hang" in joined:
+        kind = "backend_hang"
+    elif "UNAVAILABLE" in joined or "Unable to initialize backend" in joined:
+        kind = "backend_unavailable"
+    elif "no accelerator" in joined:
+        kind = "no_accelerator"
+    else:
+        kind = "probe_error"
+    # skip_reason is the machine-readable headline field; keep it short
+    # enough that the headline stays inside the driver's 1 KB tail window
+    first = attempts[0] if attempts else "no attempts"
+    return {
+        "error": joined,
+        "skip_reason": f"{kind}: {first[:120]}",
+    }
+
+
+def _real_probe_child() -> dict:
+    """Runs in the bounded subprocess: MXU + HBM + single/real-device ICI."""
     try:
         import jax
 
         from k8s_watcher_tpu.probe.ici import run_ici_probe, run_mxu_probe
 
         devices = jax.devices()
+        if devices[0].platform == "cpu":
+            # auto-detect fell back to the host CPU (tunnel down, or the
+            # accelerator runtime failed init). "Probing" the CPU would
+            # return probe_ok:true with garbage TFLOP/s — the exact
+            # silent-fallback failure the env notes warn about; the CPU
+            # collective path is covered honestly by bench_virtual_probes
+            return {
+                "error": "no accelerator: JAX auto-detect fell back to cpu "
+                         "(tunnel down or accelerator runtime init failed)"
+            }
         # inner chains amortize per-dispatch overhead (large under the
         # remote-tunnel dev setup) out of the per-op measurements
         from k8s_watcher_tpu.probe.hbm import run_hbm_probe, run_hbm_write_probe
@@ -672,6 +757,33 @@ def bench_probe() -> dict:
         }
     except Exception as exc:  # bench must still report the watcher numbers
         return {"error": str(exc)}
+
+
+def _last_good_probe() -> dict | None:
+    """Most recent prior round whose headline carried real MXU/HBM numbers
+    — the comparison anchor the headline cites when THIS round's probe is
+    skipped (an outage round must still say what normal looks like)."""
+    import glob
+    import os
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    for path in sorted(glob.glob(os.path.join(here, "BENCH_r*.json")), reverse=True):
+        try:
+            with open(path) as f:
+                parsed = json.load(f).get("parsed") or {}
+        except Exception:
+            continue
+        # r04+ headlines carry the numbers at top level; r01-r02 predate
+        # the compact headline and nest them under details.probe
+        for block in (parsed, (parsed.get("details") or {}).get("probe") or {}):
+            if block.get("mxu_tflops"):
+                return {
+                    "round": os.path.basename(path)[len("BENCH_"):-len(".json")],
+                    "mxu_tflops": block.get("mxu_tflops"),
+                    "hbm_read_gbps": block.get("hbm_read_gbps"),
+                    "hbm_write_gbps": block.get("hbm_write_gbps"),
+                }
+    return None
 
 
 def main() -> int:
@@ -743,6 +855,14 @@ def main() -> int:
         "dcn_pairs": virtual_stats.get("dcn_pair_count"),
         "detail_file": "artifacts/bench_full.json",
     }
+    if probe_stats.get("skip_reason"):
+        # outage round: the headline itself says WHY the hardware numbers
+        # are null (r04's probe_ok:false was undiagnosable from the
+        # headline) and what the last good round measured
+        headline["probe_skip_reason"] = probe_stats["skip_reason"]
+        last_good = _last_good_probe()
+        if last_good:
+            headline["last_good_probe"] = last_good
     line = json.dumps(headline)
     # NEVER crash after the measurements: print the line first, warn on
     # stderr if it outgrew the tail-capture budget (an assert here would
@@ -757,4 +877,7 @@ if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--virtual-probes":
         n = int(sys.argv[2]) if len(sys.argv) > 2 else 8
         sys.exit(_virtual_probes_child(n))
+    if len(sys.argv) > 1 and sys.argv[1] == "--real-probe":
+        print(json.dumps(_real_probe_child()))
+        sys.exit(0)
     sys.exit(main())

@@ -14,6 +14,11 @@ Watch semantics follow the Kubernetes API contract: events resume from
 ``resourceVersion``, bookmarks are requested so resume versions stay fresh,
 and a 410 Gone (either as HTTP status or as an in-stream ERROR event)
 raises ``K8sGoneError`` so the caller can relist.
+
+HTTP(S)_PROXY/NO_PROXY are honored via requests' default ``trust_env``
+(tests/test_proxy.py proves the LIST and the streamed WATCH both traverse
+a forward proxy); the notify plane's hand-rolled client supplies the same
+contract itself (notify/client.py:proxy_for).
 """
 
 from __future__ import annotations

@@ -22,10 +22,17 @@ notify plane, not the watch stream, is the throughput ceiling. Payloads
 are idempotent state snapshots, so a request that dies on a *reused*
 keep-alive connection (server idled it out) is transparently resent once
 on a fresh connection before the configured retry policy is consulted.
+
+``HTTP_PROXY``/``HTTPS_PROXY``/``NO_PROXY`` are honored (``proxy_for``)
+— the reference got this implicitly from requests; a corp-egress cluster
+fronts the notify target with a proxy and a proxy-blind client would
+hard-fail there. (The k8s API client, k8s/client.py, rides requests and
+keeps the same behavior via its default trust_env.)
 """
 
 from __future__ import annotations
 
+import base64
 import http.client
 import json
 import logging
@@ -33,11 +40,63 @@ import socket
 import ssl
 import threading
 from typing import Any, Dict, Optional, Tuple
-from urllib.parse import urlsplit
+from urllib.parse import unquote, urlsplit
 
 from k8s_watcher_tpu.config.schema import RetryPolicy
 
 logger = logging.getLogger(__name__)
+
+
+def proxy_for(
+    scheme: str, host: str, port: Optional[int] = None
+) -> Optional[Tuple[str, int, Optional[str]]]:
+    """``(proxy_host, proxy_port, proxy_basic_auth)`` for requests from
+    this origin, or None for a direct connection.
+
+    Parity with the reference's implicit behavior: its requests.Session
+    (clusterapi_client.py:10) honors ``HTTP_PROXY``/``HTTPS_PROXY``/
+    ``NO_PROXY`` out of the box, so in a corp-egress cluster the reference
+    notifier works where a proxy-blind client hard-fails. The hand-rolled
+    ``http.client`` hot path must supply the same contract itself:
+    ``urllib.request.getproxies()`` reads the env vars (both cases) and
+    ``proxy_bypass`` applies NO_PROXY. The proxy itself is reached over
+    plain HTTP (the near-universal deployment; for TLS origins the payload
+    still rides an end-to-end CONNECT tunnel, so the proxy sees only the
+    origin's host:port). Credentials in the proxy URL become a
+    Proxy-Authorization: Basic header."""
+    import urllib.request
+
+    try:
+        # requests matches NO_PROXY entries against host AND host:port;
+        # urllib's proxy_bypass only sees what we pass it, so ask both ways
+        if urllib.request.proxy_bypass(host) or (
+            port is not None and urllib.request.proxy_bypass(f"{host}:{port}")
+        ):
+            return None
+    except Exception:  # resolver hiccups in bypass lookups must not kill sends
+        pass
+    url = urllib.request.getproxies().get(scheme)
+    if not url:
+        return None
+    parts = urlsplit(url if "://" in url else f"http://{url}")
+    if not parts.hostname:
+        logger.warning("Ignoring malformed %s proxy URL %r", scheme.upper(), url)
+        return None
+    if parts.scheme == "https":
+        # a TLS-fronted proxy needs TLS-to-the-proxy (and TLS-in-TLS for
+        # https origins), which http.client cannot express — speaking
+        # plaintext to a TLS listener would stall every send until
+        # timeout. Fail open to a direct connection, loudly.
+        logger.warning(
+            "TLS proxies are not supported (%s=%r); connecting directly",
+            scheme.upper() + "_PROXY", url,
+        )
+        return None
+    auth = None
+    if parts.username:
+        raw = f"{unquote(parts.username)}:{unquote(parts.password or '')}"
+        auth = "Basic " + base64.b64encode(raw.encode("utf-8")).decode("ascii")
+    return parts.hostname, parts.port or 80, auth
 
 
 class ClusterApiClient:
@@ -75,6 +134,14 @@ class ClusterApiClient:
         self._headers = {"Content-Type": "application/json", "Connection": "keep-alive"}
         if self.api_key:
             self._headers["Authorization"] = f"Bearer {self.api_key}"
+        # resolved once at construction, like requests resolves per-session
+        # defaults: a watcher's notify target does not move at runtime
+        self._proxy = proxy_for(self._scheme, self._host, self._port)
+        if self._proxy:
+            logger.info(
+                "clusterapi requests will use %s proxy %s:%d",
+                self._scheme.upper(), self._proxy[0], self._proxy[1],
+            )
         self._local = threading.local()
         # shutdown support: abort() must be able to cut sends owned by
         # OTHER threads (threading.local hides them), so every live
@@ -100,6 +167,43 @@ class ClusterApiClient:
 
     # -- connection management (per dispatcher-worker thread) ---------------
 
+    def _new_connection(self, timeout: float) -> http.client.HTTPConnection:
+        """Fresh connection honoring the resolved proxy: direct, absolute-URI
+        forward proxy (plain http), or CONNECT tunnel (https — the proxy
+        relays bytes; TLS stays end-to-end with the origin)."""
+        if self._proxy is None:
+            if self._scheme == "https":
+                return http.client.HTTPSConnection(
+                    self._host, self._port, timeout=timeout, context=self._ssl_context
+                )
+            return http.client.HTTPConnection(self._host, self._port, timeout=timeout)
+        proxy_host, proxy_port, proxy_auth = self._proxy
+        if self._scheme == "https":
+            conn = http.client.HTTPSConnection(
+                proxy_host, proxy_port, timeout=timeout, context=self._ssl_context
+            )
+            conn.set_tunnel(
+                self._host, self._port,
+                headers={"Proxy-Authorization": proxy_auth} if proxy_auth else None,
+            )
+            return conn
+        return http.client.HTTPConnection(proxy_host, proxy_port, timeout=timeout)
+
+    def _request_target(self, path: str) -> str:
+        """Request target: origin-form normally, absolute-form when a plain
+        http request rides a forward proxy (RFC 9112 §3.2.2)."""
+        rel = f"{self._path_prefix}{path}" or "/"
+        if self._proxy is not None and self._scheme == "http":
+            return f"http://{self._host}:{self._port}{rel}"
+        return rel
+
+    def _request_headers(self) -> Dict[str, str]:
+        if self._proxy is not None and self._scheme == "http" and self._proxy[2]:
+            # https carries credentials on the CONNECT instead; adding them
+            # here would leak them to the origin server
+            return {**self._headers, "Proxy-Authorization": self._proxy[2]}
+        return self._headers
+
     def _connection(self) -> Tuple[http.client.HTTPConnection, bool]:
         """This thread's persistent connection, and whether it is fresh
         (fresh = no request has succeeded on it yet)."""
@@ -111,12 +215,7 @@ class ClusterApiClient:
         conn = getattr(self._local, "conn", None)
         if conn is not None:
             return conn, getattr(self._local, "fresh", True)
-        if self._scheme == "https":
-            conn = http.client.HTTPSConnection(
-                self._host, self._port, timeout=self.timeout, context=self._ssl_context
-            )
-        else:
-            conn = http.client.HTTPConnection(self._host, self._port, timeout=self.timeout)
+        conn = self._new_connection(self.timeout)
         self._local.conn = conn
         self._local.fresh = True
         with self._conns_lock:
@@ -159,11 +258,12 @@ class ClusterApiClient:
         """One request on the persistent connection; transparently resends
         once on a fresh connection when a *reused* keep-alive connection was
         idle-closed by the server (payloads are idempotent snapshots)."""
-        full_path = f"{self._path_prefix}{path}" or "/"
+        full_path = self._request_target(path)
+        headers = self._request_headers()
         for _ in range(2):
             conn, fresh = self._connection()
             try:
-                conn.request(method, full_path, body=body, headers=self._headers)
+                conn.request(method, full_path, body=body, headers=headers)
                 response = conn.getresponse()
                 data = response.read()  # drain so the connection is reusable
                 self._local.fresh = False
@@ -224,15 +324,10 @@ class ClusterApiClient:
         """GET the health endpoint; True iff 200 (parity: 5 s timeout)."""
         try:
             # parity with the reference's fixed 5 s health timeout
-            if self._scheme == "https":
-                conn = http.client.HTTPSConnection(
-                    self._host, self._port, timeout=5, context=self._ssl_context
-                )
-            else:
-                conn = http.client.HTTPConnection(self._host, self._port, timeout=5)
+            conn = self._new_connection(5)
             try:
-                conn.request("GET", f"{self._path_prefix}{self.health_endpoint}" or "/",
-                             headers=self._headers)
+                conn.request("GET", self._request_target(self.health_endpoint),
+                             headers=self._request_headers())
                 return conn.getresponse().status == 200
             finally:
                 conn.close()
