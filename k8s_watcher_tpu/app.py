@@ -194,6 +194,7 @@ class WatcherApp:
                     self._probe_agent.recent_cycles
                     if self._probe_agent is not None else None
                 ),
+                auth_token=self.config.watcher.status_auth_token,
             ).start()
             routes = "/metrics, /healthz, /debug/slices" + (
                 ", /debug/events" if self.audit is not None else ""

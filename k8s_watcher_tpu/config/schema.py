@@ -216,6 +216,11 @@ class WatcherConfig:
     critical_events_only: bool = False
     # net-new observability + server-side filtering
     status_port: int = 0  # 0 = no /metrics//healthz endpoint
+    # Bearer token required on every status route except /healthz; None
+    # leaves the plane open (in-cluster behind NetworkPolicy — RUNBOOK
+    # "Status-server threat model"). Inject via ${WATCHER_STATUS_TOKEN}
+    # interpolation rather than a literal in a committed file.
+    status_auth_token: Optional[str] = None
     liveness_stale_seconds: float = 900.0
     label_selector: Optional[str] = None  # k8s labelSelector pushed to the API server
     leader_election: LeaderElectionConfig = dataclasses.field(default_factory=LeaderElectionConfig)
@@ -231,7 +236,8 @@ class WatcherConfig:
         _check_known(
             raw,
             ("watch_interval", "log_level", "namespaces", "retry", "alerts",
-             "status_port", "liveness_stale_seconds", "label_selector", "leader_election",
+             "status_port", "status_auth_token", "liveness_stale_seconds",
+             "label_selector", "leader_election",
              "audit_ring_size", "list_page_size"),
             "watcher",
         )
@@ -258,6 +264,7 @@ class WatcherConfig:
             retry=RetryPolicy.from_raw(raw.get("retry") or {}, "watcher.retry", delay_default=5.0),
             critical_events_only=_opt_bool(alerts, "critical_events_only", "watcher.alerts", False),
             status_port=_opt_int(raw, "status_port", "watcher", 0),
+            status_auth_token=_opt_str(raw, "status_auth_token", "watcher", None) or None,
             liveness_stale_seconds=_opt_num(raw, "liveness_stale_seconds", "watcher", 900.0),
             label_selector=_opt_str(raw, "label_selector", "watcher", None),
             leader_election=LeaderElectionConfig.from_raw(raw.get("leader_election") or {}),
@@ -375,6 +382,9 @@ class TpuConfig:
     # /debug/trend. 0 = off. The watcher's in-process agent shares the
     # watcher's watcher.status_port server instead.
     probe_status_port: int = 0
+    # bearer token for the agent's status plane — same contract as
+    # watcher.status_auth_token (RUNBOOK "Status-server threat model")
+    probe_status_auth_token: Optional[str] = None
     probe_payload_bytes: int = 4 * 1024 * 1024
     probe_rtt_warn_ms: float = 50.0
     probe_matmul_size: int = 1024
@@ -490,7 +500,7 @@ class TpuConfig:
         _expect(probe, (dict,), "tpu.probe")
         _check_known(
             probe,
-            ("enabled", "interval_seconds", "status_port", "payload_bytes", "rtt_warn_ms", "matmul_size",
+            ("enabled", "interval_seconds", "status_port", "status_auth_token", "payload_bytes", "rtt_warn_ms", "matmul_size",
              "matmul_inner_iters",
              "hbm_bytes", "hbm_write_enabled", "expected_chips_per_host", "links_enabled",
              "link_rtt_factor", "link_rtt_floor_ms", "multislice_enabled",
@@ -539,6 +549,7 @@ class TpuConfig:
             probe_enabled=_opt_bool(probe, "enabled", "tpu.probe", False),
             probe_interval_seconds=_opt_num(probe, "interval_seconds", "tpu.probe", 30.0),
             probe_status_port=_opt_int(probe, "status_port", "tpu.probe", 0),
+            probe_status_auth_token=_opt_str(probe, "status_auth_token", "tpu.probe", None) or None,
             probe_payload_bytes=_opt_int(probe, "payload_bytes", "tpu.probe", 4 * 1024 * 1024),
             probe_rtt_warn_ms=_opt_num(probe, "rtt_warn_ms", "tpu.probe", 50.0),
             probe_matmul_size=_opt_int(probe, "matmul_size", "tpu.probe", 1024),

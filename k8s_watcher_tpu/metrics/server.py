@@ -11,6 +11,7 @@ restarted by its liveness probe instead of silently going blind.
 
 from __future__ import annotations
 
+import hmac
 import json
 import threading
 import time
@@ -76,9 +77,27 @@ class _StatusHandler(BaseHTTPRequestHandler):
     remediation = None
     # Callable[[int], list]: last-N probe cycle summaries (flight recorder)
     probes = None
+    # Optional bearer token; when set, every route except /healthz requires
+    # ``Authorization: Bearer <token>``. /healthz stays open so kubelet
+    # liveness probes keep working without httpGet header plumbing — it
+    # leaks only aliveness + heartbeat age, never node or pod state.
+    auth_token: Optional[str] = None
 
     def log_message(self, *a):
         pass
+
+    def _authorized(self, path: str) -> bool:
+        if self.auth_token is None or path == "/healthz":
+            return True
+        header = self.headers.get("Authorization", "")
+        scheme, _, presented = header.partition(" ")
+        # compare bytes: compare_digest raises TypeError on non-ASCII str
+        # (http.server decodes headers as latin-1), which would drop the
+        # connection with a traceback instead of answering 401
+        return scheme == "Bearer" and hmac.compare_digest(
+            presented.strip().encode("utf-8", "surrogateescape"),
+            self.auth_token.encode("utf-8"),
+        )
 
     def _text(self, status: int, body: str) -> None:
         data = body.encode()
@@ -98,6 +117,12 @@ class _StatusHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):  # noqa: N802
         parsed = urlparse(self.path)
+        if not self._authorized(parsed.path):
+            self.send_response(401)
+            self.send_header("WWW-Authenticate", "Bearer")
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
         if parsed.path == "/metrics":
             # JSON by default (human/driver-facing); Prometheus text when a
             # scraper asks for it (Accept header) or ?format=prometheus
@@ -176,6 +201,7 @@ class StatusServer:
         trend=None,  # Callable[[], dict] -> serves /debug/trend
         remediation=None,  # Callable[[], Optional[dict]] -> /debug/remediation
         probes=None,  # Callable[[int], list] -> /debug/probes (cycle ring)
+        auth_token: Optional[str] = None,  # bearer token; None = open (see RUNBOOK threat model)
     ):
         handler = type(
             "BoundStatusHandler",
@@ -188,6 +214,7 @@ class StatusServer:
                 "trend": staticmethod(trend) if trend else None,
                 "remediation": staticmethod(remediation) if remediation else None,
                 "probes": staticmethod(probes) if probes else None,
+                "auth_token": auth_token,
             },
         )
         self._server = ThreadingHTTPServer((host, port), handler)

@@ -148,6 +148,7 @@ def main() -> int:
             trend=agent.trend.snapshot if agent.trend is not None else None,
             remediation=remediation.snapshot if remediation is not None else None,
             probes=agent.recent_cycles,
+            auth_token=config.tpu.probe_status_auth_token,
         ).start()
         routes = "/metrics, /healthz, /debug/trend, /debug/probes" + (
             ", /debug/remediation" if remediation is not None else ""

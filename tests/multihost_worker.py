@@ -121,15 +121,20 @@ def main() -> None:
         # build the (slices, hosts, chips) mesh explicitly: gloo CPU
         # devices all report slice_index 0, so hybrid_slice_mesh's
         # runtime-truth guard (correctly) refuses to carve them into fake
-        # slices — here the carve IS the simulation, one process per slice
+        # slices — here the carve IS the simulation. Default: one process
+        # per slice; MULTIHOST_SLICES=<k> carves the processes into k
+        # slices of num_procs/k hosts each (the BASELINE acceptance-4
+        # shape: 4 procs as 2 slices x 2 hosts x 2 chips)
         devs = jax.devices()
         per = len(devs) // num_procs
-        grid = np.stack(
-            [np.array(devs[k * per:(k + 1) * per]).reshape(1, per) for k in range(num_procs)],
-            axis=0,
-        )
+        n_slices = int(os.environ.get("MULTIHOST_SLICES", num_procs))
+        assert num_procs % n_slices == 0, (n_slices, num_procs)
+        hosts_per_slice = num_procs // n_slices
+        grid = np.array(devs).reshape(n_slices, hosts_per_slice, per)
         assert all(
-            d.process_index == k for k in range(num_procs) for d in grid[k].flat
+            d.process_index == s * hosts_per_slice + h
+            for s in range(n_slices) for h in range(hosts_per_slice)
+            for d in grid[s][h].flat
         ), "device order does not group by process"
         ms_obj = ms = run_multislice_probe(
             Mesh(grid, ("slices", "hosts", "chips")), iters=2, inner_iters=4,

@@ -44,7 +44,7 @@ def _worker_env() -> dict:
     return env
 
 
-def _run_cluster(out_dir, extra_env=None, n_procs=N_PROCS):
+def _run_cluster(out_dir, extra_env=None, n_procs=N_PROCS, timeout=180):
     coordinator = f"127.0.0.1:{_free_port()}"
     env = _worker_env()
     env.update(extra_env or {})
@@ -62,7 +62,7 @@ def _run_cluster(out_dir, extra_env=None, n_procs=N_PROCS):
     outputs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=180)
+            out, _ = p.communicate(timeout=timeout)
             outputs.append(out)
     finally:
         for p in procs:
@@ -393,6 +393,155 @@ def test_prep_failure_skips_all_cross_process_links(prep_fail_results):
         s["name"].startswith("chip0/") and s["reason"] == "error"
         for s in r1["links"]["suspect_links"]
     ), r1["links"]["suspect_links"]
+
+
+N_ACCEPT = 4  # BASELINE.md acceptance rung #4: ICI psum across 4 hosts
+
+
+@pytest.fixture(scope="module")
+def acceptance4_results(tmp_path_factory):
+    # the full acceptance-4 shape: 4 real processes x 2 chips carved as a
+    # (2 slices, 2 hosts, 2 chips) virtual mesh — every probe plane at
+    # once (global ICI psum, per-edge link walk over the 4-host ring, and
+    # the cross-slice DCN pair walk with 4-process pair membership)
+    return _run_cluster(
+        tmp_path_factory.mktemp("multihost_accept4"),
+        extra_env={"MULTIHOST_MULTISLICE": "1", "MULTIHOST_SLICES": "2"},
+        n_procs=N_ACCEPT,
+        timeout=300,
+    )
+
+
+def test_acceptance4_psum_crosses_all_four_hosts(acceptance4_results):
+    """BASELINE rung #4: the ICI psum must span all 4 processes' chips."""
+    assert len(acceptance4_results) == N_ACCEPT
+    for pid, r in acceptance4_results.items():
+        assert r["initialized"] and r["process_count"] == N_ACCEPT
+        assert r["global_devices"] == N_ACCEPT * CHIPS_PER_PROC
+        assert r["mesh_shape"] == [N_ACCEPT, CHIPS_PER_PROC]
+        ici = r["ici"]
+        assert ici["n_devices"] == N_ACCEPT * CHIPS_PER_PROC, (
+            f"proc {pid} psum saw {ici['n_devices']} devices"
+        )
+        assert ici["n_hosts"] == N_ACCEPT
+        assert ici["psum_correct"] and ici["psum_rtt_ms"] > 0
+        assert r["mxu_ok"] and r["healthy"]
+
+
+def test_acceptance4_link_walk_covers_the_four_host_ring(acceptance4_results):
+    """Per-edge localization at the acceptance shape: a (4 hosts, 2 chips)
+    grid is 4 intra-host edges + a 4-ring per chip column (incl. the
+    host3-host0 wraparound) = 12 edges, each recorded exactly once by its
+    lower-indexed endpoint (wraparound: process 0)."""
+    for pid, r in acceptance4_results.items():
+        assert r["links"]["error"] is None, f"proc {pid}: {r['links']['error']}"
+        assert r["links"]["ok"], f"proc {pid} flagged suspects"
+        # each process walks its intra edge + 2 ring neighbors x 2 chips
+        assert r["links"]["n_observed"] == 5, r["links"]
+
+    all_recorded = [l for r in acceptance4_results.values() for l in r["links"]["recorded"]]
+    names = sorted(l["name"] for l in all_recorded)
+    assert len(names) == len(set(names)), f"edge recorded twice: {names}"
+    assert [n for n in names if n.startswith("host")] == [
+        f"host{h}/chip0-chip1" for h in range(N_ACCEPT)
+    ]
+    assert [n for n in names if n.startswith("chip")] == sorted(
+        f"chip{c}/host{h}-host{(h + 1) % N_ACCEPT}"
+        for c in range(CHIPS_PER_PROC) for h in range(N_ACCEPT)
+    )
+    assert all(l["correct"] and l["rtt_ms"] > 0 for l in all_recorded)
+    wrap_owned = [l["name"] for l in acceptance4_results[0]["links"]["recorded"]
+                  if "host3-host0" in l["name"]]
+    assert sorted(wrap_owned) == ["chip0/host3-host0", "chip1/host3-host0"]
+
+
+def test_acceptance4_dcn_pair_walk_with_multihost_slices(acceptance4_results):
+    """The DCN pair program between 2-host slices has FOUR member
+    processes (both slices' hosts) — all must join the same SPMD pair
+    program, the hierarchical checksum must see 4 chips per slice, and
+    the lowest-indexed member (process 0) owns the canonical record."""
+    for pid, r in acceptance4_results.items():
+        ms = r["multislice"]
+        assert ms is not None and ms["error"] is None, f"proc {pid}: {ms}"
+        assert ms["ok"], ms
+        assert ms["n_slices"] == 2
+        assert ms["per_slice_sums"] == [4.0, 4.0]
+        assert ms["slice_processes"] == [[0, 1], [2, 3]]
+        # one pair, walked by every process (all four are members)
+        assert [p["name"] for p in ms["pairs"]] == ["slice0-slice1"]
+        pair = ms["pairs"][0]
+        assert pair["error"] is None and pair["correct"] and pair["rtt_ms"] > 0
+        assert pair["owner"] == (pid == 0), f"proc {pid}: {pair}"
+
+
+def test_acceptance4_process_zero_reports(acceptance4_results):
+    assert acceptance4_results[0]["reported"] == 1
+    assert acceptance4_results[0]["payload_event_type"] == "TPU_PROBE"
+    for pid in range(1, N_ACCEPT):
+        assert acceptance4_results[pid]["reported"] == 0
+    # the gathered identity map names all four hosts on every process
+    for r in acceptance4_results.values():
+        assert set(r["hosts"].keys()) == {"0", "1", "2", "3"}
+        for idx in range(N_ACCEPT):
+            assert r["hosts"][str(idx)]["node_name"] == f"test-node-{idx}"
+
+
+def test_acceptance4_corrupt_chip_localized_and_remediated(tmp_path_factory):
+    """Fault drill at the acceptance-4 shape: corrupt process 2's chip 0
+    (global id 4096). The link walk must triangulate it on ITS host only
+    (proc 2 observes all three of the chip's edges; every other process
+    observes at most one), so exactly proc 2's actuator cordons
+    test-node-2. The DCN pair checksum also fails — but with n=2 slices
+    one pair cannot distinguish endpoint from route, so the policy's
+    n-1 bar keeps the DCN finding route-only (no extra actions)."""
+    from k8s_watcher_tpu.k8s.mock_server import MockApiServer, MockCluster
+
+    cluster = MockCluster()
+    for pid in range(N_ACCEPT):
+        cluster.add_node({
+            "metadata": {"name": f"test-node-{pid}"},
+            "spec": {},
+            "status": {"conditions": [{"type": "Ready", "status": "True"}]},
+        })
+    with MockApiServer(cluster) as api:
+        results = _run_cluster(
+            tmp_path_factory.mktemp("multihost_accept4_fault"),
+            extra_env={
+                "MULTIHOST_MULTISLICE": "1",
+                "MULTIHOST_SLICES": "2",
+                "MULTIHOST_CORRUPT_DEVICE": "4096",
+                "MULTIHOST_DCN_FAULT_DEVICE": "4096",
+                "MULTIHOST_REMEDIATE": api.url,
+            },
+            n_procs=N_ACCEPT,
+            timeout=300,
+        )
+        # link-walk triangulation lands on the corrupt chip's own process
+        assert 4096 in results[2]["links"]["suspect_devices"]
+        for pid, r in results.items():
+            # proc 0 shares no ring edge with host2's chip — its local
+            # link view is clean; every other process observes at least
+            # one corrupt edge (proc 2 all three, procs 1/3 one each)
+            assert r["links"]["ok"] == (pid == 0), f"proc {pid}: {r['links']}"
+            ms = r["multislice"]
+            # the hierarchical checksum localizes the corruption to slice 1
+            # on EVERY process (merged verdict), and the lone DCN pair
+            # fails its checksum without implicating either endpoint slice
+            assert ms["per_slice_sums"][0] == 4.0 and ms["per_slice_sums"][1] != 4.0
+            assert [s["name"] for s in ms["suspect_pair_records"]] == ["slice0-slice1"]
+            assert ms["dcn_suspect_slices"] == [], f"proc {pid}: {ms}"
+        r2 = results[2]["remediation"]
+        assert r2 is not None and len(r2["actions"]) == 1, r2
+        action = r2["actions"][0]
+        assert action["node"] == "test-node-2" and action["ok"] and action["applied"]
+        assert "4096" in action["reason"]
+        for pid in (0, 1, 3):
+            assert results[pid]["remediation"]["actions"] == [], f"proc {pid}"
+        node2 = cluster.get_node("test-node-2")
+        assert node2["spec"].get("unschedulable") is True
+        for pid in (0, 1, 3):
+            node = cluster.get_node(f"test-node-{pid}")
+            assert "unschedulable" not in node["spec"] and not node["spec"].get("taints")
 
 
 def test_host_identity_map_covers_every_process(worker_results):

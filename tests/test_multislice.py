@@ -104,7 +104,9 @@ class TestSubaxisPsum:
 
 class TestMultiSliceProbe:
     def test_healthy(self, mesh):
-        r = run_multislice_probe(mesh, iters=3, inner_iters=4)
+        # generous floor: this asserts walk shape + checksums, not latency
+        # — a loaded CI machine must not flip a false "slow" pair flag
+        r = run_multislice_probe(mesh, iters=3, inner_iters=4, pair_rtt_floor_ms=250.0)
         assert r.ok and r.error is None
         assert r.n_slices == 2 and r.devices_per_slice == 4
         assert r.per_slice_sums == [4.0, 4.0]
@@ -143,7 +145,9 @@ class TestSlicePairWalk:
 
     def test_healthy_walks_all_pairs(self):
         mesh = hybrid_slice_mesh(n_slices=4)
-        r = run_multislice_probe(mesh, iters=3, inner_iters=4)
+        # generous floor: asserts coverage/ownership, not latency (see
+        # test_healthy) — observed flaky at the 0.2ms default under load
+        r = run_multislice_probe(mesh, iters=3, inner_iters=4, pair_rtt_floor_ms=250.0)
         assert r.ok
         assert [p["name"] for p in r.pair_rtts] == [
             "slice0-slice1", "slice0-slice2", "slice0-slice3",

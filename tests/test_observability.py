@@ -44,6 +44,80 @@ class TestStatusServer:
         assert requests.get(f"{self.url}/nope", timeout=5).status_code == 404
 
 
+class TestStatusServerAuth:
+    """watcher.status_auth_token: bearer gate on everything but /healthz."""
+
+    def setup_method(self):
+        self.metrics = MetricsRegistry()
+        self.liveness = Liveness(stale_after_seconds=60.0)
+        self.server = StatusServer(
+            self.metrics, self.liveness, host="127.0.0.1", auth_token="s3cret"
+        ).start()
+        self.url = f"http://127.0.0.1:{self.server.port}"
+
+    def teardown_method(self):
+        self.server.stop()
+
+    def test_routes_reject_without_token(self):
+        for path in ("/metrics", "/debug/slices", "/debug/events", "/nope"):
+            r = requests.get(f"{self.url}{path}", timeout=5)
+            assert r.status_code == 401, path
+            assert r.headers.get("WWW-Authenticate") == "Bearer"
+            # 401 must not leak whether the route exists or what it serves
+            assert r.content == b""
+
+    def test_wrong_scheme_or_token_rejected(self):
+        for header in ("Bearer wrong", "Basic s3cret", "s3cret", "Bearer"):
+            r = requests.get(
+                f"{self.url}/metrics", headers={"Authorization": header}, timeout=5
+            )
+            assert r.status_code == 401, header
+
+    def test_correct_token_passes(self):
+        self.metrics.counter("events_received").inc(2)
+        r = requests.get(
+            f"{self.url}/metrics",
+            headers={"Authorization": "Bearer s3cret"},
+            timeout=5,
+        )
+        assert r.status_code == 200
+        assert r.json()["events_received"]["count"] == 2
+
+    def test_healthz_stays_open(self):
+        self.liveness.beat()
+        r = requests.get(f"{self.url}/healthz", timeout=5)
+        assert r.status_code == 200 and r.json()["alive"] is True
+
+    def test_config_key_round_trips(self):
+        from k8s_watcher_tpu.config.schema import TpuConfig, WatcherConfig
+
+        cfg = WatcherConfig.from_raw({"status_auth_token": "tok"})
+        assert cfg.status_auth_token == "tok"
+        # empty string (unset ${VAR:-} interpolation) means "no auth"
+        assert WatcherConfig.from_raw({"status_auth_token": ""}).status_auth_token is None
+        assert WatcherConfig.from_raw({}).status_auth_token is None
+        # the standalone probe agent's plane takes the same contract
+        tpu = TpuConfig.from_raw({"probe": {"status_auth_token": "ptok"}})
+        assert tpu.probe_status_auth_token == "ptok"
+        assert TpuConfig.from_raw({}).probe_status_auth_token is None
+
+    def test_non_ascii_authorization_header_rejected_not_crashed(self):
+        # http.server decodes header bytes as latin-1; a non-ASCII token
+        # must yield 401, not a TypeError from hmac.compare_digest that
+        # drops the connection
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", self.server.port, timeout=5)
+        try:
+            conn.putrequest("GET", "/metrics")
+            conn.putheader("Authorization", b"Bearer caf\xe9")
+            conn.endheaders()
+            resp = conn.getresponse()
+            assert resp.status == 401
+        finally:
+            conn.close()
+
+
 class TestWatcherAppStatusEndpoint:
     def test_app_serves_metrics_while_running(self):
         from k8s_watcher_tpu.app import WatcherApp
