@@ -420,10 +420,13 @@ def bench_relist_scale(n_pods: int = 10_000, page_size: int = 500) -> dict:
         return {"error": str(exc)}
 
 
-def bench_checkpoint_scale(n_pods: int = 10_000) -> dict:
-    """Checkpoint cost at tracked-pod scale: file size and flush latency
-    with ``n_pods`` skeletons in known_pods (every flush rewrites the whole
-    JSON; VERDICT r03 flagged this as unmeasured at acceptance scale)."""
+def bench_checkpoint_scale(n_pods: int = 10_000, churn: int = 250) -> dict:
+    """Checkpoint cost at tracked-pod scale, through the app's actual
+    configuration: known_pods rides a JournaledMapStore (base + delta
+    journal), so the steady-state flush journals only the ``churn`` pods
+    that changed since the last throttle window instead of rewriting the
+    whole map (VERDICT r03 flagged the whole-state rewrite as unmeasured
+    at acceptance scale; VERDICT r04 demanded it bounded at 50k)."""
     try:
         import os
         import tempfile
@@ -432,35 +435,49 @@ def bench_checkpoint_scale(n_pods: int = 10_000) -> dict:
         from k8s_watcher_tpu.state.checkpoint import CheckpointStore
         from k8s_watcher_tpu.watch.fake import build_pod
 
-        known = {
-            f"uid-{i:05d}": KubernetesWatchSource._skeleton(build_pod(
-                f"bench-pod-{i:05d}", uid=f"uid-{i:05d}", phase="Running", tpu_chips=4,
+        def skel(i: int, phase: str = "Running") -> dict:
+            return KubernetesWatchSource._skeleton(build_pod(
+                f"bench-pod-{i:05d}", uid=f"uid-{i:05d}", phase=phase, tpu_chips=4,
                 labels={"jobset.sigs.k8s.io/jobset-name": f"job-{i % 64}"},
             ))
-            for i in range(n_pods)
-        }
+
+        known = {f"uid-{i:05d}": skel(i) for i in range(n_pods)}
         with tempfile.TemporaryDirectory() as tmp:
             path = os.path.join(tmp, "ckpt.json")
-            store = CheckpointStore(path, interval_seconds=0.0)
-            store.put("known_pods", known)
+            store = CheckpointStore(path, interval_seconds=3600.0)
+            # time the journaled store directly: CheckpointStore's throttle
+            # compares monotonic() (= system uptime) against a 0.0 start,
+            # so on any host up for more than the interval the FIRST put()
+            # would auto-flush and the timed flush would measure a no-op
+            jm = store.attach_journaled_map("known_pods")  # as WatcherApp does
+            jm.replace(known)  # no hint -> full compaction
             store.update_resource_version("12345")
             t0 = time.perf_counter()
-            store.flush()
-            first_flush_s = time.perf_counter() - t0
-            size = os.path.getsize(path)
-            # steady-state: repeat flushes of the same state (what the
-            # throttled sweep pays each interval)
+            jm.flush()
+            compact_s = time.perf_counter() - t0
+            base_size = os.path.getsize(path + ".known_pods.base.json")
+            # steady-state: each throttle window flushes only the churn
+            # (the app drains the watch source's dirty-uid hint)
             times = []
-            for _ in range(5):
-                store.put("known_pods", known)
+            for r in range(5):
+                changed = set()
+                for i in range(r * churn, (r + 1) * churn):
+                    uid = f"uid-{i % n_pods:05d}"
+                    known[uid] = skel(i % n_pods, phase="Succeeded")
+                    changed.add(uid)
+                jm.replace(dict(known), changed_keys=changed)
                 t0 = time.perf_counter()
-                store.flush()
+                jm.flush()
                 times.append(time.perf_counter() - t0)
+            journal_size = os.path.getsize(path + ".known_pods.journal.jsonl")
         return {
             "n_pods": n_pods,
-            "file_bytes": size,
-            "file_mb": round(size / (1024 * 1024), 2),
-            "first_flush_ms": round(1e3 * first_flush_s, 1),
+            "churn_per_flush": churn,
+            "file_bytes": base_size,
+            "file_mb": round(base_size / (1024 * 1024), 2),
+            "journal_bytes_after_5_flushes": journal_size,
+            "compact_ms": round(1e3 * compact_s, 1),
+            "first_flush_ms": round(1e3 * compact_s, 1),  # back-compat key
             "flush_ms_median": round(1e3 * statistics.median(times), 1),
         }
     except Exception as exc:
@@ -797,6 +814,7 @@ def main() -> int:
     scan_stats = bench_frame_scan()
     relist_stats = bench_relist_scale()
     checkpoint_stats = bench_checkpoint_scale()
+    checkpoint_50k = bench_checkpoint_scale(n_pods=50_000)
     virtual_stats = bench_virtual_probes()
     probe_stats = bench_probe()
     # headline: the TRUE end-to-end number (clock starts before the
@@ -812,6 +830,7 @@ def main() -> int:
         "frame_scan": scan_stats,
         "relist_10k": relist_stats,
         "checkpoint_10k": checkpoint_stats,
+        "checkpoint_50k": checkpoint_50k,
         "probe": probe_stats,
         "probe_virtual_mesh": virtual_stats,
     }
@@ -846,6 +865,8 @@ def main() -> int:
         "relist_10k_ms": relist_stats.get("relist_ms"),
         "checkpoint_10k_flush_ms": checkpoint_stats.get("flush_ms_median"),
         "checkpoint_10k_mb": checkpoint_stats.get("file_mb"),
+        "checkpoint_50k_flush_ms": checkpoint_50k.get("flush_ms_median"),
+        "checkpoint_50k_compact_ms": checkpoint_50k.get("compact_ms"),
         "mxu_tflops": probe_stats.get("mxu_tflops"),
         "hbm_read_gbps": probe_stats.get("hbm_read_gbps"),
         "hbm_write_gbps": probe_stats.get("hbm_write_gbps"),

@@ -72,6 +72,12 @@ class KubernetesWatchSource:
         # from the checkpoint so tombstones survive restarts that land past
         # the apiserver's compaction window.
         self._known: dict = {}
+        # uids whose _known entry changed since the last drain — the
+        # checkpoint's delta hint (JournaledMapStore), so a steady-state
+        # flush journals only the churn instead of rewriting the whole
+        # map. Entries restored from the checkpoint are NOT dirty: they
+        # are already on disk.
+        self._dirty_uids: set = set()
         if checkpoint is not None:
             for uid, entry in (checkpoint.get("known_pods") or {}).items():
                 if isinstance(entry, dict):
@@ -154,6 +160,16 @@ class KubernetesWatchSource:
         inner dicts) until a later flush."""
         return dict(self._known)
 
+    def drain_dirty_uids(self) -> set:
+        """Uids whose entry changed since the last drain (incl. deletes);
+        clears the set. Call BEFORE ``known_pods()``: a change landing
+        between the drain and the snapshot journals its newer value this
+        flush AND stays marked for the next — never the reverse order,
+        where a change after the snapshot would be drained away while its
+        value never made it to disk."""
+        drained, self._dirty_uids = self._dirty_uids, set()
+        return drained
+
     def stop(self) -> None:
         self._stop.set()
         # wake a consumer blocked in the stream read: on a quiet cluster the
@@ -176,6 +192,7 @@ class KubernetesWatchSource:
             self._known.pop(uid, None)
         else:
             self._known[uid] = self._skeleton(pod)
+        self._dirty_uids.add(uid)
 
     def _relist(self) -> Iterator[WatchEvent]:
         """LIST current pods: ADDED for each, synthetic DELETED for pods
@@ -212,6 +229,7 @@ class KubernetesWatchSource:
                 yield WatchEvent(type=EventType.ADDED, pod=pod, resource_version=rv)
         for uid in [u for u in self._known if u not in listed_uids]:
             tombstone = self._known.pop(uid)
+            self._dirty_uids.add(uid)
             legacy = bool(tombstone.get("legacy_tombstone", False))
             if legacy:
                 # strip the marker from a COPY — a pending throttled

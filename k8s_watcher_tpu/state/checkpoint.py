@@ -5,12 +5,18 @@ I/O stays off the hot path even at 1 k events/min. A missing or corrupt
 checkpoint degrades to a cold start — never a crash.
 
 Cost at scale (measured, bench_checkpoint_scale / tests/test_k8s.py):
-every flush rewrites the whole JSON; at 10k tracked pods the file is
-~4 MB and one flush costs tens of ms of serialization + write. That cost
-is paid at most once per ``interval_seconds`` (default 5 s) on whichever
-thread trips the throttle, and the lock is held only for a shallow dict
-copy — the watch loop's per-event ``update_resource_version`` never waits
-on serialization.
+a plain flush rewrites the whole JSON — ~4 MB / tens of ms at 10k tracked
+pods, ~19 MB / >200 ms at 50k. That whole-state rewrite is fine for the
+small sections (resourceVersion, phase/slice snapshots) but not for the
+``known_pods`` skeleton map, which dominates the state and whose churn per
+throttle window is tiny compared to its size. Large maps therefore go
+through :class:`JournaledMapStore` (attach via
+``CheckpointStore.attach_journaled_map``): a base snapshot plus an
+append-only delta journal, so a steady-state flush costs O(changed
+entries), not O(tracked pods) — measured at 50k pods in
+``bench_checkpoint_scale``. The base is rewritten (compaction) only when
+the journal has grown past the size of the map itself, amortizing the
+O(state) cost over O(state) appended deltas.
 """
 
 from __future__ import annotations
@@ -22,11 +28,222 @@ import tempfile
 import threading
 import time
 from pathlib import Path
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Iterable, Optional, Set
 
 logger = logging.getLogger(__name__)
 
 _SCHEMA_VERSION = 1
+
+
+def _atomic_write(path: Path, payload: str) -> bool:
+    """Write-temp + rename; returns False (after logging) on failure so
+    callers can keep their dirty state for a retry."""
+    tmp = None
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=str(path.parent), prefix=path.name, suffix=".tmp")
+        with os.fdopen(fd, "w") as fh:
+            fh.write(payload)
+        os.replace(tmp, path)
+        return True
+    except OSError as exc:
+        logger.error("Atomic write to %s failed: %s", path, exc)
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        return False
+
+
+class JournaledMapStore:
+    """Incremental persistence for one large string-keyed map.
+
+    On-disk layout (both under the parent checkpoint's directory):
+
+    - ``<name>.base.json`` — ``{"version": 1, "gen": G, "map": {...}}``,
+      written atomically (temp + rename) on compaction;
+    - ``<name>.journal.jsonl`` — one JSON object per line,
+      ``{"g": G, "k": key, "v": value}`` for an upsert or
+      ``{"g": G, "k": key, "d": true}`` for a delete, appended in
+      complete lines on each flush.
+
+    Load replays journal lines IN ORDER over the base map (last write
+    wins) and stops at the first malformed line — a crash mid-append
+    leaves at most one partial trailing line, which is discarded. The
+    generation number fences the compaction crash window: a new base is
+    renamed into place BEFORE the journal is truncated, so a crash
+    between the two leaves stale journal lines whose ``g`` no longer
+    matches the base's — they are skipped on load instead of reverting
+    newer base values.
+
+    Same contracts as CheckpointStore: values must be replaced, never
+    mutated in place (``replace`` keeps the caller's dict by reference);
+    serialization happens outside the lock; a corrupt file degrades to a
+    cold start, never a crash; no fsync (a lost checkpoint costs a cold
+    start, by design).
+    """
+
+    def __init__(
+        self,
+        path_stem: os.PathLike | str,
+        *,
+        compact_factor: float = 1.0,
+        min_compact_entries: int = 2048,
+    ):
+        stem = Path(path_stem)
+        self.base_path = stem.with_name(stem.name + ".base.json")
+        self.journal_path = stem.with_name(stem.name + ".journal.jsonl")
+        # compact when journal lines > max(min_compact_entries,
+        # compact_factor * len(map)) — the default amortizes one O(state)
+        # base rewrite over >= O(state) appended deltas
+        self.compact_factor = compact_factor
+        self.min_compact_entries = min_compact_entries
+        self._lock = threading.Lock()
+        # serializes flush/compaction I/O: a concurrent append racing a
+        # compaction's generation bump would write lines the new fence
+        # silently discards on load
+        self._io_lock = threading.Lock()
+        self._map: Dict[str, Any] = {}
+        self._gen = 0
+        self._journal_entries = 0
+        # keys journaled at next flush; None = full compaction needed
+        # (unknown delta, e.g. legacy migration or a replace() without a
+        # changed_keys hint)
+        self._pending: Optional[Set[str]] = set()
+        self._load()
+
+    def _load(self) -> None:
+        try:
+            data = json.loads(self.base_path.read_text())
+            if (
+                isinstance(data, dict)
+                and data.get("version") == _SCHEMA_VERSION
+                and isinstance(data.get("map"), dict)
+            ):
+                self._map = data["map"]
+                self._gen = int(data.get("gen", 0))
+            else:
+                logger.warning("Journaled map %s has unknown schema; starting cold", self.base_path)
+        except FileNotFoundError:
+            pass
+        except (json.JSONDecodeError, OSError, ValueError) as exc:
+            logger.warning("Corrupt journaled map base %s (%s); starting cold", self.base_path, exc)
+        try:
+            journal = self.journal_path.read_text()
+        except FileNotFoundError:
+            return
+        except OSError as exc:
+            logger.warning("Unreadable journal %s (%s); using base only", self.journal_path, exc)
+            return
+        for line in journal.splitlines():
+            if not line.strip():
+                continue
+            try:
+                entry = json.loads(line)
+                if entry.get("g") != self._gen:
+                    continue  # stale generation (compaction crash window)
+                key = entry["k"]
+            except (json.JSONDecodeError, KeyError, TypeError, AttributeError):
+                # partial trailing line from a crash mid-append; anything
+                # after it is unordered relative to the tear — stop
+                logger.warning("Journal %s has a torn line; replay stopped there", self.journal_path)
+                break
+            self._journal_entries += 1
+            if entry.get("d"):
+                self._map.pop(key, None)
+            else:
+                self._map[key] = entry.get("v")
+
+    # -- accessors ---------------------------------------------------------
+
+    def current(self) -> Dict[str, Any]:
+        """Shallow copy of the live map (same contract as known_pods())."""
+        with self._lock:
+            return dict(self._map)
+
+    @property
+    def pending(self) -> bool:
+        with self._lock:
+            return self._pending is None or bool(self._pending)
+
+    def replace(self, new_map: Dict[str, Any], changed_keys: Optional[Iterable[str]] = None) -> None:
+        """Adopt ``new_map`` as the live state. ``changed_keys`` is the
+        caller's delta hint (keys upserted or deleted since the LAST
+        replace); without it the next flush pays a full compaction —
+        correct for any caller, incremental only for hinting ones."""
+        with self._lock:
+            self._map = new_map
+            if changed_keys is None:
+                self._pending = None
+            elif self._pending is not None:
+                self._pending.update(changed_keys)
+            # else: full compaction already pending, which supersedes hints
+
+    # -- persistence -------------------------------------------------------
+
+    def flush(self) -> None:
+        with self._io_lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        with self._lock:
+            pending = self._pending
+            snapshot = self._map  # entries are never mutated in place
+            self._pending = set()
+        if pending is None:
+            self._compact(snapshot)
+            return
+        if not pending:
+            return
+        # a delta already past the compaction threshold (a relist marked
+        # every uid dirty) would journal ~the whole state and then compact
+        # on the next flush anyway — writing the state up to 3x; compact
+        # directly instead
+        # >= so the commonest case — pending EQUALS the whole map — takes
+        # this path with the default compact_factor of 1.0
+        if len(pending) >= max(self.min_compact_entries, self.compact_factor * len(snapshot)):
+            self._compact(snapshot)
+            return
+        lines = []
+        for key in pending:
+            if key in snapshot:
+                lines.append(json.dumps({"g": self._gen, "k": key, "v": snapshot[key]}))
+            else:
+                lines.append(json.dumps({"g": self._gen, "k": key, "d": True}))
+        blob = "\n".join(lines) + "\n"
+        try:
+            self.journal_path.parent.mkdir(parents=True, exist_ok=True)
+            with open(self.journal_path, "a") as fh:
+                fh.write(blob)  # one write call: a crash tears at most the tail
+        except OSError as exc:
+            logger.error("Journal append to %s failed: %s", self.journal_path, exc)
+            with self._lock:
+                # retry these keys next flush rather than dropping the delta
+                if self._pending is not None:
+                    self._pending.update(pending)
+            return
+        self._journal_entries += len(pending)
+        if self._journal_entries > max(self.min_compact_entries, self.compact_factor * len(snapshot)):
+            self._compact(snapshot)
+
+    def _compact(self, snapshot: Dict[str, Any]) -> None:
+        """Rewrite the base from ``snapshot`` under a new generation, then
+        truncate the journal. Crash between the two: stale journal lines
+        carry the old generation and are skipped on load."""
+        gen = self._gen + 1
+        payload = json.dumps({"version": _SCHEMA_VERSION, "gen": gen, "map": snapshot})
+        if not _atomic_write(self.base_path, payload):
+            with self._lock:
+                self._pending = None  # still owe a full write
+            return
+        self._gen = gen
+        self._journal_entries = 0
+        try:
+            open(self.journal_path, "w").close()
+        except OSError as exc:
+            # harmless: the stale lines are generation-fenced out on load
+            logger.warning("Could not truncate journal %s: %s", self.journal_path, exc)
 
 
 class CheckpointStore:
@@ -37,7 +254,32 @@ class CheckpointStore:
         self._state: Dict[str, Any] = {"version": _SCHEMA_VERSION}
         self._dirty = False
         self._last_flush = 0.0
+        self._journaled: Dict[str, JournaledMapStore] = {}
         self._load()
+
+    def attach_journaled_map(self, key: str, **opts: Any) -> JournaledMapStore:
+        """Route ``key`` through an incremental :class:`JournaledMapStore`
+        (files ``<checkpoint>.<key>.base.json`` / ``.journal.jsonl``).
+        ``get``/``put``/``flush`` keep working unchanged for the key; a
+        legacy copy inside the single-file state is migrated out on
+        attach, so old checkpoints restore seamlessly."""
+        store = JournaledMapStore(self.path.with_name(self.path.name + "." + key), **opts)
+        with self._lock:
+            legacy = self._state.pop(key, None)
+            if legacy is not None:
+                self._dirty = True
+        if not isinstance(legacy, (dict, type(None))):
+            # a foreign writer's garbage (string/list/number) must degrade
+            # to a cold map, not crash the first get() — same tolerance as
+            # the per-entry checks in watch.py
+            logger.warning(
+                "Discarding malformed legacy %r section during journaled-map migration", key
+            )
+            legacy = None
+        if legacy is not None and not store.current():
+            store.replace(legacy)  # unknown delta -> full compaction on flush
+        self._journaled[key] = store
+        return store
 
     def _load(self) -> None:
         try:
@@ -65,10 +307,18 @@ class CheckpointStore:
         self.maybe_flush()
 
     def get(self, key: str, default: Any = None) -> Any:
+        journaled = self._journaled.get(key)
+        if journaled is not None:
+            return journaled.current() or default
         with self._lock:
             return self._state.get(key, default)
 
-    def put(self, key: str, value: Any) -> None:
+    def put(self, key: str, value: Any, *, changed_keys: Optional[Iterable[str]] = None) -> None:
+        journaled = self._journaled.get(key)
+        if journaled is not None:
+            journaled.replace(value, changed_keys=changed_keys)
+            self.maybe_flush()
+            return
         with self._lock:
             self._state[key] = value
             self._dirty = True
@@ -86,11 +336,15 @@ class CheckpointStore:
         """Flush if dirty and the throttle interval has elapsed."""
         now = time.monotonic()
         with self._lock:
-            if not self._dirty or now - self._last_flush < self.interval_seconds:
+            if now - self._last_flush < self.interval_seconds:
+                return
+            if not self._dirty and not any(s.pending for s in self._journaled.values()):
                 return
         self.flush()
 
     def flush(self) -> None:
+        for store in self._journaled.values():
+            store.flush()
         with self._lock:
             # shallow copy under the lock, serialize OUTSIDE it: values are
             # replaced wholesale (put/update_resource_version), never
@@ -102,15 +356,4 @@ class CheckpointStore:
             self._dirty = False
             self._last_flush = time.monotonic()
         snapshot = json.dumps(snapshot_state)
-        self.path.parent.mkdir(parents=True, exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=str(self.path.parent), prefix=self.path.name, suffix=".tmp")
-        try:
-            with os.fdopen(fd, "w") as fh:
-                fh.write(snapshot)
-            os.replace(tmp, self.path)
-        except OSError as exc:
-            logger.error("Checkpoint flush to %s failed: %s", self.path, exc)
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
+        _atomic_write(self.path, snapshot)
