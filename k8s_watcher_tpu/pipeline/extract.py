@@ -98,8 +98,10 @@ def extract_pod_data(
     accelerator_label: str = "cloud.google.com/gke-tpu-accelerator",
     delta: Optional[PhaseDelta] = None,
     slice_info: Optional[Dict[str, Any]] = None,
+    chips: Optional[int] = None,
 ) -> Dict[str, Any]:
-    """Build the notify payload for one pod event."""
+    """Build the notify payload for one pod event. ``chips`` accepts a
+    precomputed ``pod_accelerator_chips`` result (hot-path dedup)."""
     metadata = pod.get("metadata") or {}
     status = pod.get("status") or {}
     spec = pod.get("spec") or {}
@@ -147,7 +149,8 @@ def extract_pod_data(
         "event_timestamp": datetime.now(timezone.utc).isoformat(),
     }
 
-    chips = pod_accelerator_chips(pod, resource_key)
+    if chips is None:
+        chips = pod_accelerator_chips(pod, resource_key)
     if chips > 0 or slice_info:
         data["tpu"] = {
             "resource_key": resource_key,

@@ -14,7 +14,7 @@
 
 from __future__ import annotations
 
-from typing import Any, Dict, Iterable, Sequence
+from typing import Any, Dict, Iterable, Optional, Sequence
 
 from k8s_watcher_tpu.watch.source import EventType, WatchEvent
 
@@ -76,10 +76,16 @@ class TpuResourceFilter:
         self.resource_key = resource_key
         self.enabled = enabled
 
-    def __call__(self, event: WatchEvent) -> bool:
+    def __call__(self, event: WatchEvent, chips: Optional[int] = None) -> bool:
+        """``chips`` lets the pipeline pass a precomputed
+        ``pod_accelerator_chips`` result: the same walk otherwise runs
+        again in slice-identity inference and payload extraction (hot
+        path at 10k+ events/s)."""
         if not self.enabled:
             return True
-        if pod_accelerator_chips(event.pod, self.resource_key) > 0:
+        if chips is None:
+            chips = pod_accelerator_chips(event.pod, self.resource_key)
+        if chips > 0:
             return True
         # legacy-checkpoint tombstones have no resource spec to match;
         # dropping their DELETED would silently leak the pod in downstream

@@ -79,8 +79,13 @@ def infer_slice_identity(
     resource_key: str = "google.com/tpu",
     topology_label: str = "cloud.google.com/gke-tpu-topology",
     accelerator_label: str = "cloud.google.com/gke-tpu-accelerator",
+    chips: Optional[int] = None,
 ) -> Optional[SliceIdentity]:
-    """Slice identity for a pod, or None for non-slice (or non-TPU) pods."""
+    """Slice identity for a pod, or None for non-slice (or non-TPU) pods.
+
+    ``chips`` accepts a precomputed ``pod_accelerator_chips`` result so
+    the per-event hot path walks the container resources once, not once
+    per stage."""
     metadata = pod.get("metadata") or {}
     labels = metadata.get("labels") or {}
     annotations = metadata.get("annotations") or {}
@@ -97,7 +102,8 @@ def infer_slice_identity(
     else:
         return None  # standalone pod: not slice-shaped
 
-    chips = pod_accelerator_chips(pod, resource_key)
+    if chips is None:
+        chips = pod_accelerator_chips(pod, resource_key)
     if chips <= 0:
         return None
 

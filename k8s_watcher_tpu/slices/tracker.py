@@ -180,17 +180,20 @@ class SliceTracker:
         return dict(self._slices)
 
     def observe(
-        self, event: WatchEvent, delta: PhaseDelta
+        self, event: WatchEvent, delta: PhaseDelta, chips: Optional[int] = None
     ) -> Tuple[Optional[Dict[str, Any]], List[Dict[str, Any]]]:
         """Fold one pod event into slice state.
 
         Returns ``(slice_info for the pod payload, [slice notifications])``.
+        ``chips`` forwards a precomputed ``pod_accelerator_chips`` result
+        to the identity inference (hot-path dedup).
         """
         identity = infer_slice_identity(
             event.pod,
             resource_key=self.resource_key,
             topology_label=self.topology_label,
             accelerator_label=self.accelerator_label,
+            chips=chips,
         )
         if identity is None:
             return None, []
