@@ -109,10 +109,13 @@ class WatcherApp:
             else None
         )
         if self.checkpoint is not None:
-            # known_pods dominates checkpoint state (O(tracked pods), ~19 MB
-            # at 50k) while its per-window churn is tiny — journal it so a
-            # steady-state flush costs O(churn), not O(cluster)
+            # known_pods and phases dominate checkpoint state (O(tracked
+            # pods) — ~19 MB + ~2 MB at 50k) while their per-window churn
+            # is tiny — journal both so a steady-state flush costs
+            # O(churn), not O(cluster); the remaining single-file state
+            # (resourceVersion + slice aggregates) stays small
             self.checkpoint.attach_journaled_map("known_pods")
+            self.checkpoint.attach_journaled_map("phases")
         self.notifier = notifier or build_notifier(config)
         self.liveness = Liveness(config.watcher.liveness_stale_seconds)
         self.audit = None
@@ -364,7 +367,14 @@ class WatcherApp:
         # store will actually flush (or at shutdown)
         if not (force or self.checkpoint.due()):
             return
-        self.checkpoint.put("phases", self.phase_tracker.snapshot())
+        # drain-before-snapshot, same contract as known_pods below; an
+        # idle window (no phase churn) skips the O(tracked-pods) snapshot
+        # build entirely
+        changed_phases = self.phase_tracker.drain_dirty_uids()
+        if changed_phases is None or changed_phases:  # None = persist everything
+            self.checkpoint.put(
+                "phases", self.phase_tracker.snapshot(), changed_keys=changed_phases
+            )
         self.checkpoint.put("slices", self.slice_tracker.snapshot())
         known = getattr(self.source, "known_pods", None)
         if callable(known):

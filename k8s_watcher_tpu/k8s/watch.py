@@ -25,6 +25,7 @@ from typing import Iterator, Optional
 
 from k8s_watcher_tpu.config.schema import RetryPolicy
 from k8s_watcher_tpu.k8s.client import K8sApiError, K8sClient, K8sGoneError
+from k8s_watcher_tpu.state.dirty import DirtyKeys
 from k8s_watcher_tpu.watch.source import EventType, WatchEvent
 
 logger = logging.getLogger(__name__)
@@ -76,8 +77,10 @@ class KubernetesWatchSource:
         # checkpoint's delta hint (JournaledMapStore), so a steady-state
         # flush journals only the churn instead of rewriting the whole
         # map. Entries restored from the checkpoint are NOT dirty: they
-        # are already on disk.
-        self._dirty_uids: set = set()
+        # are already on disk. Bounded (state/dirty.py): collapses to
+        # "everything changed" instead of growing forever when no
+        # checkpoint ever drains it.
+        self._dirty = DirtyKeys()
         if checkpoint is not None:
             for uid, entry in (checkpoint.get("known_pods") or {}).items():
                 if isinstance(entry, dict):
@@ -160,15 +163,15 @@ class KubernetesWatchSource:
         inner dicts) until a later flush."""
         return dict(self._known)
 
-    def drain_dirty_uids(self) -> set:
-        """Uids whose entry changed since the last drain (incl. deletes);
-        clears the set. Call BEFORE ``known_pods()``: a change landing
+    def drain_dirty_uids(self) -> Optional[set]:
+        """Uids whose entry changed since the last drain (incl. deletes),
+        or None for "unknown — persist everything"; clears the
+        accumulator. Call BEFORE ``known_pods()``: a change landing
         between the drain and the snapshot journals its newer value this
         flush AND stays marked for the next — never the reverse order,
         where a change after the snapshot would be drained away while its
         value never made it to disk."""
-        drained, self._dirty_uids = self._dirty_uids, set()
-        return drained
+        return self._dirty.drain()
 
     def stop(self) -> None:
         self._stop.set()
@@ -192,7 +195,7 @@ class KubernetesWatchSource:
             self._known.pop(uid, None)
         else:
             self._known[uid] = self._skeleton(pod)
-        self._dirty_uids.add(uid)
+        self._dirty.mark(uid, len(self._known))
 
     def _relist(self) -> Iterator[WatchEvent]:
         """LIST current pods: ADDED for each, synthetic DELETED for pods
@@ -229,7 +232,7 @@ class KubernetesWatchSource:
                 yield WatchEvent(type=EventType.ADDED, pod=pod, resource_version=rv)
         for uid in [u for u in self._known if u not in listed_uids]:
             tombstone = self._known.pop(uid)
-            self._dirty_uids.add(uid)
+            self._dirty.mark(uid, len(self._known))
             legacy = bool(tombstone.get("legacy_tombstone", False))
             if legacy:
                 # strip the marker from a COPY — a pending throttled

@@ -16,6 +16,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, Optional, Tuple
 
+from k8s_watcher_tpu.state.dirty import DirtyKeys
 from k8s_watcher_tpu.watch.source import EventType, WatchEvent
 
 
@@ -65,9 +66,22 @@ class PhaseTracker:
 
     def __init__(self):
         self._state: Dict[str, Tuple[str, Tuple]] = {}
+        # uids whose PERSISTED value (the phase — snapshot() drops
+        # readiness) changed since the last drain; the checkpoint's delta
+        # hint, mirroring KubernetesWatchSource. Bounded: collapses to
+        # "everything changed" instead of growing forever when no
+        # checkpoint ever drains it (state/dirty.py)
+        self._dirty = DirtyKeys()
 
     def __len__(self) -> int:
         return len(self._state)
+
+    def drain_dirty_uids(self) -> Optional[set]:
+        """Uids whose snapshot entry changed since the last drain (incl.
+        deletes), or None for "unknown — persist everything"; clears the
+        accumulator. Same drain-before-snapshot ordering contract as
+        KubernetesWatchSource.drain_dirty_uids."""
+        return self._dirty.drain()
 
     def observe(self, event: WatchEvent) -> PhaseDelta:
         uid = event.uid or f"{event.namespace}/{event.name}"
@@ -75,7 +89,9 @@ class PhaseTracker:
         prev = self._state.get(uid)
 
         if event.type == EventType.DELETED:
-            self._state.pop(uid, None)
+            if prev is not None:
+                self._state.pop(uid)
+                self._dirty.mark(uid, len(self._state))
             return PhaseDelta(
                 old_phase=prev[0] if prev else None,
                 new_phase=new_phase,
@@ -86,6 +102,10 @@ class PhaseTracker:
 
         ready = _ready_tuple(event.pod)
         self._state[uid] = (new_phase, ready)
+        if prev is None or prev[0] != new_phase:
+            # readiness-only updates keep the persisted value identical —
+            # journaling them would churn the checkpoint for nothing
+            self._dirty.mark(uid, len(self._state))
         if prev is None:
             return PhaseDelta(None, new_phase, phase_changed=True, readiness_changed=False)
         old_phase, old_ready = prev

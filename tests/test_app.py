@@ -102,9 +102,16 @@ class TestWatcherApp:
         # trackers with private ones, so checkpoints were always empty
         assert app.pipeline.phase_tracker is app.phase_tracker
         app.run()
-        data = json.loads((tmp_path / "ck.json").read_text())
-        assert len(data["phases"]) == 2
-        assert set(data["phases"].values()) == {"Running"}
+        # phases ride the journaled store, not the single file — read back
+        # the way a restarted app would
+        from k8s_watcher_tpu.state.checkpoint import CheckpointStore
+
+        ck = CheckpointStore(tmp_path / "ck.json")
+        ck.attach_journaled_map("phases")
+        phases = ck.get("phases")
+        assert len(phases) == 2
+        assert set(phases.values()) == {"Running"}
+        assert "phases" not in json.loads((tmp_path / "ck.json").read_text())
 
 
 class TestRestartResume:

@@ -116,6 +116,55 @@ class TestPhaseTracker:
         t2.restore(snap)
         assert len(t2) == 1
 
+    def test_dirty_uids_track_persisted_changes_only(self):
+        """The checkpoint delta hint: phase changes and deletes mark the
+        uid dirty; readiness-only updates (not in snapshot()) must not."""
+        t = PhaseTracker()
+        uid = "u1"
+        p_notready = build_pod("w0", uid=uid, phase="Running", tpu_chips=4,
+                               container_statuses=[{"name": "c", "ready": False, "restartCount": 0}])
+        p_ready = build_pod("w0", uid=uid, phase="Running", tpu_chips=4,
+                            container_statuses=[{"name": "c", "ready": True, "restartCount": 0}])
+        t.observe(ev(p_notready))
+        assert t.drain_dirty_uids() == {uid}
+        assert t.drain_dirty_uids() == set()  # drained
+        t.observe(ev(p_ready, EventType.MODIFIED))  # readiness flip only
+        assert t.drain_dirty_uids() == set()
+        p_done = build_pod("w0", uid=uid, phase="Succeeded", tpu_chips=4)
+        t.observe(ev(p_done, EventType.MODIFIED))
+        assert t.drain_dirty_uids() == {uid}
+        t.observe(ev(p_done, EventType.DELETED))
+        assert t.drain_dirty_uids() == {uid}
+        # deleting an untracked pod doesn't dirty anything
+        t.observe(ev(p_done, EventType.DELETED))
+        assert t.drain_dirty_uids() == set()
+
+    def test_dirty_set_collapses_instead_of_leaking(self):
+        """With no checkpoint draining it, the dirty accumulator must not
+        grow one entry per churned uid forever — past the floor it
+        collapses to the 'everything changed' sentinel (drain -> None),
+        which checkpoint consumers treat as a full compaction."""
+        from k8s_watcher_tpu.state.dirty import DirtyKeys
+
+        d = DirtyKeys(floor=10)
+        for i in range(10):
+            d.mark(f"u{i}", 3)  # live map stays tiny; floor governs
+        assert d._keys is not None
+        d.mark("u10", 3)  # 11 > max(10, 3): collapse
+        assert d._keys is None
+        d.mark("u11", 3)  # further marks are absorbed, not accumulated
+        assert d.drain() is None
+        # draining resets to a live accumulator
+        d.mark("u12", 3)
+        assert d.drain() == {"u12"}
+
+    def test_restore_is_not_dirty(self):
+        t = PhaseTracker()
+        t.observe(ev(tpu_pod()))
+        t2 = PhaseTracker()
+        t2.restore(t.snapshot())
+        assert t2.drain_dirty_uids() == set()
+
     def test_restore_does_not_fire_spurious_readiness_change(self):
         # regression: restored (readiness-unknown) state compared against the
         # first real heartbeat used to notify readiness_changed for every pod
