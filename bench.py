@@ -505,8 +505,12 @@ def bench_checkpoint_scale(n_pods: int = 10_000, churn: int = 250) -> dict:
             # so on any host up for more than the interval the FIRST put()
             # would auto-flush and the timed flush would measure a no-op
             jm = store.attach_journaled_map("known_pods")  # as WatcherApp does
-            jm.replace(known)  # no hint -> full compaction
+            # rv first: update_resource_version runs the store-level
+            # maybe_flush (first call always fires — monotonic() vs a 0.0
+            # start), which would compact the journaled map BEFORE the
+            # timer if the replace preceded it
             store.update_resource_version("12345")
+            jm.replace(known)  # no hint -> full compaction
             t0 = time.perf_counter()
             jm.flush()
             compact_s = time.perf_counter() - t0
