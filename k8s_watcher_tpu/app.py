@@ -104,7 +104,11 @@ class WatcherApp:
         self.config = config
         self.metrics = metrics or MetricsRegistry()
         self.checkpoint = (
-            CheckpointStore(config.state.checkpoint_path, interval_seconds=config.state.checkpoint_interval_seconds)
+            CheckpointStore(
+                config.state.checkpoint_path,
+                interval_seconds=config.state.checkpoint_interval_seconds,
+                metrics=self.metrics,
+            )
             if config.state.checkpoint_path
             else None
         )
@@ -202,6 +206,7 @@ class WatcherApp:
                     self._probe_agent.recent_cycles
                     if self._probe_agent is not None else None
                 ),
+                checkpoint=self.checkpoint.stats if self.checkpoint is not None else None,
                 auth_token=self.config.watcher.status_auth_token,
             ).start()
             routes = "/metrics, /healthz, /debug/slices" + (
@@ -210,6 +215,8 @@ class WatcherApp:
                 ", /debug/probes" if self._probe_agent is not None else ""
             ) + (
                 ", /debug/remediation" if remediation_state is not None else ""
+            ) + (
+                ", /debug/checkpoint" if self.checkpoint is not None else ""
             )
             logger.info("Status endpoint on :%d (%s)", self.status_server.port, routes)
         if self.config.watcher.leader_election.enabled:

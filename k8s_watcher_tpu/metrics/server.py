@@ -77,6 +77,9 @@ class _StatusHandler(BaseHTTPRequestHandler):
     remediation = None
     # Callable[[int], list]: last-N probe cycle summaries (flight recorder)
     probes = None
+    # Callable[[], dict]: checkpoint store stats (journal depth, last
+    # flush cost) — the persistence plane's health surface
+    checkpoint = None
     # Optional bearer token; when set, every route except /healthz requires
     # ``Authorization: Bearer <token>``. /healthz stays open so kubelet
     # liveness probes keep working without httpGet header plumbing — it
@@ -175,6 +178,11 @@ class _StatusHandler(BaseHTTPRequestHandler):
                 self._json(400, {"error": f"bad n={params.get('n')!r}"})
                 return
             self._json(200, {"probes": self.probes(n)})
+        elif parsed.path == "/debug/checkpoint":
+            if self.checkpoint is None:
+                self._json(404, {"error": "checkpointing not enabled (state.checkpoint_path)"})
+                return
+            self._json(200, {"checkpoint": self.checkpoint()})
         elif parsed.path == "/debug/remediation":
             if self.remediation is None:
                 self._json(404, {"error": "remediation not wired (tpu.remediation.enabled)"})
@@ -201,6 +209,7 @@ class StatusServer:
         trend=None,  # Callable[[], dict] -> serves /debug/trend
         remediation=None,  # Callable[[], Optional[dict]] -> /debug/remediation
         probes=None,  # Callable[[int], list] -> /debug/probes (cycle ring)
+        checkpoint=None,  # Callable[[], dict] -> /debug/checkpoint (store stats)
         auth_token: Optional[str] = None,  # bearer token; None = open (see RUNBOOK threat model)
     ):
         handler = type(
@@ -214,6 +223,7 @@ class StatusServer:
                 "trend": staticmethod(trend) if trend else None,
                 "remediation": staticmethod(remediation) if remediation else None,
                 "probes": staticmethod(probes) if probes else None,
+                "checkpoint": staticmethod(checkpoint) if checkpoint else None,
                 "auth_token": auth_token,
             },
         )

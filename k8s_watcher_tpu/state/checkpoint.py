@@ -162,6 +162,34 @@ class JournaledMapStore:
         with self._lock:
             return dict(self._map)
 
+    def stats(self) -> Dict[str, Any]:
+        """Observability snapshot for /debug/checkpoint: generation,
+        journal depth, live-map size, and on-disk byte counts."""
+        # gen/journal_entries mutate under _io_lock (the flush path);
+        # reading them without it could pair a post-compaction generation
+        # with the pre-compaction journal depth — a torn snapshot on the
+        # exact compaction-health signal this surface exists for
+        with self._io_lock:
+            gen = self._gen
+            journal_entries = self._journal_entries
+        with self._lock:
+            map_size = len(self._map)
+            pending = self._pending
+            pending_desc = "full" if pending is None else len(pending)
+        def _size(p: Path) -> Optional[int]:
+            try:
+                return p.stat().st_size
+            except OSError:
+                return None
+        return {
+            "generation": gen,
+            "journal_entries": journal_entries,
+            "pending": pending_desc,
+            "map_size": map_size,
+            "base_bytes": _size(self.base_path),
+            "journal_bytes": _size(self.journal_path),
+        }
+
     @property
     def pending(self) -> bool:
         with self._lock:
@@ -247,15 +275,38 @@ class JournaledMapStore:
 
 
 class CheckpointStore:
-    def __init__(self, path: os.PathLike | str, *, interval_seconds: float = 5.0):
+    def __init__(
+        self,
+        path: os.PathLike | str,
+        *,
+        interval_seconds: float = 5.0,
+        metrics=None,  # metrics.MetricsRegistry, optional
+    ):
         self.path = Path(path)
         self.interval_seconds = interval_seconds
+        self.metrics = metrics
         self._lock = threading.Lock()
         self._state: Dict[str, Any] = {"version": _SCHEMA_VERSION}
         self._dirty = False
         self._last_flush = 0.0
+        self._last_flush_ms: Optional[float] = None
         self._journaled: Dict[str, JournaledMapStore] = {}
         self._load()
+
+    def stats(self) -> Dict[str, Any]:
+        """Observability snapshot for /debug/checkpoint."""
+        with self._lock:
+            main_keys = sorted(k for k in self._state if k != "version")
+            last_flush_age = time.monotonic() - self._last_flush if self._last_flush else None
+            last_flush_ms = self._last_flush_ms
+        return {
+            "path": str(self.path),
+            "interval_seconds": self.interval_seconds,
+            "last_flush_age_seconds": round(last_flush_age, 1) if last_flush_age is not None else None,
+            "last_flush_ms": last_flush_ms,
+            "single_file_keys": main_keys,
+            "journaled": {key: s.stats() for key, s in self._journaled.items()},
+        }
 
     def attach_journaled_map(self, key: str, **opts: Any) -> JournaledMapStore:
         """Route ``key`` through an incremental :class:`JournaledMapStore`
@@ -343,8 +394,18 @@ class CheckpointStore:
         self.flush()
 
     def flush(self) -> None:
+        t0 = time.perf_counter()
         for store in self._journaled.values():
             store.flush()
+        self._flush_main()
+        flush_ms = 1e3 * (time.perf_counter() - t0)
+        with self._lock:
+            self._last_flush_ms = round(flush_ms, 2)
+        if self.metrics is not None:
+            self.metrics.counter("checkpoint_flushes").inc()
+            self.metrics.histogram("checkpoint_flush_duration").record(flush_ms / 1e3)
+
+    def _flush_main(self) -> None:
         with self._lock:
             # shallow copy under the lock, serialize OUTSIDE it: values are
             # replaced wholesale (put/update_resource_version), never

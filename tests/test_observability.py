@@ -118,6 +118,52 @@ class TestStatusServerAuth:
             conn.close()
 
 
+class TestDebugCheckpointRoute:
+    def test_route_serves_store_stats(self, tmp_path):
+        from k8s_watcher_tpu.state.checkpoint import CheckpointStore
+
+        ck = CheckpointStore(tmp_path / "c.json", interval_seconds=0.0, metrics=MetricsRegistry())
+        ck.attach_journaled_map("known_pods")
+        ck.put("known_pods", {"u1": {"v": 1}, "u2": {"v": 2}})
+        ck.put("slices", {"s": 1})
+        ck.flush()
+        server = StatusServer(
+            MetricsRegistry(), Liveness(), host="127.0.0.1", checkpoint=ck.stats
+        ).start()
+        try:
+            body = requests.get(
+                f"http://127.0.0.1:{server.port}/debug/checkpoint", timeout=5
+            ).json()["checkpoint"]
+            assert body["single_file_keys"] == ["resource_version", "slices"] or \
+                body["single_file_keys"] == ["slices"]
+            jm = body["journaled"]["known_pods"]
+            assert jm["map_size"] == 2
+            assert jm["generation"] >= 1
+            assert jm["base_bytes"] and jm["base_bytes"] > 0
+            assert body["last_flush_ms"] is not None
+        finally:
+            server.stop()
+
+    def test_route_404_when_not_wired(self):
+        server = StatusServer(MetricsRegistry(), Liveness(), host="127.0.0.1").start()
+        try:
+            r = requests.get(f"http://127.0.0.1:{server.port}/debug/checkpoint", timeout=5)
+            assert r.status_code == 404
+        finally:
+            server.stop()
+
+    def test_flush_metrics_recorded(self, tmp_path):
+        from k8s_watcher_tpu.state.checkpoint import CheckpointStore
+
+        m = MetricsRegistry()
+        ck = CheckpointStore(tmp_path / "c.json", interval_seconds=0.0, metrics=m)
+        ck.put("x", 1)  # auto-flush via maybe_flush
+        ck.flush()
+        dump = m.dump()
+        assert dump["checkpoint_flushes"]["count"] >= 2
+        assert dump["checkpoint_flush_duration"]["count"] >= 2
+
+
 class TestWatcherAppStatusEndpoint:
     def test_app_serves_metrics_while_running(self):
         from k8s_watcher_tpu.app import WatcherApp
