@@ -529,6 +529,13 @@ def bench_checkpoint_scale(n_pods: int = 10_000, churn: int = 250) -> dict:
                 jm.flush()
                 times.append(time.perf_counter() - t0)
             journal_size = os.path.getsize(path + ".known_pods.journal.jsonl")
+            # cold-start restore: base read + journal replay, what a
+            # restarted watcher pays before its first relist
+            t0 = time.perf_counter()
+            reloaded = CheckpointStore(path, interval_seconds=3600.0)
+            reloaded.attach_journaled_map("known_pods")
+            load_s = time.perf_counter() - t0
+            n_loaded = len(reloaded.get("known_pods") or {})
         return {
             "n_pods": n_pods,
             "churn_per_flush": churn,
@@ -538,6 +545,8 @@ def bench_checkpoint_scale(n_pods: int = 10_000, churn: int = 250) -> dict:
             "compact_ms": round(1e3 * compact_s, 1),
             "first_flush_ms": round(1e3 * compact_s, 1),  # back-compat key
             "flush_ms_median": round(1e3 * statistics.median(times), 1),
+            "reload_ms": round(1e3 * load_s, 1),
+            "reload_pods": n_loaded,
         }
     except Exception as exc:
         return {"error": str(exc)}
