@@ -881,6 +881,7 @@ def main() -> int:
     burst_stats = bench_burst_drain()
     scan_stats = bench_frame_scan()
     relist_stats = bench_relist_scale()
+    relist_50k = bench_relist_scale(n_pods=50_000)
     checkpoint_stats = bench_checkpoint_scale()
     checkpoint_50k = bench_checkpoint_scale(n_pods=50_000)
     virtual_stats = bench_virtual_probes()
@@ -897,6 +898,7 @@ def main() -> int:
         "burst": burst_stats,
         "frame_scan": scan_stats,
         "relist_10k": relist_stats,
+        "relist_50k": relist_50k,
         "checkpoint_10k": checkpoint_stats,
         "checkpoint_50k": checkpoint_50k,
         "probe": probe_stats,

@@ -21,6 +21,7 @@ Test hooks: ``MockCluster.add/modify/delete_pod`` drive the event stream;
 from __future__ import annotations
 
 import base64
+import bisect
 import json
 import threading
 import time
@@ -103,6 +104,43 @@ class MockCluster:
         self._fail_status = 500
         self.namespaces = ["default", "kube-system"]
         self._leases: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        # sorted-key cache per collection, keyed on the rv it was built
+        # at: any mutation bumps _rv, invalidating it. Without this every
+        # page re-sorted and re-filtered the WHOLE map — O(n^2/page_size)
+        # across a paged list, 22 s for a 50k-pod relist
+        self._sorted_keys: Dict[str, Tuple[int, list]] = {}
+
+    def _sorted_collection_keys(self, collection: str, mapping) -> list:
+        """Sorted key list for ``mapping``, cached until the next
+        mutation. Call under ``self._lock``."""
+        cached = self._sorted_keys.get(collection)
+        if cached is not None and cached[0] == self._rv:
+            return cached[1]
+        keys = sorted(mapping)
+        self._sorted_keys[collection] = (self._rv, keys)
+        return keys
+
+    def _cursor_page(self, collection: str, mapping, after, limit, match) -> list:
+        """Cursor scan shared by the paged LISTs: up to ``limit+1``
+        (key, obj) pairs with key > ``after`` satisfying ``match(key,
+        obj)`` (limit+1 so _page_body can detect "more remain"). Call
+        under ``self._lock``."""
+        keys = self._sorted_collection_keys(collection, mapping)
+        want = limit + 1 if limit else None
+        matches = []
+        for key in keys[bisect.bisect_right(keys, after):]:
+            obj = mapping.get(key)
+            if obj is None:
+                # deleted since the cache was built: delete_* pops the map
+                # and bumps _rv in two separate lock holds, so a list
+                # landing between them sees a momentarily-stale cache
+                continue
+            if not match(key, obj):
+                continue
+            matches.append((key, obj))
+            if want is not None and len(matches) >= want:
+                break
+        return matches
 
     # -- state mutation (test hooks) --------------------------------------
 
@@ -304,10 +342,17 @@ class MockCluster:
         with self._lock:
             if snapshot_rv is not None and int(snapshot_rv) < self._oldest_rv:
                 return _expired_continue_status()
+            if after[0]:
+                # node tokens encode ns "" — a foreign-namespace cursor
+                # sorts above every ("", name) key, i.e. no results
+                # (tuple-compare behavior of the pre-cache implementation)
+                return 200, self._page_body("NodeList", [], limit, snapshot_rv)
             matches = [
                 (("", name), node)
-                for name, node in sorted(self._nodes.items())
-                if _matches_selector(node, selector) and ("", name) > after
+                for name, node in self._cursor_page(
+                    "nodes", self._nodes, after[1], limit,
+                    lambda _name, node: _matches_selector(node, selector),
+                )
             ]
             return 200, self._page_body("NodeList", matches, limit, snapshot_rv)
 
@@ -390,13 +435,11 @@ class MockCluster:
         with self._lock:
             if snapshot_rv is not None and int(snapshot_rv) < self._oldest_rv:
                 return _expired_continue_status()
-            matches = [
-                (key, pod)
-                for key, pod in sorted(self._pods.items())
-                if (namespace is None or key[0] == namespace)
-                and _matches_selector(pod, selector)
-                and key > after
-            ]
+            matches = self._cursor_page(
+                "pods", self._pods, after, limit,
+                lambda key, pod: (namespace is None or key[0] == namespace)
+                and _matches_selector(pod, selector),
+            )
             return 200, self._page_body("PodList", matches, limit, snapshot_rv)
 
     def events_since(self, rv: int, deadline: float, collection: str = "pods") -> Optional[List[Dict[str, Any]]]:

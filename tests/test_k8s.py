@@ -471,6 +471,23 @@ class TestListPagination:
         }
         assert names == {f"p{i:03d}" for i in range(25)}
 
+    def test_stale_sorted_key_cache_skips_deleted_keys(self, mock_api):
+        """delete_pod pops the map and bumps the rv in two separate lock
+        holds; a LIST landing between them sees the sorted-key cache
+        still carrying the popped key — the scan must skip it, not
+        KeyError into a 500."""
+        cluster = mock_api.cluster
+        for i in range(6):
+            cluster.add_pod(build_pod(f"p{i:03d}", uid=f"u{i:03d}"))
+        client = make_client(mock_api)
+        client.list_pods(limit=10)  # builds the cache at the current rv
+        # simulate the mid-delete window: pop WITHOUT the rv bump
+        with cluster._lock:
+            cluster._pods.pop(("default", "p003"))
+        body = client.list_pods(limit=10)
+        names = [p["metadata"]["name"] for p in body["items"]]
+        assert names == [f"p{i:03d}" for i in range(6) if i != 3]
+
     def test_exact_multiple_has_no_dangling_page(self, mock_api):
         for i in range(20):
             mock_api.cluster.add_pod(build_pod(f"p{i:03d}"))
