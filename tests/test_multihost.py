@@ -544,6 +544,65 @@ def test_acceptance4_corrupt_chip_localized_and_remediated(tmp_path_factory):
             assert "unschedulable" not in node["spec"] and not node["spec"].get("taints")
 
 
+def test_dcn_fault_in_multinode_slice_quarantines_all_member_nodes(tmp_path_factory):
+    """A slice with TWO member hosts fails its DCN plane: the merged
+    pair-walk classification implicates the SLICE, the policy maps it to
+    ALL member nodes, and the single slice-scope actor (process 0)
+    quarantines both — exactly filling the default 2-node budget. Six
+    processes as (3 slices x 2 hosts x 2 chips): corrupt slice 1's
+    chip so both of its pairs fail checksum (count = n-1 = 2), while
+    slices 0/2 each observe one bad pair (below the bar)."""
+    from k8s_watcher_tpu.k8s.mock_server import MockApiServer, MockCluster
+
+    n_procs = 6
+    cluster = MockCluster()
+    for pid in range(n_procs):
+        cluster.add_node({
+            "metadata": {"name": f"test-node-{pid}"},
+            "spec": {},
+            "status": {"conditions": [{"type": "Ready", "status": "True"}]},
+        })
+    with MockApiServer(cluster) as api:
+        results = _run_cluster(
+            tmp_path_factory.mktemp("multihost_slice2node"),
+            extra_env={
+                "MULTIHOST_MULTISLICE": "1",
+                "MULTIHOST_SLICES": "3",
+                # slice 1 = processes 2,3; corrupt proc 2's chip 0
+                "MULTIHOST_DCN_FAULT_DEVICE": str(2 * 2048),
+                "MULTIHOST_REMEDIATE": api.url,
+            },
+            n_procs=n_procs,
+            timeout=420,
+        )
+        for pid, r in results.items():
+            ms = r["multislice"]
+            assert ms is not None and ms["error"] is None, f"proc {pid}: {ms}"
+            assert ms["slice_processes"] == [[0, 1], [2, 3], [4, 5]]
+            # merged verdict is replicated on every process
+            assert ms["dcn_suspect_slices"] == [1], f"proc {pid}: {ms}"
+            suspect_names = sorted(s["name"] for s in ms["suspect_pair_records"])
+            assert suspect_names == ["slice0-slice1", "slice1-slice2"], f"proc {pid}"
+        # slice-scope actor split: ONLY process 0 acts, on BOTH of slice
+        # 1's nodes (the default max_quarantined_nodes budget is exactly 2)
+        r0 = results[0]["remediation"]
+        assert r0 is not None and len(r0["actions"]) == 2, r0
+        acted_nodes = sorted(a["node"] for a in r0["actions"])
+        assert acted_nodes == ["test-node-2", "test-node-3"]
+        assert all(a["ok"] and a["applied"] for a in r0["actions"])
+        for pid in range(1, n_procs):
+            assert results[pid]["remediation"]["actions"] == [], f"proc {pid}"
+        for pid in (2, 3):
+            node = cluster.get_node(f"test-node-{pid}")
+            assert node["spec"].get("unschedulable") is True
+            assert any(
+                t["key"] == "k8s-watcher-tpu/ici-fault" for t in node["spec"]["taints"]
+            )
+        for pid in (0, 1, 4, 5):
+            node = cluster.get_node(f"test-node-{pid}")
+            assert "unschedulable" not in node["spec"] and not node["spec"].get("taints")
+
+
 def test_host_identity_map_covers_every_process(worker_results):
     """A suspect chip on a remote process is only actionable if process 0's
     report can map that process_index to a node — every worker must see the
