@@ -1275,6 +1275,43 @@ class TestJournaledMapStore:
         ck = self._attached(tmp_path)
         assert ck.get("known_pods") is None  # empty map -> default
 
+    def test_concurrent_replace_and_flush_lose_nothing(self, tmp_path):
+        """The app flushes from whichever thread trips the throttle while
+        the watch thread keeps replacing — concurrent flush() calls and
+        interleaved replaces must never lose a hinted delta or tear the
+        journal (the _io_lock serializes appends against compaction's
+        generation bump)."""
+        from k8s_watcher_tpu.state.checkpoint import JournaledMapStore
+
+        store = JournaledMapStore(tmp_path / "m", min_compact_entries=8, compact_factor=0.0)
+        model = {}
+        stop = threading.Event()
+        errors = []
+
+        def flusher():
+            try:
+                while not stop.is_set():
+                    store.flush()
+            except Exception as exc:  # noqa: BLE001 — the assertion IS "no exception"
+                errors.append(exc)
+
+        threads = [threading.Thread(target=flusher) for _ in range(2)]
+        for t in threads:
+            t.start()
+        try:
+            for i in range(300):
+                key = f"k{i % 17}"
+                model[key] = {"v": i}
+                store.replace(dict(model), changed_keys={key})
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+        assert not errors, errors
+        store.flush()
+        reloaded = JournaledMapStore(tmp_path / "m")
+        assert reloaded.current() == model
+
     def test_maybe_flush_sees_journaled_pending(self, tmp_path):
         """A put() touching ONLY the journaled map must still flush when
         the throttle window elapses — the main-state dirty bit alone
