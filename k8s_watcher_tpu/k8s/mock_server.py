@@ -549,10 +549,16 @@ class _Handler(BaseHTTPRequestHandler):
         if "limit" not in params:
             return None
         try:
-            return int(params["limit"])
+            limit = int(params["limit"])
         except ValueError:
             self._json(400, {"kind": "Status", "code": 400, "message": "malformed limit"})
             return _BAD_LIMIT
+        if limit < 0:
+            # a negative limit would slice matches[:limit] empty and then
+            # IndexError building the continue token — same 400 contract
+            self._json(400, {"kind": "Status", "code": 400, "message": "malformed limit"})
+            return _BAD_LIMIT
+        return limit
 
     def _json(self, status: int, body: Dict[str, Any]) -> None:
         data = json.dumps(body).encode()

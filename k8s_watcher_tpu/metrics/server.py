@@ -97,7 +97,9 @@ class _StatusHandler(BaseHTTPRequestHandler):
         # compare bytes: compare_digest raises TypeError on non-ASCII str
         # (http.server decodes headers as latin-1), which would drop the
         # connection with a traceback instead of answering 401
-        return scheme == "Bearer" and hmac.compare_digest(
+        # auth schemes are case-insensitive (RFC 9110 §11.1); proxies and
+        # some clients normalize to lowercase
+        return scheme.lower() == "bearer" and hmac.compare_digest(
             presented.strip().encode("utf-8", "surrogateescape"),
             self.auth_token.encode("utf-8"),
         )

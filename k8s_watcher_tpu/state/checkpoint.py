@@ -116,13 +116,21 @@ class JournaledMapStore:
     def _load(self) -> None:
         try:
             data = json.loads(self.base_path.read_text())
+            # gen is load-bearing (it fences journal replay): a base whose
+            # gen isn't a plain int is corrupt AS A WHOLE — adopting its
+            # map with a reset gen would replay the wrong journal lines
+            # over it, and int(None/list) raising out of __init__ would
+            # crash-loop the watcher instead of degrading (the module
+            # contract: cold start, never a crash)
             if (
                 isinstance(data, dict)
                 and data.get("version") == _SCHEMA_VERSION
                 and isinstance(data.get("map"), dict)
+                and isinstance(data.get("gen", 0), int)
+                and not isinstance(data.get("gen", 0), bool)
             ):
                 self._map = data["map"]
-                self._gen = int(data.get("gen", 0))
+                self._gen = data.get("gen", 0)
             else:
                 logger.warning("Journaled map %s has unknown schema; starting cold", self.base_path)
         except FileNotFoundError:
@@ -247,9 +255,13 @@ class JournaledMapStore:
         except OSError as exc:
             logger.error("Journal append to %s failed: %s", self.journal_path, exc)
             with self._lock:
-                # retry these keys next flush rather than dropping the delta
-                if self._pending is not None:
-                    self._pending.update(pending)
+                # a SURVIVED write error (ENOSPC mid-flush) can leave a
+                # torn line in the MIDDLE of the journal; replay stops at
+                # the first malformed line, so any append after the tear
+                # would be silently discarded on reload. Force a full
+                # compaction (new base, truncated journal) instead of
+                # retrying appends past the tear.
+                self._pending = None
             return
         self._journal_entries += len(pending)
         if self._journal_entries > max(self.min_compact_entries, self.compact_factor * len(snapshot)):

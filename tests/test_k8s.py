@@ -606,11 +606,14 @@ class TestListPagination:
         mock_api.cluster.add_pod(build_pod("p0"))
         client = make_client(mock_api)
         for path in ("/api/v1/pods", "/api/v1/nodes"):
-            with pytest.raises(K8sApiError) as exc_info:
-                client._request("GET", path, params={"limit": "abc"})
-            assert exc_info.value.status == 400, path
-            assert not isinstance(exc_info.value, K8sGoneError)
-            assert "malformed limit" in str(exc_info.value)
+            # "-1" would slice the page empty and IndexError building the
+            # continue token — same 400 contract as non-integers
+            for bad in ("abc", "-1"):
+                with pytest.raises(K8sApiError) as exc_info:
+                    client._request("GET", path, params={"limit": bad})
+                assert exc_info.value.status == 400, (path, bad)
+                assert not isinstance(exc_info.value, K8sGoneError)
+                assert "malformed limit" in str(exc_info.value)
 
 
 class TestKubernetesWatchSource:
@@ -1274,6 +1277,62 @@ class TestJournaledMapStore:
         (tmp_path / "c.json.known_pods.journal.jsonl").write_text("garbage\n")
         ck = self._attached(tmp_path)
         assert ck.get("known_pods") is None  # empty map -> default
+
+    def test_non_int_generation_degrades_whole_base(self, tmp_path):
+        """gen fences journal replay: a base whose gen is null/string must
+        cold-start ENTIRELY (not crash on int(), and not adopt the map
+        with a reset gen — that would replay the wrong journal lines)."""
+        base = tmp_path / "c.json.known_pods.base.json"
+        for bad_gen in (None, "abc", [1], True):
+            base.write_text(json.dumps({"version": 1, "gen": bad_gen, "map": {"u1": {"v": 1}}}))
+            ck = self._attached(tmp_path)
+            assert ck.get("known_pods") is None, f"gen={bad_gen!r} adopted the base"
+
+    def test_survived_append_failure_forces_compaction(self, tmp_path, monkeypatch):
+        """ENOSPC mid-append can leave a torn line in the MIDDLE of the
+        journal; replay stops at the first malformed line, so appends
+        after the tear would vanish on reload. A failed append must
+        force a full compaction (new generation), not retry appends."""
+        import builtins
+
+        from k8s_watcher_tpu.state.checkpoint import JournaledMapStore
+
+        store = JournaledMapStore(tmp_path / "m")
+        store.replace({"a": 1}, changed_keys={"a"})
+        store.flush()
+        # simulate the torn-middle state AND the failed append together:
+        # the append write raises after partial bytes landed
+        journal = tmp_path / "m.journal.jsonl"
+        real_open = builtins.open
+
+        def failing_open(path, mode="r", *a, **kw):
+            if str(path) == str(journal) and "a" in mode:
+                fh = real_open(path, mode, *a, **kw)
+                fh.write('{"g": 0, "k": "torn')  # partial bytes
+                fh.flush()
+
+                class Boom:
+                    def __enter__(self):
+                        return self
+
+                    def __exit__(self, *exc):
+                        fh.close()
+                        return False
+
+                    def write(self, *_):
+                        raise OSError(28, "No space left on device")
+
+                return Boom()
+            return real_open(path, mode, *a, **kw)
+
+        monkeypatch.setattr(builtins, "open", failing_open)
+        store.replace({"a": 1, "b": 2}, changed_keys={"b"})
+        store.flush()  # append fails -> full compaction owed
+        monkeypatch.setattr(builtins, "open", real_open)
+        store.flush()  # compacts: new base, truncated journal
+        reloaded = JournaledMapStore(tmp_path / "m")
+        assert reloaded.current() == {"a": 1, "b": 2}
+        assert (tmp_path / "m.journal.jsonl").read_text() == ""
 
     def test_concurrent_replace_and_flush_lose_nothing(self, tmp_path):
         """The app flushes from whichever thread trips the throttle while

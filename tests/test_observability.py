@@ -73,6 +73,17 @@ class TestStatusServerAuth:
             )
             assert r.status_code == 401, header
 
+    def test_scheme_is_case_insensitive(self):
+        # RFC 9110 §11.1: auth schemes are case-insensitive; proxies may
+        # normalize to lowercase
+        for scheme in ("bearer", "BEARER", "BeArEr"):
+            r = requests.get(
+                f"{self.url}/metrics",
+                headers={"Authorization": f"{scheme} s3cret"},
+                timeout=5,
+            )
+            assert r.status_code == 200, scheme
+
     def test_correct_token_passes(self):
         self.metrics.counter("events_received").inc(2)
         r = requests.get(
