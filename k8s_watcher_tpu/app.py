@@ -392,7 +392,8 @@ class WatcherApp:
             # without drain support fall back to full rewrites.
             drain = getattr(self.source, "drain_dirty_uids", None)
             changed = drain() if callable(drain) else None
-            self.checkpoint.put("known_pods", known(), changed_keys=changed)
+            if changed is None or changed:  # skip the O(n) snapshot when idle
+                self.checkpoint.put("known_pods", known(), changed_keys=changed)
 
     def stop(self) -> None:
         self._stop.set()

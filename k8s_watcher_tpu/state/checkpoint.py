@@ -232,12 +232,10 @@ class JournaledMapStore:
             return
         if not pending:
             return
-        # a delta already past the compaction threshold (a relist marked
-        # every uid dirty) would journal ~the whole state and then compact
-        # on the next flush anyway — writing the state up to 3x; compact
-        # directly instead
-        # >= so the commonest case — pending EQUALS the whole map — takes
-        # this path with the default compact_factor of 1.0
+        # a delta at or past the compaction threshold (>= so a relist that
+        # marked EVERY uid dirty lands here at the default factor of 1.0)
+        # would journal ~the whole state and then compact next flush
+        # anyway — writing the state up to 3x; compact directly instead
         if len(pending) >= max(self.min_compact_entries, self.compact_factor * len(snapshot)):
             self._compact(snapshot)
             return
