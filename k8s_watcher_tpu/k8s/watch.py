@@ -223,6 +223,13 @@ class KubernetesWatchSource:
             ),
             metrics=self.metrics,
         ):
+            if self._stop.is_set():
+                # shutdown mid-pagination: abort WITHOUT the tombstone
+                # sweep or rv save below — synthesizing DELETED for every
+                # not-yet-listed pod would be wrong, and the partial list
+                # must not become the resume point. Bounds shutdown at one
+                # in-flight page request instead of the whole relist.
+                return
             if restarted:
                 listed_uids.clear()
             rv = page_rv or rv
@@ -259,6 +266,12 @@ class KubernetesWatchSource:
         """Yield events forever (until ``stop()``), reconnecting as needed."""
         backoff = self.retry.delay_seconds
         reconnects = 0
+        # consecutive watch-phase 410s with no delivered frame or clean
+        # window expiry in between: the first is normal recovery (relist
+        # immediately), repeats mean the relist itself keeps outlasting
+        # the watch cache — those must back off and count, or the loop
+        # degenerates into unbounded back-to-back full-cluster LISTs
+        gone_streak = 0
 
         if self.resource_version is None and self.checkpoint is not None:
             self.resource_version = self.checkpoint.resource_version()
@@ -295,8 +308,16 @@ class KubernetesWatchSource:
             if need_list:
                 try:
                     yield from self._relist()
+                    if self._stop.is_set():
+                        return
                     need_list = False
                     self.heartbeat()
+                    # a completed relist is proof of a healthy apiserver:
+                    # transient blips must not accumulate across days into
+                    # max_reconnects exhaustion (or a forever-escalated
+                    # backoff) on an otherwise-recovering stream
+                    backoff = self.retry.delay_seconds
+                    reconnects = 0
                 except (K8sGoneError, K8sApiError) as exc:
                     if self._stop.is_set():
                         return
@@ -336,25 +357,64 @@ class KubernetesWatchSource:
                         # every later reconnect to max_delay forever
                         backoff = self.retry.delay_seconds
                         reconnects = 0
+                        gone_streak = 0
                         self._save_rv(rv)
                         continue
                     event = WatchEvent(type=event_type, pod=obj, resource_version=rv)
                     self._track(event_type, obj)
                     backoff = self.retry.delay_seconds  # healthy stream resets backoff
                     reconnects = 0
+                    gone_streak = 0
                     yield event
                     # checkpoint only after the consumer processed the event
                     # (generator resumes here on next()) — a crash mid-event
                     # then replays it instead of silently skipping it
                     self._save_rv(rv)
-                # bounded watch expired normally -> reconnect immediately
-                self.heartbeat()  # a clean window expiry is still a live link
+                # bounded watch expired normally -> reconnect immediately.
+                # Surviving a whole window (even frameless — the bookmark
+                # hint is advisory and a fully-prefiltered stream can be
+                # silent) proves both the link and the resume rv, so it
+                # resets the same counters a delivered frame does:
+                # otherwise unrelated blips accumulate across days into
+                # max_reconnects exhaustion on a healthy quiet cluster
+                self.heartbeat()
+                backoff = self.retry.delay_seconds
+                reconnects = 0
+                gone_streak = 0
                 logger.debug("Watch window expired; reconnecting from rv=%s", self.resource_version)
 
-            except K8sGoneError:
+            except K8sGoneError as exc:
                 logger.warning("resourceVersion %s expired (410 Gone); relisting", self.resource_version)
                 self.resource_version = None
                 need_list = True
+                gone_streak += 1
+                if gone_streak > 1:
+                    # relist -> watch 410 -> relist with nothing healthy in
+                    # between: the relist keeps outlasting the watch cache.
+                    # Its OWN escalation and bound (the shared counters
+                    # deliberately reset on every successful relist, which
+                    # this cycle contains by construction) — without them
+                    # this loop would hammer full-cluster LISTs forever.
+                    if (
+                        self.max_reconnects is not None
+                        and gone_streak - 1 > self.max_reconnects
+                    ):
+                        logger.error(
+                            "Watch 410d immediately after %d consecutive relists; giving up",
+                            gone_streak,
+                        )
+                        raise
+                    delay = min(
+                        self.retry.delay_seconds
+                        * self.retry.backoff_multiplier ** (gone_streak - 2),
+                        self.retry.max_delay_seconds,
+                    )
+                    logger.warning(
+                        "Watch 410d again right after a relist (streak %d); backing off %.1fs",
+                        gone_streak, delay,
+                    )
+                    if self._stop.wait(delay):
+                        return
 
             except K8sApiError as exc:
                 if self._stop.is_set():

@@ -159,6 +159,11 @@ class NodeWatcher:
     def _run(self) -> None:
         backoff = self.retry.delay_seconds
         need_list = True
+        # consecutive watch-phase 410s with nothing healthy in between:
+        # the first relists immediately (normal recovery), repeats back
+        # off with escalation — same discipline as the pod watch loop,
+        # minus the give-up (this daemon thread must never die)
+        gone_streak = 0
         while not self._stop.is_set():
             try:
                 if need_list:
@@ -199,14 +204,29 @@ class NodeWatcher:
                         self.resource_version = rv
                     event_type = raw.get("type", "")
                     backoff = self.retry.delay_seconds
+                    gone_streak = 0  # a delivered frame breaks the 410 cycle
                     if event_type == "BOOKMARK":
                         continue
                     self._emit(event_type, obj, time.monotonic())
+                gone_streak = 0  # surviving a whole window proves the rv
                 logger.debug("Node watch window expired; reconnecting from rv=%s", self.resource_version)
             except K8sGoneError:
                 logger.warning("Node watch resourceVersion expired; relisting")
                 self.resource_version = None
                 need_list = True
+                gone_streak += 1
+                if gone_streak > 1:
+                    delay = min(
+                        self.retry.delay_seconds
+                        * self.retry.backoff_multiplier ** (gone_streak - 2),
+                        self.retry.max_delay_seconds,
+                    )
+                    logger.warning(
+                        "Node watch 410d again right after a relist (streak %d); backing off %.1fs",
+                        gone_streak, delay,
+                    )
+                    if self._stop.wait(delay):
+                        return
             except Exception as exc:  # noqa: BLE001 — this daemon thread must
                 # never die silently: the pod plane's failures crash run() and
                 # restart the process, but an uncaught error here would just

@@ -81,18 +81,23 @@ class Dispatcher:
         self._pending_lock = threading.Lock()
         self.metrics = metrics or MetricsRegistry()
         self._started = False
+        # serializes the check-then-spawn in start(): two producers'
+        # first submit() calls racing the auto-start must not each spawn
+        # a worker set (2x the configured POST fan-out)
+        self._start_lock = threading.Lock()
         self._stopping = threading.Event()
         # set when the drain window expired: workers stop claiming work
         self._abandon = threading.Event()
 
     def start(self) -> None:
-        if self._started:
-            return
-        self._started = True
-        for i in range(self._workers):
-            t = threading.Thread(target=self._worker, name=f"notify-worker-{i}", daemon=True)
-            t.start()
-            self._threads.append(t)
+        with self._start_lock:
+            if self._started:
+                return
+            self._started = True
+            for i in range(self._workers):
+                t = threading.Thread(target=self._worker, name=f"notify-worker-{i}", daemon=True)
+                t.start()
+                self._threads.append(t)
 
     def submit(self, notification: Notification) -> bool:
         """Enqueue without blocking; coalesce per-key, drop-oldest on
@@ -101,9 +106,11 @@ class Dispatcher:
         was accepted. Lossy latest-wins semantics: acceptance is not a
         delivery guarantee — a concurrent overflow drop may still evict the
         key's slot, discarding the newest payload for that key (counted as
-        ``dispatch_dropped_overflow_coalesced``). Returns False when the
-        notification was rejected outright (overflow of uncoalesced
-        entries, or shutdown in progress)."""
+        ``dispatch_dropped_overflow_coalesced``). Returns False only for
+        shutdown in progress — overflow never rejects the NEW entry (the
+        oldest queued one is evicted instead, observable as
+        ``dispatch_dropped_overflow``), so callers must watch the drop
+        counters, not the return value, for backpressure."""
         if self._stopping.is_set():
             self.metrics.counter("dispatch_dropped_stopping").inc()
             return False
@@ -218,3 +225,24 @@ class Dispatcher:
                     logger.exception("Dispatcher abort callback failed")
         for t in self._threads:
             t.join(timeout=max(0.1, deadline - time.monotonic()))
+        # a submit() that passed the _stopping check just before set()
+        # can land its entry AFTER drain saw an empty queue and the
+        # workers exited — accepted (True, dispatch_enqueued counted) but
+        # never claimable. Sweep and account the strays so no accepted
+        # notification is lost UNACCOUNTED. (WatcherApp.shutdown stops
+        # every producer before the dispatcher, so nothing races this
+        # sweep itself.)
+        strays = 0
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            self._queue.task_done()
+            if self._claim(item) is not None or isinstance(item, Notification):
+                strays += 1
+        # the drain-expiry branch above already counted its backlog via
+        # unfinished_tasks — only a CLEAN drain can have unaccounted strays
+        if strays and drained:
+            logger.warning("%d notification(s) accepted mid-shutdown were never sent", strays)
+            self.metrics.counter("dispatch_abandoned_shutdown").inc(strays)

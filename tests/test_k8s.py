@@ -980,6 +980,63 @@ class TestKubernetesWatchSource:
         # paging attempts x 1 page each — not an unbounded loop
         assert len(client.page_sizes) <= 9
 
+    def test_repeated_watch_410_backs_off_and_gives_up(self, mock_api):
+        """A watch that 410s immediately after EVERY relist (the relist
+        keeps outlasting the watch cache) must escalate its own backoff
+        and give up at the bound — not loop back-to-back full-cluster
+        LISTs forever. The first 410 still relists immediately (normal
+        recovery)."""
+        for i in range(5):
+            mock_api.cluster.add_pod(build_pod(f"p{i}", uid=f"u{i}"))
+
+        class Always410Watch(CountingClient):
+            def watch_pods(self, *a, **kw):
+                raise K8sGoneError("rv expired", status=410)
+                yield  # pragma: no cover — make it a generator
+
+        client = Always410Watch(mock_api)
+        retry = RetryPolicy(max_attempts=5, delay_seconds=0.02, backoff_multiplier=2.0)
+        source = KubernetesWatchSource(client, retry=retry, max_reconnects=2)
+        t0 = time.monotonic()
+        with pytest.raises(K8sGoneError):
+            for _ in source.events():
+                pass
+        # streak 1 relists immediately, streaks 2..3 after escalating
+        # delays, streak 4 exceeds the bound: max_reconnects+2 relists
+        # of 1 page each, then the raise
+        assert len(client.page_sizes) == 4, client.page_sizes
+        assert time.monotonic() - t0 >= 0.02 + 0.04  # the escalating waits ran
+
+    def test_clean_window_expiry_resets_reconnect_budget(self, mock_api):
+        """Frameless clean watch-window expiries (quiet cluster, advisory
+        bookmarks ignored) must reset the transient-failure budget like
+        delivered frames do — otherwise unrelated blips accumulate across
+        days into max_reconnects exhaustion on a healthy stream."""
+        mock_api.cluster.add_pod(build_pod("p0", uid="u0"))
+
+        class FlakyWatch(CountingClient):
+            def __init__(self, server):
+                super().__init__(server)
+                self.calls = 0
+
+            def watch_pods(self, *a, **kw):
+                self.calls += 1
+                if self.calls > 8:
+                    raise K8sApiError("done", status=599)  # end the test
+                if self.calls % 2 == 1:
+                    raise K8sApiError("transient blip", status=500)
+                return iter(())  # clean frameless window expiry
+
+        client = FlakyWatch(mock_api)
+        retry = RetryPolicy(max_attempts=5, delay_seconds=0.01, backoff_multiplier=1.0)
+        # 4 alternating blips against max_reconnects=2: without the
+        # clean-expiry reset the 3rd blip would exhaust the budget early
+        source = KubernetesWatchSource(client, retry=retry, max_reconnects=2)
+        with pytest.raises(K8sApiError):
+            for _ in source.events():
+                pass
+        assert client.calls > 8, "budget exhausted early — clean expiries did not reset it"
+
     def test_relist_pages_10k_pods_with_tombstones(self, mock_api):
         """The relist path streams bounded pages at cluster scale: 10k
         pods arrive in list_page_size chunks (never one unbounded

@@ -428,3 +428,49 @@ class TestCoalescing:
         d.stop()
         assert d._pending == {}  # dropped slots must not leak pending payloads
         assert d.metrics.counter("dispatch_dropped_overflow").value == 3
+
+
+class TestDispatcherShutdownRaces:
+    def test_concurrent_first_submits_spawn_one_worker_set(self):
+        """Two producers' first submit() calls race the auto-start: the
+        check-then-spawn is locked, so exactly ``workers`` threads exist
+        no matter how many submitters arrive at once."""
+        d = Dispatcher(lambda p: True, workers=3, coalesce=False)
+        barrier = threading.Barrier(8)
+
+        def first_submit(i):
+            barrier.wait(5)
+            d.submit(Notification({"name": f"p{i}"}, time.monotonic()))
+
+        threads = [threading.Thread(target=first_submit, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(5)
+        try:
+            assert len(d._threads) == 3, f"duplicate worker sets spawned: {len(d._threads)}"
+        finally:
+            d.stop()
+
+    def test_entry_accepted_mid_shutdown_is_swept_and_accounted(self):
+        """A submit() that passes the _stopping check just before stop()
+        can land its entry after the clean drain and worker exit — it
+        must be swept and counted as abandoned, never silently stranded
+        as an accepted-but-unaccounted notification."""
+        d = Dispatcher(lambda p: True, workers=1, coalesce=False)
+        d.start()
+        real_drain = d.drain
+
+        def drain_then_inject(timeout):
+            ok = real_drain(timeout)
+            # emulate the TOCTOU: wait for the workers to exit on
+            # stopping+empty, THEN land the racing entry
+            for t in d._threads:
+                t.join(5)
+            d._queue.put_nowait(Notification({"name": "stray"}, time.monotonic()))
+            return ok
+
+        d.drain = drain_then_inject
+        d.stop()
+        assert d.metrics.counter("dispatch_abandoned_shutdown").value == 1
+        assert d._queue.empty()
