@@ -496,6 +496,12 @@ class K8sClient:
                 )
             except requests.RequestException as exc:
                 raise K8sApiError(f"watch connect failed: {exc}") from exc
+            # register BEFORE any body read: reading an error body below
+            # can block on a stalled stream for the full read timeout, and
+            # an unregistered response is invisible to abort_watch() — a
+            # SIGTERM landing there would wedge shutdown past any grace
+            # period
+            self._active_watch_response = response
             if response.status_code == 410:
                 raise K8sGoneError("watch: resourceVersion expired (410 Gone)", status=410)
             if response.status_code >= 400:
@@ -505,7 +511,6 @@ class K8sClient:
                 raise K8sApiError(
                     f"watch: HTTP {response.status_code}: {response.text[:300]}", status=response.status_code
                 )
-            self._active_watch_response = response
             if self._watch_aborted:
                 # abort_watch() ran while we were connecting: there was no
                 # response for it to close, so honor the abort here

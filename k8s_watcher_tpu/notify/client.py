@@ -75,7 +75,28 @@ def proxy_for(
             return None
     except Exception:  # resolver hiccups in bypass lookups must not kill sends
         pass
-    url = urllib.request.getproxies().get(scheme)
+    try:
+        # urllib's proxy_bypass is suffix-matching only; requests ALSO
+        # honors CIDR entries (NO_PROXY=10.0.0.0/8) for IP-literal hosts —
+        # without this, an in-cluster IP target gets routed through the
+        # egress proxy that can't reach it
+        import ipaddress
+        import os
+
+        addr = ipaddress.ip_address(host.strip("[]"))
+        no_proxy = os.environ.get("no_proxy") or os.environ.get("NO_PROXY") or ""
+        for entry in (e.strip() for e in no_proxy.split(",")):
+            if "/" in entry:
+                try:
+                    if addr in ipaddress.ip_network(entry, strict=False):
+                        return None
+                except ValueError:
+                    continue
+    except ValueError:
+        pass  # hostname, not an IP literal: suffix matching above suffices
+    proxies = urllib.request.getproxies()
+    # requests falls back to ALL_PROXY when no scheme-specific proxy is set
+    url = proxies.get(scheme) or proxies.get("all")
     if not url:
         return None
     parts = urlsplit(url if "://" in url else f"http://{url}")
@@ -148,7 +169,11 @@ class ClusterApiClient:
         # connection is also registered here
         self._abort = threading.Event()
         self._conns_lock = threading.Lock()
-        self._conns: set = set()
+        # conn -> owning thread: abort() closes every value; registration
+        # prunes entries whose thread died (its threading.local dropped
+        # the only other reference, and nothing else would ever close the
+        # keep-alive socket — unbounded fd growth under thread churn)
+        self._conns: dict = {}
 
     def abort(self) -> None:
         """Cut every in-flight send and suppress further attempts: pending
@@ -230,14 +255,22 @@ class ClusterApiClient:
                 except Exception:
                     pass
                 raise ConnectionError("client aborted (shutting down)")
-            self._conns.add(conn)
+            for stale_conn, owner in [
+                (c, t) for c, t in self._conns.items() if not t.is_alive()
+            ]:
+                del self._conns[stale_conn]
+                try:
+                    stale_conn.close()
+                except Exception:
+                    pass
+            self._conns[conn] = threading.current_thread()
         return conn, True
 
     def _drop_connection(self) -> None:
         conn = getattr(self._local, "conn", None)
         if conn is not None:
             with self._conns_lock:
-                self._conns.discard(conn)
+                self._conns.pop(conn, None)
             try:
                 conn.close()
             except Exception:
@@ -251,7 +284,11 @@ class ClusterApiClient:
         http.client.RemoteDisconnected,
         http.client.BadStatusLine,
         ConnectionResetError,
+        ConnectionAbortedError,
         BrokenPipeError,
+        # an HTTPS keep-alive idled out without a clean close_notify
+        # (common through LBs) surfaces as an SSL EOF on the next request
+        ssl.SSLEOFError,
     )
 
     def _request(self, method: str, path: str, body: Optional[bytes]) -> Tuple[int, bytes]:
@@ -287,7 +324,13 @@ class ClusterApiClient:
         4xx responses are not retried (client error — retrying can't help).
         """
         endpoint = f"{self.base_url}{self.pod_update_endpoint}"
-        body = json.dumps(pod_data).encode("utf-8")
+        try:
+            body = json.dumps(pod_data).encode("utf-8")
+        except (TypeError, ValueError) as exc:
+            # the documented contract is boolean-never-raises; a
+            # non-serializable payload is a False, not a caller crash
+            logger.error("Unserializable pod payload (%s); dropping", exc)
+            return False
         attempts = max(1, self.retry.max_attempts)
         delay = self.retry.delay_seconds
         for attempt in range(1, attempts + 1):
@@ -299,7 +342,9 @@ class ClusterApiClient:
                 if status == 200:
                     logger.debug("Updated pod data for %s", pod_data.get("name", "unknown"))
                     return True
-                retriable = status >= 500
+                # 5xx, plus the two 4xx codes that MEAN "try again":
+                # 429 rate limiting and 408 request timeout
+                retriable = status >= 500 or status in (408, 429)
                 logger.error(
                     "Failed to update pod data. Status: %s, Response: %s",
                     status, text.decode("utf-8", errors="replace")[:500],
@@ -321,15 +366,28 @@ class ClusterApiClient:
         return False
 
     def health_check(self) -> bool:
-        """GET the health endpoint; True iff 200 (parity: 5 s timeout)."""
+        """GET the health endpoint; True iff 200 (parity: 5 s timeout).
+        Abort-aware like the send path: a client that has formally
+        abandoned its target must not mint new sockets to it, and an
+        in-flight probe must be cuttable (registered) so shutdown isn't
+        held up to the probe timeout by a hung target."""
+        if self._abort.is_set():
+            return False
         try:
             # parity with the reference's fixed 5 s health timeout
             conn = self._new_connection(5)
+            with self._conns_lock:
+                if self._abort.is_set():
+                    conn.close()
+                    return False
+                self._conns[conn] = threading.current_thread()
             try:
                 conn.request("GET", self._request_target(self.health_endpoint),
                              headers=self._request_headers())
                 return conn.getresponse().status == 200
             finally:
+                with self._conns_lock:
+                    self._conns.pop(conn, None)
                 conn.close()
         except Exception:
             return False

@@ -117,6 +117,54 @@ class TestClusterApiClient:
         assert ClusterApiClient(url).health_check() is True
         assert ClusterApiClient("http://127.0.0.1:1").health_check() is False
 
+    def test_429_and_408_are_retried(self, api_server):
+        # rate limiting / request timeout are the 4xx codes that MEAN
+        # "try again" — dropping the state update on the first 429 would
+        # leave the receiver's view stale for the whole burst
+        server, url = api_server
+        server.script = [429, 408]
+        client = ClusterApiClient(url, retry=RetryPolicy(max_attempts=3, delay_seconds=0.0))
+        assert client.update_pod_status({}) is True
+        assert len(server.received) == 3
+
+    def test_unserializable_payload_returns_false(self, api_server):
+        # documented contract: boolean, never raises
+        _, url = api_server
+        client = ClusterApiClient(url)
+        assert client.update_pod_status({"bad": object()}) is False
+
+    def test_tls_teardown_counts_as_stale_connection(self):
+        import ssl
+
+        assert ssl.SSLEOFError in ClusterApiClient._STALE_CONN_ERRORS
+        assert ConnectionAbortedError in ClusterApiClient._STALE_CONN_ERRORS
+
+    def test_health_check_refuses_after_abort(self, api_server):
+        _, url = api_server
+        client = ClusterApiClient(url)
+        assert client.health_check() is True
+        client.abort()
+        assert client.health_check() is False
+
+    def test_dead_thread_connections_are_pruned(self, api_server):
+        """Each dying sender thread's keep-alive socket must leave the
+        registry at the next registration — not accumulate until abort."""
+        _, url = api_server
+        client = ClusterApiClient(url)
+
+        def send():
+            assert client.update_pod_status({"name": "w"}) is True
+
+        for _ in range(4):
+            t = threading.Thread(target=send)
+            t.start()
+            t.join(5)
+        # one final registration from a live thread prunes all dead ones
+        assert client.update_pod_status({"name": "w"}) is True
+        with client._conns_lock:
+            owners = list(client._conns.values())
+        assert len(owners) == 1 and owners[0].is_alive()
+
 
 class TestDispatcher:
     def _notification(self, i=0):
@@ -260,7 +308,7 @@ class TestBoundedShutdown:
         client._abort = RacedEvent()
         with pytest.raises(ConnectionError):
             client._connection()
-        assert client._conns == set(), "raced connection left registered"
+        assert not client._conns, "raced connection left registered"
         assert getattr(client._local, "conn", None) is None
         assert client._abort.checks == 2
 
