@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: test check check-scale integration integration-kind integration-mock bench dryrun dryrun-128 accept
+.PHONY: test check check-scale integration integration-kind integration-mock bench bench-smoke dryrun dryrun-128 accept
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -31,6 +31,13 @@ integration-mock:
 
 bench:
 	$(PY) bench.py
+
+# Bounded-budget regression smoke: the e2e latency tier + the sharded
+# ingest ceiling + small relist/checkpoint runs, no probes (~5 s of
+# measurement). Also runs pre-merge as the slow-marked
+# tests/test_bench_smoke.py.
+bench-smoke:
+	$(PY) bench.py --smoke
 
 dryrun:
 	$(PY) __graft_entry__.py 8

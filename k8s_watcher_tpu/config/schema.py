@@ -588,6 +588,46 @@ class TpuConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class IngestConfig:
+    """The ``ingest:`` section — net-new sharded watch ingest.
+
+    ``shards`` watch streams (each its own connection + resume version,
+    partitioned by a stable hash of the pod UID) feed one bounded MPSC
+    queue drained in batches of up to ``batch_max`` events through
+    ``EventPipeline.process_batch``. ``shards: 1`` runs the SAME queue +
+    batch machinery over a single stream — there is no unsharded code
+    path. Shard partition push-down rides a ``shard`` query param the
+    in-repo mock apiserver (and a shard-aware proxy) honors; a stock
+    apiserver ignores it and each stream drops non-owned events
+    client-side, so shards > 1 against a stock apiserver multiplies
+    watch-stream load by the shard count (see ARCHITECTURE.md "Sharded
+    ingest").
+    """
+
+    shards: int = 1
+    batch_max: int = 128
+    queue_capacity: int = 8192
+
+    @classmethod
+    def from_raw(cls, raw: Mapping[str, Any]) -> "IngestConfig":
+        _check_known(raw, ("shards", "batch_max", "queue_capacity"), "ingest")
+        shards = _opt_int(raw, "shards", "ingest", 1)
+        if shards < 1:
+            raise SchemaError(f"config key 'ingest.shards': must be >= 1, got {shards}")
+        batch_max = _opt_int(raw, "batch_max", "ingest", 128)
+        if batch_max < 1:
+            raise SchemaError(f"config key 'ingest.batch_max': must be >= 1, got {batch_max}")
+        queue_capacity = _opt_int(raw, "queue_capacity", "ingest", 8192)
+        if queue_capacity < batch_max:
+            raise SchemaError(
+                f"config key 'ingest.queue_capacity': must be >= batch_max "
+                f"({batch_max}), got {queue_capacity} (a queue smaller than one "
+                f"batch can never fill a batch and would throttle the drain)"
+            )
+        return cls(shards=shards, batch_max=batch_max, queue_capacity=queue_capacity)
+
+
+@dataclasses.dataclass(frozen=True)
 class StateConfig:
     """The ``state:`` section — net-new checkpoint/resume (SURVEY.md §5).
 
@@ -618,13 +658,14 @@ class AppConfig:
     kubernetes: KubernetesConfig
     tpu: TpuConfig
     state: StateConfig
+    ingest: IngestConfig = dataclasses.field(default_factory=IngestConfig)
 
-    TOP_LEVEL_KEYS = ("environment", "watcher", "clusterapi", "kubernetes", "tpu", "state")
+    TOP_LEVEL_KEYS = ("environment", "watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest")
 
     @classmethod
     def from_raw(cls, raw: Mapping[str, Any], environment: str) -> "AppConfig":
         _check_known(raw, cls.TOP_LEVEL_KEYS, "<root>")
-        for section in ("watcher", "clusterapi", "kubernetes", "tpu", "state"):
+        for section in ("watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest"):
             _expect(raw.get(section) or {}, (dict,), section)
         # The reference's development.yaml declared `environment: local` while
         # the CLI only accepted development|staging|production, leaving the
@@ -640,4 +681,5 @@ class AppConfig:
             kubernetes=KubernetesConfig.from_raw(raw.get("kubernetes") or {}),
             tpu=TpuConfig.from_raw(raw.get("tpu") or {}),
             state=StateConfig.from_raw(raw.get("state") or {}),
+            ingest=IngestConfig.from_raw(raw.get("ingest") or {}),
         )

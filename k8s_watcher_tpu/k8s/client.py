@@ -25,12 +25,14 @@ from __future__ import annotations
 
 import json
 import logging
+import threading
 from typing import Any, Dict, Iterator, List, Optional
 
 import requests
 import urllib3
 
 from k8s_watcher_tpu.k8s.kubeconfig import K8sConnection
+from k8s_watcher_tpu.watch.sharded import parse_shard_selector
 
 logger = logging.getLogger(__name__)
 
@@ -220,13 +222,21 @@ class K8sClient:
         limit: Optional[int] = None,
         label_selector: Optional[str] = None,
         continue_token: Optional[str] = None,
+        shard_selector: Optional[str] = None,
     ) -> Dict[str, Any]:
         """One page of pods; returns the raw PodList body (items +
         metadata.resourceVersion, the resume point for a subsequent watch,
         + metadata.continue when more pages remain). Pass the previous
         page's ``metadata.continue`` as ``continue_token`` to fetch the
         next page; an expired token raises K8sGoneError (410) and the
-        caller must restart the list (see ``list_pods_paged``)."""
+        caller must restart the list (see ``list_pods_paged``).
+
+        ``shard_selector`` ("i/n", watch/sharded.py) asks the server to
+        return only pods whose uid-hash lands on shard i. The in-repo mock
+        apiserver honors it (each shard's LIST pages 1/n of the cluster,
+        with its own continue-token chain); a stock apiserver ignores the
+        unknown param and the caller's client-side ownership filter keeps
+        correctness."""
         params: Dict[str, Any] = {}
         if limit:
             params["limit"] = limit
@@ -234,6 +244,8 @@ class K8sClient:
             params["labelSelector"] = label_selector
         if continue_token:
             params["continue"] = continue_token
+        if shard_selector:
+            params["shard"] = shard_selector
         return self._get(self._pods_path(namespace), params).json()
 
     def _list_paged(self, fetch_page, max_restarts: int):
@@ -284,24 +296,76 @@ class K8sClient:
         page_size: int = 500,
         label_selector: Optional[str] = None,
         max_restarts: int = 2,
+        shard_selector: Optional[str] = None,
     ):
         """Stream a large pod LIST in bounded pages (``limit``+``continue``
         — the SDK-provided behavior at reference pod_watcher.py:264 that
         the from-scratch client must supply itself; without it every
         relist of a large cluster is one unbounded response). Contract:
-        see ``_list_paged``."""
+        see ``_list_paged``; ``shard_selector``: see ``list_pods``."""
         return self._list_paged(
             lambda token: self.list_pods(
                 namespace,
                 limit=page_size,
                 label_selector=label_selector,
                 continue_token=token,
+                shard_selector=shard_selector,
             ),
             max_restarts,
         )
 
     @staticmethod
-    def iter_list_pages(pages, *, metrics=None, metric_prefix: str = "relist"):
+    def _prefetch_iter(source):
+        """One-ahead prefetch: a helper thread pulls the NEXT page (HTTP
+        round trip + server-side serialization + JSON decode) while the
+        consumer processes the current one — the fetch/process overlap
+        that makes a paged relist's wall time max(fetch, process) per page
+        instead of their sum. Exceptions (410 token expiry included)
+        re-raise in the consumer, in order. The consumer abandoning early
+        sets ``cancel``; the helper notices at its next hand-off."""
+        import queue as _queue
+
+        out: "_queue.Queue" = _queue.Queue(maxsize=1)
+        done = object()
+        cancel = threading.Event()
+
+        def put_cancellable(item) -> bool:
+            """Bounded put that gives up once the consumer abandoned us —
+            EVERY pump-side put must go through this, the terminal
+            sentinels included, or an early-exiting consumer leaves the
+            pump thread blocked forever holding a full LIST page."""
+            while not cancel.is_set():
+                try:
+                    out.put(item, timeout=0.2)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        def pump() -> None:
+            try:
+                for item in source:
+                    if not put_cancellable(item):
+                        return
+                put_cancellable(done)
+            except BaseException as exc:  # noqa: BLE001 — forwarded, not handled
+                put_cancellable(("__exc__", exc))
+
+        thread = threading.Thread(target=pump, name="list-page-prefetch", daemon=True)
+        thread.start()
+        try:
+            while True:
+                item = out.get()
+                if item is done:
+                    return
+                if isinstance(item, tuple) and len(item) == 2 and item[0] == "__exc__":
+                    raise item[1]
+                yield item
+        finally:
+            cancel.set()
+
+    @staticmethod
+    def iter_list_pages(pages, *, metrics=None, metric_prefix: str = "relist", prefetch: bool = False):
         """Consume a ``_list_paged`` stream page by page, yielding
         ``(rv, items, attempt_changed)`` while recording the shared relist
         cost metrics (``<prefix>s``/``<prefix>_pages``/
@@ -312,9 +376,13 @@ class K8sClient:
         the first page of a RESTARTED attempt (new snapshot): consumers
         must reset anything accumulated from the aborted attempt's pages
         (both relist consumers reset their tombstone bookkeeping — the
-        invariants live HERE so the pod and node paths can't drift)."""
+        invariants live HERE so the pod and node paths can't drift).
+        ``prefetch`` overlaps the next page's fetch with the current
+        page's processing (see ``_prefetch_iter``)."""
         import time
 
+        if prefetch:
+            pages = K8sClient._prefetch_iter(pages)
         t0 = time.monotonic()
         if metrics is not None:
             metrics.counter(f"{metric_prefix}s").inc()
@@ -424,6 +492,7 @@ class K8sClient:
         allow_bookmarks: bool = True,
         label_selector: Optional[str] = None,
         scanner=None,  # native.scanner.FrameScanner — hot-loop prefilter
+        shard_selector: Optional[str] = None,
     ) -> Iterator[Dict[str, Any]]:
         """Stream raw pod watch events (``{"type": ..., "object": ...}``)
         until the server closes the bounded watch or an error occurs.
@@ -432,7 +501,13 @@ class K8sClient:
         accelerator resource are skipped WITHOUT a JSON parse and surface as
         lightweight ``{"type": "PREFILTERED"}`` markers carrying only the
         resourceVersion (the hot loop's dominant cost in a mostly-non-TPU
-        cluster is decoding pods the resource filter then discards)."""
+        cluster is decoding pods the resource filter then discards).
+
+        ``shard_selector`` ("i/n") asks the server to stream only shard
+        i's pods (the mock apiserver honors it). Against a server that
+        ignores it, frames whose uid the scanner can extract are dropped
+        pre-parse when they hash to another shard — the same PREFILTERED
+        contract, so the resume version still advances."""
         return self._watch(
             self._pods_path(namespace),
             resource_version=resource_version,
@@ -440,6 +515,7 @@ class K8sClient:
             allow_bookmarks=allow_bookmarks,
             label_selector=label_selector,
             scanner=scanner,
+            shard_selector=shard_selector,
         )
 
     def watch_nodes(
@@ -473,6 +549,7 @@ class K8sClient:
         allow_bookmarks: bool,
         label_selector: Optional[str],
         scanner,
+        shard_selector: Optional[str] = None,
     ) -> Iterator[Dict[str, Any]]:
         params: Dict[str, Any] = {"watch": "true", "timeoutSeconds": timeout_seconds}
         if resource_version:
@@ -481,6 +558,8 @@ class K8sClient:
             params["allowWatchBookmarks"] = "true"
         if label_selector:
             params["labelSelector"] = label_selector
+        if shard_selector:
+            params["shard"] = shard_selector
 
         # Read timeout must outlast the server-side watch window or we'd kill
         # healthy idle watches; +30 s of slack over timeoutSeconds.
@@ -515,7 +594,8 @@ class K8sClient:
                 # abort_watch() ran while we were connecting: there was no
                 # response for it to close, so honor the abort here
                 raise K8sApiError("watch aborted during connect")
-            yield from self._decode_watch_stream(response, scanner)
+            shard = parse_shard_selector(shard_selector) if shard_selector else None
+            yield from self._decode_watch_stream(response, scanner, shard)
         except (requests.RequestException, urllib3.exceptions.HTTPError, OSError) as exc:
             # urllib3/socket errors surface directly on the raw-chunk fast
             # path (iter_lines would have wrapped them in requests types)
@@ -559,7 +639,7 @@ class K8sClient:
             "object": {"metadata": {"resourceVersion": resource_version}},
         }
 
-    def _decode_watch_stream(self, response, scanner) -> Iterator[Dict[str, Any]]:
+    def _decode_watch_stream(self, response, scanner, shard=None) -> Iterator[Dict[str, Any]]:
         """Turn the chunked HTTP body into watch events.
 
         Three paths, fastest first:
@@ -568,6 +648,13 @@ class K8sClient:
           bytes are never touched by the interpreter;
         - per-frame scanner: iter_lines + scan before parse;
         - no scanner: iter_lines + parse (reference-equivalent behavior).
+
+        ``shard`` (``(i, n)``) adds the client-side shard ownership skip on
+        the per-frame path: a frame whose scanned uid hashes to another
+        shard becomes an rv-only PREFILTERED marker without a JSON parse.
+        The chunk path has no per-frame uid, so foreign-shard frames there
+        parse and are dropped by the watch source — correctness is always
+        the source's post-parse filter; this is only the fast path.
         """
         if scanner is None:
             for line in response.iter_lines():
@@ -588,7 +675,7 @@ class K8sClient:
                 if not line:
                     continue
                 scan = scanner.scan(line)
-                if scan.skippable:
+                if scan.skippable or (shard is not None and scan.foreign_shard(*shard)):
                     yield self._prefiltered_marker(scan.resource_version)
                 else:
                     yield self._parse_frame(line)
@@ -621,7 +708,7 @@ class K8sClient:
             # server closed mid-line without a trailing newline: the tail is
             # the final frame
             scan = scanner.scan(tail)
-            if scan.skippable:
+            if scan.skippable or (shard is not None and scan.foreign_shard(*shard)):
                 yield self._prefiltered_marker(scan.resource_version)
             else:
                 yield self._parse_frame(tail)

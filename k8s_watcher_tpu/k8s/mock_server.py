@@ -88,6 +88,67 @@ def _matches_selector(pod: Dict[str, Any], selector: List[Tuple[str, Optional[st
     return True
 
 
+def _parse_shard(param: Optional[str]) -> Optional[Tuple[int, int]]:
+    """``(shard, shards)`` from the ``shard=i/n`` query param the sharded
+    ingest sends (watch/sharded.py wire format), or None. A malformed
+    selector is IGNORED (None), matching a stock apiserver's treatment of
+    unknown/garbage query params — the client's ownership filter keeps
+    correctness either way."""
+    if not param:
+        return None
+    from k8s_watcher_tpu.watch.sharded import parse_shard_selector
+
+    return parse_shard_selector(param)
+
+
+def _matches_shard(obj: Dict[str, Any], shard: Optional[Tuple[int, int]]) -> bool:
+    """Server-side shard push-down: uid-hash partition, the same
+    ``shard_of`` the client uses (the whole point is that both sides
+    compute the identical stable partition)."""
+    if shard is None:
+        return True
+    from k8s_watcher_tpu.watch.sharded import shard_of
+
+    uid = (obj.get("metadata") or {}).get("uid") or ""
+    return shard_of(uid, shard[1]) == shard[0]
+
+
+class _PreserializedList(dict):
+    """A list-response body whose items are already JSON text.
+
+    ``_Handler._json`` splices ``items_json`` into the encoded body
+    instead of re-serializing every object: the per-object JSON is built
+    (and cached) once on the cluster side — the mock's analogue of the
+    real apiserver's serialized watch cache. Without it a paged LIST
+    deep-copied and double-encoded every pod per page, and at 10k+ pods
+    the MOCK dominated the relist benches this server exists to serve.
+
+    Direct in-process consumers (tests calling ``cluster.list_pods``
+    without HTTP) still read ``body["items"]``: the list materializes
+    lazily from the cached text on first access — same decoupled-copy
+    guarantee the old per-object deep copy gave.
+    """
+
+    def __getitem__(self, key):
+        if key == "items" and not dict.__contains__(self, "items"):
+            dict.__setitem__(
+                self, "items", [json.loads(t) for t in dict.__getitem__(self, "items_json")]
+            )
+        return dict.__getitem__(self, key)
+
+    def get(self, key, default=None):
+        try:
+            return self[key]
+        except KeyError:
+            return default
+
+    def encode(self) -> bytes:
+        items_json = self.pop("items_json")
+        self.pop("items", None)  # drop any lazily materialized copy
+        head = json.dumps(self)
+        return (head[:-1] + ',"items":[' + ",".join(items_json) + "]}").encode()
+
+
 class MockCluster:
     """Shared cluster state + event journal."""
 
@@ -109,6 +170,11 @@ class MockCluster:
         # page re-sorted and re-filtered the WHOLE map — O(n^2/page_size)
         # across a paged list, 22 s for a 50k-pod relist
         self._sorted_keys: Dict[str, Tuple[int, list]] = {}
+        # per-pod serialized-JSON cache (key -> (rv, json_text)), the
+        # mock's analogue of the apiserver's serialized watch cache:
+        # LIST pages splice cached text instead of deep-copy + re-encode
+        # per pod per page. rv-validated, entries dropped on delete.
+        self._pod_json: Dict[Tuple[str, str], Tuple[str, str]] = {}
 
     def _sorted_collection_keys(self, collection: str, mapping) -> list:
         """Sorted key list for ``mapping``, cached until the next
@@ -170,6 +236,7 @@ class MockCluster:
         key = (namespace, name)
         with self._lock:
             pod = self._pods.pop(key, None)
+            self._pod_json.pop(key, None)
         if pod is None:
             return None
         return self._record("DELETED", pod)
@@ -254,6 +321,7 @@ class MockCluster:
     def delete_node(self, name: str) -> Optional[int]:
         with self._lock:
             node = self._nodes.pop(name, None)
+            self._pod_json.pop(("", name), None)  # node cache key (ns "")
         if node is None:
             return None
         return self._record("DELETED", node, collection="nodes")
@@ -356,6 +424,17 @@ class MockCluster:
             ]
             return 200, self._page_body("NodeList", matches, limit, snapshot_rv)
 
+    def _serialized(self, key: Tuple[str, str], obj: Dict[str, Any]) -> str:
+        """Cached JSON text for one object (the dumps IS the under-lock
+        snapshot a deep copy used to provide). Call under ``self._lock``."""
+        rv = str((obj.get("metadata") or {}).get("resourceVersion", ""))
+        cached = self._pod_json.get(key)
+        if cached is not None and cached[0] == rv:
+            return cached[1]
+        text = json.dumps(obj)
+        self._pod_json[key] = (rv, text)
+        return text
+
     def _page_body(
         self,
         kind: str,
@@ -364,7 +443,8 @@ class MockCluster:
         snapshot_rv: Optional[str],
     ) -> Dict[str, Any]:
         """One page + metadata (rv pinned to the list's snapshot, continue
-        token when more remain). Call under ``self._lock``."""
+        token when more remain). Call under ``self._lock``. The returned
+        body carries pre-serialized items (see ``_PreserializedList``)."""
         rv = snapshot_rv if snapshot_rv is not None else str(self._rv)
         next_token = None
         if limit and len(matches) > limit:
@@ -374,12 +454,12 @@ class MockCluster:
         metadata: Dict[str, Any] = {"resourceVersion": rv}
         if next_token:
             metadata["continue"] = next_token
-        return {
-            "kind": kind,
-            "apiVersion": "v1",
-            "metadata": metadata,
-            "items": [json.loads(json.dumps(obj)) for _key, obj in matches],
-        }
+        return _PreserializedList(
+            kind=kind,
+            apiVersion="v1",
+            metadata=metadata,
+            items_json=[self._serialized(key, obj) for key, obj in matches],
+        )
 
     def compact(self) -> None:
         """Forget journal history: any watch resuming below the current rv
@@ -411,6 +491,7 @@ class MockCluster:
         limit: Optional[int],
         label_selector: Optional[str] = None,
         continue_token: Optional[str] = None,
+        shard: Optional[str] = None,
     ) -> Tuple[int, Dict[str, Any]]:
         """(status, body) for ``GET .../pods`` with ``limit``+``continue``
         pagination (the apiserver contract the paged client consumes):
@@ -428,6 +509,7 @@ class MockCluster:
         between pages is journaled at rv > snapshot and arrives via the
         resumed watch."""
         selector = _parse_label_selector(label_selector)
+        shard_sel = _parse_shard(shard)
         try:
             snapshot_rv, after = _decode_continue(continue_token)
         except ValueError:
@@ -438,7 +520,8 @@ class MockCluster:
             matches = self._cursor_page(
                 "pods", self._pods, after, limit,
                 lambda key, pod: (namespace is None or key[0] == namespace)
-                and _matches_selector(pod, selector),
+                and _matches_selector(pod, selector)
+                and _matches_shard(pod, shard_sel),
             )
             return 200, self._page_body("PodList", matches, limit, snapshot_rv)
 
@@ -561,7 +644,7 @@ class _Handler(BaseHTTPRequestHandler):
         return limit
 
     def _json(self, status: int, body: Dict[str, Any]) -> None:
-        data = json.dumps(body).encode()
+        data = body.encode() if isinstance(body, _PreserializedList) else json.dumps(body).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
@@ -636,7 +719,8 @@ class _Handler(BaseHTTPRequestHandler):
             if limit is _BAD_LIMIT:
                 return
             status, body = self.cluster.list_pods(
-                namespace, limit, params.get("labelSelector"), params.get("continue")
+                namespace, limit, params.get("labelSelector"), params.get("continue"),
+                shard=params.get("shard"),
             )
             self._json(status, body)
 
@@ -735,6 +819,7 @@ class _Handler(BaseHTTPRequestHandler):
         timeout_s = min(int(params.get("timeoutSeconds", "30") or "30"), 300)
         deadline = time.monotonic() + timeout_s
         selector = _parse_label_selector(params.get("labelSelector"))
+        shard_sel = _parse_shard(params.get("shard")) if collection == "pods" else None
         send_bookmarks = params.get("allowWatchBookmarks") == "true"
         last_frame = time.monotonic()
 
@@ -779,6 +864,8 @@ class _Handler(BaseHTTPRequestHandler):
                     if namespace is not None and obj_ns != namespace:
                         continue
                     if selector and not _matches_selector(obj, selector):
+                        continue
+                    if not _matches_shard(obj, shard_sel):
                         continue
                     write_frame(event)
                     last_frame = time.monotonic()

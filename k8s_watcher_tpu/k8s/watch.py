@@ -26,6 +26,7 @@ from typing import Iterator, Optional
 from k8s_watcher_tpu.config.schema import RetryPolicy
 from k8s_watcher_tpu.k8s.client import K8sApiError, K8sClient, K8sGoneError
 from k8s_watcher_tpu.state.dirty import DirtyKeys
+from k8s_watcher_tpu.watch.sharded import shard_of
 from k8s_watcher_tpu.watch.source import EventType, WatchEvent
 
 logger = logging.getLogger(__name__)
@@ -47,7 +48,11 @@ class KubernetesWatchSource:
         scanner=None,  # native.scanner.FrameScanner: skip-parse prefilter
         metrics=None,  # metrics.MetricsRegistry, optional
         list_page_size: int = 500,  # LIST pagination (limit+continue)
+        shard: int = 0,  # this stream's shard index (uid-hash partition)
+        shards: int = 1,  # total shard streams; 1 = whole cluster
     ):
+        if not 0 <= shard < shards:
+            raise ValueError(f"shard {shard} out of range for {shards} shards")
         self.client = client
         self.namespace = namespace
         self.label_selector = label_selector
@@ -60,6 +65,12 @@ class KubernetesWatchSource:
         self.heartbeat = heartbeat or (lambda: None)
         self.scanner = scanner
         self.metrics = metrics
+        self.shard = shard
+        self.shards = shards
+        # pushed to the server (mock apiserver / shard-aware proxy honor
+        # it; a stock apiserver ignores it and the client-side ownership
+        # checks below keep the partition correct)
+        self.shard_selector = f"{shard}/{shards}" if shards > 1 else None
         self._stop = threading.Event()
         # uid -> pod SKELETON of live pods, so a relist can synthesize
         # DELETED events for pods that vanished while the watch was
@@ -83,6 +94,11 @@ class KubernetesWatchSource:
         self._dirty = DirtyKeys()
         if checkpoint is not None:
             for uid, entry in (checkpoint.get("known_pods") or {}).items():
+                if shards > 1 and shard_of(uid, shards) != shard:
+                    # not ours: a ShardCheckpointView pre-filters, but a raw
+                    # store handed to a shard source must not make this
+                    # shard tombstone the other shards' pods after restart
+                    continue
                 if isinstance(entry, dict):
                     self._known[uid] = entry
                     continue
@@ -127,8 +143,12 @@ class KubernetesWatchSource:
         containers included, same as the filter itself), and phase."""
         meta = pod.get("metadata") or {}
         spec = pod.get("spec") or {}
+        # resourceVersion rides along so _track can prove "unchanged
+        # object" on the next relist and skip the rebuild + dirty churn
         skel_meta = {
-            k: meta[k] for k in ("name", "namespace", "uid", "labels") if meta.get(k)
+            k: meta[k]
+            for k in ("name", "namespace", "uid", "labels", "resourceVersion")
+            if meta.get(k)
         }
         annotations = {
             k: v for k, v in (meta.get("annotations") or {}).items()
@@ -188,12 +208,26 @@ class KubernetesWatchSource:
                 self.checkpoint.update_resource_version(rv)
 
     def _track(self, event_type: str, pod: dict) -> None:
-        uid = (pod.get("metadata") or {}).get("uid")
+        meta = pod.get("metadata") or {}
+        uid = meta.get("uid")
         if not uid:
             return
         if event_type == EventType.DELETED:
             self._known.pop(uid, None)
         else:
+            rv = meta.get("resourceVersion")
+            prev = self._known.get(uid)
+            if (
+                prev is not None
+                and rv
+                and (prev.get("metadata") or {}).get("resourceVersion") == rv
+            ):
+                # same object version we already track (the dominant case
+                # across a relist — most pods didn't change during the
+                # disconnect): identical skeleton, so skip the rebuild AND
+                # the dirty mark. Before this, every relist marked every
+                # uid dirty and forced a whole-map checkpoint compaction.
+                return
             self._known[uid] = self._skeleton(pod)
         self._dirty.mark(uid, len(self._known))
 
@@ -215,13 +249,22 @@ class KubernetesWatchSource:
         relist)."""
         rv = None
         listed_uids: set = set()
+        shards = self.shards
         for page_rv, items, restarted in K8sClient.iter_list_pages(
             self.client.list_pods_paged(
                 self.namespace,
                 page_size=self.list_page_size,
                 label_selector=self.label_selector,
+                shard_selector=self.shard_selector,
             ),
             metrics=self.metrics,
+            # overlap the next page's fetch+decode with this page's
+            # skeleton tracking/yields — relist wall time becomes
+            # max(fetch, process) per page, not their sum. Only for an
+            # UNSHARDED stream: sharded relists already run N concurrent
+            # page chains, and doubling the thread count there just
+            # thrashes the scheduler on small hosts
+            prefetch=self.shards == 1,
         ):
             if self._stop.is_set():
                 # shutdown mid-pagination: abort WITHOUT the tombstone
@@ -234,7 +277,13 @@ class KubernetesWatchSource:
                 listed_uids.clear()
             rv = page_rv or rv
             for pod in items:
-                listed_uids.add((pod.get("metadata") or {}).get("uid"))
+                uid = (pod.get("metadata") or {}).get("uid")
+                if shards > 1 and shard_of(uid or "", shards) != self.shard:
+                    # server ignored the shard selector (stock apiserver):
+                    # this shard must neither track nor emit pods another
+                    # shard owns — the ownership filter IS the partition
+                    continue
+                listed_uids.add(uid)
                 self._track(EventType.ADDED, pod)
                 yield WatchEvent(type=EventType.ADDED, pod=pod, resource_version=rv)
         for uid in [u for u in self._known if u not in listed_uids]:
@@ -337,12 +386,14 @@ class KubernetesWatchSource:
                     timeout_seconds=self.watch_timeout_seconds,
                     label_selector=self.label_selector,
                     scanner=self.scanner,
+                    shard_selector=self.shard_selector,
                 ):
                     if self._stop.is_set():
                         return
                     self.heartbeat()  # any frame (incl. bookmarks) = live apiserver link
                     obj = raw.get("object") or {}
-                    rv = (obj.get("metadata") or {}).get("resourceVersion")
+                    meta = obj.get("metadata") or {}
+                    rv = meta.get("resourceVersion")
                     event_type = raw.get("type", "")
                     if event_type == EventType.BOOKMARK or event_type == EventType.PREFILTERED:
                         # rv-only frames: bookmarks, and frames the native
@@ -355,6 +406,22 @@ class KubernetesWatchSource:
                         # an all-non-TPU cluster these may be the ONLY frames,
                         # so backoff must reset here too or one blip escalates
                         # every later reconnect to max_delay forever
+                        backoff = self.retry.delay_seconds
+                        reconnects = 0
+                        gone_streak = 0
+                        self._save_rv(rv)
+                        continue
+                    if (
+                        self.shards > 1
+                        and shard_of(meta.get("uid") or "", self.shards) != self.shard
+                    ):
+                        # another shard's pod reached us (stock apiserver
+                        # ignored the shard selector and the scanner could
+                        # not skip it pre-parse): rv-only treatment, same
+                        # as a prefiltered frame — the resume point must
+                        # still advance or a quiet shard would replay these
+                        if self.metrics is not None:
+                            self.metrics.counter("events_other_shard").inc()
                         backoff = self.retry.delay_seconds
                         reconnects = 0
                         gone_streak = 0

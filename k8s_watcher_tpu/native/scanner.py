@@ -31,6 +31,11 @@ logger = logging.getLogger(__name__)
 
 _TYPE_RE = re.compile(rb'"type"\s*:\s*"([^"\\]*)"')
 _RV_RE = re.compile(rb'"resourceVersion"\s*:\s*"([^"\\]*)"')
+# first "uid" value: for serialized k8s objects that is metadata's own
+# (same declaration-order argument as resourceVersion above). Consumed by
+# the sharded-ingest client-side ownership skip (k8s/client.py): a frame
+# whose uid hashes to another shard is dropped pre-parse.
+_UID_RE = re.compile(rb'"uid"\s*:\s*"([^"\\]*)"')
 
 
 @dataclasses.dataclass(frozen=True)
@@ -38,6 +43,7 @@ class FrameScan:
     type: Optional[str]  # None = could not tell — full-parse
     resource_version: Optional[str]
     has_key: bool  # True also when in doubt — full-parse
+    uid: Optional[str] = None  # None = could not tell — no shard verdict
 
     # Event types that may be skipped when the key is absent. ERROR and
     # BOOKMARK frames never carry the key but must take the full path (they
@@ -52,15 +58,37 @@ class FrameScan:
             and self.resource_version is not None
         )
 
+    def foreign_shard(self, shard: int, shards: int) -> bool:
+        """True when this frame provably belongs to ANOTHER ingest shard
+        (uid extracted, hash owned elsewhere) and is safe to skip as an
+        rv-only marker. Doubt (no uid/type/rv) routes to the full parse —
+        the watch source's post-parse ownership filter keeps correctness,
+        same false-positives-cost-time-never-correctness contract as
+        ``skippable``."""
+        if shards <= 1 or self.uid is None:
+            return False
+        from k8s_watcher_tpu.watch.sharded import shard_of
+
+        return (
+            self.type in self._SKIPPABLE
+            and self.resource_version is not None
+            and shard_of(self.uid, shards) != shard
+        )
+
 
 _FULL_PARSE = FrameScan(type=None, resource_version=None, has_key=True)
 
 
 class PythonFrameScanner:
-    """Regex fallback with semantics identical to the native scanner."""
+    """Regex fallback with semantics identical to the native scanner.
 
-    def __init__(self, resource_key: str):
+    ``extract_uid=False`` (an UNSHARDED stream — ``foreign_shard`` never
+    consults the uid there) skips the uid regex on the per-frame path;
+    the sharded construction sites opt in."""
+
+    def __init__(self, resource_key: str, *, extract_uid: bool = True):
         self.resource_key = resource_key
+        self.extract_uid = extract_uid
         self._quoted_key = f'"{resource_key}"'.encode()
 
     def scan(self, raw: bytes) -> FrameScan:
@@ -68,10 +96,12 @@ class PythonFrameScanner:
             return _FULL_PARSE
         t = _TYPE_RE.search(raw)
         rv = _RV_RE.search(raw)
+        uid = _UID_RE.search(raw) if self.extract_uid else None
         return FrameScan(
             type=t.group(1).decode() if t else None,
             resource_version=rv.group(1).decode() if rv else None,
             has_key=self._quoted_key in raw,
+            uid=uid.group(1).decode() if uid else None,
         )
 
     def scan_chunk(self, buf: bytes):
@@ -125,8 +155,9 @@ _CHUNK_RECS = 256  # frames decoded per native call
 class NativeFrameScanner:
     """ctypes front-end for the fastscan C ABI."""
 
-    def __init__(self, resource_key: str, lib_path):
+    def __init__(self, resource_key: str, lib_path, *, extract_uid: bool = True):
         self.resource_key = resource_key
+        self.extract_uid = extract_uid
         self._quoted_key = f'"{resource_key}"'.encode()
         lib = ctypes.CDLL(str(lib_path))
         self._fn = lib.fastscan_frame
@@ -201,22 +232,31 @@ class NativeFrameScanner:
         )
         if flags < 0:
             return _FULL_PARSE
+        # uid rides the Python regex on this per-frame path (the C ABI
+        # predates shard ingest and extracts only type/rv) — semantics
+        # stay IDENTICAL to PythonFrameScanner, which the parity test
+        # pins. The chunked hot path never builds FrameScans, so this
+        # regex never runs per-frame there.
+        uid = _UID_RE.search(raw) if self.extract_uid else None
         return FrameScan(
             type=self._type_buf.value.decode() if flags & 2 else None,
             resource_version=self._rv_buf.value.decode() if flags & 4 else None,
             has_key=bool(flags & 1),
+            uid=uid.group(1).decode() if uid else None,
         )
 
 
-def make_scanner(resource_key: str, *, prefer_native: bool = True):
-    """Best available scanner for ``resource_key`` (native, else Python)."""
+def make_scanner(resource_key: str, *, prefer_native: bool = True, extract_uid: bool = True):
+    """Best available scanner for ``resource_key`` (native, else Python).
+    ``extract_uid=False`` for unsharded streams skips the per-frame uid
+    work nothing would consume."""
     if prefer_native:
         from k8s_watcher_tpu.native.build import build_fastscan
 
         lib_path = build_fastscan()
         if lib_path is not None:
             try:
-                return NativeFrameScanner(resource_key, lib_path)
+                return NativeFrameScanner(resource_key, lib_path, extract_uid=extract_uid)
             except OSError as exc:
                 logger.warning("native fastscan unloadable (%s); using Python scanner", exc)
-    return PythonFrameScanner(resource_key)
+    return PythonFrameScanner(resource_key, extract_uid=extract_uid)

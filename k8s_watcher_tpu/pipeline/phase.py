@@ -13,8 +13,7 @@ recreate churn, UIDs are not).
 
 from __future__ import annotations
 
-import dataclasses
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 from k8s_watcher_tpu.state.dirty import DirtyKeys
 from k8s_watcher_tpu.watch.source import EventType, WatchEvent
@@ -45,9 +44,12 @@ def pod_restarts(pod: Dict[str, Any]) -> int:
     return sum(int(cs.get("restartCount", 0) or 0) for cs in statuses)
 
 
-@dataclasses.dataclass(frozen=True)
-class PhaseDelta:
-    """What changed for a pod between consecutive observations."""
+class PhaseDelta(NamedTuple):
+    """What changed for a pod between consecutive observations.
+
+    A NamedTuple, not a frozen dataclass: one is created per event on the
+    ingest hot path, and a frozen dataclass pays object.__setattr__ per
+    field (~4x the construction cost) for the same immutability."""
 
     old_phase: Optional[str]  # None = first sighting
     new_phase: str
@@ -83,9 +85,21 @@ class PhaseTracker:
         KubernetesWatchSource.drain_dirty_uids."""
         return self._dirty.drain()
 
-    def observe(self, event: WatchEvent) -> PhaseDelta:
-        uid = event.uid or f"{event.namespace}/{event.name}"
-        new_phase = event.phase
+    def observe(
+        self,
+        event: WatchEvent,
+        *,
+        uid: Optional[str] = None,
+        new_phase: Optional[str] = None,
+        ready_tuple: Optional[Tuple] = None,
+    ) -> PhaseDelta:
+        """``uid``/``new_phase``/``ready_tuple`` accept the pipeline's
+        precomputed values (hot-path dedup — the same derivations otherwise
+        re-run in slice tracking); omitted, they derive from the event."""
+        if uid is None:
+            uid = event.uid or f"{event.namespace}/{event.name}"
+        if new_phase is None:
+            new_phase = event.phase
         prev = self._state.get(uid)
 
         if event.type == EventType.DELETED:
@@ -100,7 +114,7 @@ class PhaseTracker:
                 deleted=True,
             )
 
-        ready = _ready_tuple(event.pod)
+        ready = ready_tuple if ready_tuple is not None else _ready_tuple(event.pod)
         self._state[uid] = (new_phase, ready)
         if prev is None or prev[0] != new_phase:
             # readiness-only updates keep the persisted value identical —

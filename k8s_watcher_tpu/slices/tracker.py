@@ -38,7 +38,7 @@ class SlicePhase:
     TERMINATED = "Terminated"
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(slots=True)
 class _Member:
     uid: str
     name: str
@@ -53,6 +53,17 @@ class _Member:
     node_ready: bool = True
 
 
+def _member_contrib(m: "_Member") -> tuple:
+    """One member's contribution to the aggregate counters:
+    (bad, node_down, succeeded, running_ready)."""
+    return (
+        1 if m.phase in ("Failed", "Unknown") else 0,
+        1 if (not m.node_ready and m.phase != "Succeeded") else 0,
+        1 if m.phase == "Succeeded" else 0,
+        1 if (m.phase == "Running" and m.ready and m.node_ready) else 0,
+    )
+
+
 @dataclasses.dataclass
 class SliceState:
     identity: SliceIdentity
@@ -65,6 +76,26 @@ class SliceState:
     # slice whose worker was PREEMPTED reads differently from one whose
     # worker crashed
     last_disruption: Optional[Dict[str, Any]] = None
+    # running aggregate counters [bad, node_down, succeeded, running_ready],
+    # maintained by SliceTracker's member-mutation helpers so
+    # aggregate_phase is O(1) on the 10k+ events/s hot path instead of an
+    # O(members) walk per event. None = unmaintained (states built by hand,
+    # e.g. property tests): aggregate_phase falls back to the full walk,
+    # which stays the semantic definition the counters must match
+    # (tests/test_ingest_shards.py pins the equivalence).
+    counts: Optional[List[int]] = None
+
+    def walk_counts(self) -> tuple:
+        """The aggregate counters computed from scratch — the ground truth
+        the maintained ``counts`` must always equal."""
+        bad = node_down = succ = rr = 0
+        for m in self.members.values():
+            b, nd, s, r = _member_contrib(m)
+            bad += b
+            node_down += nd
+            succ += s
+            rr += r
+        return bad, node_down, succ, rr
 
     def aggregate_phase(self) -> str:
         if not self.members:
@@ -72,19 +103,18 @@ class SliceState:
             # healthy (a quota-stuck JobSet deleted while Pending must still
             # terminate, or its state would leak forever)
             return SlicePhase.TERMINATED if self.ever_had_members else SlicePhase.FORMING
-        phases = [m.phase for m in self.members.values()]
-        if any(p in ("Failed", "Unknown") for p in phases):
+        bad, node_down, succ, running_ready = (
+            self.counts if self.counts is not None else self.walk_counts()
+        )
+        if bad:
             return SlicePhase.DEGRADED
         # a dead node under a non-terminal member degrades the slice NOW,
         # not minutes later when the node controller evicts the pod
-        if any(not m.node_ready and m.phase != "Succeeded" for m in self.members.values()):
+        if node_down:
             return SlicePhase.DEGRADED
-        if all(p == "Succeeded" for p in phases):
+        if succ == len(self.members):
             return SlicePhase.COMPLETED
         expected = self.identity.expected_workers
-        running_ready = sum(
-            1 for m in self.members.values() if m.phase == "Running" and m.ready and m.node_ready
-        )
         if expected is not None:
             if len(self.members) < expected and self.ever_ready:
                 return SlicePhase.DEGRADED  # lost workers after being whole
@@ -153,6 +183,14 @@ class SliceTracker:
         # repair/autoscale mints fresh names, so they'd otherwise
         # accumulate forever in a long-lived leader.
         self._down_nodes: Dict[str, bool] = {}
+        # uid -> (labels, annotations, nodeSelector, chips, SliceIdentity):
+        # identity inference re-derives the same frozen SliceIdentity from
+        # the same metadata on every event of a pod's life — cache it per
+        # uid, validated by value-equality of its actual inputs (pods are
+        # rebuilt per event, so object identity never hits). Touched only
+        # from observe() (the single ingest drain thread); evicted on
+        # DELETED and size-bounded against uid-churn pathology.
+        self._ident_cache: Dict[str, tuple] = {}
         # node_name -> number of live members scheduled on it, maintained at
         # the two member-mutation sites in _observe_locked. Makes the
         # "is this node still referenced?" pruning checks O(1) instead of a
@@ -170,6 +208,33 @@ class SliceTracker:
         else:
             self._node_refs.pop(name, None)
 
+    # -- counted member mutation (every tracker-side member write goes
+    # through these two, so SliceState.counts stays exact) -----------------
+
+    @staticmethod
+    def _member_set_locked(state: SliceState, uid: str, member: _Member) -> None:
+        prev = state.members.get(uid)
+        state.members[uid] = member
+        counts = state.counts
+        if counts is not None:
+            new = _member_contrib(member)
+            if prev is not None:
+                old = _member_contrib(prev)
+                for i in range(4):
+                    counts[i] += new[i] - old[i]
+            else:
+                for i in range(4):
+                    counts[i] += new[i]
+
+    @staticmethod
+    def _member_pop_locked(state: SliceState, uid: str) -> Optional[_Member]:
+        removed = state.members.pop(uid, None)
+        if removed is not None and state.counts is not None:
+            old = _member_contrib(removed)
+            for i in range(4):
+                state.counts[i] -= old[i]
+        return removed
+
     def __len__(self) -> int:
         return len(self._slices)
 
@@ -180,33 +245,72 @@ class SliceTracker:
         return dict(self._slices)
 
     def observe(
-        self, event: WatchEvent, delta: PhaseDelta, chips: Optional[int] = None
+        self,
+        event: WatchEvent,
+        delta: PhaseDelta,
+        chips: Optional[int] = None,
+        *,
+        uid: Optional[str] = None,
+        phase: Optional[str] = None,
+        ready_tuple: Optional[Tuple] = None,
     ) -> Tuple[Optional[Dict[str, Any]], List[Dict[str, Any]]]:
         """Fold one pod event into slice state.
 
         Returns ``(slice_info for the pod payload, [slice notifications])``.
-        ``chips`` forwards a precomputed ``pod_accelerator_chips`` result
-        to the identity inference (hot-path dedup).
+        ``chips``/``uid``/``phase``/``ready_tuple`` forward the pipeline's
+        precomputed derivations (hot-path dedup); omitted, they derive
+        from the event.
         """
-        identity = infer_slice_identity(
-            event.pod,
-            resource_key=self.resource_key,
-            topology_label=self.topology_label,
-            accelerator_label=self.accelerator_label,
-            chips=chips,
-        )
+        pod = event.pod
+        if uid is None:
+            uid = event.uid
+        metadata = pod.get("metadata") or {}
+        labels = metadata.get("labels") or {}
+        annotations = metadata.get("annotations") or {}
+        node_selector = (pod.get("spec") or {}).get("nodeSelector") or {}
+        cached = self._ident_cache.get(uid) if uid else None
+        if (
+            cached is not None
+            and cached[0] == labels
+            and cached[1] == annotations
+            and cached[2] == node_selector
+            and cached[3] == chips
+        ):
+            identity = cached[4]
+        else:
+            identity = infer_slice_identity(
+                pod,
+                resource_key=self.resource_key,
+                topology_label=self.topology_label,
+                accelerator_label=self.accelerator_label,
+                chips=chips,
+            )
+            if identity is not None and uid and chips is not None:
+                if len(self._ident_cache) > 200_000:
+                    self._ident_cache.clear()  # uid-churn pathology bound
+                self._ident_cache[uid] = (labels, annotations, node_selector, chips, identity)
+        if event.type == EventType.DELETED and uid:
+            self._ident_cache.pop(uid, None)
         if identity is None:
             return None, []
 
         with self._lock:
-            return self._observe_locked(event, identity)
+            return self._observe_locked(
+                event, identity, uid=uid, phase=phase, ready_tuple=ready_tuple
+            )
 
     def _observe_locked(
-        self, event: WatchEvent, identity
+        self,
+        event: WatchEvent,
+        identity,
+        *,
+        uid: Optional[str] = None,
+        phase: Optional[str] = None,
+        ready_tuple: Optional[Tuple] = None,
     ) -> Tuple[Optional[Dict[str, Any]], List[Dict[str, Any]]]:
         state = self._slices.get(identity.key)
         if state is None:
-            state = SliceState(identity=identity)
+            state = SliceState(identity=identity, counts=[0, 0, 0, 0])
             restored = self._restored.pop(identity.key, None)
             if restored:
                 # resume pre-restart aggregate so a slice that lost workers
@@ -218,10 +322,11 @@ class SliceTracker:
         elif identity.topology and not state.identity.topology:
             state.identity = identity  # later pods may carry richer metadata
 
-        uid = event.uid
+        if uid is None:
+            uid = event.uid
         removed = None
         if event.type == EventType.DELETED:
-            removed = state.members.pop(uid, None)
+            removed = self._member_pop_locked(state, uid)
             if removed is not None:
                 self._node_ref_delta_locked(removed.node_name, -1)
                 disruption = extract_disruption(event.pod)
@@ -232,23 +337,55 @@ class SliceTracker:
                 self._slices.pop(identity.key, None)
                 return None, []
         else:
-            node_name = (event.pod.get("spec") or {}).get("nodeName")
+            pod = event.pod
+            node_name = (pod.get("spec") or {}).get("nodeName")
+            if phase is None:
+                phase = event.phase
+            if ready_tuple:
+                # (name, ready, restarts) triples — the SAME walk pod_ready/
+                # pod_restarts would do, already done once in the pipeline
+                ready = all(flag for _name, flag, _rc in ready_tuple)
+                restarts = sum(rc for _name, _flag, rc in ready_tuple)
+            else:
+                # () = pod reports no containerStatuses (pod_ready then
+                # falls back to the Ready condition); None = not precomputed
+                ready = pod_ready(pod)
+                restarts = 0 if ready_tuple == () else pod_restarts(pod)
+            node_up = self._node_up_locked(node_name)
             prev = state.members.get(uid)
+            if (
+                prev is not None
+                and prev.phase == phase
+                and prev.ready == ready
+                and prev.restarts == restarts
+                and prev.node_name == node_name
+                and prev.node_ready == node_up
+            ):
+                # status noise: nothing the aggregate depends on moved, so
+                # skip the member replace AND the recompute — the dominant
+                # event class at sustained churn (heartbeat-style MODIFIEDs)
+                return {
+                    "key": identity.key,
+                    "worker_index": identity.worker_index,
+                    "phase": state.phase,
+                    "expected_workers": identity.expected_workers,
+                    "observed_workers": len(state.members),
+                }, []
             if prev is None or prev.node_name != node_name:
                 # node_name changes at most once per pod (None -> scheduled)
                 if prev is not None:
                     self._node_ref_delta_locked(prev.node_name, -1)
                 self._node_ref_delta_locked(node_name, +1)
-            state.members[uid] = _Member(
+            self._member_set_locked(state, uid, _Member(
                 uid=uid,
                 name=event.name,
                 worker_index=identity.worker_index,
-                phase=event.phase,
-                ready=pod_ready(event.pod),
-                restarts=pod_restarts(event.pod),
+                phase=phase,
+                ready=ready,
+                restarts=restarts,
                 node_name=node_name,
-                node_ready=self._node_up_locked(node_name),
-            )
+                node_ready=node_up,
+            ))
 
         if state.members:
             state.ever_had_members = True
@@ -340,7 +477,9 @@ class SliceTracker:
                     if member.node_name == node_name and member.node_ready != ready:
                         # replace, don't mutate: debug_snapshot() formats
                         # shallow-copied member dicts outside the lock
-                        state.members[uid] = dataclasses.replace(member, node_ready=ready)
+                        self._member_set_locked(
+                            state, uid, dataclasses.replace(member, node_ready=ready)
+                        )
                         touched = True
                 if touched:
                     notifications.extend(self._recompute_locked(state))
@@ -370,7 +509,9 @@ class SliceTracker:
                 for uid, member in list(state.members.items()):
                     if member.node_name and member.node_name not in present and member.node_ready:
                         self._down_nodes[member.node_name] = False  # observed absent
-                        state.members[uid] = dataclasses.replace(member, node_ready=False)
+                        self._member_set_locked(
+                            state, uid, dataclasses.replace(member, node_ready=False)
+                        )
                         touched = True
                 if touched:
                     notifications.extend(self._recompute_locked(state))

@@ -90,15 +90,28 @@ class JournaledMapStore:
         *,
         compact_factor: float = 1.0,
         min_compact_entries: int = 2048,
+        compact_slice_entries: int = 4096,
     ):
         stem = Path(path_stem)
         self.base_path = stem.with_name(stem.name + ".base.json")
         self.journal_path = stem.with_name(stem.name + ".journal.jsonl")
+        # in-progress incremental compaction target; a leftover from a
+        # crash is garbage (never loaded) and removed on startup
+        self.tmp_path = stem.with_name(stem.name + ".base.json.compacting")
         # compact when journal lines > max(min_compact_entries,
         # compact_factor * len(map)) — the default amortizes one O(state)
         # base rewrite over >= O(state) appended deltas
         self.compact_factor = compact_factor
         self.min_compact_entries = min_compact_entries
+        # throttled flushes (CheckpointStore.maybe_flush) serialize at most
+        # this many entries of an in-progress compaction per call, bounding
+        # the per-flush pause: a 50k-entry one-shot compact was ~217 ms of
+        # stop-the-world on the drain thread (BENCH_r05); 4096-entry slices
+        # bound it at ~50 ms, interleaved with normal delta flushes.
+        # 0 = one-shot compaction always.
+        # Direct flush() calls still complete compaction in full — they are
+        # the durability barrier (shutdown, tests).
+        self.compact_slice_entries = compact_slice_entries
         self._lock = threading.Lock()
         # serializes flush/compaction I/O: a concurrent append racing a
         # compaction's generation bump would write lines the new fence
@@ -111,9 +124,18 @@ class JournaledMapStore:
         # (unknown delta, e.g. legacy migration or a replace() without a
         # changed_keys hint)
         self._pending: Optional[Set[str]] = set()
+        # in-progress sliced compaction (guarded by _io_lock): dict with
+        # gen/snapshot/keys/idx/fh/delta, or None
+        self._compacting: Optional[Dict[str, Any]] = None
         self._load()
 
     def _load(self) -> None:
+        try:
+            # a crash mid-compaction leaves a partial target file; it is
+            # never read (only the renamed base is), so just reclaim it
+            self.tmp_path.unlink()
+        except OSError:
+            pass
         try:
             data = json.loads(self.base_path.read_text())
             # gen is load-bearing (it fences journal replay): a base whose
@@ -180,6 +202,12 @@ class JournaledMapStore:
         with self._io_lock:
             gen = self._gen
             journal_entries = self._journal_entries
+            comp = self._compacting
+            compacting = (
+                {"target_gen": comp["gen"], "written": comp["idx"], "total": len(comp["keys"])}
+                if comp is not None
+                else None
+            )
         with self._lock:
             map_size = len(self._map)
             pending = self._pending
@@ -196,12 +224,18 @@ class JournaledMapStore:
             "map_size": map_size,
             "base_bytes": _size(self.base_path),
             "journal_bytes": _size(self.journal_path),
+            "compacting": compacting,
         }
 
     @property
     def pending(self) -> bool:
         with self._lock:
-            return self._pending is None or bool(self._pending)
+            if self._pending is None or bool(self._pending):
+                return True
+        # an in-progress compaction is pending work too: the throttled
+        # flusher must keep calling until the new base lands (read without
+        # _io_lock — a momentarily stale answer only delays one interval)
+        return self._compacting is not None
 
     def replace(self, new_map: Dict[str, Any], changed_keys: Optional[Iterable[str]] = None) -> None:
         """Adopt ``new_map`` as the live state. ``changed_keys`` is the
@@ -218,27 +252,66 @@ class JournaledMapStore:
 
     # -- persistence -------------------------------------------------------
 
-    def flush(self) -> None:
+    def flush(self, finalize: bool = True) -> None:
+        """Persist pending deltas. ``finalize=True`` (the default — direct
+        calls are the durability barrier: shutdown, tests) also drives any
+        in-progress compaction to completion; ``finalize=False`` (the
+        throttled ``CheckpointStore.maybe_flush`` path) advances it by at
+        most ``compact_slice_entries`` entries, bounding the per-flush
+        pause on the ingest drain thread."""
         with self._io_lock:
-            self._flush_locked()
+            self._flush_locked(finalize)
 
-    def _flush_locked(self) -> None:
+    def _flush_locked(self, finalize: bool = True) -> None:
         with self._lock:
             pending = self._pending
             snapshot = self._map  # entries are never mutated in place
             self._pending = set()
+        if self._compacting is not None:
+            if pending is None:
+                # a newer full rewrite supersedes the half-built target
+                self._abort_compaction()
+                self._start_compaction(snapshot, finalize)
+            else:
+                if pending:
+                    # journal at the CURRENT gen — the old base + journal
+                    # stay the durable truth until the new base lands —
+                    # and remember the keys: their values changed after
+                    # the compaction snapshot, so the new base needs them
+                    # re-journaled under the new gen at finalize
+                    if not self._append_journal(pending, snapshot):
+                        self._abort_compaction()
+                        return
+                    self._compacting["delta"].update(pending)
+                self._advance_compaction(finalize)
+            return
         if pending is None:
-            self._compact(snapshot)
+            self._start_compaction(snapshot, finalize)
             return
         if not pending:
             return
-        # a delta at or past the compaction threshold (>= so a relist that
-        # marked EVERY uid dirty lands here at the default factor of 1.0)
-        # would journal ~the whole state and then compact next flush
-        # anyway — writing the state up to 3x; compact directly instead
+        # a delta at or past the compaction threshold (>= so a mass change
+        # that marked EVERY uid dirty lands here at the default factor of
+        # 1.0) would journal ~the whole state and then compact next flush
+        # anyway — writing the state up to 3x; compact instead. One-shot
+        # (finalize) compaction skips the journal entirely: the new base
+        # lands in THIS call. SLICED compaction journals the delta first —
+        # its new base lands many throttle windows later, and a crash in
+        # between must not revert these keys to their pre-delta values.
         if len(pending) >= max(self.min_compact_entries, self.compact_factor * len(snapshot)):
-            self._compact(snapshot)
+            if not finalize and self.compact_slice_entries:
+                if not self._append_journal(pending, snapshot):
+                    return
+            self._start_compaction(snapshot, finalize)
             return
+        if not self._append_journal(pending, snapshot):
+            return
+        if self._journal_entries > max(self.min_compact_entries, self.compact_factor * len(snapshot)):
+            self._start_compaction(snapshot, finalize)
+
+    def _append_journal(self, pending: Set[str], snapshot: Dict[str, Any]) -> bool:
+        """Append ``pending``'s current values as gen-fenced journal lines;
+        False (with a forced full rewrite owed) on failure."""
         lines = []
         for key in pending:
             if key in snapshot:
@@ -260,10 +333,132 @@ class JournaledMapStore:
                 # compaction (new base, truncated journal) instead of
                 # retrying appends past the tear.
                 self._pending = None
-            return
+            return False
         self._journal_entries += len(pending)
-        if self._journal_entries > max(self.min_compact_entries, self.compact_factor * len(snapshot)):
+        return True
+
+    # -- sliced compaction -------------------------------------------------
+
+    def _start_compaction(self, snapshot: Dict[str, Any], finalize: bool) -> None:
+        """One-shot compact when finalizing (or slicing disabled), else
+        open the incremental target and write the first slice."""
+        if finalize or not self.compact_slice_entries:
             self._compact(snapshot)
+            return
+        gen = self._gen + 1
+        try:
+            self.tmp_path.parent.mkdir(parents=True, exist_ok=True)
+            fh = open(self.tmp_path, "w")
+            fh.write('{"version": %d, "gen": %d, "map": {' % (_SCHEMA_VERSION, gen))
+        except OSError as exc:
+            logger.error("Could not open compaction target %s: %s", self.tmp_path, exc)
+            with self._lock:
+                self._pending = None  # still owe the full write
+            return
+        self._compacting = {
+            "gen": gen,
+            "snapshot": snapshot,
+            "keys": list(snapshot),
+            "idx": 0,
+            "fh": fh,
+            # keys whose value changed after the snapshot was captured;
+            # re-journaled under the new gen at finalize so the new base +
+            # journal replay to the LIVE state, not the snapshot
+            "delta": set(),
+        }
+        self._advance_compaction(finalize=False)
+
+    def _abort_compaction(self) -> None:
+        comp = self._compacting
+        if comp is None:
+            return
+        self._compacting = None
+        try:
+            comp["fh"].close()
+        except Exception:  # noqa: BLE001 — best-effort teardown
+            pass
+        try:
+            self.tmp_path.unlink()
+        except OSError:
+            pass
+
+    def _compaction_failed(self, what: str, exc: Exception) -> None:
+        logger.error("Compaction %s for %s failed: %s", what, self.base_path, exc)
+        self._abort_compaction()
+        with self._lock:
+            self._pending = None  # still owe the full write
+
+    def _advance_compaction(self, finalize: bool) -> None:
+        """Serialize the next slice (all remaining when ``finalize``) into
+        the target file; rename it into place once every entry is down."""
+        comp = self._compacting
+        keys = comp["keys"]
+        idx = comp["idx"]
+        end = len(keys) if finalize else min(idx + self.compact_slice_entries, len(keys))
+        if end > idx:
+            snapshot = comp["snapshot"]
+            dumps = json.dumps
+            blob = ",".join(dumps(k) + ":" + dumps(snapshot[k]) for k in keys[idx:end])
+            if idx > 0:
+                blob = "," + blob
+            try:
+                comp["fh"].write(blob)
+            except OSError as exc:
+                self._compaction_failed("slice write", exc)
+                return
+            comp["idx"] = end
+        if comp["idx"] < len(keys):
+            return  # more slices on later flushes
+        self._finalize_compaction()
+
+    def _finalize_compaction(self) -> None:
+        """Close the target, re-journal the during-compaction delta under
+        the NEW generation, then rename the base into place.
+
+        Crash ordering (same fence discipline as ``_compact``):
+        - after the delta append, before the rename: the old base is still
+          in place, its old-gen journal lines replay, the new-gen delta
+          lines are fenced out — consistent;
+        - after the rename, before the journal rewrite below: old-gen
+          lines are fenced out, the new-gen delta lines replay over the
+          new base — consistent. The rewrite is space reclamation only.
+        """
+        comp = self._compacting
+        gen = comp["gen"]
+        try:
+            comp["fh"].write("}}")
+            comp["fh"].close()
+        except OSError as exc:
+            self._compaction_failed("target close", exc)
+            return
+        with self._lock:
+            current = self._map
+            delta_entries = [(k, k in current, current.get(k)) for k in comp["delta"]]
+        lines = [
+            json.dumps({"g": gen, "k": k, "v": v}) if present
+            else json.dumps({"g": gen, "k": k, "d": True})
+            for k, present, v in delta_entries
+        ]
+        if lines:
+            try:
+                with open(self.journal_path, "a") as jfh:
+                    jfh.write("\n".join(lines) + "\n")
+            except OSError as exc:
+                self._compaction_failed("delta append", exc)
+                return
+        try:
+            os.replace(self.tmp_path, self.base_path)
+        except OSError as exc:
+            # orphaned future-gen delta lines stay in the journal —
+            # harmless, the fence skips them on load
+            self._compaction_failed("rename", exc)
+            return
+        self._compacting = None
+        self._gen = gen
+        self._journal_entries = len(lines)
+        # reclaim the old-gen (now fenced-out) journal lines; atomic so a
+        # crash can't tear the delta lines we just made load-bearing
+        _atomic_write(self.journal_path, "\n".join(lines) + "\n" if lines else "")
 
     def _compact(self, snapshot: Dict[str, Any]) -> None:
         """Rewrite the base from ``snapshot`` under a new generation, then
@@ -394,19 +589,22 @@ class CheckpointStore:
             return time.monotonic() - self._last_flush >= self.interval_seconds
 
     def maybe_flush(self) -> None:
-        """Flush if dirty and the throttle interval has elapsed."""
+        """Flush if dirty and the throttle interval has elapsed. Throttled
+        flushes advance an in-progress base compaction by bounded slices
+        (``finalize=False``) so the hot path never eats a whole-map
+        serialization in one pause."""
         now = time.monotonic()
         with self._lock:
             if now - self._last_flush < self.interval_seconds:
                 return
             if not self._dirty and not any(s.pending for s in self._journaled.values()):
                 return
-        self.flush()
+        self.flush(finalize=False)
 
-    def flush(self) -> None:
+    def flush(self, finalize: bool = True) -> None:
         t0 = time.perf_counter()
         for store in self._journaled.values():
-            store.flush()
+            store.flush(finalize)
         self._flush_main()
         flush_ms = 1e3 * (time.perf_counter() - t0)
         with self._lock:

@@ -14,6 +14,7 @@ import threading
 import time
 from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence
 
+from k8s_watcher_tpu.watch.sharded import shard_of
 from k8s_watcher_tpu.watch.source import EventType, WatchEvent
 
 _UID_COUNTER = itertools.count(1)
@@ -116,6 +117,30 @@ def pod_lifecycle(
     final = build_pod(name, namespace, uid=uid, phase=phases[-1], resource_version=str(rv), **pod_kwargs)
     events.append(WatchEvent(type=EventType.DELETED, pod=final, resource_version=str(rv)))
     return events
+
+
+def shard_streams(events: Iterable[WatchEvent], shards: int) -> List[List[WatchEvent]]:
+    """Partition a scripted event sequence into per-shard streams by the
+    SAME stable uid-hash partition production ingest uses (shard_of), with
+    per-stream order preserved — so a sharded fake replay delivers each
+    UID's events in script order on exactly one stream, exactly like N real
+    shard watch streams would."""
+    streams: List[List[WatchEvent]] = [[] for _ in range(max(1, shards))]
+    for event in events:
+        key = event.uid or f"{event.namespace}/{event.name}"
+        streams[shard_of(key, max(1, shards))].append(event)
+    return streams
+
+
+def sharded_fake_sources(
+    events: Iterable[WatchEvent], shards: int, **kwargs: Any
+) -> List["FakeWatchSource"]:
+    """One ``FakeWatchSource`` per shard stream (kwargs as for
+    ``FakeWatchSource``). Feed these to ``ShardedWatchSource`` so tests and
+    the mock tier exercise the exact sharded-ingest code path — shard
+    count 1 included (one stream through the same queue + batch drain, not
+    a special case)."""
+    return [FakeWatchSource(stream, **kwargs) for stream in shard_streams(events, shards)]
 
 
 class FakeWatchSource:

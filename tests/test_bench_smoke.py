@@ -1,0 +1,45 @@
+"""Pre-merge bench smoke (slow tier): ``bench.py --smoke`` inside a budget.
+
+The headline p50 and the ingest ceiling regressed silently between rounds
+more than once; this tier catches that pre-merge. It is ``slow``-marked
+(tens of seconds of measurement + interpreter startup), so the tier-1
+``-m 'not slow'`` gate skips it — run it via ``make bench-smoke`` or
+``pytest -m slow tests/test_bench_smoke.py``.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+@pytest.mark.slow
+def test_bench_smoke_headline_within_budget():
+    proc = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "bench.py"), "--smoke"],
+        capture_output=True,
+        text=True,
+        timeout=240,  # generous wall budget: sandboxed CI hosts stall; the
+        # MEASURED budget inside the smoke tier is ~5 s of benchmark work
+        cwd=str(REPO_ROOT),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    headline = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert headline["smoke"] is True
+    # the three regression tripwires, with slack for noisy hosts:
+    # e2e latency tier completed and p50 is in sane range (<50 ms — an
+    # order of magnitude above healthy, so only a real regression trips)
+    completed, offered = headline["e2e_completed"].split("/")
+    assert completed == offered != "0", headline
+    assert 0 < headline["value"] < 50.0, headline
+    # sharded ingest ceiling didn't collapse back to the r05 single-loop
+    # era (~14k): half of that margin guards against host noise
+    assert headline["max_sustained_events_per_sec"] > 7000, headline
+    # relist still covers every pod (count mismatch -> error field)
+    assert headline["relist_10k_ms"] is not None, headline
+    detail = json.loads((REPO_ROOT / "artifacts" / "bench_smoke.json").read_text())
+    assert detail["details"]["relist_10k"]["events"] == detail["details"]["relist_10k"]["n_pods"]

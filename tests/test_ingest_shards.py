@@ -1,0 +1,412 @@
+"""Sharded watch ingest + batched pipeline: the ordering, isolation and
+batch-boundary invariants the tentpole rests on.
+
+- per-pod-UID event ordering is preserved under concurrent shard streams
+  (one UID rides exactly one stream, one FIFO queue, one drain);
+- a 410-Gone relist on ONE shard re-syncs only that shard's partition and
+  never disturbs (or duplicates) the other shards' flow;
+- phase-delta and slice aggregation are independent of where batch
+  boundaries fall (batch of 1 == batch of N for the same event order);
+- per-shard resourceVersion bookkeeping resumes independently, and the
+  shard-count change invalidates resume points (clean relist);
+- the incremental checkpoint compaction keeps per-flush pauses bounded
+  while never losing mid-compaction churn.
+"""
+
+import threading
+import time
+
+import pytest
+
+from k8s_watcher_tpu.metrics import MetricsRegistry
+from k8s_watcher_tpu.pipeline.pipeline import EventPipeline
+from k8s_watcher_tpu.slices.tracker import SliceTracker
+from k8s_watcher_tpu.watch.fake import (
+    FakeWatchSource,
+    build_pod,
+    pod_lifecycle,
+    shard_streams,
+    sharded_fake_sources,
+)
+from k8s_watcher_tpu.watch.sharded import (
+    EventBatchQueue,
+    ShardCheckpointView,
+    ShardedWatchSource,
+    parse_shard_selector,
+    shard_of,
+)
+from k8s_watcher_tpu.watch.source import EventType, WatchEvent
+
+
+def churn_events(n_pods=24, steps=6):
+    """Interleaved multi-pod lifecycles with per-UID sequence numbers."""
+    events = []
+    phases = ["Pending", "Running", "Running", "Succeeded"]
+    for step in range(steps):
+        for i in range(n_pods):
+            pod = build_pod(
+                f"pod-{i}", uid=f"uid-{i}", tpu_chips=4,
+                phase=phases[min(step, len(phases) - 1)],
+                resource_version=str(step * n_pods + i + 1),
+                labels={"seq": str(step)},
+            )
+            etype = EventType.ADDED if step == 0 else EventType.MODIFIED
+            events.append(WatchEvent(type=etype, pod=pod, resource_version=pod["metadata"]["resourceVersion"]))
+    return events
+
+
+class TestShardPartition:
+    def test_shard_of_is_stable_and_total(self):
+        for shards in (1, 2, 3, 8):
+            for uid in ("uid-1", "uid-x", ""):
+                s = shard_of(uid, shards)
+                assert 0 <= s < shards
+                assert s == shard_of(uid, shards)  # stable across calls
+
+    def test_parse_shard_selector(self):
+        assert parse_shard_selector("0/1") == (0, 1)
+        assert parse_shard_selector("3/4") == (3, 4)
+        for bad in ("", "4/4", "-1/4", "a/b", "1", "1/0", None):
+            assert parse_shard_selector(bad) is None
+
+    def test_shard_streams_partition_is_exact_and_ordered(self):
+        events = churn_events()
+        streams = shard_streams(events, 4)
+        assert sum(len(s) for s in streams) == len(events)
+        for i, stream in enumerate(streams):
+            for ev in stream:
+                assert shard_of(ev.uid, 4) == i
+        # per-uid order within its stream matches script order
+        for stream in streams:
+            seen = {}
+            for ev in stream:
+                seq = int(ev.pod["metadata"]["labels"]["seq"])
+                assert seq >= seen.get(ev.uid, -1)
+                seen[ev.uid] = seq
+
+
+class TestPerUidOrdering:
+    def test_order_preserved_under_concurrent_shards(self):
+        events = churn_events(n_pods=32, steps=8)
+        source = ShardedWatchSource(
+            sharded_fake_sources(events, 4), batch_max=16, queue_capacity=64,
+        )
+        observed = {}
+        for batch in source.batches():
+            for ev in batch:
+                observed.setdefault(ev.uid, []).append(
+                    int(ev.pod["metadata"]["labels"]["seq"])
+                )
+        assert sum(len(v) for v in observed.values()) == len(events)
+        for uid, seqs in observed.items():
+            assert seqs == sorted(seqs), f"{uid} observed out of order: {seqs}"
+
+    def test_shard_count_one_uses_same_machinery(self):
+        """No special case: one shard rides the same queue + batch path."""
+        events = pod_lifecycle("w0", phases=("Pending", "Running"), tpu_chips=4)
+        source = ShardedWatchSource(sharded_fake_sources(events, 1), batch_max=8)
+        drained = [ev.type for batch in source.batches() for ev in batch]
+        assert drained == ["ADDED", "MODIFIED", "DELETED"]
+        assert source.per_shard_counts == [3]
+
+
+class TestShardIsolationOn410:
+    def test_one_shard_relist_does_not_disturb_others(self):
+        """Shard 0's stream dies with a 410 (compaction) and relists; shard
+        1 keeps flowing uninterrupted, no cross-shard duplicates appear,
+        and shard 0's partition is re-synced via its own LIST."""
+        from k8s_watcher_tpu.k8s.client import K8sClient
+        from k8s_watcher_tpu.k8s.kubeconfig import K8sConnection
+        from k8s_watcher_tpu.k8s.mock_server import MockApiServer
+        from k8s_watcher_tpu.k8s.watch import KubernetesWatchSource
+
+        with MockApiServer() as api:
+            pods = {}
+            for i in range(12):
+                uid = f"uid-410-{i}"
+                pods[uid] = build_pod(f"p{i}", uid=uid, phase="Running", tpu_chips=4)
+                api.cluster.add_pod(pods[uid])
+            shard0_uids = {u for u in pods if shard_of(u, 2) == 0}
+            shard1_uids = set(pods) - shard0_uids
+            assert shard0_uids and shard1_uids, "partition degenerate; adjust uids"
+
+            sources = [
+                KubernetesWatchSource(
+                    K8sClient(K8sConnection(server=api.url), request_timeout=10.0),
+                    watch_timeout_seconds=10, shard=i, shards=2,
+                    resource_version=None,
+                )
+                for i in range(2)
+            ]
+            sharded = ShardedWatchSource(sources, batch_max=32, queue_capacity=512)
+            seen = {}
+            lock = threading.Lock()
+
+            def consume():
+                for batch in sharded.batches():
+                    with lock:
+                        for ev in batch:
+                            seen.setdefault(ev.uid, []).append(ev.type)
+
+            t = threading.Thread(target=consume, daemon=True)
+            t.start()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and len(seen) < 12:
+                time.sleep(0.05)
+            assert len(seen) == 12
+
+            # poison ONLY shard 0's resume point, then compact so its next
+            # reconnect 410s; shard 1's stream and rv are untouched
+            sources[0].resource_version = "1"
+            sources[0].client.abort_watch()
+            sources[0].client._watch_aborted = False  # one-shot kick, not shutdown
+            api.cluster.compact()
+            # meanwhile shard 1 keeps receiving live MODIFIEDs
+            movers = sorted(shard1_uids)[:2]
+            for uid in movers:
+                name = pods[uid]["metadata"]["name"]
+                api.cluster.set_phase("default", name, "Failed")
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                with lock:
+                    relisted = all(
+                        seen.get(u, []).count("ADDED") >= 2 for u in shard0_uids
+                    )
+                    moved = all("MODIFIED" in seen.get(u, []) for u in movers)
+                if relisted and moved:
+                    break
+                time.sleep(0.05)
+            sharded.stop()
+            t.join(timeout=5)
+            with lock:
+                # shard 0 relisted ITS pods (re-ADDs)...
+                for uid in shard0_uids:
+                    assert seen[uid].count("ADDED") >= 2, (uid, seen[uid])
+                # ...while shard 1's pods were NOT re-listed by shard 0's
+                # recovery (exactly one ADDED each) and kept flowing
+                for uid in shard1_uids:
+                    assert seen[uid].count("ADDED") == 1, (uid, seen[uid])
+                for uid in movers:
+                    assert "MODIFIED" in seen[uid], (uid, seen[uid])
+
+
+class TestBatchBoundaryDeltas:
+    def _run(self, events, batch_sizes):
+        metrics = MetricsRegistry()
+        sunk = []
+        pipeline = EventPipeline(
+            environment="production",
+            sink=sunk.append,
+            slice_tracker=SliceTracker("production"),
+            metrics=metrics,
+        )
+        i = 0
+        sizes = list(batch_sizes)
+        while i < len(events):
+            n = sizes.pop(0) if sizes else 1
+            pipeline.process_batch(events[i:i + n])
+            i += n
+        return [(n.kind, n.payload.get("event_type"), n.payload.get("name", n.payload.get("slice"))) for n in sunk]
+
+    def test_phase_and_slice_deltas_independent_of_batch_boundaries(self):
+        """The same event order produces the same notifications whether it
+        arrives as 1-event batches, one giant batch, or ragged batches —
+        batching amortizes overhead, never changes semantics."""
+        def mk_events():
+            events = []
+            for phase_step in ("Pending", "Running", "Succeeded"):
+                for w in range(4):
+                    pod = build_pod(
+                        f"sl-w{w}", uid=f"uid-sl-{w}", phase=phase_step, tpu_chips=4,
+                        tpu_topology="2x2x4",
+                        gke_slice_fields={
+                            "jobset.sigs.k8s.io/jobset-name": "train",
+                            "batch.kubernetes.io/job-completion-index": w,
+                        },
+                        container_statuses=[{
+                            "name": "main", "ready": phase_step == "Running", "restartCount": 0,
+                        }],
+                    )
+                    etype = EventType.ADDED if phase_step == "Pending" else EventType.MODIFIED
+                    events.append(WatchEvent(type=etype, pod=pod))
+            return events
+
+        reference = self._run(mk_events(), [1] * 12)
+        assert reference, "reference run produced no notifications"
+        assert self._run(mk_events(), [12]) == reference
+        assert self._run(mk_events(), [5, 3, 1, 2, 1]) == reference
+
+    def test_process_equals_process_batch(self):
+        from k8s_watcher_tpu.faults.injection import ChurnGenerator
+
+        def run(batched):
+            churn = ChurnGenerator(n_slices=4, workers_per_slice=4, seed=11)
+            events = list(churn.events(600))
+            metrics = MetricsRegistry()
+            sunk = []
+            pipe = EventPipeline(
+                environment="production", sink=sunk.append,
+                slice_tracker=SliceTracker("production"), metrics=metrics,
+            )
+            if batched:
+                for i in range(0, len(events), 64):
+                    pipe.process_batch(events[i:i + 64])
+            else:
+                for ev in events:
+                    pipe.process(ev)
+            dump = metrics.dump()
+            counters = {
+                k: v["count"] for k, v in dump.items() if "count" in v and v["count"]
+            }
+            return counters, [n.payload.get("uid", n.payload.get("slice")) for n in sunk]
+
+        assert run(batched=False) == run(batched=True)
+
+
+class TestShardCheckpointView:
+    def test_per_shard_rv_keys_are_isolated_and_count_scoped(self, tmp_path):
+        from k8s_watcher_tpu.state.checkpoint import CheckpointStore
+
+        store = CheckpointStore(tmp_path / "ck.json", interval_seconds=0.0)
+        v0 = ShardCheckpointView(store, 0, 2)
+        v1 = ShardCheckpointView(store, 1, 2)
+        v0.update_resource_version("100")
+        v1.update_resource_version("200")
+        assert v0.resource_version() == "100"
+        assert v1.resource_version() == "200"
+        # changing the shard COUNT invalidates every resume point: the old
+        # partition's rv must not resume under a new partition
+        assert ShardCheckpointView(store, 0, 3).resource_version() is None
+
+    def test_known_pods_restore_is_shard_filtered(self, tmp_path):
+        from k8s_watcher_tpu.state.checkpoint import CheckpointStore
+
+        store = CheckpointStore(tmp_path / "ck.json", interval_seconds=0.0)
+        known = {f"uid-{i}": {"metadata": {"uid": f"uid-{i}"}} for i in range(16)}
+        store.put("known_pods", known)
+        for shard in range(4):
+            view = ShardCheckpointView(store, shard, 4)
+            restored = view.get("known_pods")
+            assert restored
+            for uid in restored:
+                assert shard_of(uid, 4) == shard
+        total = sum(len(ShardCheckpointView(store, s, 4).get("known_pods")) for s in range(4))
+        assert total == 16
+
+
+class TestBatchQueue:
+    def test_close_drains_remaining_then_ends(self):
+        q = EventBatchQueue(capacity=8)
+        for i in range(5):
+            assert q.put(i)
+        q.close()
+        assert not q.put(99)  # closed: producers stop
+        got = []
+        while True:
+            batch = q.get_batch(2)
+            if batch is None:
+                break
+            got.extend(batch)
+        assert got == [0, 1, 2, 3, 4]
+
+    def test_backpressure_blocks_until_drained(self):
+        q = EventBatchQueue(capacity=4)
+        for i in range(4):
+            q.put(i)
+        landed = threading.Event()
+
+        def blocked_put():
+            q.put("late")
+            landed.set()
+
+        t = threading.Thread(target=blocked_put, daemon=True)
+        t.start()
+        assert not landed.wait(0.15), "put should block at capacity"
+        assert q.get_batch(4) == [0, 1, 2, 3]
+        assert landed.wait(2.0), "put should land once space frees"
+        assert q.put_blocked > 0
+        assert q.get_batch(4) == ["late"]
+
+
+class TestIncrementalCompaction:
+    def test_sliced_compaction_bounds_pause_and_keeps_churn(self, tmp_path):
+        from k8s_watcher_tpu.state.checkpoint import JournaledMapStore
+
+        store = JournaledMapStore(tmp_path / "m", compact_slice_entries=500)
+        state = {f"u{i:04d}": {"v": i} for i in range(4000)}
+        store.replace(dict(state))  # no hint -> full rewrite owed
+        flushes = 0
+        while store.pending:
+            store.flush(finalize=False)
+            flushes += 1
+            if flushes == 2:
+                # churn DURING compaction must survive into the new base
+                state["u0001"] = {"v": "mid-compaction"}
+                state["u9999"] = {"v": "new"}
+                store.replace(dict(state), changed_keys={"u0001", "u9999"})
+            assert flushes < 60, "compaction never converged"
+        assert flushes >= 4000 // 500, "compaction was not sliced"
+        reloaded = JournaledMapStore(tmp_path / "m")
+        assert reloaded.current() == state
+
+    def test_direct_flush_remains_a_full_durability_barrier(self, tmp_path):
+        """Shutdown calls flush() once; everything pending must be on disk
+        after it — slicing only applies to the throttled path."""
+        from k8s_watcher_tpu.state.checkpoint import JournaledMapStore
+
+        store = JournaledMapStore(tmp_path / "m", compact_slice_entries=100)
+        state = {f"u{i}": {"v": i} for i in range(1000)}
+        store.replace(dict(state))
+        store.flush()  # finalize=True default
+        assert not store.pending
+        assert JournaledMapStore(tmp_path / "m").current() == state
+
+    def test_shutdown_mid_compaction_completes_on_final_flush(self, tmp_path):
+        from k8s_watcher_tpu.state.checkpoint import JournaledMapStore
+
+        store = JournaledMapStore(tmp_path / "m", compact_slice_entries=100)
+        state = {f"u{i}": {"v": i} for i in range(1000)}
+        store.replace(dict(state))
+        store.flush(finalize=False)  # one slice only
+        assert store.pending  # compaction in progress
+        store.flush()  # the shutdown barrier
+        assert JournaledMapStore(tmp_path / "m").current() == state
+
+
+class TestOtherShardEvents:
+    def test_watch_source_drops_foreign_shard_events_but_advances_rv(self):
+        """Against a server that ignores the shard selector, a shard
+        stream must neither track nor emit another shard's pods — but its
+        resume version must still advance past them."""
+        from k8s_watcher_tpu.k8s.client import K8sClient
+        from k8s_watcher_tpu.k8s.kubeconfig import K8sConnection
+        from k8s_watcher_tpu.k8s.mock_server import MockApiServer
+        from k8s_watcher_tpu.k8s.watch import KubernetesWatchSource
+
+        class NoShardPushdown(K8sClient):
+            def list_pods(self, *a, **kw):
+                kw.pop("shard_selector", None)
+                return super().list_pods(*a, **kw)
+
+            def watch_pods(self, *a, **kw):
+                kw.pop("shard_selector", None)
+                return super().watch_pods(*a, **kw)
+
+        with MockApiServer() as api:
+            uids = [f"uid-f-{i}" for i in range(10)]
+            for i, uid in enumerate(uids):
+                api.cluster.add_pod(build_pod(f"f{i}", uid=uid, phase="Running", tpu_chips=4))
+            metrics = MetricsRegistry()
+            source = KubernetesWatchSource(
+                NoShardPushdown(K8sConnection(server=api.url), request_timeout=10.0),
+                watch_timeout_seconds=5, shard=0, shards=2, metrics=metrics,
+            )
+            mine = {u for u in uids if shard_of(u, 2) == 0}
+            got = []
+            for ev in source.events():
+                got.append(ev.uid)
+                if len(got) >= len(mine):
+                    break
+            source.stop()
+            assert set(got) == mine
+            assert set(source.known_pods()) == mine
