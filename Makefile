@@ -33,9 +33,10 @@ bench:
 	$(PY) bench.py
 
 # Bounded-budget regression smoke: the e2e latency tier + the sharded
-# ingest ceiling + small relist/checkpoint runs, no probes (~5 s of
-# measurement). Also runs pre-merge as the slow-marked
-# tests/test_bench_smoke.py.
+# ingest ceiling + the NOTIFY egress ramp/burst (keyed lanes + batched
+# POSTs — regressions here fail loudly, same as ingest) + small
+# relist/checkpoint runs, no probes (~8 s of measurement). Also runs
+# pre-merge as the slow-marked tests/test_bench_smoke.py.
 bench-smoke:
 	$(PY) bench.py --smoke
 

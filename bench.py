@@ -61,7 +61,18 @@ class _SinkHandler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_POST(self):
-        self._read_payload()
+        payload = self._read_payload()
+        if self.path.endswith("update_batch"):
+            # batched update_pod_statuses contract: per-item results
+            body = json.dumps(
+                {"results": [True] * len(payload.get("updates", []))}
+            ).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         self._respond_ok()
 
     def do_GET(self):
@@ -272,7 +283,14 @@ def bench_e2e_apiserver(n_events: int = 600, events_per_sec: float = 100.0) -> d
 
 
 def bench_burst_drain(n_events: int = 1000) -> dict:
-    """Unpaced burst: how fast can the notify plane drain a backlog?"""
+    """Unpaced burst: how fast can the notify plane drain a backlog?
+
+    Round 7 drives the PRODUCTION egress shape — keyed lanes, pooled
+    connections, adaptive coalescing (watermark 64), batched endpoint.
+    ``drain_notify_per_sec`` keeps the r06 definition (sent / total
+    including ingest time) so rounds stay comparable; the egress-only
+    reading is ``drain_only_notify_per_sec`` (sent / post-ingest drain
+    time), which isolates the notify plane from the churn generator."""
     from k8s_watcher_tpu.faults.injection import ChurnGenerator
     from k8s_watcher_tpu.metrics import MetricsRegistry
     from k8s_watcher_tpu.notify.client import ClusterApiClient
@@ -286,8 +304,12 @@ def bench_burst_drain(n_events: int = 1000) -> dict:
     url = f"http://127.0.0.1:{server.server_address[1]}"
 
     metrics = MetricsRegistry()
-    client = ClusterApiClient(url, timeout=5.0)
-    dispatcher = Dispatcher(client.update_pod_status, capacity=16384, workers=4, metrics=metrics)
+    client = ClusterApiClient(url, timeout=5.0, pool_size=4)
+    dispatcher = Dispatcher(
+        client.update_pod_status, capacity=16384, workers=4, metrics=metrics,
+        coalesce_watermark=64,
+        send_batch=client.update_pod_statuses, batch_max=32,
+    )
     dispatcher.start()
     pipeline = EventPipeline(
         environment="production", sink=dispatcher.submit,
@@ -304,14 +326,247 @@ def bench_burst_drain(n_events: int = 1000) -> dict:
     server.shutdown()
     server.server_close()
     sent = metrics.counter("dispatch_sent").value
+    drain_seconds = max(1e-6, total - ingest_seconds)
     return {
         "notifications": sent,
         "drain_notify_per_sec": round(sent / total, 1),
+        # egress-only reading: backlog drained per second after ingest
+        # stopped offering (noisy when the drain is near-instant, but
+        # free of the churn generator's time)
+        "drain_only_notify_per_sec": round(sent / drain_seconds, 1),
+        "drain_seconds": round(drain_seconds, 4),
+        "coalesced": metrics.counter("dispatch_coalesced").value,
+        "batches": metrics.counter("dispatch_batches").value,
+        "lane_high_water": dispatcher.lane_high_water,
         # unpaced pipeline capacity (filters + phase delta + slice
         # aggregation + enqueue, no pacing sleep): headroom over the
         # 1k events/min acceptance target
         "ingest_events_per_sec": round(n_events / ingest_seconds, 0),
     }
+
+
+# -- egress saturation ramp (round 7) ---------------------------------------
+
+
+def _egress_stack(
+    n_notifications: int,
+    *,
+    rate: Optional[float],
+    workers: int = 4,
+    batch_max: int = 32,
+    capacity: int = 16384,
+    coalesce_watermark: int = 64,
+) -> dict:
+    """Drive ``n_notifications`` distinct-pod notifications through the
+    PRODUCTION egress shape: keyed lanes -> worker fan-out -> pooled
+    keep-alive connections -> (batched) HTTP POSTs against a local sink;
+    paced at ``rate`` notifications/s, unpaced when ``rate`` is None.
+
+    Keys are DISTINCT per notification so coalescing never collapses the
+    offer — delivered == offered - drops, and the sustained number reads
+    as true egress throughput at unchanged delivery semantics."""
+    from k8s_watcher_tpu.metrics import MetricsRegistry
+    from k8s_watcher_tpu.notify.client import ClusterApiClient
+    from k8s_watcher_tpu.notify.dispatcher import Dispatcher
+    from k8s_watcher_tpu.pipeline.pipeline import Notification
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _SinkHandler)
+    server.daemon_threads = True
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    metrics = MetricsRegistry()
+    client = ClusterApiClient(
+        f"http://127.0.0.1:{server.server_address[1]}", timeout=5.0, pool_size=workers
+    )
+    dispatcher = Dispatcher(
+        client.update_pod_status, capacity=capacity, workers=workers,
+        metrics=metrics, coalesce_watermark=coalesce_watermark,
+        send_batch=client.update_pod_statuses if batch_max > 1 else None,
+        batch_max=batch_max,
+    )
+    dispatcher.start()
+    # pre-built outside the timed window (same discipline as _ingest_stack)
+    monotonic = time.monotonic
+    notifications = [
+        Notification(
+            {"uid": f"egress-{i}", "name": f"egress-{i}", "phase": "Running",
+             "environment": "production"},
+            0.0, kind="pod",
+        )
+        for i in range(n_notifications)
+    ]
+    interval = 1.0 / rate if rate else 0.0
+    submit = dispatcher.submit
+    t0 = monotonic()
+    for i, notification in enumerate(notifications):
+        if interval and i % 16 == 0:
+            # pacing checked every 16 submits: a per-submit sleep syscall
+            # would cap the producer below the rates under test
+            delay = t0 + i * interval - monotonic()
+            if delay > 0:
+                time.sleep(delay)
+        submit(notification._replace(received_monotonic=monotonic()))
+    offered_seconds = monotonic() - t0
+    dispatcher.drain(60.0)
+    total_seconds = monotonic() - t0
+    dispatcher.stop()
+    server.shutdown()
+    server.server_close()
+    dump = metrics.dump()
+
+    def count(name: str) -> int:
+        return dump.get(name, {}).get("count", 0)
+
+    sent = count("dispatch_sent")
+    return {
+        "offered": n_notifications,
+        "delivered": sent,
+        "failed": count("dispatch_failed"),
+        "overflow_drops": count("dispatch_dropped_overflow"),
+        "coalesced": count("dispatch_coalesced"),
+        "batches": count("dispatch_batches"),
+        "batch_items": count("dispatch_batch_items"),
+        "offered_seconds": offered_seconds,
+        "total_seconds": total_seconds,
+        "lane_high_water": dispatcher.lane_high_water,
+        "lane_capacity": max(1, capacity // workers),
+        "workers": workers,
+        "latency_p50_ms": dump.get("event_to_notify_latency", {}).get("p50_ms"),
+    }
+
+
+def _egress_step(rate: float, seconds_per_step: float, workers: int = 4) -> dict:
+    """One paced egress step at ``rate`` notifications/s. Same retry-once
+    discipline as the ingest ramp's ``_saturation_step``: a sandboxed-CI
+    scheduler hiccup must read as noise, not as the plane's ceiling."""
+    n = int(rate * seconds_per_step)
+    best = None
+    attempts = 0
+    for _attempt in range(2):
+        attempts += 1
+        run = _egress_stack(n, rate=rate, workers=workers)
+        sustained = round(run["delivered"] / run["total_seconds"], 1)
+        step = {
+            "offered_notify_per_sec": rate,
+            "sustained_notify_per_sec": sustained,
+            "per_worker_notify_per_sec": round(sustained / run["workers"], 1),
+            "delivered": run["delivered"],
+            "failed": run["failed"],
+            "overflow_drops": run["overflow_drops"],
+            "batches": run["batches"],
+            "lane_high_water": run["lane_high_water"],
+            "lane_capacity": run["lane_capacity"],
+            "workers": run["workers"],
+        }
+        # a verdict-clean attempt always beats a failing one, whatever the
+        # raw sustained numbers say — otherwise a hiccup-run with a higher
+        # reading shadows the clean retry and the ramp reports a false
+        # ceiling, defeating the retry's whole purpose
+        if best is None or _step_beats(step, best, _egress_step_verdict):
+            best = step
+        if _egress_step_verdict(best) is None:
+            break
+    if attempts > 1:
+        best["retried"] = True
+    return best
+
+
+def _step_beats(step: dict, best: dict, verdict) -> bool:
+    """True when ``step`` should replace ``best``: clean beats failing;
+    within the same verdict class, higher sustained wins."""
+    step_clean = verdict(step) is None
+    best_clean = verdict(best) is None
+    if step_clean != best_clean:
+        return step_clean
+    key = (
+        "sustained_notify_per_sec"
+        if "sustained_notify_per_sec" in step
+        else "sustained_events_per_sec"
+    )
+    return step[key] > best[key]
+
+
+def _egress_step_verdict(step: dict) -> Optional[str]:
+    # overflow means the bounded lanes filled faster than the workers
+    # could POST (even with batching) — the egress plane's hard wall.
+    # A missed schedule without overflow is attributed by the lane
+    # high-water mark: deep lanes mean the POST side was behind
+    # (egress_workers); shallow lanes mean the single submit producer
+    # couldn't offer the rate (egress_submit).
+    if step["overflow_drops"] > 0:
+        return "egress_lanes_overflow"
+    if step["failed"] > 0:
+        return "egress_sink_errors"
+    if step["sustained_notify_per_sec"] < 0.95 * step["offered_notify_per_sec"]:
+        if step["lane_high_water"] >= 0.5 * step["lane_capacity"]:
+            return "egress_workers"
+        return "egress_submit"
+    return None
+
+
+def _unpaced_egress_blast(n_notifications: int = 20_000) -> dict:
+    """The raw egress ceiling: pre-filled lanes, no pacing — how fast the
+    worker fan-out + pooled connections + batched POSTs can move a backlog.
+    This is the number the paced ramp approaches from below."""
+    run = _egress_stack(n_notifications, rate=None, capacity=max(32768, n_notifications))
+    return {
+        "notify_per_sec": round(run["delivered"] / run["total_seconds"], 1),
+        "delivered": run["delivered"],
+        "batches": run["batches"],
+        "mean_batch_items": (
+            round(run["batch_items"] / run["batches"], 1) if run["batches"] else 0.0
+        ),
+        "lane_high_water": run["lane_high_water"],
+        "workers": run["workers"],
+    }
+
+
+def bench_egress_saturation(max_rate: float = 32000.0, seconds_per_step: float = 2.0) -> dict:
+    """Mirror of the ingest saturation ramp for the NOTIFY side: double the
+    offered notifications/s until the egress plane misses the schedule or
+    its lanes overflow, bisect the ceiling, and name WHICH stage gave out
+    (``egress_workers`` / ``egress_lanes_overflow`` / ``egress_submit``).
+
+    The r06 plane drained bursts at ~520 notifications/s against a ~17k
+    events/s ingest — this ramp is the regression tripwire that keeps the
+    rebuilt plane (keyed lanes + pooled connections + adaptive coalescing
+    + micro-batching) 10x+ above that."""
+    try:
+        steps = []
+        rate = 1000.0
+        max_clean_rate = 0.0
+        first_saturating_stage = None
+        failed_rate = None
+        while rate <= max_rate:
+            step = _egress_step(rate, seconds_per_step)
+            steps.append(step)
+            first_saturating_stage = _egress_step_verdict(step)
+            if first_saturating_stage:
+                failed_rate = rate
+                break
+            max_clean_rate = step["sustained_notify_per_sec"]
+            rate *= 2.0
+        if failed_rate is not None and max_clean_rate > 0:
+            lo, hi = max_clean_rate, failed_rate
+            for _ in range(3):
+                mid = (lo + hi) / 2.0
+                step = _egress_step(mid, seconds_per_step)
+                steps.append(step)
+                verdict = _egress_step_verdict(step)
+                if verdict:
+                    first_saturating_stage = verdict
+                    hi = mid
+                else:
+                    lo = step["sustained_notify_per_sec"]
+                    max_clean_rate = max(max_clean_rate, lo)
+        return {
+            "max_sustained_notify_per_sec": round(max_clean_rate, 1),
+            # None = clean through max_rate on this host
+            "first_saturating_stage": first_saturating_stage,
+            "unpaced_egress": _unpaced_egress_blast(),
+            "steps": steps,
+        }
+    except Exception as exc:  # one failed step must not sink the whole bench
+        return {"error": str(exc)}
 
 
 def bench_saturation(max_rate: float = 32000.0, seconds_per_step: float = 3.0) -> dict:
@@ -474,7 +729,8 @@ def _saturation_step(rate: float, seconds_per_step: float) -> dict:
             "queue_put_blocked": run["queue_put_blocked"],
             "per_shard_events_per_sec": run["per_shard_events_per_sec"],
         }
-        if best is None or step["sustained_events_per_sec"] > best["sustained_events_per_sec"]:
+        # same clean-beats-failing rule as _egress_step (_step_beats)
+        if best is None or _step_beats(step, best, _step_verdict):
             best = step
         if _step_verdict(best) is None:
             break
@@ -563,10 +819,20 @@ def bench_relist_scale(n_pods: int = 10_000, page_size: int = 500, shards: int =
     through the SHARDED relist path — ``shards`` watch sources each paging
     its uid-hash partition (per-shard continue-token chains, server-side
     shard push-down) CONCURRENTLY against the in-repo mock apiserver over
-    real HTTP, with tombstone bookkeeping live. One shard's pagination is
-    inherently serial (each continue token depends on the previous page);
-    shard-parallelism is what breaks that wall. ``serial_relist_ms``
-    (one unsharded source, same data) is reported for the speedup."""
+    real HTTP, with tombstone bookkeeping live. ``serial_relist_ms`` (one
+    unsharded source, same data) is reported for the speedup.
+
+    Honest ceiling (round 7): with the mock apiserver IN-PROCESS, every
+    byte of page decode on every chain shares one GIL, so N concurrent
+    chains can at best MATCH one prefetch-pipelined serial chain — there
+    is no parallelism to harvest, only scheduling overhead to amortize
+    (r06's 0.6x was a real regression — an O(shards x pods) server-side
+    shard scan, fixed by the mock's partition cache; the residue around
+    1.0x is the GIL bound, not contention). Against an out-of-process
+    apiserver the chains' server-side serialization + network time DOES
+    overlap. The metric a sharded deployment actually buys is
+    ``single_shard_relist_ms``: a 410 on one shard relists 1/N of the
+    cluster while the other streams keep flowing."""
     try:
         from k8s_watcher_tpu.k8s.client import K8sClient
         from k8s_watcher_tpu.k8s.kubeconfig import K8sConnection
@@ -596,6 +862,13 @@ def bench_relist_scale(n_pods: int = 10_000, page_size: int = 500, shards: int =
             t0 = time.monotonic()
             serial_events = sum(1 for _ in serial._relist())
             serial_seconds = time.monotonic() - t0
+
+            # one shard's 410 recovery: relist 1/N of the cluster while
+            # the other streams keep flowing — the latency a sharded
+            # deployment actually buys (see docstring)
+            t0 = time.monotonic()
+            single_shard_events = sum(1 for _ in make_source(0, shards)._relist())
+            single_shard_seconds = time.monotonic() - t0
 
             sources = [make_source(i, shards) for i in range(shards)]
             counts = [0] * shards
@@ -634,6 +907,11 @@ def bench_relist_scale(n_pods: int = 10_000, page_size: int = 500, shards: int =
             "sharded_relist_ms": round(1e3 * relist_seconds, 1),
             "serial_relist_ms": round(1e3 * serial_seconds, 1),
             "shard_speedup": round(serial_seconds / relist_seconds, 2),
+            # 410 recovery for ONE shard (1/N of the cluster) — the
+            # sharded deployment's real relist win
+            "single_shard_relist_ms": round(1e3 * single_shard_seconds, 1),
+            "single_shard_events": single_shard_events,
+            "single_shard_recovery_speedup": round(serial_seconds / single_shard_seconds, 2),
             "pods_per_sec": round(n_pods / best_seconds, 0),
         }
     except Exception as exc:
@@ -1065,8 +1343,23 @@ def main(smoke: bool = False) -> int:
             "steps": [],
             "smoke": True,
         }
+        # bounded egress tier: one paced step at 4k notifications/s (the
+        # ramp's verdict machinery end to end) + the unpaced ceiling —
+        # enough to trip on a 10x egress regression in ~3 s
+        egress_step = _egress_step(4000.0, 1.5)
+        egress_blast = _unpaced_egress_blast(8000)
+        egress = {
+            "max_sustained_notify_per_sec": max(
+                egress_step["sustained_notify_per_sec"], egress_blast["notify_per_sec"]
+            ),
+            "first_saturating_stage": _egress_step_verdict(egress_step),
+            "unpaced_egress": egress_blast,
+            "steps": [egress_step],
+            "smoke": True,
+        }
+        burst_stats = bench_burst_drain(n_events=1000)
         skipped = {"skipped": "smoke"}
-        pipeline_stats = pipeline_500 = burst_stats = scan_stats = skipped
+        pipeline_stats = pipeline_500 = scan_stats = skipped
         relist_50k = checkpoint_50k = virtual_stats = probe_stats = skipped
         relist_stats = bench_relist_scale(n_pods=2000)
         checkpoint_stats = bench_checkpoint_scale(n_pods=5000)
@@ -1077,6 +1370,7 @@ def main(smoke: bool = False) -> int:
         # degrade with offered load (queueing would show here first)
         pipeline_500 = bench_watch_pipeline(n_events=2500, events_per_sec=500.0)
         saturation = bench_saturation()
+        egress = bench_egress_saturation()
         burst_stats = bench_burst_drain()
         scan_stats = bench_frame_scan()
         relist_stats = bench_relist_scale()
@@ -1094,6 +1388,7 @@ def main(smoke: bool = False) -> int:
         "pipeline": pipeline_stats,
         "pipeline_500eps": pipeline_500,
         "saturation": saturation,
+        "egress_saturation": egress,
         "burst": burst_stats,
         "frame_scan": scan_stats,
         "relist_10k": relist_stats,
@@ -1132,6 +1427,9 @@ def main(smoke: bool = False) -> int:
         "e2e_completed": f"{e2e_stats.get('completed', 0)}/{e2e_stats.get('offered', 0)}",
         "max_sustained_events_per_sec": saturation.get("max_sustained_events_per_sec"),
         "saturating_stage": saturation.get("first_saturating_stage"),
+        "max_sustained_notify_per_sec": egress.get("max_sustained_notify_per_sec"),
+        "egress_saturating_stage": egress.get("first_saturating_stage"),
+        "burst_drain_notify_per_sec": burst_stats.get("drain_notify_per_sec"),
         "relist_10k_ms": relist_stats.get("relist_ms"),
         "relist_shard_speedup": relist_stats.get("shard_speedup"),
         "checkpoint_10k_flush_ms": checkpoint_stats.get("flush_ms_median"),

@@ -35,9 +35,13 @@ def build_notifier(config: AppConfig) -> ClusterApiClient:
         c.api_key,
         c.timeout,
         pod_update_endpoint=c.pod_update_endpoint,
+        pod_update_batch_endpoint=c.pod_update_batch_endpoint,
         health_endpoint=c.health_endpoint,
         retry=c.retry,
         verify_tls=c.verify_tls,
+        # one keep-alive connection per egress worker: workers must never
+        # serialize on a shared socket (the r06 burst-drain wall)
+        pool_size=c.resolved_pool_size(config.ingest.shards),
     )
 
 
@@ -171,15 +175,25 @@ class WatcherApp:
 
             self.audit = AuditRing(config.watcher.audit_ring_size)
         self.status_server: Optional[StatusServer] = None
+        c = config.clusterapi
         self.dispatcher = Dispatcher(
             self.notifier.update_pod_status,
-            capacity=config.clusterapi.queue_capacity,
-            workers=config.clusterapi.workers,
-            coalesce=config.clusterapi.coalesce,
+            capacity=c.queue_capacity,
+            # egress fan-out scales with the ingest fan-in unless pinned
+            workers=c.resolved_workers(config.ingest.shards),
+            coalesce=c.coalesce,
+            coalesce_watermark=c.coalesce_watermark,
             metrics=self.metrics,
             # bounds shutdown: when stop()'s drain window expires, cut
             # in-flight sends instead of waiting out attempts x timeout
             abort=getattr(self.notifier, "abort", None),
+            # micro-batching under backlog (per-item below batch_max=2);
+            # a receiver without the batch endpoint falls back per-item
+            send_batch=(
+                getattr(self.notifier, "update_pod_statuses", None)
+                if c.batch_max > 1 else None
+            ),
+            batch_max=c.batch_max,
         )
         self.source = source or build_source(config, self.checkpoint, self.liveness.beat, self.metrics)
         # EVERY source runs behind the sharded-ingest machinery (bounded

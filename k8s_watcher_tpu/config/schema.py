@@ -285,17 +285,33 @@ class ClusterApiConfig:
     base_url: str = "http://localhost:3000"
     api_key: Optional[str] = None
     pod_update_endpoint: str = "/api/pods/update"
+    pod_update_batch_endpoint: str = "/api/pods/update_batch"
     health_endpoint: str = "/health"
     timeout: float = 30.0
     retry: RetryPolicy = dataclasses.field(default_factory=lambda: RetryPolicy(delay_seconds=2.0))
-    # net-new: async dispatcher knobs (queue + worker so one slow POST can't
-    # stall the watch stream — prerequisite for the <1s p50 target)
+    # net-new: async egress-plane knobs (keyed worker fan-out so one slow
+    # POST can't stall the watch stream — prerequisite for the <1s p50
+    # target — and distinct pods POST concurrently under churn)
     queue_capacity: int = 1024
-    workers: int = 2
+    # egress worker count (= lane count: notifications hash by coalesce
+    # key onto per-worker FIFO lanes). 0 = auto: scale with ingest.shards
+    # (max(2, 2 x shards) — the fan-in side grows with the fan-out side)
+    workers: int = 0
     # latest-wins per pod/slice while queued: update_pod_status is a state
     # update, so a newer payload supersedes an unsent older one for the same
     # object (bounds queue growth per object under churn)
     coalesce: bool = True
+    # lane depth at which latest-wins collapse starts. Below it same-key
+    # updates queue uncollapsed (the receiver sees every transition while
+    # egress keeps up); 0 = collapse whenever a same-key payload is still
+    # waiting (the pre-round-7 behavior)
+    coalesce_watermark: int = 0
+    # pooled keep-alive connections to the notify target; 0 = match workers
+    pool_size: int = 0
+    # micro-batch size for the batched update_pod_statuses endpoint under
+    # backlog; 0/1 = per-item sends only (a receiver without the batch
+    # endpoint falls back automatically either way)
+    batch_max: int = 0
     verify_tls: bool = True  # for https endpoints with self-signed certs
 
     @classmethod
@@ -303,7 +319,7 @@ class ClusterApiConfig:
         _check_known(
             raw,
             ("base_url", "auth", "endpoints", "timeout", "retry", "queue_capacity", "workers",
-             "coalesce", "verify_tls"),
+             "coalesce", "coalesce_watermark", "pool_size", "batch_max", "verify_tls"),
             "clusterapi",
         )
         auth = raw.get("auth") or {}
@@ -311,19 +327,38 @@ class ClusterApiConfig:
         _check_known(auth, ("api_key",), "clusterapi.auth")
         endpoints = raw.get("endpoints") or {}
         _expect(endpoints, (dict,), "clusterapi.endpoints")
-        _check_known(endpoints, ("pod_update", "health"), "clusterapi.endpoints")
+        _check_known(endpoints, ("pod_update", "pod_update_batch", "health"), "clusterapi.endpoints")
+        for key, floor in (("workers", 0), ("coalesce_watermark", 0), ("pool_size", 0), ("batch_max", 0)):
+            if _opt_int(raw, key, "clusterapi", 0) < floor:
+                raise SchemaError(f"config key 'clusterapi.{key}': must be >= {floor}")
         return cls(
             base_url=_opt_str(raw, "base_url", "clusterapi", "http://localhost:3000").rstrip("/"),
             api_key=_opt_str(auth, "api_key", "clusterapi.auth", None),
             pod_update_endpoint=_opt_str(endpoints, "pod_update", "clusterapi.endpoints", "/api/pods/update"),
+            pod_update_batch_endpoint=_opt_str(
+                endpoints, "pod_update_batch", "clusterapi.endpoints", "/api/pods/update_batch"
+            ),
             health_endpoint=_opt_str(endpoints, "health", "clusterapi.endpoints", "/health"),
             timeout=_opt_num(raw, "timeout", "clusterapi", 30.0),
             retry=RetryPolicy.from_raw(raw.get("retry") or {}, "clusterapi.retry", delay_default=2.0),
             queue_capacity=_opt_int(raw, "queue_capacity", "clusterapi", 1024),
-            workers=_opt_int(raw, "workers", "clusterapi", 2),
+            workers=_opt_int(raw, "workers", "clusterapi", 0),
             coalesce=_opt_bool(raw, "coalesce", "clusterapi", True),
+            coalesce_watermark=_opt_int(raw, "coalesce_watermark", "clusterapi", 0),
+            pool_size=_opt_int(raw, "pool_size", "clusterapi", 0),
+            batch_max=_opt_int(raw, "batch_max", "clusterapi", 0),
             verify_tls=_opt_bool(raw, "verify_tls", "clusterapi", True),
         )
+
+    def resolved_workers(self, ingest_shards: int = 1) -> int:
+        """The egress worker/lane count: explicit, or scaled with the
+        ingest fan-out (max(2, 2 x shards)) when ``workers: 0``."""
+        return self.workers or max(2, 2 * max(1, ingest_shards))
+
+    def resolved_pool_size(self, ingest_shards: int = 1) -> int:
+        """Connection-pool size: explicit, or one keep-alive connection
+        per egress worker so workers never serialize on a socket."""
+        return self.pool_size or self.resolved_workers(ingest_shards)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -16,6 +16,12 @@ implementing the exact API subset ``K8sClient`` consumes:
 Test hooks: ``MockCluster.add/modify/delete_pod`` drive the event stream;
 ``compact()`` expires old resourceVersions to exercise the relist path;
 ``fail_next(n)`` injects transient HTTP 500s to exercise backoff.
+
+The server also exposes the clusterapi NOTIFY surface (``GET /health``,
+``POST /api/pods/update`` and the batched ``POST /api/pods/update_batch``
+— payloads land in ``MockCluster.status_updates``), so egress-plane
+integration tests drive the real ``ClusterApiClient`` against it without
+a second server implementation.
 """
 
 from __future__ import annotations
@@ -175,6 +181,18 @@ class MockCluster:
         # LIST pages splice cached text instead of deep-copy + re-encode
         # per pod per page. rv-validated, entries dropped on delete.
         self._pod_json: Dict[Tuple[str, str], Tuple[str, str]] = {}
+        # per-shard sorted-key partition, keyed on (collection, shards)
+        # and the rv it was built at. Without it every sharded LIST page
+        # rescanned the WHOLE key space computing a crc32 per pod to find
+        # its 1/n matches — O(shards x n_pods x pages) of GIL-bound work
+        # that made a 4-shard concurrent relist SLOWER than one serial
+        # page chain (bench r06: shard_speedup 0.6)
+        self._shard_keys: Dict[Tuple[str, int], Tuple[int, List[list]]] = {}
+        # clusterapi-surface test hook: status updates POSTed to
+        # /api/pods/update[_batch] (the mock doubles as a notify target so
+        # the egress plane can be integration-tested without a second
+        # server implementation)
+        self.status_updates: List[Dict[str, Any]] = []
 
     def _sorted_collection_keys(self, collection: str, mapping) -> list:
         """Sorted key list for ``mapping``, cached until the next
@@ -186,12 +204,34 @@ class MockCluster:
         self._sorted_keys[collection] = (self._rv, keys)
         return keys
 
-    def _cursor_page(self, collection: str, mapping, after, limit, match) -> list:
+    def _shard_partition_keys(
+        self, collection: str, mapping, shard: int, shards: int
+    ) -> list:
+        """Shard ``shard``'s sorted key list under the uid-hash partition,
+        cached until the next mutation — one O(n) crc32 sweep per (rv,
+        shard count) instead of one per scanned key per page. Call under
+        ``self._lock``."""
+        cached = self._shard_keys.get((collection, shards))
+        if cached is None or cached[0] != self._rv:
+            from k8s_watcher_tpu.watch.sharded import shard_of
+
+            parts: List[list] = [[] for _ in range(shards)]
+            for key in self._sorted_collection_keys(collection, mapping):
+                obj = mapping.get(key)
+                uid = ((obj or {}).get("metadata") or {}).get("uid") or ""
+                parts[shard_of(uid, shards)].append(key)
+            cached = (self._rv, parts)
+            self._shard_keys[(collection, shards)] = cached
+        return cached[1][shard]
+
+    def _cursor_page(self, collection: str, mapping, after, limit, match, keys=None) -> list:
         """Cursor scan shared by the paged LISTs: up to ``limit+1``
         (key, obj) pairs with key > ``after`` satisfying ``match(key,
         obj)`` (limit+1 so _page_body can detect "more remain"). Call
-        under ``self._lock``."""
-        keys = self._sorted_collection_keys(collection, mapping)
+        under ``self._lock``. ``keys``: pre-restricted sorted key list
+        (shard partitions); defaults to the whole collection."""
+        if keys is None:
+            keys = self._sorted_collection_keys(collection, mapping)
         want = limit + 1 if limit else None
         matches = []
         for key in keys[bisect.bisect_right(keys, after):]:
@@ -517,13 +557,44 @@ class MockCluster:
         with self._lock:
             if snapshot_rv is not None and int(snapshot_rv) < self._oldest_rv:
                 return _expired_continue_status()
+            shard_keys = None
+            if shard_sel is not None:
+                # pre-partitioned key list: the scan touches only this
+                # shard's pods, no per-key hash (see _shard_partition_keys)
+                shard_keys = self._shard_partition_keys(
+                    "pods", self._pods, shard_sel[0], shard_sel[1]
+                )
             matches = self._cursor_page(
                 "pods", self._pods, after, limit,
                 lambda key, pod: (namespace is None or key[0] == namespace)
-                and _matches_selector(pod, selector)
-                and _matches_shard(pod, shard_sel),
+                and _matches_selector(pod, selector),
+                keys=shard_keys,
             )
             return 200, self._page_body("PodList", matches, limit, snapshot_rv)
+
+    # -- clusterapi notify surface (egress-plane integration target) -------
+
+    def record_status_update(self, payload: Dict[str, Any]) -> bool:
+        """Accept one ``update_pod_status`` POST (clusterapi contract).
+        Always succeeds; the payload lands in ``status_updates`` for
+        assertions."""
+        with self._lock:
+            self.status_updates.append(payload)
+        return True
+
+    def record_status_updates(self, payloads: List[Any]) -> List[bool]:
+        """Accept one ``update_pod_statuses`` batch POST; per-item results
+        (a non-dict item is rejected, the rest of the batch still lands —
+        the per-item result list is the point of the batch wire shape)."""
+        results = []
+        with self._lock:
+            for payload in payloads:
+                if isinstance(payload, dict):
+                    self.status_updates.append(payload)
+                    results.append(True)
+                else:
+                    results.append(False)
+        return results
 
     def events_since(self, rv: int, deadline: float, collection: str = "pods") -> Optional[List[Dict[str, Any]]]:
         """Block until there are journal events > rv in ``collection`` or the
@@ -664,6 +735,10 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/version":
             self._json(200, {"major": "1", "minor": "31", "gitVersion": "v1.31.0-mock"})
             return
+        if path == "/health":
+            # clusterapi-surface health endpoint (ClusterApiClient.health_check)
+            self._json(200, {"ok": True})
+            return
         if path == "/api/v1/namespaces":
             items = [{"metadata": {"name": ns}} for ns in self.cluster.namespaces]
             self._json(200, {"kind": "NamespaceList", "items": items})
@@ -743,6 +818,21 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(fail, {"kind": "Status", "code": fail, "message": "injected failure"})
             return
         path = urlparse(self.path).path
+        if path == "/api/pods/update":
+            # clusterapi notify surface: one status-update payload
+            self.cluster.record_status_update(body)
+            self._json(200, {"ok": True})
+            return
+        if path == "/api/pods/update_batch":
+            # batched notify (ClusterApiClient.update_pod_statuses wire
+            # shape); malformed batch envelope (non-dict body included)
+            # -> 400, per-item verdicts ride back in "results"
+            updates = body.get("updates") if isinstance(body, dict) else None
+            if not isinstance(updates, list):
+                self._json(400, {"kind": "Status", "code": 400, "message": "updates must be a list"})
+                return
+            self._json(200, {"results": self.cluster.record_status_updates(updates)})
+            return
         lease = _parse_lease_path(path)
         if lease is not None and lease[1] is None:  # POST to the collection creates
             namespace = lease[0]

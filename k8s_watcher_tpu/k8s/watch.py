@@ -260,11 +260,14 @@ class KubernetesWatchSource:
             metrics=self.metrics,
             # overlap the next page's fetch+decode with this page's
             # skeleton tracking/yields — relist wall time becomes
-            # max(fetch, process) per page, not their sum. Only for an
-            # UNSHARDED stream: sharded relists already run N concurrent
-            # page chains, and doubling the thread count there just
-            # thrashes the scheduler on small hosts
-            prefetch=self.shards == 1,
+            # max(fetch, process) per page, not their sum. Sharded
+            # streams prefetch too (round 7): each chain's synchronous
+            # request->decode->track loop otherwise stalls a GIL-switch
+            # interval per page handoff, and with N concurrent chains
+            # those bubbles convoy — measured as sharded relist running
+            # SLOWER than one serial chain (r06 shard_speedup 0.6); the
+            # in-flight page per chain hides the handoff inside decode
+            prefetch=True,
         ):
             if self._stop.is_set():
                 # shutdown mid-pagination: abort WITHOUT the tombstone

@@ -89,6 +89,16 @@ class Gauge:
             self._value = float(value)
             self._set = True
 
+    def set_max(self, value: float) -> None:
+        """Raise the reading to ``value`` if it is a new high-water mark —
+        the check-and-set runs in one lock hold so concurrent reporters
+        (per-lane dispatch workers) can't regress the mark."""
+        value = float(value)
+        with self._lock:
+            if not self._set or value > self._value:
+                self._value = value
+                self._set = True
+
     def clear(self) -> None:
         """Withdraw the reading: a gauge whose source started erroring must
         disappear from scrapes, not freeze at its last healthy value."""

@@ -15,13 +15,23 @@ Reference defects fixed (SURVEY.md §2):
 - retry: config-driven retry with exponential backoff for connection errors
   and 5xx (the reference's retry config was never consumed).
 
-The POST hot path runs on a persistent per-thread ``http.client``
-connection instead of ``requests`` (~4x lower per-call overhead, and no
+The POST hot path runs on a POOL of persistent ``http.client``
+connections instead of ``requests`` (~4x lower per-call overhead, and no
 shared-session contention between dispatcher workers) — under churn the
-notify plane, not the watch stream, is the throughput ceiling. Payloads
-are idempotent state snapshots, so a request that dies on a *reused*
-keep-alive connection (server idled it out) is transparently resent once
-on a fresh connection before the configured retry policy is consulted.
+notify plane, not the watch stream, is the throughput ceiling. The pool
+(round 7) replaces the old per-thread connection: any worker borrows any
+warm connection (LIFO, so the hottest socket is reused first), up to
+``pool_size`` live connections, each with its own stale-teardown resend
+and all of them cuttable by ``abort()``. Payloads are idempotent state
+snapshots, so a request that dies on a *reused* keep-alive connection
+(server idled it out) is transparently resent once on a fresh connection
+before the configured retry policy is consulted.
+
+``update_pod_statuses`` POSTs many payloads in ONE request to the batch
+endpoint (``clusterapi.endpoints.pod_update_batch``); a receiver without
+that endpoint (404/405/501) flips a latch and the client reports "no
+batch support" (None) so the dispatcher falls back to per-item sends —
+the probe costs one request ever.
 
 ``HTTP_PROXY``/``HTTPS_PROXY``/``NO_PROXY`` are honored (``proxy_for``)
 — the reference got this implicitly from requests; a corp-egress cluster
@@ -39,7 +49,8 @@ import logging
 import socket
 import ssl
 import threading
-from typing import Any, Dict, Optional, Tuple
+import time
+from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import unquote, urlsplit
 
 from k8s_watcher_tpu.config.schema import RetryPolicy
@@ -128,16 +139,20 @@ class ClusterApiClient:
         timeout: float = 30.0,
         *,
         pod_update_endpoint: str = "/api/pods/update",
+        pod_update_batch_endpoint: str = "/api/pods/update_batch",
         health_endpoint: str = "/health",
         retry: Optional[RetryPolicy] = None,
         verify_tls: bool = True,
+        pool_size: int = 8,
     ):
         self.base_url = base_url.rstrip("/")
         self.api_key = api_key
         self.timeout = timeout
         self.pod_update_endpoint = pod_update_endpoint
+        self.pod_update_batch_endpoint = pod_update_batch_endpoint
         self.health_endpoint = health_endpoint
         self.retry = retry or RetryPolicy(max_attempts=1, delay_seconds=0.0)
+        self.pool_size = max(1, pool_size)
 
         parts = urlsplit(self.base_url)
         if parts.scheme not in ("http", "https"):
@@ -163,34 +178,39 @@ class ClusterApiClient:
                 "clusterapi requests will use %s proxy %s:%d",
                 self._scheme.upper(), self._proxy[0], self._proxy[1],
             )
-        self._local = threading.local()
-        # shutdown support: abort() must be able to cut sends owned by
-        # OTHER threads (threading.local hides them), so every live
-        # connection is also registered here
         self._abort = threading.Event()
-        self._conns_lock = threading.Lock()
-        # conn -> owning thread: abort() closes every value; registration
-        # prunes entries whose thread died (its threading.local dropped
-        # the only other reference, and nothing else would ever close the
-        # keep-alive socket — unbounded fd growth under thread churn)
-        self._conns: dict = {}
+        # pool state, all under one condition: idle connections (LIFO so
+        # the warmest socket is borrowed first), the live-connection count
+        # (idle + borrowed) the pool_size cap bounds, and the registry of
+        # EVERY live connection — borrowed ones included — so abort() can
+        # cut a send another thread owns mid-recv
+        self._pool_cond = threading.Condition()
+        self._free: list = []
+        self._live = 0
+        self._conns: set = set()
+        # latched True the first time the batch endpoint answers
+        # 404/405/501: the receiver has no batch support, stop probing
+        self._batch_unsupported = False
 
     def abort(self) -> None:
         """Cut every in-flight send and suppress further attempts: pending
-        retry sleeps wake immediately, retry loops exit, and live sockets
-        are closed so a worker blocked in a long recv errors out now
-        instead of after the full request timeout. One-way; used to bound
-        shutdown when the notify target is dead or hung."""
+        retry sleeps wake immediately, retry loops exit, pool waiters wake,
+        and live sockets are closed so a worker blocked in a long recv
+        errors out now instead of after the full request timeout. One-way;
+        used to bound shutdown when the notify target is dead or hung."""
         self._abort.set()
-        with self._conns_lock:
+        with self._pool_cond:
             conns = list(self._conns)
+            self._conns.clear()
+            self._free.clear()
+            self._pool_cond.notify_all()
         for conn in conns:
             try:
                 conn.close()
             except Exception:
                 pass
 
-    # -- connection management (per dispatcher-worker thread) ---------------
+    # -- connection pool -----------------------------------------------------
 
     def _new_connection(self, timeout: float) -> http.client.HTTPConnection:
         """Fresh connection honoring the resolved proxy: direct, absolute-URI
@@ -229,53 +249,72 @@ class ClusterApiClient:
             return {**self._headers, "Proxy-Authorization": self._proxy[2]}
         return self._headers
 
-    def _connection(self) -> Tuple[http.client.HTTPConnection, bool]:
-        """This thread's persistent connection, and whether it is fresh
-        (fresh = no request has succeeded on it yet)."""
-        if self._abort.is_set():
-            # abort() only closes REGISTERED sockets: minting a new one
-            # here (e.g. _request's transparent resend after abort cut the
-            # old conn) would dodge the shutdown cut entirely
-            raise ConnectionError("client aborted (shutting down)")
-        conn = getattr(self._local, "conn", None)
-        if conn is not None:
-            return conn, getattr(self._local, "fresh", True)
-        conn = self._new_connection(self.timeout)
-        self._local.conn = conn
-        self._local.fresh = True
-        with self._conns_lock:
-            # re-check under the lock that serializes registration against
-            # abort()'s sweep: a conn minted after the is_set() check above
-            # but registered after the sweep copied _conns would otherwise
-            # escape the cut for up to a full request timeout
-            if self._abort.is_set():
-                self._local.conn = None
-                try:
-                    conn.close()
-                except Exception:
-                    pass
-                raise ConnectionError("client aborted (shutting down)")
-            for stale_conn, owner in [
-                (c, t) for c, t in self._conns.items() if not t.is_alive()
-            ]:
-                del self._conns[stale_conn]
-                try:
-                    stale_conn.close()
-                except Exception:
-                    pass
-            self._conns[conn] = threading.current_thread()
-        return conn, True
+    def _acquire(self, fresh_only: bool = False) -> http.client.HTTPConnection:
+        """Borrow a pooled connection (mint one while under the pool_size
+        cap; otherwise wait for a return). Minting and registration happen
+        under the SAME lock as abort()'s sweep, so a connection can never
+        slip past the shutdown cut. Raises ConnectionError on abort or
+        pool-exhaustion timeout (the send path maps it to False + retry).
 
-    def _drop_connection(self) -> None:
-        conn = getattr(self._local, "conn", None)
-        if conn is not None:
-            with self._conns_lock:
-                self._conns.pop(conn, None)
+        ``fresh_only``: the caller just watched a REUSED keep-alive die on
+        teardown — its idle siblings in the stack sat through the same
+        idle window and are suspect too, so drain and close them and mint
+        a genuinely fresh connection (without this, the transparent
+        resend could borrow another stale socket and fail a send against
+        a healthy server)."""
+        deadline = time.monotonic() + self.timeout
+        with self._pool_cond:
+            if fresh_only:
+                # drain only the conns idle RIGHT NOW — they shared the
+                # suspect's idle window. A sibling returned while we wait
+                # below just completed a request, so it is provably live
+                # and must NOT be closed (that would turn one stale
+                # teardown into a reconnect spike under load)
+                while self._free:
+                    stale = self._free.pop()
+                    self._conns.discard(stale)
+                    self._live -= 1
+                    try:
+                        stale.close()
+                    except Exception:
+                        pass
+            while True:
+                if self._abort.is_set():
+                    raise ConnectionError("client aborted (shutting down)")
+                if self._free:
+                    return self._free.pop()
+                if self._live < self.pool_size:
+                    # HTTPConnection() does no I/O until the request, so
+                    # minting under the lock is cheap
+                    conn = self._new_connection(self.timeout)
+                    conn._kw_fresh = True  # no request has succeeded on it yet
+                    self._live += 1
+                    self._conns.add(conn)
+                    return conn
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._pool_cond.wait(remaining):
+                    raise ConnectionError(
+                        f"connection pool exhausted ({self.pool_size} in flight)"
+                    )
+
+    def _release(self, conn: http.client.HTTPConnection, *, discard: bool) -> None:
+        """Return a borrowed connection: back to the idle stack when
+        healthy, closed and forgotten when ``discard`` (or when abort()'s
+        sweep already unregistered it while borrowed)."""
+        close = False
+        with self._pool_cond:
+            if discard or conn not in self._conns:
+                self._conns.discard(conn)
+                self._live -= 1
+                close = True
+            else:
+                self._free.append(conn)
+            self._pool_cond.notify()
+        if close:
             try:
                 conn.close()
             except Exception:
                 pass
-        self._local.conn = None
 
     # a reused keep-alive connection the server idle-closed fails fast with
     # one of these teardown errors; anything else (timeouts especially) must
@@ -292,30 +331,79 @@ class ClusterApiClient:
     )
 
     def _request(self, method: str, path: str, body: Optional[bytes]) -> Tuple[int, bytes]:
-        """One request on the persistent connection; transparently resends
-        once on a fresh connection when a *reused* keep-alive connection was
+        """One request on a pooled connection; transparently resends once
+        on a fresh connection when a *reused* keep-alive connection was
         idle-closed by the server (payloads are idempotent snapshots)."""
         full_path = self._request_target(path)
         headers = self._request_headers()
-        for _ in range(2):
-            conn, fresh = self._connection()
+        for attempt in range(2):
+            conn = self._acquire(fresh_only=attempt > 0)
+            fresh = getattr(conn, "_kw_fresh", True)
             try:
                 conn.request(method, full_path, body=body, headers=headers)
                 response = conn.getresponse()
                 data = response.read()  # drain so the connection is reusable
-                self._local.fresh = False
+                conn._kw_fresh = False
+                self._release(conn, discard=False)
                 return response.status, data
             except self._STALE_CONN_ERRORS:
-                self._drop_connection()
+                self._release(conn, discard=True)
                 if fresh:
                     raise
                 # reused connection died on teardown — resend on a fresh one
             except Exception:
-                self._drop_connection()
+                self._release(conn, discard=True)
                 raise
         raise ConnectionError("unreachable")  # pragma: no cover
 
     # -- public API ---------------------------------------------------------
+
+    @staticmethod
+    def _retriable(status: int) -> bool:
+        """5xx, plus the two 4xx codes that MEAN "try again": 429 rate
+        limiting and 408 request timeout. The single home of the
+        predicate — the retry loop and its callers' was-it-already-logged
+        checks must agree."""
+        return status >= 500 or status in (408, 429)
+
+    def _post_retrying(self, path: str, body: bytes) -> Tuple[int, bytes]:
+        """POST ``body`` with the configured retry policy; returns the
+        final ``(status, response_bytes)`` — (0, b"") when every attempt
+        died at the connection level (or abort() cut the client). Retries
+        connection errors, timeouts, 5xx, 408 and 429; other statuses
+        return immediately (client error — retrying can't help). Never
+        raises."""
+        endpoint = f"{self.base_url}{path}"
+        attempts = max(1, self.retry.max_attempts)
+        delay = self.retry.delay_seconds
+        for attempt in range(1, attempts + 1):
+            if self._abort.is_set():
+                return 0, b""
+            try:
+                logger.debug("POST %s (attempt %d/%d)", endpoint, attempt, attempts)
+                status, text = self._request("POST", path, body)
+                if status == 200:
+                    return status, text
+                if self._retriable(status):
+                    logger.error(
+                        "Failed to update pod data. Status: %s, Response: %s",
+                        status, text.decode("utf-8", errors="replace")[:500],
+                    )
+                else:
+                    return status, text
+            except socket.timeout:
+                logger.error("Timeout: request to %s exceeded %.1fs", endpoint, self.timeout)
+            except (ConnectionError, OSError, http.client.HTTPException):
+                logger.error("Connection error: unable to connect to clusterapi at %s", endpoint)
+            except Exception as exc:  # parity: never raise out of the send path
+                logger.error("Unexpected error calling clusterapi: %s", exc)
+                return 0, b""
+            if attempt < attempts and delay > 0:
+                # abort-aware backoff: wakes immediately on shutdown
+                if self._abort.wait(min(delay, self.retry.max_delay_seconds)):
+                    return 0, b""
+                delay *= self.retry.backoff_multiplier
+        return 0, b""
 
     def update_pod_status(self, pod_data: Dict[str, Any]) -> bool:
         """POST one payload; True iff the server returned 200.
@@ -323,7 +411,6 @@ class ClusterApiClient:
         Retries connection errors, timeouts and 5xx per the retry policy;
         4xx responses are not retried (client error — retrying can't help).
         """
-        endpoint = f"{self.base_url}{self.pod_update_endpoint}"
         try:
             body = json.dumps(pod_data).encode("utf-8")
         except (TypeError, ValueError) as exc:
@@ -331,39 +418,78 @@ class ClusterApiClient:
             # non-serializable payload is a False, not a caller crash
             logger.error("Unserializable pod payload (%s); dropping", exc)
             return False
-        attempts = max(1, self.retry.max_attempts)
-        delay = self.retry.delay_seconds
-        for attempt in range(1, attempts + 1):
-            if self._abort.is_set():
-                return False
-            try:
-                logger.debug("POST %s (attempt %d/%d)", endpoint, attempt, attempts)
-                status, text = self._request("POST", self.pod_update_endpoint, body)
-                if status == 200:
-                    logger.debug("Updated pod data for %s", pod_data.get("name", "unknown"))
-                    return True
-                # 5xx, plus the two 4xx codes that MEAN "try again":
-                # 429 rate limiting and 408 request timeout
-                retriable = status >= 500 or status in (408, 429)
-                logger.error(
-                    "Failed to update pod data. Status: %s, Response: %s",
-                    status, text.decode("utf-8", errors="replace")[:500],
-                )
-                if not retriable:
-                    return False
-            except socket.timeout:
-                logger.error("Timeout: request to %s exceeded %.1fs", endpoint, self.timeout)
-            except (ConnectionError, OSError, http.client.HTTPException):
-                logger.error("Connection error: unable to connect to clusterapi at %s", endpoint)
-            except Exception as exc:  # parity: boolean contract, never raise
-                logger.error("Unexpected error calling clusterapi: %s", exc)
-                return False
-            if attempt < attempts and delay > 0:
-                # abort-aware backoff: wakes immediately on shutdown
-                if self._abort.wait(min(delay, self.retry.max_delay_seconds)):
-                    return False
-                delay *= self.retry.backoff_multiplier
+        status, text = self._post_retrying(self.pod_update_endpoint, body)
+        if status == 200:
+            logger.debug("Updated pod data for %s", pod_data.get("name", "unknown"))
+            return True
+        if status and not self._retriable(status):
+            # retriable statuses were already logged per attempt
+            logger.error(
+                "Failed to update pod data. Status: %s, Response: %s",
+                status, text.decode("utf-8", errors="replace")[:500],
+            )
         return False
+
+    def update_pod_statuses(self, payloads: List[Dict[str, Any]]) -> Optional[List[bool]]:
+        """POST many payloads in ONE request to the batch endpoint; one
+        bool per payload, or None when the receiver has no batch endpoint
+        (404/405/501 — latched, so the dispatcher permanently falls back
+        to per-item sends after one probe). Same retry policy as the
+        per-item path. Never raises.
+
+        Wire shape: ``{"updates": [payload, ...]}`` out;
+        ``{"results": [bool, ...]}`` back (absent/odd-shaped results read
+        as all-accepted — the server answered 200 for the batch)."""
+        if self._batch_unsupported:
+            return None
+        try:
+            body = json.dumps({"updates": payloads}).encode("utf-8")
+        except (TypeError, ValueError):
+            # let the per-item fallback isolate WHICH payload is bad
+            return None
+        status, text = self._post_retrying(self.pod_update_batch_endpoint, body)
+        if 400 <= status < 500 and status not in (408, 429):
+            # the batch ROUTE is refused — 404/405/501 from the receiver
+            # itself, or 400/403/... from a gateway/auth proxy that only
+            # knows the per-item path. Our wire shape is fixed, so none of
+            # these are per-payload verdicts: latch and fall back per-item
+            # (the ground-truth path), which delivers — or attributes
+            # failure per payload — instead of dropping whole batches
+            # exactly when backlog is high
+            self._batch_unsupported = True
+            logger.info(
+                "Batch endpoint %s refused (HTTP %d); falling back to per-item updates",
+                self.pod_update_batch_endpoint, status,
+            )
+            return None
+        if status != 200:
+            # connection-level failure or retry-exhausted 5xx: the server
+            # itself is sick — per-item sends would fare no better. Status
+            # 0 = every attempt died at the connection level (or abort)
+            logger.error(
+                "Batch update of %d payloads failed. Status: %s, Response: %s",
+                len(payloads),
+                status or "connection-level failure",
+                text.decode("utf-8", errors="replace")[:500],
+            )
+            return [False] * len(payloads)
+        try:
+            results = json.loads(text or b"{}").get("results")
+        except (ValueError, AttributeError):
+            results = None
+        if not isinstance(results, list):
+            return [True] * len(payloads)  # 200 with no verdicts = batch accepted
+        if len(results) != len(payloads):
+            # partial/garbled verdict list: treat the unacknowledged tail
+            # as FAILED, never as silently sent (the receiver may not have
+            # seen those payloads at all)
+            logger.error(
+                "Batch response carried %d results for %d payloads; counting the tail failed",
+                len(results), len(payloads),
+            )
+            results = results[:len(payloads)]
+            results += [False] * (len(payloads) - len(results))
+        return [bool(r) for r in results]
 
     def health_check(self) -> bool:
         """GET the health endpoint; True iff 200 (parity: 5 s timeout).
@@ -374,20 +500,22 @@ class ClusterApiClient:
         if self._abort.is_set():
             return False
         try:
-            # parity with the reference's fixed 5 s health timeout
-            conn = self._new_connection(5)
-            with self._conns_lock:
+            # parity with the reference's fixed 5 s health timeout; its own
+            # connection outside the pool (a health probe must not borrow —
+            # or get stuck behind — the send path's sockets), registered
+            # under the pool condition so abort() can still cut it
+            with self._pool_cond:
                 if self._abort.is_set():
-                    conn.close()
                     return False
-                self._conns[conn] = threading.current_thread()
+                conn = self._new_connection(5)
+                self._conns.add(conn)
             try:
                 conn.request("GET", self._request_target(self.health_endpoint),
                              headers=self._request_headers())
                 return conn.getresponse().status == 200
             finally:
-                with self._conns_lock:
-                    self._conns.pop(conn, None)
+                with self._pool_cond:
+                    self._conns.discard(conn)
                 conn.close()
         except Exception:
             return False

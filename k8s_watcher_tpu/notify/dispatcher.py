@@ -1,32 +1,48 @@
-"""Async notification dispatcher: bounded queue + worker threads.
+"""Async notification dispatcher: keyed worker fan-out over per-lane FIFOs.
 
 The reference notified synchronously inside the watch loop (pod_watcher.py:236
 — disabled, but that was the design), so one slow POST would stall the whole
 stream. SURVEY.md §3.1 calls this the key hazard for the <1 s p50 target.
-Here the pipeline enqueues and returns; worker threads drain the queue and
+Here the pipeline enqueues and returns; worker threads drain their lanes and
 the event→notify latency histogram is recorded when the POST *completes* —
 the honest end-to-end number.
 
-Backpressure policy, in order:
-- **Coalescing** (on by default): while a notification for the same pod
-  uid / slice key is still waiting in the queue, a newer one REPLACES its
-  payload instead of queueing behind it. ``update_pod_status`` is a state
-  update, not an event log — the receiver only ever needs the latest state,
-  and under churn this bounds queue growth per object instead of per event.
-  In-flight sends are never coalesced into (their payload is already on the
-  wire); a newer event for the same key simply queues next.
-- **Drop-oldest** when the bounded queue still fills (pathological fan-out
-  of distinct keys): the oldest entry is dropped (and counted) rather than
-  blocking the watch stream.
+Round-7 egress plane (ISSUE 2): the single shared queue + 2 blocking
+workers capped burst drain at ~520 notifications/s (bench_full r06) while
+ingest ran ~30k events/s. The rebuild:
+
+- **Keyed lanes.** Notifications hash by coalesce key (crc32, stable) onto
+  ``workers`` FIFO lanes, one worker per lane. One pod's updates always ride
+  one lane → one worker → submit-order delivery; DISTINCT pods spread
+  across lanes and POST concurrently. Keyless notifications (probe
+  reports) round-robin — they carry no ordering contract.
+- **Adaptive coalescing.** Latest-wins collapse is a LOSS (the receiver
+  misses intermediate transitions); it exists to bound backlog, not to be
+  the steady state. With ``coalesce_watermark > 0``, same-key updates
+  queue uncollapsed while the lane is shallower than the watermark and
+  only start collapsing once backlog proves the egress side is behind.
+  ``coalesce_watermark=0`` keeps the old always-collapse behavior.
+- **Micro-batching.** When a lane has more than one claimable entry and a
+  ``send_batch`` callable is wired (ClusterApiClient.update_pod_statuses),
+  the worker drains up to ``batch_max`` entries into ONE batched POST.
+  ``send_batch`` returning None means the receiver doesn't support the
+  batch endpoint — the worker falls back to per-item sends for that batch
+  (and the client remembers, so the probe costs one request ever).
+
+Backpressure policy, in order: adaptive coalescing (above), then
+**drop-oldest** when the bounded lane still fills (pathological fan-out of
+distinct keys): the oldest entry in the lane is dropped (and counted)
+rather than blocking the watch stream.
 """
 
 from __future__ import annotations
 
+import collections
 import logging
-import queue
 import threading
 import time
-from typing import Callable, Optional, Tuple, Union
+import zlib
+from typing import Callable, List, Optional, Tuple, Union
 
 from k8s_watcher_tpu.metrics import MetricsRegistry
 from k8s_watcher_tpu.pipeline.pipeline import Notification
@@ -37,10 +53,9 @@ _Key = Tuple[str, str]
 
 
 def coalesce_key(notification: Notification) -> Optional[_Key]:
-    """Latest-wins identity of a notification, or None if it must never be
-    collapsed. Pods coalesce on uid, slices on the slice key, nodes on the
-    node name; probe reports pass through uncoalesced (each carries
-    distinct measurements)."""
+    """Ordering/coalescing identity of a notification, or None if it has
+    neither (each probe report carries distinct measurements). Pods key on
+    uid, slices on the slice key, nodes on the node name."""
     payload = notification.payload
     if notification.kind == "pod":
         uid = payload.get("uid")
@@ -54,6 +69,22 @@ def coalesce_key(notification: Notification) -> Optional[_Key]:
     return None
 
 
+class _Lane:
+    """One worker's bounded FIFO: entries are either a Notification
+    (keyless) or a _Key marker. Markers map 1:1 onto elements of
+    ``waiting[key]`` (a per-key FIFO of payloads), which is what keeps
+    per-key submit order exact under coalescing, overflow AND the
+    mixed collapse/no-collapse regimes of the adaptive watermark."""
+
+    __slots__ = ("cond", "entries", "waiting", "high_water")
+
+    def __init__(self) -> None:
+        self.cond = threading.Condition()
+        self.entries: collections.deque = collections.deque()
+        self.waiting: dict = {}  # _Key -> deque[Notification]
+        self.high_water = 0
+
+
 class Dispatcher:
     def __init__(
         self,
@@ -62,24 +93,41 @@ class Dispatcher:
         capacity: int = 1024,
         workers: int = 2,
         coalesce: bool = True,
+        coalesce_watermark: int = 0,
         metrics: Optional[MetricsRegistry] = None,
         abort: Optional[Callable[[], None]] = None,
+        send_batch: Optional[Callable[[List[dict]], Optional[List[bool]]]] = None,
+        batch_max: int = 16,
     ):
         """``abort``: called when stop()'s drain window expires with sends
         still in flight — it must cut them fast (ClusterApiClient.abort
         closes live sockets and cancels retry backoff), making
         ``drain_timeout`` a real bound on shutdown even against a dead or
-        hung notify target."""
+        hung notify target.
+
+        ``capacity`` is the TOTAL backlog bound, split evenly across the
+        per-worker lanes. ``coalesce_watermark``: lane depth at which
+        latest-wins collapse starts (0 = collapse whenever a same-key
+        payload is still waiting, the pre-round-7 behavior).
+        ``send_batch``/``batch_max``: see the module docstring."""
         self._send = send
-        self._abort = abort
-        self._queue: "queue.Queue[Union[Notification, _Key]]" = queue.Queue(maxsize=max(1, capacity))
+        self._send_batch = send_batch
+        self._batch_max = max(1, batch_max)
+        self._abort_cb = abort
         self._workers = max(1, workers)
-        self._threads: list = []
+        self._lanes = [_Lane() for _ in range(self._workers)]
+        self._lane_capacity = max(1, capacity // self._workers)
         self._coalesce = coalesce
-        # key -> freshest Notification not yet claimed by a worker
-        self._pending: dict = {}
-        self._pending_lock = threading.Lock()
+        # clamp the watermark below the per-lane capacity: overflow caps
+        # lane depth at _lane_capacity, so a watermark at or above it
+        # would be unreachable — adaptive coalescing would silently never
+        # engage and backpressure would degrade to pure drop-oldest loss
+        # (e.g. auto-scaled workers shrinking each lane's share)
+        self._coalesce_watermark = min(
+            max(0, coalesce_watermark), max(1, self._lane_capacity // 2)
+        )
         self.metrics = metrics or MetricsRegistry()
+        self._threads: list = []
         self._started = False
         # serializes the check-then-spawn in start(): two producers'
         # first submit() calls racing the auto-start must not each spawn
@@ -88,114 +136,207 @@ class Dispatcher:
         self._stopping = threading.Event()
         # set when the drain window expired: workers stop claiming work
         self._abandon = threading.Event()
+        # accepted-but-undelivered entries; drain() blocks on this
+        # condition instead of polling (submit +1; send completion,
+        # overflow drop and the shutdown sweep -1)
+        self._drain_cond = threading.Condition()
+        self._outstanding = 0
+        self._rr = 0  # round-robin cursor for keyless notifications
+
+    # -- introspection (bench / metrics) -----------------------------------
+
+    @property
+    def lane_high_water(self) -> int:
+        return max(lane.high_water for lane in self._lanes)
+
+    def lane_depths(self) -> List[int]:
+        return [len(lane.entries) for lane in self._lanes]
 
     def start(self) -> None:
         with self._start_lock:
             if self._started:
                 return
             self._started = True
-            for i in range(self._workers):
-                t = threading.Thread(target=self._worker, name=f"notify-worker-{i}", daemon=True)
+            for i, lane in enumerate(self._lanes):
+                t = threading.Thread(
+                    target=self._worker, args=(i, lane),
+                    name=f"notify-worker-{i}", daemon=True,
+                )
                 t.start()
                 self._threads.append(t)
 
+    # -- submit side --------------------------------------------------------
+
+    def _lane_for(self, key: Optional[_Key]) -> _Lane:
+        if key is None:
+            # keyless: no ordering contract, spread the load (plain int
+            # increment; a rare race only skews balance, never correctness)
+            self._rr = rr = (self._rr + 1) % self._workers
+            return self._lanes[rr]
+        return self._lanes[zlib.crc32(f"{key[0]}\x00{key[1]}".encode()) % self._workers]
+
     def submit(self, notification: Notification) -> bool:
-        """Enqueue without blocking; coalesce per-key, drop-oldest on
-        overflow. Returns True when the notification (or, under coalescing,
-        a queue slot now carrying ITS payload as the key's latest state)
-        was accepted. Lossy latest-wins semantics: acceptance is not a
-        delivery guarantee — a concurrent overflow drop may still evict the
-        key's slot, discarding the newest payload for that key (counted as
-        ``dispatch_dropped_overflow_coalesced``). Returns False only for
-        shutdown in progress — overflow never rejects the NEW entry (the
-        oldest queued one is evicted instead, observable as
-        ``dispatch_dropped_overflow``), so callers must watch the drop
-        counters, not the return value, for backpressure."""
+        """Enqueue without blocking; coalesce per-key above the watermark,
+        drop-oldest on overflow. Returns True when the notification (or,
+        under coalescing, a queue slot now carrying ITS payload as the
+        key's latest state) was accepted. Lossy semantics under pressure:
+        acceptance is not a delivery guarantee — a later overflow drop may
+        still evict this key's oldest waiting payload (counted as
+        ``dispatch_dropped_overflow``). Returns False only for shutdown in
+        progress — overflow never rejects the NEW entry (the oldest queued
+        one is evicted instead), so callers must watch the drop counters,
+        not the return value, for backpressure."""
         if self._stopping.is_set():
             self.metrics.counter("dispatch_dropped_stopping").inc()
             return False
         if not self._started:
             self.start()
 
-        entry: Union[Notification, _Key] = notification
-        if self._coalesce:
-            key = coalesce_key(notification)
-            if key is not None:
-                with self._pending_lock:
-                    if key in self._pending:
-                        # a queued (unclaimed) entry exists for this object:
-                        # newer state supersedes it in place, no new slot
-                        self._pending[key] = notification
-                        self.metrics.counter("dispatch_coalesced").inc()
-                        return True
-                    self._pending[key] = notification
-                entry = key
+        # the key decides the LANE whether or not collapsing is enabled:
+        # per-key submit-order delivery is the structural contract,
+        # coalescing is only the backpressure policy on top of it
+        key = coalesce_key(notification)
+        lane = self._lane_for(key)
+        counter = self.metrics.counter
+        dropped = dropped_coalesced = 0
+        with lane.cond:
+            if key is not None and self._coalesce:
+                q = lane.waiting.get(key)
+                if q and len(lane.entries) >= self._coalesce_watermark:
+                    # backlog past the watermark: latest-wins on the key's
+                    # NEWEST waiting payload — no new slot, order intact
+                    q[-1] = notification
+                    counter("dispatch_coalesced").inc()
+                    return True
+                if q is None:
+                    q = lane.waiting[key] = collections.deque()
+                q.append(notification)
+                entry: Union[Notification, _Key] = key
+            else:
+                entry = notification
+            while len(lane.entries) >= self._lane_capacity:
+                oldest = lane.entries.popleft()
+                # (cannot be our own entry: it isn't enqueued yet)
+                if not isinstance(oldest, Notification):
+                    oq = lane.waiting.get(oldest)
+                    if oq:
+                        oq.popleft()  # markers map 1:1 onto waiting payloads
+                        if not oq:
+                            del lane.waiting[oldest]
+                        dropped_coalesced += 1
+                dropped += 1
+            # count the entry outstanding BEFORE it becomes claimable (we
+            # still hold lane.cond): counting after the unlock would let a
+            # fast worker's completion transiently zero the balance and
+            # wake drain() with another send still in flight
+            with self._drain_cond:
+                self._outstanding += 1
+            lane.entries.append(entry)
+            depth = len(lane.entries)
+            if depth > lane.high_water:
+                lane.high_water = depth
+                self.metrics.gauge("dispatch_lane_high_water").set_max(depth)
+            lane.cond.notify()
+        if dropped:
+            counter("dispatch_dropped_overflow").inc(dropped)
+            if dropped_coalesced:
+                counter("dispatch_dropped_overflow_coalesced").inc(dropped_coalesced)
+            self._finish(dropped)
+        counter("dispatch_enqueued").inc()
+        return True
 
-        while True:
-            try:
-                self._queue.put_nowait(entry)
-                self.metrics.counter("dispatch_enqueued").inc()
-                return True
-            except queue.Full:
-                try:
-                    oldest = self._queue.get_nowait()
-                    self._queue.task_done()
-                    # (cannot be our own entry: at most one slot per key
-                    # exists, and ours hasn't been enqueued yet)
-                    if not isinstance(oldest, Notification):
-                        # evicting a coalesced slot drops the NEWEST payload
-                        # for that key (latest-wins), not the oldest — count
-                        # it distinctly so the loss is attributable
-                        with self._pending_lock:
-                            evicted = self._pending.pop(oldest, None)
-                        if evicted is not None:
-                            self.metrics.counter("dispatch_dropped_overflow_coalesced").inc()
-                    self.metrics.counter("dispatch_dropped_overflow").inc()
-                except queue.Empty:
-                    pass
+    # -- worker side ---------------------------------------------------------
 
-    def _claim(self, entry: Union[Notification, _Key]) -> Optional[Notification]:
+    @staticmethod
+    def _claim(lane: _Lane, entry: Union[Notification, _Key]) -> Notification:
+        """Resolve an entry to its payload-bearing Notification. Call under
+        ``lane.cond``. Never misses: markers and waiting payloads are
+        maintained 1:1 by submit and the overflow drop."""
         if isinstance(entry, Notification):
             return entry
-        with self._pending_lock:
-            return self._pending.pop(entry, None)
+        q = lane.waiting[entry]
+        notification = q.popleft()
+        if not q:
+            del lane.waiting[entry]
+        return notification
 
-    def _worker(self) -> None:
+    def _worker(self, index: int, lane: _Lane) -> None:
         hist = self.metrics.histogram("event_to_notify_latency")
         while True:
             if self._abandon.is_set():
                 return  # drain window expired: leave the backlog unclaimed
+            with lane.cond:
+                if not lane.entries:
+                    if self._stopping.is_set():
+                        return
+                    lane.cond.wait(0.1)
+                    continue
+                take = 1
+                if self._send_batch is not None and self._batch_max > 1:
+                    # micro-batching is backlog-driven: a quiet lane sends
+                    # single POSTs (no added latency); a backlog drains in
+                    # batched POSTs
+                    take = min(len(lane.entries), self._batch_max)
+                claimed = [self._claim(lane, lane.entries.popleft()) for _ in range(take)]
+            self._deliver(claimed, hist)
+
+    def _deliver(self, notifications: List[Notification], hist) -> None:
+        payloads = [n.payload for n in notifications]
+        counter = self.metrics.counter
+        results: Optional[List[bool]] = None
+        if len(payloads) > 1 and self._send_batch is not None:
             try:
-                item = self._queue.get(timeout=0.1)
-            except queue.Empty:
-                if self._stopping.is_set():
-                    return
-                continue
-            try:
-                notification = self._claim(item)
-                if notification is None:
-                    continue  # its slot was dropped by overflow handling
+                results = self._send_batch(payloads)
+                if results is not None:
+                    # count only batch POSTs that actually completed — a
+                    # raising batch path must not report a healthy batch rate
+                    counter("dispatch_batches").inc()
+                    counter("dispatch_batch_items").inc(len(payloads))
+            except Exception as exc:  # send contract is list-or-None, but be safe
+                logger.error("Batch notifier raised: %s", exc)
+                results = [False] * len(payloads)
+            if results is not None and len(results) < len(payloads):
+                # a short result list (misbehaving receiver) must not
+                # leave the tail uncounted — pad as failed
+                results = list(results) + [False] * (len(payloads) - len(results))
+        if results is None:  # no batch path, or receiver doesn't support it
+            results = []
+            for payload in payloads:
                 ok = False
                 try:
-                    ok = self._send(notification.payload)
+                    ok = self._send(payload)
                 except Exception as exc:  # send contract is boolean, but be safe
                     logger.error("Notifier raised: %s", exc)
-                if ok:
-                    self.metrics.counter("dispatch_sent").inc()
-                    hist.record(time.monotonic() - notification.received_monotonic)
-                else:
-                    self.metrics.counter("dispatch_failed").inc()
-            finally:
-                self._queue.task_done()
+                results.append(ok)
+        now = time.monotonic()
+        sent = failed = 0
+        for notification, ok in zip(notifications, results):
+            if ok:
+                sent += 1
+                hist.record(now - notification.received_monotonic)
+            else:
+                failed += 1
+        if sent:
+            counter("dispatch_sent").inc(sent)
+        if failed:
+            counter("dispatch_failed").inc(failed)
+        self._finish(len(notifications))
+
+    def _finish(self, n: int) -> None:
+        with self._drain_cond:
+            self._outstanding -= n
+            if self._outstanding <= 0:
+                self._drain_cond.notify_all()
+
+    # -- drain / shutdown ----------------------------------------------------
 
     def drain(self, timeout: float = 10.0) -> bool:
-        """Wait (bounded) for the queue to empty; True if fully drained."""
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            if self._queue.unfinished_tasks == 0:
-                return True
-            time.sleep(0.01)
-        return self._queue.unfinished_tasks == 0
+        """Wait (bounded) until every accepted notification completed (sent,
+        failed, or dropped); True if fully drained. Condition-based — the
+        waiter wakes the moment the last send completes, not on the next
+        tick of a poll loop."""
+        with self._drain_cond:
+            return self._drain_cond.wait_for(lambda: self._outstanding <= 0, timeout)
 
     def stop(self, drain_timeout: float = 5.0) -> None:
         """Shut down within ~``drain_timeout``: signal stop first (new
@@ -208,41 +349,47 @@ class Dispatcher:
         drain_timeout = max(0.1, drain_timeout)
         deadline = time.monotonic() + drain_timeout
         self._stopping.set()  # reject new submits; workers exit once dry
+        for lane in self._lanes:
+            with lane.cond:
+                lane.cond.notify_all()
         # 90% of the budget drains; the rest joins workers post-abort
         drained = self.drain(drain_timeout * 0.9)
         if not drained:
-            backlog = self._queue.unfinished_tasks
+            with self._drain_cond:
+                backlog = max(0, self._outstanding)
             logger.warning(
                 "Notify drain window expired with %d undelivered; aborting in-flight sends",
                 backlog,
             )
             self.metrics.counter("dispatch_abandoned_shutdown").inc(backlog)
             self._abandon.set()
-            if self._abort is not None:
+            for lane in self._lanes:
+                with lane.cond:
+                    lane.cond.notify_all()
+            if self._abort_cb is not None:
                 try:
-                    self._abort()
+                    self._abort_cb()
                 except Exception:
                     logger.exception("Dispatcher abort callback failed")
         for t in self._threads:
             t.join(timeout=max(0.1, deadline - time.monotonic()))
         # a submit() that passed the _stopping check just before set()
-        # can land its entry AFTER drain saw an empty queue and the
+        # can land its entry AFTER drain saw an empty plane and the
         # workers exited — accepted (True, dispatch_enqueued counted) but
         # never claimable. Sweep and account the strays so no accepted
         # notification is lost UNACCOUNTED. (WatcherApp.shutdown stops
         # every producer before the dispatcher, so nothing races this
         # sweep itself.)
         strays = 0
-        while True:
-            try:
-                item = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            self._queue.task_done()
-            if self._claim(item) is not None or isinstance(item, Notification):
-                strays += 1
-        # the drain-expiry branch above already counted its backlog via
-        # unfinished_tasks — only a CLEAN drain can have unaccounted strays
-        if strays and drained:
-            logger.warning("%d notification(s) accepted mid-shutdown were never sent", strays)
-            self.metrics.counter("dispatch_abandoned_shutdown").inc(strays)
+        for lane in self._lanes:
+            with lane.cond:
+                while lane.entries:
+                    self._claim(lane, lane.entries.popleft())
+                    strays += 1
+        if strays:
+            self._finish(strays)
+            # the drain-expiry branch above already counted its backlog —
+            # only a CLEAN drain can have unaccounted strays
+            if drained:
+                logger.warning("%d notification(s) accepted mid-shutdown were never sent", strays)
+                self.metrics.counter("dispatch_abandoned_shutdown").inc(strays)

@@ -39,7 +39,21 @@ def test_bench_smoke_headline_within_budget():
     # sharded ingest ceiling didn't collapse back to the r05 single-loop
     # era (~14k): half of that margin guards against host noise
     assert headline["max_sustained_events_per_sec"] > 7000, headline
+    # egress plane: the ramp must produce a number + a verdict field, and
+    # sustained notify throughput must stay >= 5x the r06 seed (520/s) —
+    # the rebuilt plane measures 15-20k/s, so 2600 only trips on a real
+    # regression, not host noise
+    assert headline["max_sustained_notify_per_sec"] > 2600, headline
+    assert "egress_saturating_stage" in headline, headline
+    # burst drain is recorded and didn't collapse back to the r06 plane
+    # (~520/s; the rebuilt plane drains 3x+ that with ingest in the
+    # denominator — 1000 guards the 10x drain-phase win against noise)
+    assert headline["burst_drain_notify_per_sec"] > 1000, headline
     # relist still covers every pod (count mismatch -> error field)
     assert headline["relist_10k_ms"] is not None, headline
     detail = json.loads((REPO_ROOT / "artifacts" / "bench_smoke.json").read_text())
     assert detail["details"]["relist_10k"]["events"] == detail["details"]["relist_10k"]["n_pods"]
+    egress = detail["details"]["egress_saturation"]
+    assert egress["steps"], egress
+    assert "first_saturating_stage" in egress, egress
+    assert detail["details"]["burst"]["drain_notify_per_sec"] is not None

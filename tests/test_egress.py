@@ -1,0 +1,507 @@
+"""Egress-plane tests (round 7): per-key FIFO ordering under concurrent
+workers, overflow/coalesce counter accounting under contention, adaptive
+coalescing watermarks, micro-batching with per-item fallback, the pooled
+client against the mock server's notify surface, and condition-based drain.
+"""
+
+import collections
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from k8s_watcher_tpu.metrics import MetricsRegistry
+from k8s_watcher_tpu.notify.client import ClusterApiClient
+from k8s_watcher_tpu.notify.dispatcher import Dispatcher
+from k8s_watcher_tpu.pipeline.pipeline import Notification
+
+
+def _pod(uid, seq=0, **extra):
+    return Notification({"uid": uid, "name": uid, "seq": seq, **extra},
+                        time.monotonic(), kind="pod")
+
+
+class _RecordingSink:
+    """Thread-safe in-process send callable recording delivery order."""
+
+    def __init__(self, delay=0.0, batch_results=None):
+        self.lock = threading.Lock()
+        self.delivered = []
+        self.batch_sizes = []
+        self.delay = delay
+        self.batch_results = batch_results  # None => batch unsupported
+
+    def send(self, payload):
+        if self.delay:
+            time.sleep(self.delay)
+        with self.lock:
+            self.delivered.append(payload)
+        return True
+
+    def send_batch(self, payloads):
+        if self.batch_results is None:
+            return None  # receiver has no batch endpoint
+        if self.delay:
+            time.sleep(self.delay)
+        with self.lock:
+            self.delivered.extend(payloads)
+            self.batch_sizes.append(len(payloads))
+        return [True] * len(payloads)
+
+
+class TestPerKeyOrdering:
+    """ISSUE 2 acceptance: interleaved updates to the same pod arrive in
+    submit order under >= 4 concurrent egress workers; distinct pods may
+    interleave freely."""
+
+    def _run(self, *, workers, coalesce_watermark, n_pods=12, n_seq=150, producers=3,
+             coalesce=True):
+        sink = _RecordingSink()
+        d = Dispatcher(
+            sink.send, workers=workers, capacity=1 << 16, coalesce=coalesce,
+            coalesce_watermark=coalesce_watermark, metrics=MetricsRegistry(),
+        )
+        d.start()
+        # each producer owns a disjoint pod set (a pod's updates must come
+        # from ONE submitter for "submit order" to be well-defined), but
+        # all producers hammer the dispatcher concurrently
+        def produce(pods):
+            for seq in range(n_seq):
+                for uid in pods:
+                    d.submit(_pod(uid, seq))
+
+        pod_sets = [
+            [f"pod-{p}-{j}" for j in range(n_pods // producers)]
+            for p in range(producers)
+        ]
+        threads = [threading.Thread(target=produce, args=(s,)) for s in pod_sets]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert d.drain(30.0)
+        d.stop()
+        per_key = collections.defaultdict(list)
+        for payload in sink.delivered:
+            per_key[payload["uid"]].append(payload["seq"])
+        return per_key
+
+    def test_fifo_per_pod_across_four_workers_no_collapse(self):
+        per_key = self._run(workers=4, coalesce_watermark=1 << 30)
+        assert len(per_key) == 12
+        for uid, seqs in per_key.items():
+            assert seqs == sorted(seqs), f"{uid} delivered out of order: {seqs[:20]}"
+            assert len(seqs) == 150  # watermark never reached -> no collapse
+
+    def test_fifo_per_pod_with_always_coalesce(self):
+        # latest-wins may DROP intermediate updates but must never reorder
+        per_key = self._run(workers=6, coalesce_watermark=0)
+        for uid, seqs in per_key.items():
+            assert seqs == sorted(seqs), f"{uid} delivered out of order: {seqs[:20]}"
+            assert seqs[-1] == 149  # the newest state always lands
+
+    def test_fifo_per_pod_with_coalescing_disabled(self):
+        # the key decides the lane even with collapsing off: full history,
+        # exact submit order, across 4 workers
+        per_key = self._run(workers=4, coalesce_watermark=0, coalesce=False)
+        assert len(per_key) == 12
+        for uid, seqs in per_key.items():
+            assert seqs == list(range(150)), f"{uid}: {seqs[:20]}"
+
+    def test_same_key_always_same_lane(self):
+        d = Dispatcher(lambda p: True, workers=8, metrics=MetricsRegistry())
+        lanes = {d._lane_for(("pod", f"u{i}")) for _ in range(50) for i in (7,)}
+        assert len(lanes) == 1  # deterministic key -> lane mapping
+
+
+class TestCounterAccounting:
+    """Every accepted submit must be accounted exactly once:
+    enqueued == sent + failed + dropped_overflow (+ abandoned at
+    shutdown); coalesced counts replacements that consumed no slot."""
+
+    def test_conservation_under_contention(self):
+        sink = _RecordingSink(delay=0.0005)
+        d = Dispatcher(
+            sink.send, workers=4, capacity=64, coalesce_watermark=0,
+            metrics=MetricsRegistry(),
+        )
+        d.start()
+        accepted = [0] * 6
+        n_per_producer = 400
+
+        def produce(p):
+            for i in range(n_per_producer):
+                # 32 hot keys shared across producers + unique cold keys:
+                # exercises coalesce-replace, overflow drop and plain sends
+                uid = f"hot-{i % 32}" if i % 3 else f"cold-{p}-{i}"
+                if d.submit(_pod(uid, i)):
+                    accepted[p] += 1
+
+        threads = [threading.Thread(target=produce, args=(p,)) for p in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert d.drain(60.0)
+        d.stop()
+        c = d.metrics.counter
+        enqueued = c("dispatch_enqueued").value
+        coalesced = c("dispatch_coalesced").value
+        sent = c("dispatch_sent").value
+        failed = c("dispatch_failed").value
+        dropped = c("dispatch_dropped_overflow").value
+        abandoned = c("dispatch_abandoned_shutdown").value
+        assert sum(accepted) == enqueued + coalesced
+        assert enqueued == sent + failed + dropped + abandoned
+        assert sent == len(sink.delivered)
+        assert dropped > 0, "contention test never hit the overflow path"
+        assert coalesced > 0, "contention test never hit the coalesce path"
+
+    def test_overflow_coalesced_counter_tracks_keyed_drops(self):
+        gate = threading.Event()
+        d = Dispatcher(lambda p: gate.wait(5) or True, workers=1, capacity=2,
+                       metrics=MetricsRegistry())
+        d.start()
+        d.submit(_pod("u0"))  # claimed by the worker
+        time.sleep(0.1)
+        for i in range(1, 6):
+            d.submit(_pod(f"u{i}"))
+        gate.set()
+        assert d.drain(5.0)
+        d.stop()
+        c = d.metrics.counter
+        assert c("dispatch_dropped_overflow").value == 3
+        # every dropped entry was a keyed slot
+        assert c("dispatch_dropped_overflow_coalesced").value == 3
+        assert all(lane.waiting == {} for lane in d._lanes)
+
+
+class TestAdaptiveCoalescing:
+    def test_below_watermark_preserves_every_update(self):
+        gate = threading.Event()
+        sink = _RecordingSink()
+
+        def gated(payload):
+            gate.wait(5)
+            return sink.send(payload)
+
+        d = Dispatcher(gated, workers=1, coalesce_watermark=100,
+                       metrics=MetricsRegistry())
+        d.start()
+        d.submit(_pod("u1", 0))
+        time.sleep(0.1)  # worker claims seq 0, then blocks on the gate
+        for seq in (1, 2, 3):
+            d.submit(_pod("u1", seq))
+        gate.set()
+        assert d.drain(5.0)
+        d.stop()
+        assert [p["seq"] for p in sink.delivered] == [0, 1, 2, 3]
+        assert d.metrics.counter("dispatch_coalesced").value == 0
+
+    def test_above_watermark_collapses_latest_wins(self):
+        gate = threading.Event()
+        sink = _RecordingSink()
+
+        def gated(payload):
+            gate.wait(5)
+            return sink.send(payload)
+
+        # watermark 2: the lane must be >= 2 deep before collapse starts
+        d = Dispatcher(gated, workers=1, coalesce_watermark=2,
+                       metrics=MetricsRegistry())
+        d.start()
+        d.submit(_pod("u1", 0))
+        time.sleep(0.1)
+        for seq in (1, 2, 3, 4, 5):
+            d.submit(_pod("u1", seq))
+        gate.set()
+        assert d.drain(5.0)
+        d.stop()
+        seqs = [p["seq"] for p in sink.delivered]
+        assert seqs[0] == 0 and seqs[-1] == 5
+        assert seqs == sorted(seqs)
+        assert d.metrics.counter("dispatch_coalesced").value > 0
+        assert len(seqs) < 6  # some intermediate states collapsed
+
+
+class TestMicroBatching:
+    def test_backlog_drains_in_batches(self):
+        gate = threading.Event()
+        sink = _RecordingSink(batch_results=[])
+
+        def gated_batch(payloads):
+            gate.wait(5)
+            return sink.send_batch(payloads)
+
+        d = Dispatcher(sink.send, send_batch=gated_batch, batch_max=8,
+                       workers=1, coalesce_watermark=1 << 30,
+                       metrics=MetricsRegistry())
+        d.start()
+        d.submit(_pod("u0", 0))
+        time.sleep(0.1)  # worker claims the first entry solo
+        for i in range(1, 17):
+            d.submit(_pod(f"u{i}", i))
+        gate.set()
+        assert d.drain(10.0)
+        d.stop()
+        assert len(sink.delivered) == 17
+        assert sink.batch_sizes and max(sink.batch_sizes) <= 8
+        assert d.metrics.counter("dispatch_batches").value == len(sink.batch_sizes)
+        assert d.metrics.counter("dispatch_batch_items").value == sum(sink.batch_sizes)
+
+    def test_batch_unsupported_falls_back_per_item(self):
+        gate = threading.Event()
+        sink = _RecordingSink(batch_results=None)  # send_batch -> None
+
+        def gated_send(payload):
+            gate.wait(5)
+            return sink.send(payload)
+
+        d = Dispatcher(gated_send, send_batch=sink.send_batch, batch_max=8,
+                       workers=2, coalesce_watermark=1 << 30,
+                       metrics=MetricsRegistry())
+        d.start()
+        for i in range(12):
+            d.submit(_pod(f"u{i}", i))
+        gate.set()
+        assert d.drain(10.0)
+        d.stop()
+        assert len(sink.delivered) == 12  # every payload still delivered
+        assert d.metrics.counter("dispatch_batches").value == 0
+        assert d.metrics.counter("dispatch_sent").value == 12
+
+    def test_quiet_lane_sends_single_posts(self):
+        sink = _RecordingSink(batch_results=[])
+        d = Dispatcher(sink.send, send_batch=sink.send_batch, batch_max=8,
+                       workers=1, metrics=MetricsRegistry())
+        d.start()
+        for i in range(5):
+            d.submit(_pod(f"u{i}"))
+            assert d.drain(5.0)  # one at a time: no backlog ever forms
+        d.stop()
+        assert sink.batch_sizes == []  # no batch POST for single items
+        assert len(sink.delivered) == 5
+
+
+class TestConditionDrain:
+    def test_drain_wakes_on_completion_not_poll_tick(self):
+        release = threading.Event()
+        d = Dispatcher(lambda p: release.wait(10) or True, workers=1,
+                       metrics=MetricsRegistry())
+        d.start()
+        d.submit(_pod("u1"))
+        result = {}
+
+        def drainer():
+            t0 = time.monotonic()
+            result["ok"] = d.drain(10.0)
+            result["dt"] = time.monotonic() - t0
+
+        t = threading.Thread(target=drainer)
+        t.start()
+        time.sleep(0.3)
+        release.set()
+        t.join(10)
+        d.stop()
+        assert result["ok"] is True
+        # woken by the condition, not a timeout expiry
+        assert result["dt"] < 2.0
+
+    def test_drain_timeout_returns_false(self):
+        release = threading.Event()
+        d = Dispatcher(lambda p: release.wait(10) or True, workers=1,
+                       metrics=MetricsRegistry())
+        d.start()
+        d.submit(_pod("u1"))
+        time.sleep(0.05)
+        assert d.drain(0.2) is False
+        release.set()
+        assert d.drain(5.0) is True
+        d.stop()
+
+    def test_drain_empty_returns_immediately(self):
+        d = Dispatcher(lambda p: True, metrics=MetricsRegistry())
+        d.start()
+        t0 = time.monotonic()
+        assert d.drain(5.0) is True
+        assert time.monotonic() - t0 < 0.5
+        d.stop()
+
+
+class TestMockServerNotifySurface:
+    """The in-repo mock apiserver doubles as a clusterapi notify target:
+    the real pooled client drives its /health, per-item and batch routes."""
+
+    @pytest.fixture
+    def mock_api(self):
+        from k8s_watcher_tpu.k8s.mock_server import MockApiServer
+
+        with MockApiServer() as api:
+            yield api
+
+    def test_health_and_single_update(self, mock_api):
+        client = ClusterApiClient(mock_api.url)
+        assert client.health_check() is True
+        assert client.update_pod_status({"name": "w0", "uid": "u0"}) is True
+        assert mock_api.cluster.status_updates[0]["uid"] == "u0"
+
+    def test_batch_update_round_trip(self, mock_api):
+        client = ClusterApiClient(mock_api.url)
+        results = client.update_pod_statuses([{"uid": "a"}, {"uid": "b"}])
+        assert results == [True, True]
+        assert [u["uid"] for u in mock_api.cluster.status_updates] == ["a", "b"]
+
+    def test_batch_per_item_verdicts(self, mock_api):
+        client = ClusterApiClient(mock_api.url)
+        results = client.update_pod_statuses([{"uid": "a"}, "not-a-dict", {"uid": "c"}])
+        assert results == [True, False, True]
+
+    def test_dispatcher_through_mock_batch_endpoint(self, mock_api):
+        client = ClusterApiClient(mock_api.url, pool_size=4)
+        d = Dispatcher(client.update_pod_status, send_batch=client.update_pod_statuses,
+                       batch_max=16, workers=4, coalesce_watermark=1 << 30,
+                       metrics=MetricsRegistry(), abort=client.abort)
+        d.start()
+        for i in range(200):
+            d.submit(_pod(f"u{i}", i))
+        assert d.drain(30.0)
+        d.stop()
+        assert len(mock_api.cluster.status_updates) == 200
+        assert d.metrics.counter("dispatch_sent").value == 200
+
+
+class TestBatchFallbackAgainstStockServer:
+    """A receiver WITHOUT the batch endpoint (404) must cost one probe
+    request, latch, and deliver everything per-item."""
+
+    @pytest.fixture
+    def stock_server(self):
+        class _Stock(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length) or b"{}")
+                if self.path.endswith("update_batch"):
+                    body = b'{"message":"no such route"}'
+                    self.send_response(404)
+                else:
+                    with self.server.lock:
+                        self.server.received.append(payload)
+                    body = b'{"ok":true}'
+                    self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        server = ThreadingHTTPServer(("127.0.0.1", 0), _Stock)
+        server.received, server.lock = [], threading.Lock()
+        server.daemon_threads = True
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        yield server, f"http://127.0.0.1:{server.server_address[1]}"
+        server.shutdown()
+        server.server_close()
+
+    def test_gateway_403_on_batch_route_latches_fallback(self):
+        """An auth proxy that only knows the per-item route (403 on the
+        batch path) must trigger the same per-item fallback as a 404 —
+        [False]*n would drop whole batches exactly under backlog."""
+
+        class _Proxy(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                if self.path.endswith("update_batch"):
+                    body, status = b'{"message":"forbidden"}', 403
+                else:
+                    body, status = b'{"ok":true}', 200
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        server = ThreadingHTTPServer(("127.0.0.1", 0), _Proxy)
+        server.daemon_threads = True
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            client = ClusterApiClient(f"http://127.0.0.1:{server.server_address[1]}")
+            assert client.update_pod_statuses([{"uid": "a"}, {"uid": "b"}]) is None
+            assert client._batch_unsupported is True
+            assert client.update_pod_status({"uid": "a"}) is True
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_short_batch_results_count_tail_as_failed(self):
+        """200 with fewer verdicts than payloads: the unacknowledged tail
+        must read as FAILED, never silently as sent."""
+
+        class _Short(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                body = b'{"results": [true]}'  # one verdict for three payloads
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        server = ThreadingHTTPServer(("127.0.0.1", 0), _Short)
+        server.daemon_threads = True
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            client = ClusterApiClient(f"http://127.0.0.1:{server.server_address[1]}")
+            results = client.update_pod_statuses([{"uid": "a"}, {"uid": "b"}, {"uid": "c"}])
+            assert results == [True, False, False]
+            assert client._batch_unsupported is False
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_latched_fallback_delivers_everything(self, stock_server):
+        server, url = stock_server
+        client = ClusterApiClient(url, pool_size=2)
+        assert client.update_pod_statuses([{"uid": "x"}]) is None
+        assert client._batch_unsupported is True
+        # latched: no second probe request
+        assert client.update_pod_statuses([{"uid": "y"}]) is None
+
+        d = Dispatcher(client.update_pod_status, send_batch=client.update_pod_statuses,
+                       batch_max=8, workers=2, metrics=MetricsRegistry())
+        d.start()
+        for i in range(30):
+            d.submit(_pod(f"u{i}", i))
+        assert d.drain(30.0)
+        d.stop()
+        assert len(server.received) == 30
+        assert d.metrics.counter("dispatch_sent").value == 30
+        assert d.metrics.counter("dispatch_batches").value == 0
+
+
+class TestLaneMetrics:
+    def test_lane_high_water_gauge_exported(self):
+        gate = threading.Event()
+        m = MetricsRegistry()
+        d = Dispatcher(lambda p: gate.wait(5) or True, workers=2, metrics=m)
+        d.start()
+        for i in range(40):
+            d.submit(_pod(f"u{i}"))
+        gate.set()
+        assert d.drain(10.0)
+        d.stop()
+        assert d.lane_high_water > 0
+        assert m.gauge("dispatch_lane_high_water").value == d.lane_high_water
+        assert len(d.lane_depths()) == 2

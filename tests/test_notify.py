@@ -146,11 +146,12 @@ class TestClusterApiClient:
         client.abort()
         assert client.health_check() is False
 
-    def test_dead_thread_connections_are_pruned(self, api_server):
-        """Each dying sender thread's keep-alive socket must leave the
-        registry at the next registration — not accumulate until abort."""
-        _, url = api_server
-        client = ClusterApiClient(url)
+    def test_pool_reuses_connections_across_threads(self, api_server):
+        """The pool decouples connections from threads: serial sends from
+        many short-lived threads ride ONE warm keep-alive socket instead
+        of minting (and leaking) one per thread."""
+        server, url = api_server
+        client = ClusterApiClient(url, pool_size=4)
 
         def send():
             assert client.update_pod_status({"name": "w"}) is True
@@ -159,11 +160,31 @@ class TestClusterApiClient:
             t = threading.Thread(target=send)
             t.start()
             t.join(5)
-        # one final registration from a live thread prunes all dead ones
         assert client.update_pod_status({"name": "w"}) is True
-        with client._conns_lock:
-            owners = list(client._conns.values())
-        assert len(owners) == 1 and owners[0].is_alive()
+        with client._pool_cond:
+            assert client._live == 1, f"{client._live} sockets for serial sends"
+            assert len(client._free) == 1  # returned to the idle stack
+
+    def test_pool_caps_concurrent_connections(self, api_server):
+        """N concurrent senders against pool_size=2 must share 2 sockets
+        (blocking briefly), never mint one per thread."""
+        server, url = api_server
+        client = ClusterApiClient(url, pool_size=2)
+        barrier = threading.Barrier(6)
+        ok = []
+
+        def send(i):
+            barrier.wait(5)
+            ok.append(client.update_pod_status({"name": f"w{i}"}))
+
+        threads = [threading.Thread(target=send, args=(i,)) for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        assert ok == [True] * 6
+        with client._pool_cond:
+            assert client._live <= 2, f"pool cap breached: {client._live}"
 
 
 class TestDispatcher:
@@ -289,28 +310,25 @@ class TestBoundedShutdown:
         assert done.wait(2.0), "abort did not cancel the retry backoff"
         assert result["ok"] is False
 
-    def test_connection_registered_during_abort_is_cut(self):
-        # TOCTOU window: a worker passes the pre-mint is_set() check, then
-        # abort() sweeps the registry, then the worker registers its new
-        # connection — the re-check under _conns_lock must cut it, or the
-        # send escapes the shutdown bound for a full request timeout
+    def test_acquire_after_abort_refuses(self):
+        # minting happens under the SAME lock as abort()'s sweep, so a
+        # post-abort acquire must refuse instead of minting a socket that
+        # escapes the shutdown cut for a full request timeout
         client = ClusterApiClient("http://127.0.0.1:9", timeout=30.0)
-
-        class RacedEvent:
-            """is_set() False at the pre-mint check, True (abort landed)
-            by the re-check under the registration lock."""
-            def __init__(self):
-                self.checks = 0
-            def is_set(self):
-                self.checks += 1
-                return self.checks > 1
-
-        client._abort = RacedEvent()
+        client.abort()
         with pytest.raises(ConnectionError):
-            client._connection()
-        assert not client._conns, "raced connection left registered"
-        assert getattr(client._local, "conn", None) is None
-        assert client._abort.checks == 2
+            client._acquire()
+        assert not client._conns and not client._free and client._live == 0
+
+    def test_borrowed_connection_swept_by_abort_is_discarded(self, api_server):
+        # a connection abort() swept while borrowed must be closed on
+        # release, never returned to the idle stack for reuse
+        _, url = api_server
+        client = ClusterApiClient(url)
+        conn = client._acquire()
+        client.abort()
+        client._release(conn, discard=False)
+        assert not client._free and client._live == 0
 
     def test_graceful_drain_still_delivers(self, api_server):
         # healthy target: stop() must still deliver the backlog, not abort
@@ -379,6 +397,50 @@ class TestPersistentConnection:
     def test_bad_scheme_rejected(self):
         with pytest.raises(ValueError, match="http"):
             ClusterApiClient("ftp://example.com")
+
+    def test_resend_after_stale_pool_mints_fresh_not_another_stale(self):
+        """A whole idle pool can go stale together (server keep-alive
+        timeout). The transparent resend must mint a FRESH connection,
+        not borrow the next stale sibling — otherwise a send against a
+        healthy server fails with the default max_attempts=1 policy."""
+        import http.client as hc
+        from types import SimpleNamespace
+
+        class FakeConn:
+            def __init__(self, stale):
+                self.stale = stale
+                self.closed = False
+
+            def request(self, *a, **k):
+                if self.stale:
+                    raise hc.RemoteDisconnected("idle-closed")
+
+            def getresponse(self):
+                return SimpleNamespace(status=200, read=lambda: b"{}")
+
+            def close(self):
+                self.closed = True
+
+        client = ClusterApiClient("http://example.invalid", pool_size=3)
+        stale = [FakeConn(stale=True), FakeConn(stale=True)]
+        for conn in stale:
+            conn._kw_fresh = False  # a request once succeeded on it
+        with client._pool_cond:
+            client._free = list(stale)
+            client._conns = set(stale)
+            client._live = len(stale)
+        minted = []
+
+        def mint(timeout):
+            conn = FakeConn(stale=False)
+            minted.append(conn)
+            return conn
+
+        client._new_connection = mint
+        status, _ = client._request("POST", "/api/pods/update", b"{}")
+        assert status == 200
+        assert len(minted) == 1  # resend minted fresh instead of reusing stale
+        assert all(c.closed for c in stale)  # idle siblings were drained
 
 
 def test_verify_tls_config_key():
@@ -474,7 +536,8 @@ class TestCoalescing:
         gate.set()
         assert d.drain(5.0)
         d.stop()
-        assert d._pending == {}  # dropped slots must not leak pending payloads
+        # dropped slots must not leak waiting payloads
+        assert all(lane.waiting == {} for lane in d._lanes)
         assert d.metrics.counter("dispatch_dropped_overflow").value == 3
 
 
@@ -515,10 +578,14 @@ class TestDispatcherShutdownRaces:
             # stopping+empty, THEN land the racing entry
             for t in d._threads:
                 t.join(5)
-            d._queue.put_nowait(Notification({"name": "stray"}, time.monotonic()))
+            lane = d._lanes[0]
+            with lane.cond:
+                lane.entries.append(Notification({"name": "stray"}, time.monotonic()))
+            with d._drain_cond:
+                d._outstanding += 1
             return ok
 
         d.drain = drain_then_inject
         d.stop()
         assert d.metrics.counter("dispatch_abandoned_shutdown").value == 1
-        assert d._queue.empty()
+        assert all(not lane.entries for lane in d._lanes)
