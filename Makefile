@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: test check check-scale integration integration-kind integration-mock bench bench-smoke dryrun dryrun-128 accept
+.PHONY: test check check-scale integration integration-kind integration-mock bench bench-smoke trace-smoke dryrun dryrun-128 accept
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -39,6 +39,15 @@ bench:
 # pre-merge as the slow-marked tests/test_bench_smoke.py.
 bench-smoke:
 	$(PY) bench.py --smoke
+
+# Tracing-plane correctness smoke: boot the mock cluster through the REAL
+# app wiring (mock apiserver doubles as the notify target), churn pods,
+# and assert watch_to_notify_seconds populates, the Prometheus exposition
+# carries real `le` buckets, and a head-sampled trace shows all six
+# stages at /debug/trace. The OVERHEAD side of the tracing budget (<3%
+# at 1/256) is gated by bench-smoke (bench_trace_overhead).
+trace-smoke:
+	$(PY) scripts/trace_smoke.py
 
 dryrun:
 	$(PY) __graft_entry__.py 8

@@ -629,12 +629,16 @@ def _ingest_stack(
     rate: Optional[float] = None,
     shards: int = 2,  # 2 keeps the thread count sane on small CI hosts
     batch_max: int = 256,
+    trace_sample: int = 256,  # tracing plane head-sample rate; 0 = off
 ) -> dict:
     """Drive ``n_events`` of churn through the PRODUCTION ingest shape:
     ``shards`` producer streams -> ShardedWatchSource's bounded MPSC queue
     -> batched drain (``EventPipeline.process_batch``) -> dispatcher ->
     HTTP notify stack; paced at ``rate`` events/s jointly across shards,
-    unpaced when ``rate`` is None.
+    unpaced when ``rate`` is None. The tracing plane rides along at the
+    production default (1/256 head sampling) so every saturation artifact
+    carries the sampled watch->notify latency attribution; ``trace_sample=0``
+    is the overhead gate's untraced control.
 
     Events are pre-generated OUTSIDE the timed window (the synthetic pod
     builder costs ~45 us/event — triple a real stream's frame decode — and
@@ -646,6 +650,7 @@ def _ingest_stack(
     from k8s_watcher_tpu.notify.dispatcher import Dispatcher
     from k8s_watcher_tpu.pipeline.pipeline import EventPipeline
     from k8s_watcher_tpu.slices.tracker import SliceTracker
+    from k8s_watcher_tpu.trace import Tracer
     from k8s_watcher_tpu.watch.fake import shard_streams
     from k8s_watcher_tpu.watch.sharded import ShardedWatchSource
 
@@ -653,14 +658,22 @@ def _ingest_stack(
     server.daemon_threads = True
     threading.Thread(target=server.serve_forever, daemon=True).start()
     metrics = MetricsRegistry()
+    tracer = (
+        Tracer(sample_rate=trace_sample, ring_size=256, metrics=metrics)
+        if trace_sample > 0 else None
+    )
     client = ClusterApiClient(
         f"http://127.0.0.1:{server.server_address[1]}", timeout=5.0
     )
-    dispatcher = Dispatcher(client.update_pod_status, capacity=capacity, workers=4, metrics=metrics)
+    dispatcher = Dispatcher(
+        client.update_pod_status, capacity=capacity, workers=4, metrics=metrics,
+        tracer=tracer,
+    )
     dispatcher.start()
     pipeline = EventPipeline(
         environment="production", sink=dispatcher.submit,
         slice_tracker=SliceTracker("production"), metrics=metrics,
+        tracer=tracer,
     )
     churn = ChurnGenerator(n_slices=16, workers_per_slice=4, chips_per_worker=4, seed=42)
     events = list(churn.events(n_events))
@@ -671,7 +684,9 @@ def _ingest_stack(
         _PacedReplaySource([(indexed[id(ev)], ev) for ev in stream], interval, start_event)
         for stream in shard_streams(events, shards)
     ]
-    source = ShardedWatchSource(producers, batch_max=batch_max, queue_capacity=capacity)
+    source = ShardedWatchSource(
+        producers, batch_max=batch_max, queue_capacity=capacity, tracer=tracer
+    )
     source.start()  # pumps block on start_event until t0 is stamped
     processed = 0
     t0 = time.monotonic()
@@ -691,6 +706,16 @@ def _ingest_stack(
     server.shutdown()
     server.server_close()
     overflow = metrics.dump().get("dispatch_dropped_overflow", {}).get("count", 0)
+    watch_to_notify = None
+    if tracer is not None:
+        summary = metrics.histogram("watch_to_notify_seconds").summary()
+        watch_to_notify = {
+            "count": summary.get("count", 0),
+            "p50_ms": round(summary.get("p50_ms", 0.0), 3),
+            "p90_ms": round(summary.get("p90_ms", 0.0), 3),
+            "p99_ms": round(summary.get("p99_ms", 0.0), 3),
+            "sample_rate": trace_sample,
+        }
     return {
         "ingest_seconds": ingest_seconds,
         "overflow": overflow,
@@ -704,6 +729,8 @@ def _ingest_stack(
         ],
         "shards": shards,
         "batch_max": batch_max,
+        # sampled end-to-end attribution (None when trace_sample=0)
+        "watch_to_notify": watch_to_notify,
     }
 
 
@@ -728,6 +755,9 @@ def _saturation_step(rate: float, seconds_per_step: float) -> dict:
             "queue_capacity": run["queue_capacity"],
             "queue_put_blocked": run["queue_put_blocked"],
             "per_shard_events_per_sec": run["per_shard_events_per_sec"],
+            # sampled watch->notify p50/p90/p99 at THIS offered rate — the
+            # tracing plane's end-to-end number under the full ramp
+            "watch_to_notify": run["watch_to_notify"],
         }
         # same clean-beats-failing rule as _egress_step (_step_beats)
         if best is None or _step_beats(step, best, _step_verdict):
@@ -769,6 +799,7 @@ def _unpaced_blast(n_events: int = 30_000) -> dict:
         "us_per_event": round(1e6 * dt / n_events, 1),
         "queue_high_water": run["queue_high_water"],
         "per_shard_events_per_sec": run["per_shard_events_per_sec"],
+        "watch_to_notify": run["watch_to_notify"],
     }
 
 
@@ -812,6 +843,147 @@ def _saturation_ramp(max_rate: float, seconds_per_step: float) -> dict:
         "unpaced_ingest": _unpaced_blast(),
         "steps": steps,
     }
+
+
+def _hot_path_replay(events, *, trace_sample: int, batch_max: int = 256) -> float:
+    """One deterministic single-threaded replay of the ingest hot path
+    over pre-built ``events``: the REAL pump body (the inlined sampling
+    branch in ``ShardedWatchSource._pump``) run synchronously on this
+    thread into the REAL bounded MPSC queue, then the REAL batched
+    pipeline drain (``EventPipeline.process_batch``) into a null sink.
+    No threads, no sockets — wall time IS the hot path's cost. Returns
+    elapsed seconds for the whole replay."""
+    from k8s_watcher_tpu.metrics import MetricsRegistry
+    from k8s_watcher_tpu.pipeline.pipeline import EventPipeline
+    from k8s_watcher_tpu.slices.tracker import SliceTracker
+    from k8s_watcher_tpu.trace import Tracer
+    from k8s_watcher_tpu.watch.fake import sharded_fake_sources
+    from k8s_watcher_tpu.watch.sharded import ShardedWatchSource
+
+    n = len(events)
+    for ev in events:
+        ev.trace = None  # the pump attaches traces; reset between rounds
+    metrics = MetricsRegistry()
+    tracer = (
+        Tracer(sample_rate=trace_sample, ring_size=256, metrics=metrics)
+        if trace_sample > 0 else None
+    )
+    pipeline = EventPipeline(
+        environment="production", sink=lambda notification: None,
+        slice_tracker=SliceTracker("production"), metrics=metrics,
+        tracer=tracer,
+    )
+    source = ShardedWatchSource(
+        sharded_fake_sources(events, 1), batch_max=batch_max,
+        queue_capacity=n + 1, tracer=tracer,
+    )
+    drained = 0
+    t0 = time.perf_counter()
+    source.run_pump_inline(0)  # capacity > n: no put ever blocks
+    for batch in source.batches():
+        pipeline.process_batch(batch)
+        drained += len(batch)
+        if drained >= n:
+            break
+    elapsed = time.perf_counter() - t0
+    source.stop()
+    return elapsed
+
+
+def bench_trace_overhead(n_events: int = 20_000) -> dict:
+    """The tracing plane's hot-path cost gate: the production ingest hot
+    path replayed with tracing OFF vs tracing at the production 1/256
+    head-sample rate. The budget is <3% — unsampled events pay one
+    branch + a countdown decrement and nothing else, and this is the
+    tripwire that keeps it that way.
+
+    The GATED number comes from ``_hot_path_replay``: a single-threaded,
+    socket-free replay of the real pump + queue + batched pipeline,
+    min-of-interleaved-rounds on ``time.perf_counter``. Two earlier gate
+    designs failed on the sandboxed CI hosts and are deliberately NOT
+    used: (1) full-stack wall eps — co-tenant preemption swings it ±50%
+    between ADJACENT runs (measured 5k..27k eps spread), drowning a 3%
+    effect; (2) full-stack process CPU (``time.process_time``) — the
+    egress worker/HTTP threads burn CPU *inside* the ingest-loop timing
+    window in proportion to how long the window stays open, so wall
+    noise leaks straight back into the CPU number (measured 18% fake
+    "overhead" on a host where the deterministic replay shows +0.2%).
+    The replay converges: min-of-rounds spread is <0.5% by ~4 rounds.
+    Rounds still EXTEND adaptively after the floor until the mins land
+    inside the budget or ``max_rounds`` is spent — extension cannot fake
+    a pass (min is a consistent estimator of each side's quiet floor; a
+    real >3% regression stays >3% however many rounds run).
+
+    The full production stack (threads + sockets) still runs once per
+    side for the artifact: wall eps informationally, and the traced run
+    supplies the sampled end-to-end ``watch_to_notify`` attribution."""
+    from k8s_watcher_tpu.faults.injection import ChurnGenerator
+
+    try:
+        churn = ChurnGenerator(
+            n_slices=16, workers_per_slice=4, chips_per_worker=4, seed=42
+        )
+        replay_events = list(churn.events(min(n_events, 12_000)))
+        n_replay = len(replay_events)
+        # untimed warmup: first-run allocator/bytecode warmup once read
+        # as -52% "overhead" in an unwarmed A/B
+        _hot_path_replay(replay_events, trace_sample=0)
+        _hot_path_replay(replay_events, trace_sample=256)
+        best = {0: float("inf"), 256: float("inf")}
+        min_rounds, max_rounds = 4, 12
+        rounds_run = 0
+        overhead_pct = float("inf")
+        while rounds_run < max_rounds:
+            for sample in (0, 256):
+                best[sample] = min(
+                    best[sample],
+                    _hot_path_replay(replay_events, trace_sample=sample),
+                )
+            rounds_run += 1
+            overhead_pct = 100.0 * (best[256] - best[0]) / best[0]
+            if rounds_run >= min_rounds and overhead_pct < 3.0:
+                break
+        # full-stack runs, once per side: wall eps for the artifact
+        # (informational — co-tenancy noise rides it) + the traced side's
+        # sampled end-to-end attribution
+        untraced_run = _ingest_stack(
+            n_events, capacity=65536, rate=None, trace_sample=0
+        )
+        traced_run = _ingest_stack(
+            n_events, capacity=65536, rate=None, trace_sample=256
+        )
+        # at 1/256 the traced run catches ~(n/256 x send-rate) sampled
+        # sends — at smoke scale a handful at best, so quantiles from
+        # fewer than 16 journeys come from a short trace-everything run
+        # instead (the attribution dict carries its own sample_rate)
+        attribution = traced_run["watch_to_notify"]
+        if not attribution or attribution.get("count", 0) < 16:
+            attribution = _ingest_stack(
+                min(n_events, 4000), capacity=65536, rate=None, trace_sample=1
+            )["watch_to_notify"]
+        return {
+            # full-stack wall throughput, informational
+            "untraced_events_per_sec": round(n_events / untraced_run["ingest_seconds"], 1),
+            "traced_events_per_sec": round(n_events / traced_run["ingest_seconds"], 1),
+            "sample_rate": 256,
+            # the gated numbers: deterministic single-threaded replay,
+            # us/event per side, min-of-rounds
+            "hot_path_us_per_event_untraced": round(1e6 * best[0] / n_replay, 2),
+            "hot_path_us_per_event_traced": round(1e6 * best[256] / n_replay, 2),
+            # negative = traced side measured cheaper (sub-noise-floor);
+            # the gate only cares about the positive direction
+            "overhead_pct": round(overhead_pct, 2),
+            "gate_pct": 3.0,
+            # how many interleaved off/on pairs the host needed before
+            # the mins converged (== max_rounds means the gate
+            # legitimately failed OR the host never went quiet)
+            "rounds": rounds_run,
+            "max_rounds": max_rounds,
+            "within_budget": overhead_pct < 3.0,
+            "watch_to_notify": attribution,
+        }
+    except Exception as exc:
+        return {"error": str(exc)}
 
 
 def bench_relist_scale(n_pods: int = 10_000, page_size: int = 500, shards: int = 4) -> dict:
@@ -1358,6 +1530,10 @@ def main(smoke: bool = False) -> int:
             "smoke": True,
         }
         burst_stats = bench_burst_drain(n_events=1000)
+        # tracing overhead gate at smoke scale: 12k events keep one
+        # replay round ~0.25 s — enough work that perf_counter jitter is
+        # invisible against the ~20 us/event hot-path budget
+        trace_overhead = bench_trace_overhead(n_events=12_000)
         skipped = {"skipped": "smoke"}
         pipeline_stats = pipeline_500 = scan_stats = skipped
         relist_50k = checkpoint_50k = virtual_stats = probe_stats = skipped
@@ -1372,6 +1548,7 @@ def main(smoke: bool = False) -> int:
         saturation = bench_saturation()
         egress = bench_egress_saturation()
         burst_stats = bench_burst_drain()
+        trace_overhead = bench_trace_overhead()
         scan_stats = bench_frame_scan()
         relist_stats = bench_relist_scale()
         relist_50k = bench_relist_scale(n_pods=50_000)
@@ -1390,6 +1567,7 @@ def main(smoke: bool = False) -> int:
         "saturation": saturation,
         "egress_saturation": egress,
         "burst": burst_stats,
+        "trace_overhead": trace_overhead,
         "frame_scan": scan_stats,
         "relist_10k": relist_stats,
         "relist_50k": relist_50k,
@@ -1430,6 +1608,9 @@ def main(smoke: bool = False) -> int:
         "max_sustained_notify_per_sec": egress.get("max_sustained_notify_per_sec"),
         "egress_saturating_stage": egress.get("first_saturating_stage"),
         "burst_drain_notify_per_sec": burst_stats.get("drain_notify_per_sec"),
+        # sampled end-to-end latency + the tracing plane's overhead gate
+        "watch_to_notify_p50_ms": (trace_overhead.get("watch_to_notify") or {}).get("p50_ms"),
+        "trace_overhead_pct": trace_overhead.get("overhead_pct"),
         "relist_10k_ms": relist_stats.get("relist_ms"),
         "relist_shard_speedup": relist_stats.get("shard_speedup"),
         "checkpoint_10k_flush_ms": checkpoint_stats.get("flush_ms_median"),

@@ -50,6 +50,7 @@ def build_source(
     checkpoint: Optional[CheckpointStore] = None,
     heartbeat=None,
     metrics: Optional[MetricsRegistry] = None,
+    tracer=None,  # trace.Tracer: head-samples at the shard pumps
 ) -> WatchSource:
     """Build the sharded watch ingest for this environment.
 
@@ -78,6 +79,7 @@ def build_source(
             batch_max=ingest.batch_max,
             queue_capacity=ingest.queue_capacity,
             metrics=metrics,
+            tracer=tracer,
         )
 
     from k8s_watcher_tpu.k8s.client import K8sClient
@@ -136,6 +138,7 @@ def build_source(
         batch_max=ingest.batch_max,
         queue_capacity=ingest.queue_capacity,
         metrics=metrics,
+        tracer=tracer,
     )
 
 
@@ -174,6 +177,18 @@ class WatcherApp:
             from k8s_watcher_tpu.metrics.audit import AuditRing
 
             self.audit = AuditRing(config.watcher.audit_ring_size)
+        # tracing plane: one Tracer shared by every stage — the shard pumps
+        # head-sample, the pipeline and dispatcher stamp spans and close
+        # journeys, anomalous terminals always capture
+        self.tracer = None
+        if config.trace.enabled:
+            from k8s_watcher_tpu.trace import Tracer
+
+            self.tracer = Tracer(
+                sample_rate=config.trace.sample_rate,
+                ring_size=config.trace.ring_size,
+                metrics=self.metrics,
+            )
         self.status_server: Optional[StatusServer] = None
         c = config.clusterapi
         self.dispatcher = Dispatcher(
@@ -194,8 +209,14 @@ class WatcherApp:
                 if c.batch_max > 1 else None
             ),
             batch_max=c.batch_max,
+            tracer=self.tracer,
+            # egress terminal outcomes ride the same ring as pipeline
+            # decisions: /debug/events answers both halves of the journey
+            audit=self.audit,
         )
-        self.source = source or build_source(config, self.checkpoint, self.liveness.beat, self.metrics)
+        self.source = source or build_source(
+            config, self.checkpoint, self.liveness.beat, self.metrics, self.tracer
+        )
         # EVERY source runs behind the sharded-ingest machinery (bounded
         # MPSC queue + batch drain) — a plain source (tests' FakeWatchSource)
         # is one shard stream, not a separate code path
@@ -207,8 +228,13 @@ class WatcherApp:
                 batch_max=config.ingest.batch_max,
                 queue_capacity=config.ingest.queue_capacity,
                 metrics=self.metrics,
+                tracer=self.tracer,
             )
         )
+        if self.tracer is not None and self.ingest.tracer is None:
+            # an injected pre-built ShardedWatchSource still joins the
+            # app's tracing plane (bench/test wiring passes sources in)
+            self.ingest.tracer = self.tracer
         self.slice_tracker = SliceTracker(
             config.environment,
             resource_key=config.tpu.resource_key,
@@ -229,6 +255,7 @@ class WatcherApp:
             slice_tracker=self.slice_tracker,
             metrics=self.metrics,
             audit=self.audit,
+            tracer=self.tracer,
             resource_key=config.tpu.resource_key,
             topology_label=config.tpu.topology_label,
             accelerator_label=config.tpu.accelerator_label,
@@ -264,11 +291,16 @@ class WatcherApp:
                 if self.config.tpu.remediation_enabled
                 else None
             )
+            stall_after = self.config.clusterapi.egress_stall_seconds
             self.status_server = StatusServer(
                 self.metrics,
                 self.liveness,
                 port=self.config.watcher.status_port,
                 audit=self.audit,
+                trace=self.tracer.ring if self.tracer is not None else None,
+                # /healthz covers the egress side too: all-workers-dead or
+                # a wedged lane past the stall threshold turns it 503
+                egress=lambda: self.dispatcher.egress_health(stall_after),
                 slices=self.slice_tracker.debug_snapshot,
                 trend=agent_trend,
                 remediation=remediation_state,
@@ -281,6 +313,8 @@ class WatcherApp:
             ).start()
             routes = "/metrics, /healthz, /debug/slices" + (
                 ", /debug/events" if self.audit is not None else ""
+            ) + (
+                ", /debug/trace" if self.tracer is not None else ""
             ) + (", /debug/trend" if agent_trend is not None else "") + (
                 ", /debug/probes" if self._probe_agent is not None else ""
             ) + (

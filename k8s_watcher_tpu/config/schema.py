@@ -312,6 +312,11 @@ class ClusterApiConfig:
     # backlog; 0/1 = per-item sends only (a receiver without the batch
     # endpoint falls back automatically either way)
     batch_max: int = 0
+    # /healthz turns 503 when a lane with backlog has made no progress for
+    # this long (worker wedged inside a send against a hung target) or
+    # every egress worker is dead — egress liveness, the counterpart of
+    # watcher.liveness_stale_seconds for the notify side
+    egress_stall_seconds: float = 120.0
     verify_tls: bool = True  # for https endpoints with self-signed certs
 
     @classmethod
@@ -319,7 +324,8 @@ class ClusterApiConfig:
         _check_known(
             raw,
             ("base_url", "auth", "endpoints", "timeout", "retry", "queue_capacity", "workers",
-             "coalesce", "coalesce_watermark", "pool_size", "batch_max", "verify_tls"),
+             "coalesce", "coalesce_watermark", "pool_size", "batch_max",
+             "egress_stall_seconds", "verify_tls"),
             "clusterapi",
         )
         auth = raw.get("auth") or {}
@@ -331,6 +337,12 @@ class ClusterApiConfig:
         for key, floor in (("workers", 0), ("coalesce_watermark", 0), ("pool_size", 0), ("batch_max", 0)):
             if _opt_int(raw, key, "clusterapi", 0) < floor:
                 raise SchemaError(f"config key 'clusterapi.{key}': must be >= {floor}")
+        stall = _opt_num(raw, "egress_stall_seconds", "clusterapi", 120.0)
+        if stall <= 0:
+            raise SchemaError(
+                f"config key 'clusterapi.egress_stall_seconds': must be > 0, got {stall} "
+                f"(a non-positive threshold would 503 on every queued send)"
+            )
         return cls(
             base_url=_opt_str(raw, "base_url", "clusterapi", "http://localhost:3000").rstrip("/"),
             api_key=_opt_str(auth, "api_key", "clusterapi.auth", None),
@@ -347,6 +359,7 @@ class ClusterApiConfig:
             coalesce_watermark=_opt_int(raw, "coalesce_watermark", "clusterapi", 0),
             pool_size=_opt_int(raw, "pool_size", "clusterapi", 0),
             batch_max=_opt_int(raw, "batch_max", "clusterapi", 0),
+            egress_stall_seconds=stall,
             verify_tls=_opt_bool(raw, "verify_tls", "clusterapi", True),
         )
 
@@ -663,6 +676,44 @@ class IngestConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class TraceConfig:
+    """The ``trace:`` section — net-new end-to-end event tracing plane
+    (trace/trace.py): head-sampled span trees across every hand-off an
+    event crosses (shard stream -> queue -> pipeline -> lane -> connection
+    borrow -> POST), with always-sample for anomalous terminals.
+
+    ``sample_rate: N`` keeps every Nth pod event per shard stream
+    (deterministic modular counter); ``0`` disables head sampling while
+    anomaly capture keeps recording. Unsampled events pay only the
+    sampling branch — no allocation, no lock (the <3% overhead budget the
+    bench smoke gates).
+    """
+
+    enabled: bool = True
+    sample_rate: int = 256
+    ring_size: int = 512
+
+    @classmethod
+    def from_raw(cls, raw: Mapping[str, Any]) -> "TraceConfig":
+        _check_known(raw, ("enabled", "sample_rate", "ring_size"), "trace")
+        sample_rate = _opt_int(raw, "sample_rate", "trace", 256)
+        if sample_rate < 0:
+            raise SchemaError(
+                f"config key 'trace.sample_rate': must be >= 0 (0 = anomaly-only), got {sample_rate}"
+            )
+        ring_size = _opt_int(raw, "ring_size", "trace", 512)
+        if ring_size < 1:
+            raise SchemaError(
+                f"config key 'trace.ring_size': must be >= 1 (use trace.enabled: false to turn tracing off), got {ring_size}"
+            )
+        return cls(
+            enabled=_opt_bool(raw, "enabled", "trace", True),
+            sample_rate=sample_rate,
+            ring_size=ring_size,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class StateConfig:
     """The ``state:`` section — net-new checkpoint/resume (SURVEY.md §5).
 
@@ -694,13 +745,14 @@ class AppConfig:
     tpu: TpuConfig
     state: StateConfig
     ingest: IngestConfig = dataclasses.field(default_factory=IngestConfig)
+    trace: TraceConfig = dataclasses.field(default_factory=TraceConfig)
 
-    TOP_LEVEL_KEYS = ("environment", "watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest")
+    TOP_LEVEL_KEYS = ("environment", "watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest", "trace")
 
     @classmethod
     def from_raw(cls, raw: Mapping[str, Any], environment: str) -> "AppConfig":
         _check_known(raw, cls.TOP_LEVEL_KEYS, "<root>")
-        for section in ("watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest"):
+        for section in ("watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest", "trace"):
             _expect(raw.get(section) or {}, (dict,), section)
         # The reference's development.yaml declared `environment: local` while
         # the CLI only accepted development|staging|production, leaving the
@@ -717,4 +769,5 @@ class AppConfig:
             tpu=TpuConfig.from_raw(raw.get("tpu") or {}),
             state=StateConfig.from_raw(raw.get("state") or {}),
             ingest=IngestConfig.from_raw(raw.get("ingest") or {}),
+            trace=TraceConfig.from_raw(raw.get("trace") or {}),
         )

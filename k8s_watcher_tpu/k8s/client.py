@@ -403,7 +403,7 @@ class K8sClient:
                 )
         finally:
             if metrics is not None:
-                metrics.histogram(f"{metric_prefix}_duration").record(time.monotonic() - t0)
+                metrics.histogram(f"{metric_prefix}_duration").observe_since(t0)
 
     def list_nodes_paged(
         self,

@@ -32,6 +32,12 @@ class JsonFormatter(logging.Formatter):
             "message": record.getMessage(),
             "environment": self.environment,
         }
+        # correlation key with the tracing plane: any log call made with
+        # extra={"trace_id": ...} (trace.Tracer.finish does) joins this
+        # line against /debug/trace and the trace_* metrics
+        trace_id = getattr(record, "trace_id", None)
+        if trace_id is not None:
+            payload["trace_id"] = trace_id
         if record.exc_info:
             payload["exc_info"] = self.formatException(record.exc_info)
         return json.dumps(payload, ensure_ascii=False)

@@ -33,14 +33,22 @@ class AuditRing:
             entry["ts"] = time.time()
             self._ring.append(entry)
 
-    def snapshot(self, n: Optional[int] = None) -> List[Dict[str, Any]]:
-        """Newest-first copy of the last ``n`` entries (None = all, n<=0 =
-        none — "last N" means what it says, not "dump everything")."""
+    def snapshot(
+        self, n: Optional[int] = None, *, uid: Optional[str] = None
+    ) -> List[Dict[str, Any]]:
+        """Newest-first copy of the last ``n`` matching entries (None =
+        all, n<=0 = none — "last N" means what it says, not "dump
+        everything"). ``uid`` follows one pod's full journey — its
+        pipeline decisions AND its egress terminal outcomes ride the same
+        ring, so the filter answers "what happened to my pod's
+        notification" in one query."""
         if n is not None and n <= 0:
             return []
         with self._lock:
             items = list(self._ring)
         items.reverse()
+        if uid is not None:
+            items = [e for e in items if e.get("uid") == uid]
         return items[:n]
 
     def __len__(self) -> int:

@@ -145,6 +145,12 @@ class Histogram:
             if seconds > self._max:
                 self._max = seconds
 
+    def observe_since(self, t0: float) -> None:
+        """Record ``now - t0`` (monotonic seconds) — the one-call shape the
+        hot paths use so callers never pay a second ``monotonic()`` for a
+        latency they already hold the start stamp of."""
+        self.record(time.monotonic() - t0)
+
     @property
     def count(self) -> int:
         with self._lock:
@@ -177,6 +183,13 @@ class Histogram:
             "p90_ms": 1e3 * (self.quantile(0.9) or 0.0),
             "p99_ms": 1e3 * (self.quantile(0.99) or 0.0),
             "max_ms": 1e3 * mx,
+            # real bucket boundaries (downsampled, cumulative, seconds) so
+            # snapshot consumers — and the Prometheus exposition built on
+            # the same helper — see `le` buckets, not just quantile points
+            "buckets_le_s": [
+                [bound if bound != float("inf") else "+Inf", cum]
+                for bound, cum in self.downsampled_buckets()
+            ],
         }
 
     def buckets(self):
@@ -190,6 +203,32 @@ class Histogram:
             cum += c
             out.append((bound, cum))
         out.append((float("inf"), total))
+        return out, total, s
+
+    def downsampled_buckets(self, per_decade_factor: float = 3.16):
+        """Cumulative ``(upper_bound_seconds, count)`` pairs thinned to
+        ~2 bounds per decade — the exposition/snapshot shape. The ~280
+        internal log buckets exist for quantile accuracy; exporting them
+        all would be ~283 series per histogram per replica, and cumulative
+        counts stay correct under subsetting. The final pair is always
+        ``(inf, total)``."""
+        pairs, _total, _sum = self.downsampled_buckets_with_totals(per_decade_factor)
+        return pairs
+
+    def downsampled_buckets_with_totals(self, per_decade_factor: float = 3.16):
+        """``(pairs, total, sum)`` from ONE atomic read of the counts —
+        exporters must use this, not buckets()-then-downsample (a record
+        landing between two reads would emit a count that disagrees with
+        the +Inf bucket)."""
+        buckets, total, s = self.buckets()
+        out = []
+        last_bound = 0.0
+        for i, (bound, cum) in enumerate(buckets):
+            is_last = i == len(buckets) - 1
+            if not is_last and bound < last_bound * per_decade_factor:
+                continue
+            last_bound = bound
+            out.append((bound, cum))
         return out, total, s
 
 
@@ -245,19 +284,15 @@ class MetricsRegistry:
             lines.append(f"# TYPE {metric} gauge")
             lines.append(f"{metric} {reading:g}")
         for name, h in sorted(histograms.items()):
-            metric = f"{prefix}{name}_seconds"
-            buckets, total, total_sum = h.buckets()
+            # unit suffix by Prometheus convention — but never doubled for
+            # registry names that already carry it (watch_to_notify_seconds)
+            metric = f"{prefix}{name}" if name.endswith("_seconds") else f"{prefix}{name}_seconds"
+            # real `le` buckets (shared downsampling with Histogram.summary
+            # — scrapers and the JSON snapshot must agree on boundaries),
+            # pairs + totals from one atomic read
+            pairs, total, total_sum = h.downsampled_buckets_with_totals()
             lines.append(f"# TYPE {metric} histogram")
-            # the ~280 internal log buckets exist for quantile accuracy;
-            # exporting them all would be ~283 series per histogram per
-            # replica. Downsample to ~2 bounds per decade for exposition
-            # (cumulative counts stay correct under subsetting).
-            last_bound = 0.0
-            for i, (bound, cum) in enumerate(buckets):
-                is_last = i == len(buckets) - 1
-                if not is_last and bound < last_bound * 3.16:
-                    continue
-                last_bound = bound
+            for bound, cum in pairs:
                 le = "+Inf" if bound == float("inf") else f"{bound:.3g}"
                 lines.append(f'{metric}_bucket{{le="{le}"}} {cum}')
             lines.append(f"{metric}_sum {total_sum}")

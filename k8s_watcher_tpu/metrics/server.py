@@ -2,11 +2,16 @@
 
 SURVEY.md §5 requires metrics as first-class (the reference only logged).
 This is the scrape surface: ``/metrics`` returns the full registry as JSON
-(counters with 1-minute rates, latency histograms with p50/p90/p99),
-``/healthz`` returns 200 while the watch loop is live — defined as having
-heard from the API server (event, bookmark, or successful reconnect) within
-``stale_after_seconds`` — and 503 otherwise, so a wedged watcher gets
-restarted by its liveness probe instead of silently going blind.
+(counters with 1-minute rates, latency histograms with p50/p90/p99) or
+Prometheus text exposition under content negotiation, ``/healthz`` returns
+200 while the watch loop is live — defined as having heard from the API
+server (event, bookmark, or successful reconnect) within
+``stale_after_seconds`` — AND the egress plane is moving (when wired:
+workers alive, no lane wedged past the stall threshold), 503 otherwise, so
+a wedged watcher gets restarted by its liveness probe instead of silently
+going blind in either direction. ``/debug/trace`` serves the tracing
+plane's sampled span trees (trace/trace.py), newest first, filterable by
+pod uid and by slowest stage.
 """
 
 from __future__ import annotations
@@ -69,6 +74,10 @@ class _StatusHandler(BaseHTTPRequestHandler):
     metrics: MetricsRegistry
     liveness: Liveness
     audit = None  # metrics.audit.AuditRing, optional
+    trace = None  # trace.TraceRing, optional -> serves /debug/trace
+    # Callable[[], dict]: egress-plane liveness verdict
+    # (Dispatcher.egress_health); folded into /healthz when wired
+    egress = None
     slices = None  # Callable[[], dict]: live slice states, optional
     trend = None  # Callable[[], dict]: probe trend anchors/windows, optional
     # Callable[[], Optional[dict]]: remediation policy state; the callable
@@ -143,11 +152,20 @@ class _StatusHandler(BaseHTTPRequestHandler):
             else:
                 self._json(200, self.metrics.dump())
         elif parsed.path == "/healthz":
-            alive = self.liveness.alive()
-            self._json(
-                200 if alive else 503,
-                {"alive": alive, "last_heartbeat_age_seconds": round(self.liveness.age_seconds(), 1)},
-            )
+            watch_alive = self.liveness.alive()
+            egress = self.egress() if self.egress is not None else None
+            # overall liveness = watch-loop freshness AND egress progress:
+            # a watcher whose workers are all dead (or wedged mid-send past
+            # the stall threshold) is as blind as one that lost its watch
+            alive = watch_alive and (egress is None or bool(egress.get("healthy", True)))
+            body = {
+                "alive": alive,
+                "watch_alive": watch_alive,
+                "last_heartbeat_age_seconds": round(self.liveness.age_seconds(), 1),
+            }
+            if egress is not None:
+                body["egress"] = egress
+            self._json(200 if alive else 503, body)
         elif parsed.path == "/debug/events":
             if self.audit is None:
                 self._json(404, {"error": "audit ring disabled (watcher.audit_ring_size: 0)"})
@@ -158,7 +176,40 @@ class _StatusHandler(BaseHTTPRequestHandler):
             except ValueError:
                 self._json(400, {"error": f"bad n={params.get('n')!r}"})
                 return
-            self._json(200, {"events": self.audit.snapshot(n), "ring_size": len(self.audit)})
+            self._json(
+                200,
+                {
+                    "events": self.audit.snapshot(n, uid=params.get("uid")),
+                    "ring_size": len(self.audit),
+                },
+            )
+        elif parsed.path == "/debug/trace":
+            if self.trace is None:
+                self._json(404, {"error": "tracing disabled (trace.enabled: false)"})
+                return
+            from k8s_watcher_tpu.trace import STAGES
+
+            params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+            try:
+                n = int(params.get("n", "50"))
+            except ValueError:
+                self._json(400, {"error": f"bad n={params.get('n')!r}"})
+                return
+            slowest = params.get("slowest")
+            if slowest is not None and slowest not in STAGES:
+                self._json(
+                    400,
+                    {"error": f"bad slowest={slowest!r} (stages: {', '.join(STAGES)})"},
+                )
+                return
+            self._json(
+                200,
+                {
+                    "traces": self.trace.snapshot(n, uid=params.get("uid"), slowest=slowest),
+                    "ring_size": len(self.trace),
+                    "stages": list(STAGES),
+                },
+            )
         elif parsed.path == "/debug/slices":
             if self.slices is None:
                 self._json(404, {"error": "slice tracking not wired"})
@@ -207,6 +258,8 @@ class StatusServer:
         host: str = "0.0.0.0",
         port: int = 0,
         audit=None,  # metrics.audit.AuditRing -> serves /debug/events
+        trace=None,  # trace.TraceRing -> serves /debug/trace
+        egress=None,  # Callable[[], dict] -> egress liveness folded into /healthz
         slices=None,  # Callable[[], dict] -> serves /debug/slices
         trend=None,  # Callable[[], dict] -> serves /debug/trend
         remediation=None,  # Callable[[], Optional[dict]] -> /debug/remediation
@@ -221,6 +274,8 @@ class StatusServer:
                 "metrics": metrics,
                 "liveness": liveness,
                 "audit": audit,
+                "trace": trace,
+                "egress": staticmethod(egress) if egress else None,
                 "slices": staticmethod(slices) if slices else None,
                 "trend": staticmethod(trend) if trend else None,
                 "remediation": staticmethod(remediation) if remediation else None,

@@ -54,6 +54,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import unquote, urlsplit
 
 from k8s_watcher_tpu.config.schema import RetryPolicy
+from k8s_watcher_tpu.trace import current_traces, note_send_attempt, observe_conn_borrow
 
 logger = logging.getLogger(__name__)
 
@@ -336,8 +337,15 @@ class ClusterApiClient:
         idle-closed by the server (payloads are idempotent snapshots)."""
         full_path = self._request_target(path)
         headers = self._request_headers()
+        # conn_borrow attribution only when a trace rides this thread's
+        # send (trace/trace.py thread-local): the untraced steady state
+        # must not pay two extra monotonic() calls per request
+        traced = bool(current_traces())
         for attempt in range(2):
+            borrow_start = time.monotonic() if traced else 0.0
             conn = self._acquire(fresh_only=attempt > 0)
+            if traced:
+                observe_conn_borrow(borrow_start, time.monotonic())
             fresh = getattr(conn, "_kw_fresh", True)
             try:
                 conn.request(method, full_path, body=body, headers=headers)
@@ -381,6 +389,7 @@ class ClusterApiClient:
                 return 0, b""
             try:
                 logger.debug("POST %s (attempt %d/%d)", endpoint, attempt, attempts)
+                note_send_attempt()  # retries count toward the trace/audit
                 status, text = self._request("POST", path, body)
                 if status == 200:
                     return status, text

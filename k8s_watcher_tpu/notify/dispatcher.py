@@ -46,6 +46,7 @@ from typing import Callable, List, Optional, Tuple, Union
 
 from k8s_watcher_tpu.metrics import MetricsRegistry
 from k8s_watcher_tpu.pipeline.pipeline import Notification
+from k8s_watcher_tpu.trace import clear_current_traces, send_attempts, set_current_traces
 
 logger = logging.getLogger(__name__)
 
@@ -76,13 +77,17 @@ class _Lane:
     per-key submit order exact under coalescing, overflow AND the
     mixed collapse/no-collapse regimes of the adaptive watermark."""
 
-    __slots__ = ("cond", "entries", "waiting", "high_water")
+    __slots__ = ("cond", "entries", "waiting", "high_water", "progress")
 
     def __init__(self) -> None:
         self.cond = threading.Condition()
         self.entries: collections.deque = collections.deque()
         self.waiting: dict = {}  # _Key -> deque[Notification]
         self.high_water = 0
+        # last time this lane's worker claimed or completed work —
+        # egress_health's wedge detector (a lane with backlog whose
+        # stamp stopped moving has a worker stuck in a send)
+        self.progress = time.monotonic()
 
 
 class Dispatcher:
@@ -98,6 +103,8 @@ class Dispatcher:
         abort: Optional[Callable[[], None]] = None,
         send_batch: Optional[Callable[[List[dict]], Optional[List[bool]]]] = None,
         batch_max: int = 16,
+        tracer=None,  # trace.Tracer: span stamps + terminal accounting
+        audit=None,  # metrics.audit.AuditRing: egress terminal outcomes
     ):
         """``abort``: called when stop()'s drain window expires with sends
         still in flight — it must cut them fast (ClusterApiClient.abort
@@ -127,6 +134,8 @@ class Dispatcher:
             max(0, coalesce_watermark), max(1, self._lane_capacity // 2)
         )
         self.metrics = metrics or MetricsRegistry()
+        self.tracer = tracer
+        self.audit = audit
         self._threads: list = []
         self._started = False
         # serializes the check-then-spawn in start(): two producers'
@@ -167,13 +176,16 @@ class Dispatcher:
 
     # -- submit side --------------------------------------------------------
 
-    def _lane_for(self, key: Optional[_Key]) -> _Lane:
+    def _lane_index_for(self, key: Optional[_Key]) -> int:
         if key is None:
             # keyless: no ordering contract, spread the load (plain int
             # increment; a rare race only skews balance, never correctness)
             self._rr = rr = (self._rr + 1) % self._workers
-            return self._lanes[rr]
-        return self._lanes[zlib.crc32(f"{key[0]}\x00{key[1]}".encode()) % self._workers]
+            return rr
+        return zlib.crc32(f"{key[0]}\x00{key[1]}".encode()) % self._workers
+
+    def _lane_for(self, key: Optional[_Key]) -> _Lane:
+        return self._lanes[self._lane_index_for(key)]
 
     def submit(self, notification: Notification) -> bool:
         """Enqueue without blocking; coalesce per-key above the watermark,
@@ -188,6 +200,11 @@ class Dispatcher:
         not the return value, for backpressure."""
         if self._stopping.is_set():
             self.metrics.counter("dispatch_dropped_stopping").inc()
+            # the audit ring records UNtraced shutdown drops too — same
+            # "what happened to my pod's notification" contract the
+            # overflow/abandon paths honor
+            if notification.trace is not None or self.audit is not None:
+                self._egress_terminal(notification, "dropped_stopping", lane=None)
             return False
         if not self._started:
             self.start()
@@ -196,51 +213,72 @@ class Dispatcher:
         # per-key submit-order delivery is the structural contract,
         # coalescing is only the backpressure policy on top of it
         key = coalesce_key(notification)
-        lane = self._lane_for(key)
+        lane_index = self._lane_index_for(key)
+        lane = self._lanes[lane_index]
+        trace = notification.trace
+        if trace is not None:
+            trace.lane = lane_index
+            trace.lane_enter = time.monotonic()
         counter = self.metrics.counter
         dropped = dropped_coalesced = 0
+        replaced: Optional[Notification] = None
+        evicted: List[Notification] = []
         with lane.cond:
             if key is not None and self._coalesce:
                 q = lane.waiting.get(key)
                 if q and len(lane.entries) >= self._coalesce_watermark:
                     # backlog past the watermark: latest-wins on the key's
                     # NEWEST waiting payload — no new slot, order intact
+                    replaced = q[-1]
                     q[-1] = notification
-                    counter("dispatch_coalesced").inc()
-                    return True
-                if q is None:
-                    q = lane.waiting[key] = collections.deque()
-                q.append(notification)
-                entry: Union[Notification, _Key] = key
-            else:
-                entry = notification
-            while len(lane.entries) >= self._lane_capacity:
-                oldest = lane.entries.popleft()
-                # (cannot be our own entry: it isn't enqueued yet)
-                if not isinstance(oldest, Notification):
-                    oq = lane.waiting.get(oldest)
-                    if oq:
-                        oq.popleft()  # markers map 1:1 onto waiting payloads
-                        if not oq:
-                            del lane.waiting[oldest]
-                        dropped_coalesced += 1
-                dropped += 1
-            # count the entry outstanding BEFORE it becomes claimable (we
-            # still hold lane.cond): counting after the unlock would let a
-            # fast worker's completion transiently zero the balance and
-            # wake drain() with another send still in flight
-            with self._drain_cond:
-                self._outstanding += 1
-            lane.entries.append(entry)
-            depth = len(lane.entries)
-            if depth > lane.high_water:
-                lane.high_water = depth
-                self.metrics.gauge("dispatch_lane_high_water").set_max(depth)
-            lane.cond.notify()
+                else:
+                    if q is None:
+                        q = lane.waiting[key] = collections.deque()
+                    q.append(notification)
+            if replaced is None:
+                if key is not None and self._coalesce:
+                    entry: Union[Notification, _Key] = key
+                else:
+                    entry = notification
+                while len(lane.entries) >= self._lane_capacity:
+                    oldest = lane.entries.popleft()
+                    # (cannot be our own entry: it isn't enqueued yet)
+                    if not isinstance(oldest, Notification):
+                        oq = lane.waiting.get(oldest)
+                        if oq:
+                            # markers map 1:1 onto waiting payloads
+                            evicted.append(oq.popleft())
+                            if not oq:
+                                del lane.waiting[oldest]
+                            dropped_coalesced += 1
+                    else:
+                        evicted.append(oldest)
+                    dropped += 1
+                # count the entry outstanding BEFORE it becomes claimable
+                # (we still hold lane.cond): counting after the unlock
+                # would let a fast worker's completion transiently zero
+                # the balance and wake drain() with another send in flight
+                with self._drain_cond:
+                    self._outstanding += 1
+                lane.entries.append(entry)
+                depth = len(lane.entries)
+                if depth > lane.high_water:
+                    lane.high_water = depth
+                    self.metrics.gauge("dispatch_lane_high_water").set_max(depth)
+                lane.cond.notify()
+        # terminal accounting OUTSIDE lane.cond: trace finish takes the
+        # ring lock and may log — never under a lane lock
+        if replaced is not None:
+            counter("dispatch_coalesced").inc()
+            if replaced.trace is not None:
+                self._egress_terminal(replaced, "coalesced", lane=lane_index)
+            return True
         if dropped:
             counter("dispatch_dropped_overflow").inc(dropped)
             if dropped_coalesced:
                 counter("dispatch_dropped_overflow_coalesced").inc(dropped_coalesced)
+            for victim in evicted:
+                self._egress_terminal(victim, "dropped_overflow", lane=lane_index)
             self._finish(dropped)
         counter("dispatch_enqueued").inc()
         return True
@@ -278,9 +316,34 @@ class Dispatcher:
                     # batched POSTs
                     take = min(len(lane.entries), self._batch_max)
                 claimed = [self._claim(lane, lane.entries.popleft()) for _ in range(take)]
-            self._deliver(claimed, hist)
+                lane.progress = time.monotonic()
+            self._deliver(claimed, hist, lane_index=index, lane=lane)
 
-    def _deliver(self, notifications: List[Notification], hist) -> None:
+    def _deliver(
+        self,
+        notifications: List[Notification],
+        hist,
+        lane_index: Optional[int] = None,
+        lane: Optional[_Lane] = None,
+    ) -> None:
+        claim_time = time.monotonic()
+        traces = []
+        for n in notifications:
+            trace = n.trace
+            if trace is not None:
+                # lane_wait closes at claim; the send window (post span +
+                # the client's conn_borrow stamps) starts here
+                trace.add_span("lane_wait", trace.lane_enter or claim_time, claim_time)
+                traces.append(trace)
+        # park the in-flight traces for the client's conn_borrow/attempt
+        # stamps; also zeroes the per-thread attempt counter so the audit
+        # entry below reports attempts for UNtraced sends too. Skipped
+        # entirely when neither consumer exists (bare bench stacks) — the
+        # previous window's clear leaves the thread-local empty.
+        audit = self.audit
+        window = bool(traces) or audit is not None
+        if window:
+            set_current_traces(tuple(traces))
         payloads = [n.payload for n in notifications]
         counter = self.metrics.counter
         results: Optional[List[bool]] = None
@@ -299,28 +362,158 @@ class Dispatcher:
                 # a short result list (misbehaving receiver) must not
                 # leave the tail uncounted — pad as failed
                 results = list(results) + [False] * (len(payloads) - len(results))
+        per_item_attempts: Optional[List[int]] = None
+        per_item_ends: Optional[List[float]] = None
         if results is None:  # no batch path, or receiver doesn't support it
             results = []
-            for payload in payloads:
+            # per-item end stamps: this loop makes one POST per payload,
+            # so closing every item at the loop's end would inflate each
+            # post span (and watch_to_notify) by up to the claimed-batch
+            # size worth of round-trips
+            per_item_ends = []
+            if window:
+                # re-park PER ITEM for the same reason: leaving the whole
+                # claim's traces parked would stamp every POST's
+                # conn_borrow into every trace and report window-total
+                # attempts on each
+                per_item_attempts = []
+            for notification, payload in zip(notifications, payloads):
+                if per_item_attempts is not None:
+                    item_trace = notification.trace
+                    set_current_traces((item_trace,) if item_trace is not None else ())
                 ok = False
                 try:
                     ok = self._send(payload)
                 except Exception as exc:  # send contract is boolean, but be safe
                     logger.error("Notifier raised: %s", exc)
                 results.append(ok)
+                per_item_ends.append(time.monotonic())
+                if per_item_attempts is not None:
+                    per_item_attempts.append(send_attempts())
         now = time.monotonic()
+        # batch POSTs share one send window: the attempt count (and the
+        # conn_borrow stamps above) legitimately apply to every item
+        attempts = send_attempts() if window else 0
+        if window:
+            clear_current_traces()
+        tracer = self.tracer
         sent = failed = 0
-        for notification, ok in zip(notifications, results):
+        for i, (notification, ok) in enumerate(zip(notifications, results)):
+            if per_item_ends is not None:
+                # item i's POST ran from the previous item's end (or the
+                # claim) to its own stamp — not the whole loop's window
+                item_start = per_item_ends[i - 1] if i else claim_time
+                item_end = per_item_ends[i]
+            else:
+                item_start, item_end = claim_time, now
             if ok:
                 sent += 1
-                hist.record(now - notification.received_monotonic)
+                hist.record(item_end - notification.received_monotonic)
             else:
                 failed += 1
+            trace = notification.trace
+            if trace is not None:
+                trace.add_span("post", item_start, item_end)
+            # terminal accounting only when someone records it: a traced
+            # journey, an audit ring, or a failure the tracer must capture
+            if trace is not None or audit is not None or (not ok and tracer is not None):
+                self._egress_terminal(
+                    notification, "sent" if ok else "failed",
+                    lane=lane_index, end=item_end,
+                    attempts=(
+                        per_item_attempts[i] if per_item_attempts is not None
+                        else attempts
+                    ),
+                )
         if sent:
             counter("dispatch_sent").inc(sent)
         if failed:
             counter("dispatch_failed").inc(failed)
+        if lane is not None:
+            lane.progress = now
         self._finish(len(notifications))
+
+    def _egress_terminal(
+        self,
+        notification: Notification,
+        outcome: str,
+        *,
+        lane: Optional[int],
+        end: Optional[float] = None,
+        attempts: int = 0,
+    ) -> None:
+        """One notification's terminal egress accounting: close its trace
+        (building an after-the-fact anomaly trace for drops/failures head
+        sampling missed) and append the outcome to the audit ring, so
+        ``/debug/events`` answers "what happened to my pod's notification"
+        — not just its pipeline decision. Coalesced collapses skip the
+        audit ring (they arrive at backlog rates and would evict the
+        terminal outcomes operators actually ask about); their traces
+        still complete normally."""
+        tracer = self.tracer
+        trace = notification.trace
+        if tracer is not None:
+            if trace is None and outcome in ("failed", "dropped_overflow", "abandoned"):
+                payload = notification.payload
+                trace = tracer.start_anomaly(
+                    uid=str(payload.get("uid") or ""),
+                    name=str(payload.get("name") or ""),
+                    kind=notification.kind,
+                    t0=notification.received_monotonic,
+                )
+            if trace is not None:
+                if trace.lane is None:
+                    trace.lane = lane
+                if attempts and not trace.attempts:
+                    trace.attempts = attempts
+                tracer.finish(trace, outcome, end=end)
+        if self.audit is not None and outcome != "coalesced":
+            payload = notification.payload
+            entry = {
+                "kind": "egress",
+                "outcome": outcome,
+                "notify_kind": notification.kind,
+                "uid": payload.get("uid"),
+                "name": payload.get("name"),
+                "lane": lane,
+                "attempts": attempts or (trace.attempts if trace is not None else 0),
+            }
+            if trace is not None:
+                entry["trace_id"] = trace.trace_id
+            self.audit.record(entry)
+
+    def egress_health(self, stall_after_seconds: float = 120.0) -> dict:
+        """Liveness verdict for ``/healthz``: unhealthy when every worker
+        thread is dead, or when any lane with backlog has made no progress
+        for ``stall_after_seconds`` (its worker is wedged inside a send
+        against a hung target). A dispatcher that never started, or is
+        shutting down, reports healthy — lifecycle states, not faults."""
+        now = time.monotonic()
+        started = self._started
+        stopping = self._stopping.is_set()
+        workers_alive = sum(1 for t in self._threads if t.is_alive())
+        stalled: List[dict] = []
+        if started and not stopping:
+            for i, lane in enumerate(self._lanes):
+                with lane.cond:
+                    depth = len(lane.entries)
+                    age = now - lane.progress
+                if depth > 0 and age > stall_after_seconds:
+                    stalled.append(
+                        {"lane": i, "depth": depth, "stalled_seconds": round(age, 1)}
+                    )
+        healthy = (not started) or stopping or (workers_alive > 0 and not stalled)
+        with self._drain_cond:
+            outstanding = self._outstanding
+        return {
+            "healthy": healthy,
+            "started": started,
+            "workers": self._workers,
+            "workers_alive": workers_alive,
+            "stall_after_seconds": stall_after_seconds,
+            "stalled_lanes": stalled,
+            "outstanding": outstanding,
+        }
 
     def _finish(self, n: int) -> None:
         with self._drain_cond:
@@ -381,11 +574,15 @@ class Dispatcher:
         # every producer before the dispatcher, so nothing races this
         # sweep itself.)
         strays = 0
-        for lane in self._lanes:
+        for i, lane in enumerate(self._lanes):
+            abandoned: List[Notification] = []
             with lane.cond:
                 while lane.entries:
-                    self._claim(lane, lane.entries.popleft())
+                    abandoned.append(self._claim(lane, lane.entries.popleft()))
                     strays += 1
+            # terminal accounting outside lane.cond (ring lock + logging)
+            for notification in abandoned:
+                self._egress_terminal(notification, "abandoned", lane=i)
         if strays:
             self._finish(strays)
             # the drain-expiry branch above already counted its backlog —

@@ -14,6 +14,7 @@ notify as the key <1 s p50 hazard).
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, Callable, Dict, NamedTuple, Optional
 
 from k8s_watcher_tpu.metrics import MetricsRegistry
@@ -42,6 +43,10 @@ class Notification(NamedTuple):
     payload: Dict[str, Any]
     received_monotonic: float
     kind: str = "pod"  # "pod" | "slice" | "probe" | "remediation"
+    # trace.Trace riding the POD journey this payload came from (None for
+    # unsampled events and for derived slice/probe payloads — the trace
+    # follows the one watch event it was sampled on)
+    trace: Optional[Any] = None
 
 
 class PipelineResult(NamedTuple):
@@ -66,6 +71,7 @@ class EventPipeline:
         slice_tracker: Optional[Any] = None,  # slices.SliceTracker (optional stage)
         metrics: Optional[MetricsRegistry] = None,
         audit: Optional[Any] = None,  # metrics.audit.AuditRing
+        tracer: Optional[Any] = None,  # trace.Tracer (stage spans + terminals)
         notify_all: bool = False,
         resource_key: str = "google.com/tpu",
         topology_label: str = "cloud.google.com/gke-tpu-topology",
@@ -82,10 +88,14 @@ class EventPipeline:
         self.slice_tracker = slice_tracker
         self.metrics = metrics or MetricsRegistry()
         self.audit = audit
+        self.tracer = tracer
         self.notify_all = notify_all
         self.resource_key = resource_key
         self.topology_label = topology_label
         self.accelerator_label = accelerator_label
+        # batch-entry stamp shared with the hand-off site in _process_one
+        # (the drain is single-threaded, so instance state is safe)
+        self._batch_enter = 0.0
 
     def process(self, event: WatchEvent) -> PipelineResult:
         return self.process_batch((event,))[0]
@@ -113,9 +123,39 @@ class EventPipeline:
         audit = self.audit
         record = audit.record if audit is not None else None
         process_one = self._process_one
+        tracer = self.tracer
+        tracing = tracer is not None
+        monotonic = time.monotonic
+        # one stamp per BATCH: every sampled event in it waited in the
+        # ingest queue until this drain. Events deeper in the batch bill
+        # their in-batch wait to the pipeline stage — that wait IS
+        # pipeline processing of their predecessors.
+        batch_enter = monotonic() if tracing else 0.0
+        self._batch_enter = batch_enter
         results = []
         for event in events:
             result = process_one(event, counts)
+            if tracing:
+                trace = event.trace
+                if trace is not None and not trace.handed_off:
+                    # handed-off journeys stamped their spans at the
+                    # hand-off site (_process_one) — the dispatcher may
+                    # finish() on a worker thread the instant it owns the
+                    # Notification, and finish reads the spans once. A
+                    # journey that ended HERE — filtered, insignificant,
+                    # gate-suppressed — terminates now with the drop reason
+                    now = monotonic()
+                    trace.add_span("queue_wait", trace.queue_enter, batch_enter)
+                    trace.add_span("pipeline", batch_enter, now)
+                    outcome = (
+                        result.reason if result.reason != "notified"
+                        # slice siblings notified but the pod payload
+                        # itself was suppressed (critical gate / no
+                        # significant pod delta): the POD journey
+                        # ended here
+                        else "pod_suppressed"
+                    )
+                    tracer.finish(trace, outcome, end=now)
             if record is not None and event.type != EventType.BOOKMARK:
                 pod_meta = (event.pod or {}).get("metadata") or {}
                 record(
@@ -231,7 +271,22 @@ class EventPipeline:
         payload["event_type"] = event.type
 
         if critical_ok and (self.notify_all or delta.significant):
-            self.sink(Notification(payload, event.received_monotonic, kind="pod"))
+            trace = event.trace
+            if trace is not None:
+                # spans stamped + hand-off marked BEFORE submit: the
+                # dispatcher owns the terminal outcome from here and may
+                # finish() on a worker thread immediately — finish reads
+                # the span list once, so anything added after the sink
+                # call would miss the per-stage histograms. (finish() is
+                # idempotent, so a synchronous reject inside submit stays
+                # single-counted.) The pipeline span therefore ends at
+                # hand-off for notified journeys; post-sink work (slice
+                # fan-out, logging) bills to no stage.
+                now = time.monotonic()
+                trace.add_span("queue_wait", trace.queue_enter, self._batch_enter)
+                trace.add_span("pipeline", self._batch_enter, now)
+                trace.handed_off = True
+            self.sink(Notification(payload, event.received_monotonic, kind="pod", trace=trace))
             counts["notifications_enqueued"] = counts.get("notifications_enqueued", 0) + 1
         for slice_payload in slice_notifications:
             self.sink(Notification(slice_payload, event.received_monotonic, kind="slice"))

@@ -1,0 +1,33 @@
+"""Tracing plane public surface (see trace/trace.py for the design)."""
+
+from k8s_watcher_tpu.trace.trace import (
+    ANOMALY_OUTCOMES,
+    STAGES,
+    Trace,
+    TraceRing,
+    TraceSampler,
+    Tracer,
+    clear_current_traces,
+    current_traces,
+    new_trace_id,
+    note_send_attempt,
+    observe_conn_borrow,
+    send_attempts,
+    set_current_traces,
+)
+
+__all__ = [
+    "ANOMALY_OUTCOMES",
+    "STAGES",
+    "Trace",
+    "TraceRing",
+    "TraceSampler",
+    "Tracer",
+    "clear_current_traces",
+    "current_traces",
+    "new_trace_id",
+    "note_send_attempt",
+    "observe_conn_borrow",
+    "send_attempts",
+    "set_current_traces",
+]

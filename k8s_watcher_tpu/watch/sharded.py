@@ -162,6 +162,7 @@ class ShardedWatchSource:
         batch_max: int = 128,
         queue_capacity: int = 8192,
         metrics=None,  # metrics.MetricsRegistry, optional
+        tracer=None,  # trace.Tracer, optional — head-samples at the pump
     ):
         if not sources:
             raise ValueError("ShardedWatchSource needs at least one shard source")
@@ -169,6 +170,7 @@ class ShardedWatchSource:
         self.batch_max = max(1, batch_max)
         self.queue = EventBatchQueue(queue_capacity)
         self.metrics = metrics
+        self.tracer = tracer
         self.per_shard_counts = [0] * len(self.sources)
         self._threads: List[threading.Thread] = []
         self._started = False
@@ -196,10 +198,38 @@ class ShardedWatchSource:
     # -- batched surface ---------------------------------------------------
 
     def _pump(self, shard: int, source) -> None:
+        # head-sampling decision, made HERE and only here — and INLINED:
+        # the unsampled steady state (255/256 of a 30k events/s stream)
+        # pays one local-bool branch, up to three interned-string
+        # compares and a countdown decrement; no call, no allocation, no
+        # lock (a maybe_start() call per event alone costs ~0.6 us — 2%
+        # of the whole event budget). Each shard stream samples its own
+        # 1st, N+1th, 2N+1th… pod event, so the kept set is deterministic
+        # per shard. The trace attaches BEFORE the queue put so the drain
+        # side can never observe a sampled event trace-less; any
+        # put-block backpressure wait then honestly lands in queue_wait.
+        tracer = self.tracer
+        tracing = (
+            tracer is not None and tracer.enabled and tracer.sample_rate != 0
+        )
+        rate = max(1, tracer.sample_rate) if tracing else 0
+        countdown = 1  # sample this shard's first pod event
+        monotonic = time.monotonic
         try:
             for event in source.events():
                 if self._stop.is_set():
                     return
+                if tracing:
+                    et = event.type
+                    if et == "ADDED" or et == "MODIFIED" or et == "DELETED":
+                        countdown -= 1
+                        if countdown == 0:
+                            countdown = rate
+                            trace = tracer.start(event, shard)
+                            now = monotonic()
+                            trace.add_span("shard_receive", trace.t0, now)
+                            trace.queue_enter = now
+                            event.trace = trace
                 if not self.queue.put(event):
                     return
                 self.per_shard_counts[shard] += 1
@@ -232,6 +262,22 @@ class ShardedWatchSource:
                 self._threads.append(t)
                 t.start()
 
+    def run_pump_inline(self, shard: int = 0) -> None:
+        """Run one shard's pump synchronously on the calling thread.
+
+        Measurement seam for the tracing-plane overhead gate (bench.py
+        ``_hot_path_replay``): the REAL pump body — sampling branch
+        included — with zero thread-scheduling noise. Requires queue
+        capacity ≥ the stream's length so no put ever blocks; the pump's
+        normal end-of-stream path closes the queue, after which
+        ``batches()`` drains what was enqueued without spawning pumps."""
+        with self._start_lock:
+            if self._started:
+                raise RuntimeError("run_pump_inline requires an unstarted source")
+            self._started = True
+            self._live_pumps = 1
+        self._pump(shard, self.sources[shard])
+
     def batches(self) -> Iterator[List[WatchEvent]]:
         """Yield event batches until every shard stream ends (or stop()).
         Single consumer: per-UID ordering holds because each UID lives on
@@ -248,6 +294,10 @@ class ShardedWatchSource:
                 continue
             if gauge is not None:
                 gauge.set(self.queue.high_water)
+            # queue_wait spans are stamped by EventPipeline.process_batch
+            # (one batch-enter stamp), not here: a second per-event scan of
+            # every batch on the drain thread would double the tracing
+            # plane's per-event tax for no extra fidelity
             yield batch
 
     def join(self, timeout: float = 5.0) -> None:

@@ -48,6 +48,10 @@ class WatchEvent:
     # checkpoint entry that carries no resource spec, and the accelerator
     # filter must pass it rather than silently leak the deletion
     legacy_tombstone: bool = False
+    # trace.Trace when the head sampler picked this event, else None
+    # (the 1-in-N steady state). Set ONCE by the shard pump before the
+    # queue put; downstream stages only read it.
+    trace: Optional[Any] = None
 
     @property
     def name(self) -> str:

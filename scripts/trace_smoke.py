@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Trace-plane smoke: the full mock cluster, end to end, through the REAL
+app wiring (``make trace-smoke``).
+
+Boots the in-repo mock apiserver (which doubles as the clusterapi notify
+target), points a ``WatcherApp`` at it over real HTTP with tracing on,
+churns pod phases, and asserts the tracing plane's three contracts:
+
+1. ``watch_to_notify_seconds`` is POPULATED (count > 0) in ``/metrics`` —
+   the watch-observed -> notify-delivered histogram exists and moves;
+2. the Prometheus text exposition carries real ``le`` buckets for it
+   (content negotiation on the same route);
+3. a head-sampled trace whose journey completed cleanly shows ALL SIX
+   stages at ``/debug/trace`` — shard_receive, queue_wait, pipeline,
+   lane_wait, conn_borrow, post — i.e. no hand-off drops the span context.
+
+Artifact: ``artifacts/trace_smoke.json``. Exit 0 on PASS.
+
+The overhead side of the tracing budget (<3% at 1/256 sampling) is gated
+separately by ``bench.py --smoke`` (bench_trace_overhead); this script
+gates CORRECTNESS of the plane at a sample rate high enough to observe
+quickly (1/8).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import json
+import socket
+import sys
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import requests
+
+from k8s_watcher_tpu.app import WatcherApp
+from k8s_watcher_tpu.config.loader import load_config
+from k8s_watcher_tpu.k8s.mock_server import MockApiServer
+from k8s_watcher_tpu.trace import STAGES
+from k8s_watcher_tpu.watch.fake import build_pod
+
+ARTIFACTS = REPO / "artifacts"
+N_PODS = 8
+SAMPLE_RATE = 8
+DEADLINE_S = 45.0
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _smoke_config(tmp: Path, server_url: str, status_port: int):
+    kc_path = tmp / "kubeconfig.json"
+    kc_path.write_text(json.dumps({
+        "apiVersion": "v1", "kind": "Config",
+        "clusters": [{"name": "m", "cluster": {"server": server_url}}],
+        "contexts": [{"name": "m", "context": {"cluster": "m", "user": "m"}}],
+        "current-context": "m",
+        "users": [{"name": "m", "user": {"token": "t"}}],
+    }))
+    config = load_config("development", str(REPO / "config"), env={})
+    return dataclasses.replace(
+        config,
+        kubernetes=dataclasses.replace(
+            config.kubernetes, use_mock=False, config_file=str(kc_path),
+            watch_timeout_seconds=5,
+        ),
+        # the mock apiserver IS the notify target (it serves /health +
+        # /api/pods/update[_batch]) — the POSTs are real HTTP round-trips
+        clusterapi=dataclasses.replace(
+            config.clusterapi, base_url=server_url,
+            # per-item POSTs + no coalescing: every churned transition
+            # must complete its own journey, so sampled journeys aren't
+            # collapsed away before they reach the post stage
+            coalesce=False, batch_max=1,
+        ),
+        watcher=dataclasses.replace(config.watcher, status_port=status_port),
+        trace=dataclasses.replace(
+            config.trace, enabled=True, sample_rate=SAMPLE_RATE, ring_size=256,
+        ),
+    )
+
+
+def run_smoke() -> dict:
+    import tempfile
+
+    status_port = _free_port()
+    base = f"http://127.0.0.1:{status_port}"
+    result: dict = {
+        "timestamp_utc": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "sample_rate": SAMPLE_RATE,
+        "checks": {},
+    }
+    with tempfile.TemporaryDirectory(prefix="trace-smoke-") as tmp, MockApiServer() as server:
+        for i in range(N_PODS):
+            server.cluster.add_pod(build_pod(
+                f"trace-pod-{i}", "default", uid=f"uid-{i}",
+                phase="Pending", tpu_chips=4,
+            ))
+        app = WatcherApp(_smoke_config(Path(tmp), server.url, status_port))
+        thread = threading.Thread(target=app.run, daemon=True)
+        thread.start()
+        try:
+            deadline = time.monotonic() + DEADLINE_S
+            # churn phases while polling: each flip is a significant delta
+            # -> a notification -> (for the sampled 1/8) a full journey
+            phase_flip, churned = ("Running", "Pending"), 0
+            metrics_json: dict = {}
+            six_stage_trace = None
+            while time.monotonic() < deadline:
+                for i in range(N_PODS):
+                    server.cluster.set_phase(
+                        "default", f"trace-pod-{i}", phase_flip[churned % 2]
+                    )
+                churned += 1
+                time.sleep(0.25)
+                try:
+                    metrics_json = requests.get(f"{base}/metrics", timeout=5).json()
+                    traces = requests.get(
+                        f"{base}/debug/trace?n=100", timeout=5
+                    ).json().get("traces", [])
+                except requests.RequestException:
+                    continue  # status server still coming up
+                six_stage_trace = next(
+                    (
+                        t for t in traces
+                        if t["sampled_by"] == "head" and t["outcome"] == "sent"
+                        and {s["stage"] for s in t["spans"]} >= set(STAGES)
+                    ),
+                    None,
+                )
+                populated = (
+                    metrics_json.get("watch_to_notify_seconds", {}).get("count", 0) > 0
+                )
+                if populated and six_stage_trace is not None:
+                    break
+            prom_text = requests.get(
+                f"{base}/metrics", params={"format": "prometheus"}, timeout=5
+            ).text
+            w2n = metrics_json.get("watch_to_notify_seconds", {})
+            result["churn_rounds"] = churned
+            result["watch_to_notify_seconds"] = {
+                k: w2n.get(k) for k in ("count", "p50_ms", "p90_ms", "p99_ms")
+            }
+            result["six_stage_trace"] = six_stage_trace
+            result["checks"] = {
+                "watch_to_notify_populated": w2n.get("count", 0) > 0,
+                "prometheus_le_buckets": (
+                    'k8s_watcher_watch_to_notify_seconds_bucket{le="' in prom_text
+                ),
+                "six_stage_sampled_trace": six_stage_trace is not None,
+            }
+        finally:
+            app.stop()
+            thread.join(timeout=10)
+    result["ok"] = all(result["checks"].values())
+    return result
+
+
+def main() -> int:
+    result = run_smoke()
+    ARTIFACTS.mkdir(exist_ok=True)
+    out = ARTIFACTS / "trace_smoke.json"
+    out.write_text(json.dumps(result, indent=2) + "\n")
+    checks = ", ".join(f"{k}={'PASS' if v else 'FAIL'}" for k, v in result["checks"].items())
+    print(f"{'PASS' if result['ok'] else 'FAIL'}: {checks}")
+    w2n = result.get("watch_to_notify_seconds") or {}
+    if w2n.get("count"):
+        print(
+            "watch_to_notify_seconds: count=%d p50=%.2fms p90=%.2fms p99=%.2fms"
+            % (w2n["count"], w2n["p50_ms"], w2n["p90_ms"], w2n["p99_ms"])
+        )
+    print(f"artifact: {out}")
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,9 +51,17 @@ def test_bench_smoke_headline_within_budget():
     assert headline["burst_drain_notify_per_sec"] > 1000, headline
     # relist still covers every pod (count mismatch -> error field)
     assert headline["relist_10k_ms"] is not None, headline
+    # tracing plane: the overhead gate ran, stayed inside its <3% budget,
+    # and the traced side populated the end-to-end histogram (the metric
+    # the plane exists to produce)
+    assert headline["trace_overhead_pct"] is not None, headline
+    assert headline["watch_to_notify_p50_ms"] is not None, headline
     detail = json.loads((REPO_ROOT / "artifacts" / "bench_smoke.json").read_text())
     assert detail["details"]["relist_10k"]["events"] == detail["details"]["relist_10k"]["n_pods"]
     egress = detail["details"]["egress_saturation"]
     assert egress["steps"], egress
     assert "first_saturating_stage" in egress, egress
     assert detail["details"]["burst"]["drain_notify_per_sec"] is not None
+    trace = detail["details"]["trace_overhead"]
+    assert trace["within_budget"], trace
+    assert trace["watch_to_notify"]["count"] > 0, trace

@@ -1,0 +1,551 @@
+"""Tracing-plane tests (trace/trace.py + the stage instrumentation).
+
+The plane's contracts, each pinned here:
+- head sampling is deterministic (modular counter, not RNG) and anomalous
+  terminals ALWAYS capture, head-sampled or not;
+- a sampled journey that completes cleanly carries all six stages across
+  the shard -> queue -> pipeline -> lane -> pool -> POST hand-offs, under
+  multi-shard ingest and multi-worker egress;
+- the unsampled steady state pays NO tracer work: no call, no allocation,
+  no attribute write (the <3% budget's structural half — bench.py's
+  bench_trace_overhead gates the measured half);
+- /debug/trace serves newest-first with uid / slowest-stage filters;
+- the Prometheus text exposition is byte-stable (golden) with real
+  cumulative `le` buckets;
+- egress terminal outcomes (lane, attempts, trace_id) ride the AuditRing
+  and /healthz covers egress liveness (dead workers / wedged lanes).
+"""
+
+import json
+import threading
+import time
+import tracemalloc
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+import requests
+
+from k8s_watcher_tpu.metrics import MetricsRegistry
+from k8s_watcher_tpu.notify.dispatcher import Dispatcher, Notification
+from k8s_watcher_tpu.pipeline.pipeline import EventPipeline
+from k8s_watcher_tpu.slices.tracker import SliceTracker
+from k8s_watcher_tpu.trace import STAGES, Tracer, TraceRing, TraceSampler
+from k8s_watcher_tpu.watch.fake import build_pod, sharded_fake_sources
+from k8s_watcher_tpu.watch.sharded import ShardedWatchSource
+from k8s_watcher_tpu.watch.source import EventType, WatchEvent
+
+
+def tpu_event(i: int, event_type: str = EventType.ADDED) -> WatchEvent:
+    return WatchEvent(
+        type=event_type,
+        pod=build_pod(f"pod-{i}", uid=f"uid-{i}", phase="Running", tpu_chips=4),
+    )
+
+
+class TestSamplerDeterminism:
+    def test_keeps_every_nth_starting_with_the_first(self):
+        sampler = TraceSampler(rate=4)
+        picks = [sampler.sample() for _ in range(12)]
+        assert picks == [True, False, False, False] * 3
+
+    def test_two_samplers_agree(self):
+        # modular counter, not RNG: incident replays reproduce exactly
+        a, b = TraceSampler(rate=7), TraceSampler(rate=7)
+        assert [a.sample() for _ in range(50)] == [b.sample() for _ in range(50)]
+
+    def test_rate_one_samples_everything_rate_zero_nothing(self):
+        assert all(TraceSampler(rate=1).sample() for _ in range(5))
+        assert not any(TraceSampler(rate=0).sample() for _ in range(5))
+
+    def test_maybe_start_skips_non_pod_frames(self):
+        tracer = Tracer(sample_rate=1)
+        bookmark = WatchEvent(type=EventType.BOOKMARK, pod={})
+        assert tracer.maybe_start(bookmark) is None
+        assert tracer.maybe_start(tpu_event(0)) is not None
+
+
+class TestAnomalyAlwaysSamples:
+    def test_failed_send_records_anomaly_trace_despite_sampling_off(self):
+        # head sampling disabled entirely: the failure must still land in
+        # the ring, because the dropped notification is the one the
+        # operator will ask about
+        tracer = Tracer(sample_rate=0, metrics=MetricsRegistry())
+        dispatcher = Dispatcher(lambda payload: False, workers=1, tracer=tracer)
+        dispatcher.start()
+        t0 = time.monotonic()
+        dispatcher.submit(Notification({"uid": "u-1", "name": "p-1"}, t0, kind="pod"))
+        assert dispatcher.drain(5.0)
+        dispatcher.stop()
+        traces = tracer.ring.snapshot()
+        assert len(traces) == 1
+        entry = traces[0]
+        assert entry["sampled_by"] == "anomaly"
+        assert entry["outcome"] == "failed" and entry["anomaly"] is True
+        assert entry["uid"] == "u-1"
+        assert tracer.metrics.counter("trace_anomalies").value == 1
+
+    def test_overflow_drop_records_anomaly_trace(self):
+        tracer = Tracer(sample_rate=0)
+        release = threading.Event()
+        dispatcher = Dispatcher(
+            lambda payload: release.wait(5.0), workers=1, capacity=1,
+            coalesce=False, tracer=tracer,
+        )
+        dispatcher.start()
+        t0 = time.monotonic()
+        # first submit is claimed by the (blocked) worker; the next two
+        # fight over the single lane slot -> one dropped_overflow
+        for i in range(3):
+            dispatcher.submit(Notification({"uid": f"u-{i}"}, t0, kind="pod"))
+            time.sleep(0.05)
+        release.set()
+        dispatcher.drain(5.0)
+        dispatcher.stop()
+        outcomes = [t["outcome"] for t in tracer.ring.snapshot()]
+        assert "dropped_overflow" in outcomes
+
+    def test_clean_sends_do_not_allocate_anomaly_traces(self):
+        tracer = Tracer(sample_rate=0, metrics=MetricsRegistry())
+        dispatcher = Dispatcher(lambda payload: True, workers=1, tracer=tracer)
+        dispatcher.start()
+        dispatcher.submit(Notification({"uid": "u"}, time.monotonic(), kind="pod"))
+        assert dispatcher.drain(5.0)
+        dispatcher.stop()
+        assert len(tracer.ring) == 0
+
+
+class _CountingSink(BaseHTTPRequestHandler):
+    """Minimal notify target: 200 every POST (keep-alive, so the client
+    pool's conn_borrow path is exercised for real)."""
+
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        body = b'{"success": true}'
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class TestSpanTreeCompleteness:
+    """Sample-everything run through the PRODUCTION shapes: 2 shard
+    streams -> bounded MPSC queue -> batched pipeline -> 4-worker keyed
+    dispatcher -> pooled HTTP client -> local sink. Every clean journey
+    must carry all six stages — a hand-off that loses the span context
+    shows up here as a missing stage."""
+
+    N = 24
+
+    def _run(self):
+        from k8s_watcher_tpu.notify.client import ClusterApiClient
+
+        server = ThreadingHTTPServer(("127.0.0.1", 0), _CountingSink)
+        server.daemon_threads = True
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        metrics = MetricsRegistry()
+        tracer = Tracer(sample_rate=1, ring_size=64, metrics=metrics)
+        # generous timeout: a GIL-starved suite run must not turn a slow
+        # local response into a retry-then-fail flake
+        client = ClusterApiClient(
+            f"http://127.0.0.1:{server.server_address[1]}", timeout=30.0, pool_size=4
+        )
+        dispatcher = Dispatcher(
+            client.update_pod_status, workers=4, metrics=metrics, tracer=tracer
+        )
+        dispatcher.start()
+        pipeline = EventPipeline(
+            environment="development", sink=dispatcher.submit,
+            slice_tracker=SliceTracker("development"), metrics=metrics,
+            tracer=tracer,
+        )
+        source = ShardedWatchSource(
+            sharded_fake_sources([tpu_event(i) for i in range(self.N)], 2),
+            batch_max=8, queue_capacity=256, tracer=tracer,
+        )
+        source.start()
+        processed = 0
+        for batch in source.batches():
+            pipeline.process_batch(batch)
+            processed += len(batch)
+            if processed >= self.N:
+                break
+        source.stop()
+        assert dispatcher.drain(10.0)
+        dispatcher.stop()
+        server.shutdown()
+        server.server_close()
+        return tracer, metrics
+
+    def test_every_sent_journey_carries_all_six_stages_in_order(self):
+        tracer, metrics = self._run()
+        sent = [t for t in tracer.ring.snapshot() if t["outcome"] == "sent"]
+        assert len(sent) == self.N
+        for entry in sent:
+            stages = [s["stage"] for s in entry["spans"]]
+            # completeness: all six stages present, first occurrences in
+            # hand-off order. A stale-connection resend under load may
+            # legitimately repeat conn_borrow/post (retries append spans,
+            # they never lose the context) — dedup before comparing.
+            assert set(stages) == set(STAGES), entry
+            assert list(dict.fromkeys(stages)) == list(STAGES), entry
+            assert entry["sampled_by"] == "head"
+            assert entry["lane"] is not None and entry["shard"] in (0, 1)
+            assert entry["attempts"] >= 1
+            # spans are offsets from the watch-read stamp; the first five
+            # hand-offs start in order, and conn_borrow nests INSIDE the
+            # post window (the pool acquire happens within the send)
+            spans = {s["stage"]: s for s in entry["spans"]}
+            starts = [s["start_ms"] for s in entry["spans"][:5]]
+            assert starts == sorted(starts), entry
+            post, borrow = spans["post"], spans["conn_borrow"]
+            assert post["start_ms"] <= borrow["start_ms"], entry
+            assert (
+                borrow["start_ms"] + borrow["duration_ms"]
+                <= post["start_ms"] + post["duration_ms"] + 1e-3
+            ), entry
+            assert entry["watch_to_notify_ms"] is not None
+            assert entry["slowest_stage"] in STAGES
+        # both shard pumps and several lanes actually participated
+        assert {t["shard"] for t in sent} == {0, 1}
+        assert len({t["lane"] for t in sent}) > 1
+
+    def test_end_to_end_histogram_counts_every_clean_send(self):
+        tracer, metrics = self._run()
+        assert metrics.histogram("watch_to_notify_seconds").count == self.N
+        # per-stage attribution histograms populated for every stage
+        for stage in STAGES:
+            assert metrics.histogram(f"trace_stage_{stage}").count == self.N
+
+
+class TestHotPathNoAlloc:
+    """The unsampled 255/256 path is the 30k events/s steady state: the
+    pump's inlined sampler must touch NOTHING on the event and allocate
+    NOTHING in the trace module."""
+
+    def _pump(self, n_events: int, sample_rate: int) -> Tracer:
+        tracer = Tracer(sample_rate=sample_rate, ring_size=8)
+        source = ShardedWatchSource(
+            sharded_fake_sources([tpu_event(i) for i in range(n_events)], 1),
+            batch_max=64, queue_capacity=n_events + 1, tracer=tracer,
+        )
+        source.start()
+        drained = 0
+        for batch in source.batches():
+            drained += len(batch)
+            if drained >= n_events:
+                break
+        source.stop()
+        return tracer
+
+    def test_unsampled_events_carry_no_trace_and_start_is_not_called(self):
+        n, calls = 512, []
+        tracer_holder = {}
+
+        class CountingTracer(Tracer):
+            def start(self, event, shard=None):
+                calls.append(event.uid)
+                return super().start(event, shard)
+
+        tracer = CountingTracer(sample_rate=256, ring_size=8)
+        tracer_holder["t"] = tracer
+        events = [tpu_event(i) for i in range(n)]
+        source = ShardedWatchSource(
+            sharded_fake_sources(events, 1), batch_max=64,
+            queue_capacity=n + 1, tracer=tracer,
+        )
+        source.start()
+        drained = []
+        for batch in source.batches():
+            drained.extend(batch)
+            if len(drained) >= n:
+                break
+        source.stop()
+        # one shard stream samples its 1st, 257th, 513th... pod event
+        assert len(calls) == 2
+        traced = [e for e in drained if e.trace is not None]
+        assert len(traced) == 2
+        for event in drained:
+            if event.trace is None:
+                assert event.trace is None  # no attribute write either way
+
+    def test_unsampled_pump_allocates_nothing_in_the_trace_module(self):
+        import k8s_watcher_tpu.trace.trace as trace_mod
+
+        # warm caches outside the measured window
+        self._pump(32, sample_rate=10**6)
+        trace_file = trace_mod.__file__
+        tracemalloc.start()
+        try:
+            self._pump(512, sample_rate=10**6)  # samples ONLY the first event
+            snapshot = tracemalloc.take_snapshot()
+        finally:
+            tracemalloc.stop()
+        in_trace_module = [
+            stat for stat in snapshot.statistics("filename")
+            if stat.traceback[0].filename == trace_file
+        ]
+        # the single sampled head event owns whatever shows up; 511
+        # unsampled events must contribute zero allocations here — gate
+        # generously above one Trace's footprint but far below 511 of them
+        total = sum(stat.size for stat in in_trace_module)
+        assert total < 4096, in_trace_module
+
+
+class TestDebugTraceRoute:
+    def _server(self, ring):
+        from k8s_watcher_tpu.metrics.server import Liveness, StatusServer
+
+        return StatusServer(MetricsRegistry(), Liveness(), trace=ring).start()
+
+    def _trace(self, tracer, uid, slow_stage):
+        trace = tracer.start(
+            WatchEvent(
+                type=EventType.ADDED,
+                pod=build_pod(uid, uid=uid, tpu_chips=4),
+            )
+        )
+        t0 = trace.t0
+        for i, stage in enumerate(STAGES):
+            width = 0.5 if stage == slow_stage else 0.001
+            trace.add_span(stage, t0 + i, t0 + i + width)
+        tracer.finish(trace, "sent", end=t0 + len(STAGES))
+        return trace
+
+    def test_filters_and_errors(self):
+        tracer = Tracer(sample_rate=1, ring_size=16)
+        self._trace(tracer, "uid-a", "post")
+        self._trace(tracer, "uid-b", "lane_wait")
+        self._trace(tracer, "uid-c", "lane_wait")
+        server = self._server(tracer.ring)
+        try:
+            base = f"http://127.0.0.1:{server.port}/debug/trace"
+            body = requests.get(base, timeout=5).json()
+            assert body["ring_size"] == 3 and body["stages"] == list(STAGES)
+            # newest first
+            assert [t["uid"] for t in body["traces"]] == ["uid-c", "uid-b", "uid-a"]
+            assert [s["stage"] for s in body["traces"][0]["spans"]] == list(STAGES)
+            by_uid = requests.get(f"{base}?uid=uid-a", timeout=5).json()["traces"]
+            assert [t["uid"] for t in by_uid] == ["uid-a"]
+            slow = requests.get(f"{base}?slowest=lane_wait", timeout=5).json()["traces"]
+            assert sorted(t["uid"] for t in slow) == ["uid-b", "uid-c"]
+            assert all(t["slowest_stage"] == "lane_wait" for t in slow)
+            capped = requests.get(f"{base}?slowest=lane_wait&n=1", timeout=5).json()
+            assert [t["uid"] for t in capped["traces"]] == ["uid-c"]
+            assert requests.get(f"{base}?slowest=nonsense", timeout=5).status_code == 400
+            assert requests.get(f"{base}?n=junk", timeout=5).status_code == 400
+        finally:
+            server.stop()
+
+    def test_404_when_not_wired(self):
+        from k8s_watcher_tpu.metrics.server import Liveness, StatusServer
+
+        server = StatusServer(MetricsRegistry(), Liveness()).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}/debug/trace"
+            assert requests.get(url, timeout=5).status_code == 404
+        finally:
+            server.stop()
+
+    def test_ring_bounded_newest_wins(self):
+        ring = TraceRing(capacity=2)
+        tracer = Tracer(sample_rate=1)
+        tracer.ring = ring
+        for uid in ("u1", "u2", "u3"):
+            self._trace(tracer, uid, "post")
+        assert [t["uid"] for t in ring.snapshot()] == ["u3", "u2"]
+
+
+GOLDEN_EXPOSITION = """\
+# TYPE k8s_watcher_events_received_total counter
+k8s_watcher_events_received_total 3
+# TYPE k8s_watcher_queue_depth gauge
+k8s_watcher_queue_depth 7.5
+# TYPE k8s_watcher_watch_to_notify_seconds histogram
+k8s_watcher_watch_to_notify_seconds_bucket{le="1e-05"} 0
+k8s_watcher_watch_to_notify_seconds_bucket{le="3.16e-05"} 0
+k8s_watcher_watch_to_notify_seconds_bucket{le="0.0001"} 0
+k8s_watcher_watch_to_notify_seconds_bucket{le="0.000316"} 0
+k8s_watcher_watch_to_notify_seconds_bucket{le="0.001"} 0
+k8s_watcher_watch_to_notify_seconds_bucket{le="0.00316"} 1
+k8s_watcher_watch_to_notify_seconds_bucket{le="0.01"} 1
+k8s_watcher_watch_to_notify_seconds_bucket{le="0.0316"} 1
+k8s_watcher_watch_to_notify_seconds_bucket{le="0.1"} 1
+k8s_watcher_watch_to_notify_seconds_bucket{le="0.316"} 1
+k8s_watcher_watch_to_notify_seconds_bucket{le="1"} 2
+k8s_watcher_watch_to_notify_seconds_bucket{le="3.16"} 2
+k8s_watcher_watch_to_notify_seconds_bucket{le="10"} 2
+k8s_watcher_watch_to_notify_seconds_bucket{le="31.6"} 2
+k8s_watcher_watch_to_notify_seconds_bucket{le="100"} 2
+k8s_watcher_watch_to_notify_seconds_bucket{le="+Inf"} 2
+k8s_watcher_watch_to_notify_seconds_sum 0.502
+k8s_watcher_watch_to_notify_seconds_count 2
+"""
+
+
+class TestPrometheusGolden:
+    def test_exposition_is_byte_stable(self):
+        # golden output: bucket boundaries, downsampling, unit-suffix
+        # handling and cumulative counts are all LOAD-BEARING for scrapers
+        # — a drive-by change to any of them must fail loudly, not ship
+        reg = MetricsRegistry()
+        reg.counter("events_received").inc(3)
+        reg.gauge("queue_depth").set(7.5)
+        h = reg.histogram("watch_to_notify_seconds")
+        h.record(0.002)
+        h.record(0.5)
+        assert reg.prometheus_text() == GOLDEN_EXPOSITION
+
+    def test_json_snapshot_and_exposition_share_boundaries(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("watch_to_notify_seconds")
+        h.record(0.002)
+        summary_bounds = [b for b, _ in h.summary()["buckets_le_s"]]
+        text = reg.prometheus_text()
+        text_bounds = [
+            line.split('le="')[1].split('"')[0]
+            for line in text.splitlines() if 'le="' in line
+        ]
+        rendered = [
+            "+Inf" if b == "+Inf" else f"{b:.3g}" for b in summary_bounds
+        ]
+        assert rendered == text_bounds
+
+
+class TestEgressAuditOutcomes:
+    def test_sent_and_failed_outcomes_ride_the_ring_with_lane_and_attempts(self):
+        from k8s_watcher_tpu.metrics.audit import AuditRing
+
+        ring = AuditRing(16)
+        verdicts = iter([True, False])
+        tracer = Tracer(sample_rate=1, metrics=MetricsRegistry())
+        dispatcher = Dispatcher(
+            lambda payload: next(verdicts), workers=1, tracer=tracer, audit=ring
+        )
+        dispatcher.start()
+        for i in range(2):
+            event = tpu_event(i)
+            trace = tracer.start(event)
+            dispatcher.submit(
+                Notification(
+                    {"uid": f"uid-{i}", "name": f"pod-{i}"},
+                    event.received_monotonic, kind="pod", trace=trace,
+                )
+            )
+            assert dispatcher.drain(5.0)
+        dispatcher.stop()
+        entries = [e for e in ring.snapshot() if e.get("kind") == "egress"]
+        assert [e["outcome"] for e in entries] == ["failed", "sent"]  # newest first
+        for entry in entries:
+            assert entry["lane"] == 0
+            assert entry["trace_id"]
+            assert entry["uid"].startswith("uid-")
+            # attempt counts are stamped by the real notify client's POST
+            # loop (note_send_attempt); this bare-callable sink makes none
+            # — the real-client path is pinned in TestSpanTreeCompleteness
+            assert entry["attempts"] == 0
+
+    def test_debug_events_uid_filter_joins_pipeline_and_egress_entries(self):
+        from k8s_watcher_tpu.metrics.audit import AuditRing
+        from k8s_watcher_tpu.metrics.server import Liveness, StatusServer
+
+        ring = AuditRing(16)
+        ring.record({"event_type": "ADDED", "uid": "u-1", "outcome": "notified"})
+        ring.record({"event_type": "ADDED", "uid": "u-2", "outcome": "notified"})
+        ring.record({"kind": "egress", "uid": "u-1", "outcome": "sent", "lane": 0})
+        server = StatusServer(MetricsRegistry(), Liveness(), audit=ring).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}/debug/events?uid=u-1"
+            events = requests.get(url, timeout=5).json()["events"]
+            # one pod's WHOLE journey, newest first: egress outcome then
+            # pipeline decision — and nothing about other pods
+            assert [e["outcome"] for e in events] == ["sent", "notified"]
+            assert all(e["uid"] == "u-1" for e in events)
+        finally:
+            server.stop()
+
+    def test_untraced_sends_audit_without_trace_id(self):
+        from k8s_watcher_tpu.metrics.audit import AuditRing
+
+        ring = AuditRing(8)
+        dispatcher = Dispatcher(lambda payload: True, workers=1, audit=ring)
+        dispatcher.start()
+        dispatcher.submit(Notification({"uid": "u", "name": "p"}, time.monotonic(), kind="pod"))
+        assert dispatcher.drain(5.0)
+        dispatcher.stop()
+        entry = next(e for e in ring.snapshot() if e.get("kind") == "egress")
+        assert entry["outcome"] == "sent" and "trace_id" not in entry
+
+
+class TestHealthzEgressLiveness:
+    def test_wedged_lane_past_stall_threshold_is_unhealthy(self):
+        release = threading.Event()
+        dispatcher = Dispatcher(lambda payload: release.wait(10.0), workers=1)
+        dispatcher.start()
+        t0 = time.monotonic()
+        dispatcher.submit(Notification({"uid": "a"}, t0, kind="pod"))
+        dispatcher.submit(Notification({"uid": "b"}, t0, kind="pod"))  # backlog
+        time.sleep(0.3)
+        verdict = dispatcher.egress_health(stall_after_seconds=0.1)
+        assert verdict["healthy"] is False
+        assert verdict["stalled_lanes"] and verdict["stalled_lanes"][0]["depth"] >= 1
+        release.set()
+        dispatcher.drain(5.0)
+        # progress resumed: healthy again
+        assert dispatcher.egress_health(stall_after_seconds=0.1)["healthy"] is True
+        dispatcher.stop()
+
+    def test_healthz_route_folds_egress_verdict(self):
+        from k8s_watcher_tpu.metrics.server import Liveness, StatusServer
+
+        state = {"healthy": True}
+        server = StatusServer(
+            MetricsRegistry(), Liveness(), egress=lambda: dict(state)
+        ).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}/healthz"
+            ok = requests.get(url, timeout=5)
+            assert ok.status_code == 200 and ok.json()["egress"]["healthy"] is True
+            state["healthy"] = False
+            sick = requests.get(url, timeout=5)
+            assert sick.status_code == 503
+            body = sick.json()
+            # the watch loop is fine; egress alone turned the verdict
+            assert body["watch_alive"] is True and body["alive"] is False
+        finally:
+            server.stop()
+
+    def test_never_started_dispatcher_reports_healthy(self):
+        dispatcher = Dispatcher(lambda payload: True, workers=2)
+        assert dispatcher.egress_health()["healthy"] is True
+
+
+class TestTraceIdInLogs:
+    def test_json_formatter_carries_trace_id(self):
+        import logging
+
+        from k8s_watcher_tpu.logging_setup import JsonFormatter
+
+        record = logging.LogRecord(
+            "k8s_watcher_tpu.trace.trace", logging.INFO, __file__, 1,
+            "trace %s", ("abc",), None,
+        )
+        record.trace_id = "dead-00000001"
+        payload = json.loads(JsonFormatter("production").format(record))
+        assert payload["trace_id"] == "dead-00000001"
+
+    def test_finish_emits_correlatable_line(self, caplog):
+        import logging
+
+        tracer = Tracer(sample_rate=1)
+        trace = tracer.start(tpu_event(0))
+        trace.add_span("post", trace.t0, trace.t0 + 0.01)
+        with caplog.at_level(logging.INFO, logger="k8s_watcher_tpu.trace.trace"):
+            tracer.finish(trace, "failed")  # anomaly -> INFO
+        matching = [r for r in caplog.records if getattr(r, "trace_id", None)]
+        assert matching and matching[0].trace_id == trace.trace_id
