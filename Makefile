@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: test check check-scale integration integration-kind integration-mock bench bench-smoke trace-smoke dryrun dryrun-128 accept
+.PHONY: test check check-scale integration integration-kind integration-mock bench bench-smoke trace-smoke serve-smoke dryrun dryrun-128 accept
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -48,6 +48,16 @@ bench-smoke:
 # at 1/256) is gated by bench-smoke (bench_trace_overhead).
 trace-smoke:
 	$(PY) scripts/trace_smoke.py
+
+# Serving-plane protocol smoke: boot the mock cluster through the REAL
+# app wiring with serve.enabled + a bearer token, then drive consumers
+# over real HTTP through every leg — snapshot, resumable delta long-poll
+# (gap/dup checked), chunked streaming watch, 410→re-snapshot resync,
+# 401 auth posture, /healthz folding. Artifact: artifacts/serve_smoke.json.
+# The 5k-subscriber fan-out SCALE is gated by bench-smoke
+# (bench_serve_fanout); this target gates the protocol.
+serve-smoke:
+	$(PY) scripts/serve_smoke.py
 
 dryrun:
 	$(PY) __graft_entry__.py 8

@@ -1498,6 +1498,318 @@ def _last_good_probe() -> dict | None:
     return None
 
 
+def bench_serve_fanout(
+    n_subscribers: int = 5000,
+    events_per_sec: float = 1500.0,
+    seconds: float = 3.0,
+    attempts: int = 3,
+    **kw,
+) -> dict:
+    """Retry wrapper around the fan-out tier — for STARVATION legs only
+    (throughput and hard-path coverage). Wall-clock eps on this host
+    swings +-50% between ADJACENT runs under co-tenants (see
+    bench_trace_overhead's min-of-rounds note): a starved attempt can
+    both miss the eps bar and journal too few deltas for the 410 leg to
+    fire, and either is worth retrying. A correctness failure
+    (gaps/dups/lost updates/unconverged checkers) stops the wrapper
+    COLD and is reported as-is: races are exactly the bugs that pass 2
+    attempts in 3, so "best of N" must never get to vote on them.
+    Per-attempt history is attached either way."""
+    history = []
+    best = None
+    for _ in range(max(1, attempts)):
+        result = _bench_serve_fanout_once(
+            n_subscribers=n_subscribers,
+            events_per_sec=events_per_sec,
+            seconds=seconds,
+            **kw,
+        )
+        history.append(
+            {
+                k: result[k]
+                for k in (
+                    "events_per_sec", "gaps", "dups", "gone_resyncs",
+                    "resume_reconnects", "correctness_ok", "coverage_ok", "ok",
+                )
+            }
+        )
+        if best is None or result["events_per_sec"] > best["events_per_sec"]:
+            best = result
+        if result["ok"] or not result["correctness_ok"]:
+            best = result
+            break
+    best["attempts"] = history
+    return best
+
+
+def _bench_serve_fanout_once(
+    n_subscribers: int = 5000,
+    events_per_sec: float = 1500.0,
+    seconds: float = 3.0,
+    n_keys: int = 512,
+    queue_depth: int = 512,
+    compact_horizon: int = 1024,
+    pollers: int = 4,
+    checkers: int = 64,
+    laggards: int = 32,
+    slowpokes: int = 256,
+    min_events_per_sec: float = 1000.0,
+) -> dict:
+    """Serving-plane fan-out: N concurrent subscribers against one
+    FleetView while a paced publisher churns pod state, with a
+    per-subscriber sequence checker proving ZERO gaps and ZERO dups.
+
+    What the checker enforces (the view's rv space is dense — every
+    applied delta is exactly one rv):
+
+    - raw (uncompacted) batches must carry exactly ``to_rv - from_rv``
+      deltas — a missing delta in a contiguous range is a GAP;
+    - every batch's first delta must be > the resume token and rvs must
+      ascend — a repeat is a DUP;
+    - a sampled subset (``checkers``) replays every delivered delta into
+      a model map; at the end every model must equal the independently
+      maintained shadow of what the publisher wrote (catches lost
+      updates that rv accounting alone cannot see, including through
+      latest-wins compaction and 410 resyncs).
+
+    Churn built into the run: ``slowpokes`` poll rarely enough to exceed
+    ``queue_depth`` (exercising latest-wins compaction), ``laggards``
+    are not polled at all until the drain phase (falling behind
+    ``compact_horizon`` -> 410 -> re-snapshot resync), and a rotating
+    subset reconnects with its resume token mid-run.
+    """
+    from k8s_watcher_tpu.metrics import MetricsRegistry
+    from k8s_watcher_tpu.serve import GONE, FleetView, SubscriptionHub
+
+    metrics = MetricsRegistry()
+    view = FleetView(compact_horizon=compact_horizon, metrics=metrics)
+    hub = SubscriptionHub(
+        view, max_subscribers=n_subscribers, queue_depth=queue_depth, metrics=metrics
+    )
+
+    checker_stride = max(1, n_subscribers // max(1, checkers))
+    subs = []  # [sub, model-or-None, role] ; role: 0 normal, 1 slowpoke, 2 laggard
+    for i in range(n_subscribers):
+        sub = hub.subscribe(rv=0)
+        if sub is None:
+            break
+        model = {} if i % checker_stride == 0 else None
+        role = 2 if i < laggards else (1 if i % max(1, n_subscribers // max(1, slowpokes)) == 1 else 0)
+        subs.append([sub, model, role])
+    # make sure the resync/compaction paths are exercised by CHECKED subs
+    for entry in subs[: laggards + 8]:
+        if entry[1] is None:
+            entry[1] = {}
+
+    shadow: dict = {}  # the publisher's independent truth (key -> object)
+    shadow_lock = threading.Lock()
+    publishing = threading.Event()
+    publishing.set()
+    stop = threading.Event()
+    stats_lock = threading.Lock()
+    stats = {
+        "gaps": 0, "dups": 0, "delivered": 0, "pulls": 0,
+        "compacted_pulls": 0, "gone_resyncs": 0, "resumes": 0,
+    }
+
+    def publish(i: int) -> None:
+        key = f"pod-{i % n_keys}"
+        if i % 97 == 96:  # periodic deletes keep the DELETE path honest
+            view.apply("pod", key, None)
+            with shadow_lock:
+                shadow.pop(("pod", key), None)
+            return
+        obj = {
+            "kind": "pod", "key": key, "phase": ("Pending", "Running")[i % 2],
+            "seq": i,
+        }
+        view.apply("pod", key, obj)
+        with shadow_lock:
+            shadow[("pod", key)] = obj
+
+    published = 0
+    publish_elapsed = [0.0]
+
+    def publisher() -> None:
+        nonlocal published
+        start = time.monotonic()
+        i = 0
+        while True:
+            elapsed = time.monotonic() - start
+            if elapsed >= seconds:
+                break
+            target = int(elapsed * events_per_sec)
+            while i < target:
+                publish(i)
+                i += 1
+            time.sleep(0.002)
+        published = i
+        publish_elapsed[0] = time.monotonic() - start
+        publishing.clear()
+
+    def pull_once(entry, local) -> None:
+        sub, model, _role = entry
+        result = sub.pull(timeout=0.0)
+        local["pulls"] += 1
+        if result.status == GONE:
+            # the documented resync: re-snapshot, rebase the cursor
+            local["gone_resyncs"] += 1
+            rv, objects = view.snapshot()
+            if model is not None:
+                model.clear()
+                model.update({(o["kind"], o["key"]): o for o in objects})
+            sub.rebase(rv)
+            return
+        deltas = result.deltas
+        if not deltas:
+            return
+        local["delivered"] += len(deltas)
+        if result.compacted:
+            local["compacted_pulls"] += 1
+        elif len(deltas) != result.to_rv - result.from_rv:
+            local["gaps"] += 1  # dense rv space: a short raw range lost a delta
+        prev_rv = result.from_rv
+        if model is not None:
+            for d in deltas:
+                if d.rv <= prev_rv:
+                    local["dups"] += 1
+                prev_rv = d.rv
+                if d.type == "DELETE":
+                    model.pop((d.kind, d.key), None)
+                else:
+                    model[(d.kind, d.key)] = d.object
+        else:
+            if deltas[0].rv <= prev_rv or deltas[-1].rv != result.to_rv:
+                local["dups"] += 1
+
+    def poller(my_subs) -> None:
+        local = dict.fromkeys(stats, 0)
+        sweep = 0
+        while not stop.is_set():
+            sweep += 1
+            live = publishing.is_set()
+            for idx, entry in enumerate(my_subs):
+                role = entry[2]
+                if live and role == 2:
+                    continue  # laggards sit out until the drain phase
+                if live and role == 1 and sweep % 4:
+                    continue  # slowpokes poll rarely -> compaction engages
+                pull_once(entry, local)
+                if live and idx % 16 == sweep % 16 and role == 0:
+                    # reconnect with the resume token: a NEW subscription
+                    # resuming exactly where the old cursor stopped. A
+                    # rotating ~1/16 of the normal subscribers per SWEEP
+                    # (sweeps are few inside a 3 s window — a per-N-sweeps
+                    # schedule silently never fired)
+                    old = entry[0]
+                    hub.unsubscribe(old)
+                    fresh = hub.subscribe(rv=old.rv)
+                    if fresh is not None:
+                        entry[0] = fresh
+                        local["resumes"] += 1
+            # live cadence keeps a healthy subscriber's backlog under
+            # queue_depth (raw contiguous slices — C-speed ref copies,
+            # ~10x cheaper than the per-delta latest-wins walk); polling
+            # much faster trades that for per-pull overhead x 5k
+            time.sleep(0.15 if live else 0.005)
+        with stats_lock:
+            for k, v in local.items():
+                stats[k] += v
+
+    pub_thread = threading.Thread(target=publisher, daemon=True)
+    shards = [subs[i::pollers] for i in range(pollers)]
+    poll_threads = [threading.Thread(target=poller, args=(s,), daemon=True) for s in shards]
+    pub_thread.start()
+    for t in poll_threads:
+        t.start()
+    pub_thread.join(timeout=seconds + 30)
+    # An extreme co-tenant stall can leave the publisher alive past the
+    # join budget; every comparison below (shadow, snapshot, eps) would
+    # then race a still-mutating publisher and report phantom
+    # correctness failures. Such an attempt is UNEVALUABLE starvation:
+    # flagged here, excused from the correctness legs, failed on the
+    # (retryable) coverage leg.
+    publisher_hung = pub_thread.is_alive()
+    # drain: every subscriber (laggards included now) catches up to the
+    # final view rv — bounded, so a wedged subscriber fails loudly
+    final_rv = view.rv
+    drain_deadline = time.monotonic() + 20.0
+    while time.monotonic() < drain_deadline:
+        if all(entry[0].rv >= final_rv for entry in subs):
+            break
+        time.sleep(0.02)
+    stop.set()
+    for t in poll_threads:
+        t.join(timeout=10)
+
+    converged = sum(1 for entry in subs if entry[0].rv >= final_rv)
+    # the view itself must agree with the publisher's independent shadow
+    _, objects = view.snapshot()
+    view_state = {(o["kind"], o["key"]): o for o in objects}
+    view_matches = view_state == shadow
+    model_checkers = [entry for entry in subs if entry[1] is not None]
+    # model equality is only meaningful for checkers that caught up —
+    # a starved checker short of final_rv trivially mismatches, and that
+    # is the (retryable) drain-budget leg's problem, not a replay bug
+    caught_up = [entry for entry in model_checkers if entry[0].rv >= final_rv]
+    models_ok = sum(1 for entry in caught_up if entry[1] == shadow)
+    eps = published / publish_elapsed[0] if publish_elapsed[0] else 0.0
+    # Three SEPARATE verdict legs, because the retry wrapper treats them
+    # differently: a correctness failure (possibly a nondeterministic
+    # race) must never be retried away, while coverage and throughput
+    # shortfalls are starvation artifacts a co-tenant spike can cause.
+    correctness_ok = publisher_hung or (
+        stats["gaps"] == 0
+        and stats["dups"] == 0
+        and view_matches
+        and models_ok == len(caught_up)
+        and len(subs) >= n_subscribers
+    )
+    # coverage: the hard paths actually ran AND everyone caught up within
+    # the wall-clock drain budget this attempt. Both are timing-bound on
+    # a co-tenant host (a starved publisher journals too few deltas to
+    # push anyone past the horizon; a starved drain leaves slowpokes
+    # short of final_rv with zero gaps) — retryable, NOT protocol bugs.
+    # A genuine wedge still goes red: it fails every attempt.
+    coverage_ok = (
+        not publisher_hung
+        and stats["gone_resyncs"] > 0  # the 410 resync path actually ran
+        and stats["resumes"] > 0  # ...and so did mid-run token reconnects
+        and converged == len(subs)
+    )
+    # the throughput leg of the acceptance bar: the paced publisher must
+    # actually have sustained >= 1k events/s INTO 5k subscribers
+    ok = correctness_ok and coverage_ok and eps >= min_events_per_sec
+    lag = metrics.histogram("serve_delta_lag_seconds").summary()
+    return {
+        "subscribers": len(subs),
+        "events_published": published,
+        "events_per_sec": round(eps, 1),
+        "offered_events_per_sec": events_per_sec,
+        "publish_seconds": round(publish_elapsed[0], 3),
+        "final_rv": final_rv,
+        "gaps": stats["gaps"],
+        "dups": stats["dups"],
+        "delivered_deltas": stats["delivered"],
+        "pulls": stats["pulls"],
+        "compacted_pulls": stats["compacted_pulls"],
+        "gone_resyncs": stats["gone_resyncs"],
+        "resume_reconnects": stats["resumes"],
+        "converged_subscribers": converged,
+        "state_checkers": len(model_checkers),
+        "state_checkers_converged": models_ok,
+        "view_matches_shadow": view_matches,
+        "delta_lag_p99_ms": lag.get("p99_ms"),
+        "queue_depth": queue_depth,
+        "compact_horizon": compact_horizon,
+        "min_events_per_sec": min_events_per_sec,
+        "publisher_hung": publisher_hung,
+        "correctness_ok": correctness_ok,
+        "coverage_ok": coverage_ok,
+        "ok": ok,
+    }
+
+
 def main(smoke: bool = False) -> int:
     if smoke:
         # bounded-budget smoke tier (make bench-smoke / the slow-marked
@@ -1534,6 +1846,13 @@ def main(smoke: bool = False) -> int:
         # replay round ~0.25 s — enough work that perf_counter jitter is
         # invisible against the ~20 us/event hot-path budget
         trace_overhead = bench_trace_overhead(n_events=12_000)
+        # serving-plane fan-out at FULL subscriber scale (subscriptions
+        # are cursors, so 5k of them are cheap to register) with a
+        # shortened publish window — the gap/dup/resync machinery is
+        # exercised end to end in a few seconds per attempt (the journal
+        # must outgrow the compaction horizon within the window for the
+        # 410 leg to run, so don't shrink below ~3 s)
+        serve_fanout = bench_serve_fanout(seconds=3.0)
         skipped = {"skipped": "smoke"}
         pipeline_stats = pipeline_500 = scan_stats = skipped
         relist_50k = checkpoint_50k = virtual_stats = probe_stats = skipped
@@ -1549,6 +1868,7 @@ def main(smoke: bool = False) -> int:
         egress = bench_egress_saturation()
         burst_stats = bench_burst_drain()
         trace_overhead = bench_trace_overhead()
+        serve_fanout = bench_serve_fanout(seconds=6.0)
         scan_stats = bench_frame_scan()
         relist_stats = bench_relist_scale()
         relist_50k = bench_relist_scale(n_pods=50_000)
@@ -1568,6 +1888,7 @@ def main(smoke: bool = False) -> int:
         "egress_saturation": egress,
         "burst": burst_stats,
         "trace_overhead": trace_overhead,
+        "serve_fanout": serve_fanout,
         "frame_scan": scan_stats,
         "relist_10k": relist_stats,
         "relist_50k": relist_50k,
@@ -1611,6 +1932,11 @@ def main(smoke: bool = False) -> int:
         # sampled end-to-end latency + the tracing plane's overhead gate
         "watch_to_notify_p50_ms": (trace_overhead.get("watch_to_notify") or {}).get("p50_ms"),
         "trace_overhead_pct": trace_overhead.get("overhead_pct"),
+        # serving plane: N concurrent subscribers x published events/s,
+        # ok = zero gaps/dups + every subscriber converged (incl. 410 resync)
+        "serve_subscribers": serve_fanout.get("subscribers"),
+        "serve_events_per_sec": serve_fanout.get("events_per_sec"),
+        "serve_fanout_ok": serve_fanout.get("ok", False),
         "relist_10k_ms": relist_stats.get("relist_ms"),
         "relist_shard_speedup": relist_stats.get("shard_speedup"),
         "checkpoint_10k_flush_ms": checkpoint_stats.get("flush_ms_median"),

@@ -190,6 +190,21 @@ class WatcherApp:
                 metrics=self.metrics,
             )
         self.status_server: Optional[StatusServer] = None
+        # fleet-state serving plane (serve/): a materialized view of pod/
+        # slice/probe state with resumable snapshot+delta subscriptions.
+        # The view exists from construction (the pipeline publishes into
+        # it); its HTTP server starts in run() with the other servers.
+        self.serve = None
+        if config.serve.enabled:
+            from k8s_watcher_tpu.serve import ServePlane
+
+            self.serve = ServePlane(
+                config.serve,
+                metrics=self.metrics,
+                # same bearer contract as the status plane: the serving
+                # plane must not be an unauthenticated side door
+                auth_token=config.watcher.status_auth_token,
+            )
         c = config.clusterapi
         self.dispatcher = Dispatcher(
             self.notifier.update_pod_status,
@@ -213,6 +228,17 @@ class WatcherApp:
             # egress terminal outcomes ride the same ring as pipeline
             # decisions: /debug/events answers both halves of the journey
             audit=self.audit,
+        )
+        # the notification sink every producer uses: when the serving
+        # plane is on, derived payloads (slice aggregates, probe verdicts,
+        # node-plane slice updates) fold into the fleet view on their way
+        # to the dispatcher; pods reach the view via the pipeline's
+        # publish_batch hook instead (it sees every post-filter event,
+        # including ones the critical gate suppresses from notification)
+        self._notify_sink = (
+            self.serve.wrap_sink(self.dispatcher.submit)
+            if self.serve is not None
+            else self.dispatcher.submit
         )
         self.source = source or build_source(
             config, self.checkpoint, self.liveness.beat, self.metrics, self.tracer
@@ -247,7 +273,7 @@ class WatcherApp:
             self.slice_tracker.restore(self.checkpoint.get("slices", {}) or {})
         self.pipeline = EventPipeline(
             environment=config.environment,
-            sink=self.dispatcher.submit,
+            sink=self._notify_sink,
             namespace_filter=NamespaceFilter(config.watcher.namespaces),
             resource_filter=TpuResourceFilter(config.tpu.resource_key),
             critical_gate=CriticalEventGate(config.environment, config.watcher.critical_events_only),
@@ -256,6 +282,7 @@ class WatcherApp:
             metrics=self.metrics,
             audit=self.audit,
             tracer=self.tracer,
+            view=self.serve.view if self.serve is not None else None,
             resource_key=config.tpu.resource_key,
             topology_label=config.tpu.topology_label,
             accelerator_label=config.tpu.accelerator_label,
@@ -271,13 +298,17 @@ class WatcherApp:
             self._probe_agent = ProbeAgent(
                 config.tpu,
                 environment=config.environment,
-                sink=self.dispatcher.submit,
+                sink=self._notify_sink,
                 metrics=self.metrics,
             )
 
     def run(self) -> None:
         """Blocking steady-state loop (parity: pod_watcher.py:243-277)."""
         self.dispatcher.start()
+        if self.serve is not None:
+            # before the status server so /healthz's serve verdict always
+            # reflects a STARTED plane (never a transiently-absent server)
+            self.serve.start()
         if self.config.watcher.status_port:
             agent_trend = (
                 self._probe_agent.trend.snapshot
@@ -301,6 +332,9 @@ class WatcherApp:
                 # /healthz covers the egress side too: all-workers-dead or
                 # a wedged lane past the stall threshold turns it 503
                 egress=lambda: self.dispatcher.egress_health(stall_after),
+                # /healthz covers the serving plane too: a dead serve
+                # thread silently starves every subscriber
+                serve=self.serve.health if self.serve is not None else None,
                 slices=self.slice_tracker.debug_snapshot,
                 trend=agent_trend,
                 remediation=remediation_state,
@@ -462,7 +496,7 @@ class WatcherApp:
             # its own (same connection/credentials)
             K8sClient(client.connection, request_timeout=self.config.kubernetes.request_timeout),
             tracker,
-            self.dispatcher.submit,
+            self._notify_sink,
             slice_tracker=self.slice_tracker,
             label_selector=self.config.tpu.node_watch_label_selector,
             retry=self.config.watcher.retry,
@@ -519,6 +553,8 @@ class WatcherApp:
         if self.status_server is not None:
             self.status_server.stop()
             self.status_server = None
+        if self.serve is not None:
+            self.serve.stop()
         if self._probe_agent is not None:
             self._probe_agent.stop()
         self.dispatcher.stop()

@@ -22,6 +22,7 @@ from k8s_watcher_tpu.config.schema import (  # noqa: F401
     ClusterApiConfig,
     KubernetesConfig,
     RetryPolicy,
+    ServeConfig,
     TpuConfig,
     WatcherConfig,
 )

@@ -714,6 +714,63 @@ class TraceConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """The ``serve:`` section — net-new fleet-state serving plane
+    (serve/): a kube-apiserver-style watch cache over the pipeline's
+    output. ``GET /serve/fleet`` answers a ``{rv, objects}`` snapshot;
+    ``?watch=1&rv=N`` streams resumable deltas from that rv, with
+    latest-wins per-key compaction once a subscriber's backlog exceeds
+    ``queue_depth`` and 410-Gone resync once its resume token falls
+    behind ``compact_horizon`` journaled deltas (ARCHITECTURE.md
+    "Serving plane").
+    """
+
+    enabled: bool = False
+    port: int = 0  # 0 = bind an ephemeral port (tests/smoke); fixed in prod
+    max_subscribers: int = 5000
+    # per-subscriber backlog bound: pulls with more pending deltas than
+    # this are compacted latest-wins per key before delivery
+    queue_depth: int = 128
+    # delta-journal length: resume tokens older than this many deltas get
+    # 410 Gone and must re-snapshot (the serve-side etcd compaction)
+    compact_horizon: int = 8192
+
+    @classmethod
+    def from_raw(cls, raw: Mapping[str, Any]) -> "ServeConfig":
+        _check_known(
+            raw,
+            ("enabled", "port", "max_subscribers", "queue_depth", "compact_horizon"),
+            "serve",
+        )
+        port = _opt_int(raw, "port", "serve", 0)
+        if port < 0 or port > 65535:
+            raise SchemaError(f"config key 'serve.port': must be 0..65535, got {port}")
+        max_subscribers = _opt_int(raw, "max_subscribers", "serve", 5000)
+        if max_subscribers < 1:
+            raise SchemaError(
+                f"config key 'serve.max_subscribers': must be >= 1 (use serve.enabled: "
+                f"false to turn the plane off), got {max_subscribers}"
+            )
+        queue_depth = _opt_int(raw, "queue_depth", "serve", 128)
+        if queue_depth < 1:
+            raise SchemaError(f"config key 'serve.queue_depth': must be >= 1, got {queue_depth}")
+        compact_horizon = _opt_int(raw, "compact_horizon", "serve", 8192)
+        if compact_horizon < queue_depth:
+            raise SchemaError(
+                f"config key 'serve.compact_horizon': must be >= queue_depth "
+                f"({queue_depth}), got {compact_horizon} (a horizon shorter than one "
+                f"subscriber queue would 410 subscribers before lag shedding could engage)"
+            )
+        return cls(
+            enabled=_opt_bool(raw, "enabled", "serve", False),
+            port=port,
+            max_subscribers=max_subscribers,
+            queue_depth=queue_depth,
+            compact_horizon=compact_horizon,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class StateConfig:
     """The ``state:`` section — net-new checkpoint/resume (SURVEY.md §5).
 
@@ -746,13 +803,14 @@ class AppConfig:
     state: StateConfig
     ingest: IngestConfig = dataclasses.field(default_factory=IngestConfig)
     trace: TraceConfig = dataclasses.field(default_factory=TraceConfig)
+    serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
 
-    TOP_LEVEL_KEYS = ("environment", "watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest", "trace")
+    TOP_LEVEL_KEYS = ("environment", "watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest", "trace", "serve")
 
     @classmethod
     def from_raw(cls, raw: Mapping[str, Any], environment: str) -> "AppConfig":
         _check_known(raw, cls.TOP_LEVEL_KEYS, "<root>")
-        for section in ("watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest", "trace"):
+        for section in ("watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest", "trace", "serve"):
             _expect(raw.get(section) or {}, (dict,), section)
         # The reference's development.yaml declared `environment: local` while
         # the CLI only accepted development|staging|production, leaving the
@@ -770,4 +828,5 @@ class AppConfig:
             state=StateConfig.from_raw(raw.get("state") or {}),
             ingest=IngestConfig.from_raw(raw.get("ingest") or {}),
             trace=TraceConfig.from_raw(raw.get("trace") or {}),
+            serve=ServeConfig.from_raw(raw.get("serve") or {}),
         )

@@ -31,7 +31,9 @@ import bisect
 import json
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
+
+from k8s_watcher_tpu.metrics.server import QuietThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
@@ -163,9 +165,15 @@ class MockCluster:
         self._rv = 0
         self._pods: Dict[Tuple[str, str], Dict[str, Any]] = {}
         self._nodes: Dict[str, Dict[str, Any]] = {}
-        # (rv, collection, raw watch event); one cluster-global rv space,
-        # like the real apiserver
-        self._journal: List[Tuple[int, str, Dict[str, Any]]] = []
+        # per-collection event journal as PARALLEL rv/event arrays; one
+        # cluster-global rv space, like the real apiserver, so each
+        # collection's rv list is strictly increasing and a watch poll
+        # resumes by BISECT — O(log n + results) per poll, not the
+        # O(whole-journal) list-comprehension rescan every long-poll
+        # round used to pay (at 10k-pod churn each 0.25 s wakeup walked
+        # every event ever journaled)
+        self._journal_rvs: Dict[str, List[int]] = {}
+        self._journal_events: Dict[str, List[Dict[str, Any]]] = {}
         self._oldest_rv = 0  # journal entries <= this are compacted away
         self._fail_next = 0
         self._fail_status = 500
@@ -254,7 +262,10 @@ class MockCluster:
         with self._lock:
             self._rv += 1
             obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
-            self._journal.append((self._rv, collection, {"type": event_type, "object": json.loads(json.dumps(obj))}))
+            self._journal_rvs.setdefault(collection, []).append(self._rv)
+            self._journal_events.setdefault(collection, []).append(
+                {"type": event_type, "object": json.loads(json.dumps(obj))}
+            )
             self._lock.notify_all()
             return self._rv
 
@@ -506,7 +517,8 @@ class MockCluster:
         gets 410 Gone (simulates apiserver etcd compaction)."""
         with self._lock:
             self._oldest_rv = self._rv
-            self._journal.clear()
+            self._journal_rvs.clear()
+            self._journal_events.clear()
 
     def fail_next(self, n: int = 1, status: int = 500) -> None:
         """Make the next ``n`` HTTP requests fail with ``status``
@@ -604,9 +616,14 @@ class MockCluster:
             while True:
                 if rv < self._oldest_rv:
                     return None  # compacted (possibly while we were waiting)
-                batch = [ev for (erv, coll, ev) in self._journal if erv > rv and coll == collection]
-                if batch:
-                    return batch
+                rvs = self._journal_rvs.get(collection)
+                if rvs:
+                    # the collection's rv list is strictly increasing
+                    # (appends under the cluster-global rv), so the resume
+                    # point is a bisect and the batch is one tail slice
+                    idx = bisect.bisect_right(rvs, rv)
+                    if idx < len(rvs):
+                        return self._journal_events[collection][idx:]
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return []
@@ -976,7 +993,7 @@ class MockApiServer:
         handler = type(
             "BoundHandler", (_Handler,), {"cluster": self.cluster, "server_ref": self}
         )
-        self._server = ThreadingHTTPServer((host, port), handler)
+        self._server = QuietThreadingHTTPServer((host, port), handler)
         self._server.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
 

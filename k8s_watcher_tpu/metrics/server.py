@@ -27,6 +27,55 @@ from urllib.parse import parse_qs, urlparse
 from k8s_watcher_tpu.metrics.metrics import MetricsRegistry
 
 
+class QuietThreadingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that treats a client dropping its keep-alive
+    or watch-stream connection as the normal end of a conversation, not
+    a server error worth a stderr traceback. Shared by every HTTP plane
+    (status, serve, mock apiserver) — consumers disconnecting at will is
+    the steady state for all three."""
+
+    def handle_error(self, request, client_address):
+        import sys
+
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (BrokenPipeError, ConnectionResetError, TimeoutError)):
+            return
+        super().handle_error(request, client_address)
+
+
+def send_json(handler: BaseHTTPRequestHandler, status: int, body: dict) -> None:
+    """One JSON response, Content-Length framed — the shared shape for
+    every status/serve route (keep-alive safe under HTTP/1.1)."""
+    data = json.dumps(body).encode()
+    handler.send_response(status)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(data)))
+    handler.end_headers()
+    handler.wfile.write(data)
+
+
+def bearer_authorized(header: Optional[str], token: Optional[str]) -> bool:
+    """The status plane's bearer check, shared with the serving plane
+    (serve/server.py) so /serve routes get the SAME constant-time token
+    contract instead of a second, weaker implementation.
+
+    ``token is None`` means the plane runs open (in-cluster behind
+    NetworkPolicy — RUNBOOK "Status-server threat model").
+    """
+    if token is None:
+        return True
+    scheme, _, presented = (header or "").partition(" ")
+    # compare bytes: compare_digest raises TypeError on non-ASCII str
+    # (http.server decodes headers as latin-1), which would drop the
+    # connection with a traceback instead of answering 401
+    # auth schemes are case-insensitive (RFC 9110 §11.1); proxies and
+    # some clients normalize to lowercase
+    return scheme.lower() == "bearer" and hmac.compare_digest(
+        presented.strip().encode("utf-8", "surrogateescape"),
+        token.encode("utf-8"),
+    )
+
+
 class Liveness:
     """Heartbeat stamped by the watch loop; consulted by /healthz.
 
@@ -78,6 +127,9 @@ class _StatusHandler(BaseHTTPRequestHandler):
     # Callable[[], dict]: egress-plane liveness verdict
     # (Dispatcher.egress_health); folded into /healthz when wired
     egress = None
+    # Callable[[], dict]: serving-plane liveness (ServePlane.health);
+    # folded into /healthz when the serve plane is enabled
+    serve = None
     slices = None  # Callable[[], dict]: live slice states, optional
     trend = None  # Callable[[], dict]: probe trend anchors/windows, optional
     # Callable[[], Optional[dict]]: remediation policy state; the callable
@@ -99,19 +151,9 @@ class _StatusHandler(BaseHTTPRequestHandler):
         pass
 
     def _authorized(self, path: str) -> bool:
-        if self.auth_token is None or path == "/healthz":
+        if path == "/healthz":
             return True
-        header = self.headers.get("Authorization", "")
-        scheme, _, presented = header.partition(" ")
-        # compare bytes: compare_digest raises TypeError on non-ASCII str
-        # (http.server decodes headers as latin-1), which would drop the
-        # connection with a traceback instead of answering 401
-        # auth schemes are case-insensitive (RFC 9110 §11.1); proxies and
-        # some clients normalize to lowercase
-        return scheme.lower() == "bearer" and hmac.compare_digest(
-            presented.strip().encode("utf-8", "surrogateescape"),
-            self.auth_token.encode("utf-8"),
-        )
+        return bearer_authorized(self.headers.get("Authorization"), self.auth_token)
 
     def _text(self, status: int, body: str) -> None:
         data = body.encode()
@@ -122,12 +164,7 @@ class _StatusHandler(BaseHTTPRequestHandler):
         self.wfile.write(data)
 
     def _json(self, status: int, body: dict) -> None:
-        data = json.dumps(body).encode()
-        self.send_response(status)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
+        send_json(self, status, body)
 
     def do_GET(self):  # noqa: N802
         parsed = urlparse(self.path)
@@ -154,10 +191,17 @@ class _StatusHandler(BaseHTTPRequestHandler):
         elif parsed.path == "/healthz":
             watch_alive = self.liveness.alive()
             egress = self.egress() if self.egress is not None else None
-            # overall liveness = watch-loop freshness AND egress progress:
-            # a watcher whose workers are all dead (or wedged mid-send past
-            # the stall threshold) is as blind as one that lost its watch
-            alive = watch_alive and (egress is None or bool(egress.get("healthy", True)))
+            serve = self.serve() if self.serve is not None else None
+            # overall liveness = watch-loop freshness AND egress progress
+            # AND (when enabled) the serving plane's HTTP thread: a watcher
+            # whose workers are all dead, or whose serve plane silently
+            # stopped answering 5k subscribers, is as blind-making as one
+            # that lost its watch
+            alive = (
+                watch_alive
+                and (egress is None or bool(egress.get("healthy", True)))
+                and (serve is None or bool(serve.get("healthy", True)))
+            )
             body = {
                 "alive": alive,
                 "watch_alive": watch_alive,
@@ -165,6 +209,8 @@ class _StatusHandler(BaseHTTPRequestHandler):
             }
             if egress is not None:
                 body["egress"] = egress
+            if serve is not None:
+                body["serve"] = serve
             self._json(200 if alive else 503, body)
         elif parsed.path == "/debug/events":
             if self.audit is None:
@@ -187,7 +233,7 @@ class _StatusHandler(BaseHTTPRequestHandler):
             if self.trace is None:
                 self._json(404, {"error": "tracing disabled (trace.enabled: false)"})
                 return
-            from k8s_watcher_tpu.trace import STAGES
+            from k8s_watcher_tpu.trace import ALL_STAGES
 
             params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
             try:
@@ -196,10 +242,10 @@ class _StatusHandler(BaseHTTPRequestHandler):
                 self._json(400, {"error": f"bad n={params.get('n')!r}"})
                 return
             slowest = params.get("slowest")
-            if slowest is not None and slowest not in STAGES:
+            if slowest is not None and slowest not in ALL_STAGES:
                 self._json(
                     400,
-                    {"error": f"bad slowest={slowest!r} (stages: {', '.join(STAGES)})"},
+                    {"error": f"bad slowest={slowest!r} (stages: {', '.join(ALL_STAGES)})"},
                 )
                 return
             self._json(
@@ -207,7 +253,7 @@ class _StatusHandler(BaseHTTPRequestHandler):
                 {
                     "traces": self.trace.snapshot(n, uid=params.get("uid"), slowest=slowest),
                     "ring_size": len(self.trace),
-                    "stages": list(STAGES),
+                    "stages": list(ALL_STAGES),
                 },
             )
         elif parsed.path == "/debug/slices":
@@ -260,6 +306,7 @@ class StatusServer:
         audit=None,  # metrics.audit.AuditRing -> serves /debug/events
         trace=None,  # trace.TraceRing -> serves /debug/trace
         egress=None,  # Callable[[], dict] -> egress liveness folded into /healthz
+        serve=None,  # Callable[[], dict] -> serving-plane liveness folded into /healthz
         slices=None,  # Callable[[], dict] -> serves /debug/slices
         trend=None,  # Callable[[], dict] -> serves /debug/trend
         remediation=None,  # Callable[[], Optional[dict]] -> /debug/remediation
@@ -276,6 +323,7 @@ class StatusServer:
                 "audit": audit,
                 "trace": trace,
                 "egress": staticmethod(egress) if egress else None,
+                "serve": staticmethod(serve) if serve else None,
                 "slices": staticmethod(slices) if slices else None,
                 "trend": staticmethod(trend) if trend else None,
                 "remediation": staticmethod(remediation) if remediation else None,
@@ -284,7 +332,7 @@ class StatusServer:
                 "auth_token": auth_token,
             },
         )
-        self._server = ThreadingHTTPServer((host, port), handler)
+        self._server = QuietThreadingHTTPServer((host, port), handler)
         self._server.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
 

@@ -27,6 +27,16 @@ def _ready_tuple(pod: Dict[str, Any]) -> Tuple[Tuple[str, bool, int], ...]:
     )
 
 
+def pod_key(meta: Dict[str, Any]) -> str:
+    """The pod's tracking key: uid, falling back to ``namespace/name``
+    for uid-less pods. ONE derivation shared by the pipeline's hot path,
+    the phase tracker, and the serving plane's view — the view's DELETE
+    must compute the same key its UPSERT did, and checkpointed phase keys
+    must match across restarts, so this must never diverge per call site
+    (a 'default' namespace placeholder in one copy would do exactly that)."""
+    return meta.get("uid") or f"{meta.get('namespace', '')}/{meta.get('name', '')}"
+
+
 def pod_ready(pod: Dict[str, Any]) -> bool:
     """Whole-pod readiness: every container ready; pods reporting no
     containerStatuses fall back to the ``Ready`` condition. Shared semantic
@@ -97,7 +107,7 @@ class PhaseTracker:
         precomputed values (hot-path dedup — the same derivations otherwise
         re-run in slice tracking); omitted, they derive from the event."""
         if uid is None:
-            uid = event.uid or f"{event.namespace}/{event.name}"
+            uid = pod_key(event.pod.get("metadata") or {})
         if new_phase is None:
             new_phase = event.phase
         prev = self._state.get(uid)

@@ -25,10 +25,19 @@ from k8s_watcher_tpu.pipeline.filters import (
     TpuResourceFilter,
     pod_accelerator_chips,
 )
-from k8s_watcher_tpu.pipeline.phase import PhaseTracker, _ready_tuple
+from k8s_watcher_tpu.pipeline.phase import PhaseTracker, _ready_tuple, pod_key
 from k8s_watcher_tpu.watch.source import EventType, WatchEvent
 
 logger = logging.getLogger(__name__)
+
+#: drop reasons meaning the event never entered the fleet-state view
+#: (not a pod event at all / not a watched namespace / not a TPU pod).
+#: Shared with serve/view.py publish_batch — and the trace finishing in
+#: process_batch must agree: these journeys do NOT ride the serve
+#: publish, so their end stamp must not include it.
+NEVER_IN_VIEW = frozenset(
+    {"bookmark", "error_event", "namespace_filter", "resource_filter"}
+)
 
 
 class Notification(NamedTuple):
@@ -72,6 +81,7 @@ class EventPipeline:
         metrics: Optional[MetricsRegistry] = None,
         audit: Optional[Any] = None,  # metrics.audit.AuditRing
         tracer: Optional[Any] = None,  # trace.Tracer (stage spans + terminals)
+        view: Optional[Any] = None,  # serve.FleetView (fleet-state serving plane)
         notify_all: bool = False,
         resource_key: str = "google.com/tpu",
         topology_label: str = "cloud.google.com/gke-tpu-topology",
@@ -89,6 +99,7 @@ class EventPipeline:
         self.metrics = metrics or MetricsRegistry()
         self.audit = audit
         self.tracer = tracer
+        self.view = view
         self.notify_all = notify_all
         self.resource_key = resource_key
         self.topology_label = topology_label
@@ -132,9 +143,32 @@ class EventPipeline:
         # pipeline processing of their predecessors.
         batch_enter = monotonic() if tracing else 0.0
         self._batch_enter = batch_enter
+        # per-event pipeline-span END stamps for journeys that die in
+        # this batch: the span must close when ITS event's processing
+        # returned, not after the whole batch + publish (an early
+        # dead-end in a 128-event batch would otherwise bill ~100x its
+        # real pipeline time and poison /debug/trace?slowest=pipeline)
+        ends: Dict[int, float] = {}
         results = []
-        for event in events:
-            result = process_one(event, counts)
+        append = results.append
+        for i, event in enumerate(events):
+            append(process_one(event, counts))
+            if tracing:
+                trace = event.trace
+                if trace is not None and not trace.handed_off:
+                    ends[i] = monotonic()
+        if self.view is not None:
+            # serving-plane publish hook: fold the batch's post-filter pod
+            # state into the materialized view and wake subscribers — one
+            # lock hold per BATCH, after the per-event verdicts exist (the
+            # view needs the drop reasons to skip never-in-fleet events)
+            # and BEFORE the dead-end journeys below finish, so their
+            # serve_fanout span lands while the trace is still open
+            # (finish() reads the spans once; handed-off journeys belong
+            # to the dispatcher thread and the view leaves them alone)
+            self.view.publish_batch(events, results)
+        publish_end = monotonic() if (tracing and self.view is not None) else 0.0
+        for i, (event, result) in enumerate(zip(events, results)):
             if tracing:
                 trace = event.trace
                 if trace is not None and not trace.handed_off:
@@ -143,10 +177,19 @@ class EventPipeline:
                     # finish() on a worker thread the instant it owns the
                     # Notification, and finish reads the spans once. A
                     # journey that ended HERE — filtered, insignificant,
-                    # gate-suppressed — terminates now with the drop reason
-                    now = monotonic()
+                    # gate-suppressed — terminates with the drop reason.
+                    # Its pipeline span closed at its OWN processing end;
+                    # with the serving plane on, the journey itself ends
+                    # after the publish its serve_fanout span covers —
+                    # but ONLY if it entered the view (never-in-view
+                    # events get no serve_fanout span, and billing them
+                    # the batch's publish would re-inflate the exact
+                    # durations the per-event stamps fixed)
+                    own_end = ends[i]
+                    rode_publish = publish_end and result.reason not in NEVER_IN_VIEW
+                    now = publish_end if rode_publish else own_end
                     trace.add_span("queue_wait", trace.queue_enter, batch_enter)
-                    trace.add_span("pipeline", batch_enter, now)
+                    trace.add_span("pipeline", batch_enter, own_end)
                     outcome = (
                         result.reason if result.reason != "notified"
                         # slice siblings notified but the pod payload
@@ -169,7 +212,6 @@ class EventPipeline:
                         "outcome": result.reason,
                     }
                 )
-            results.append(result)
         counter = self.metrics.counter
         for name, n in counts.items():
             counter(name).inc(n)
@@ -200,10 +242,7 @@ class EventPipeline:
         if not ns_ok:
             counts["events_dropped_namespace"] = counts.get("events_dropped_namespace", 0) + 1
             return PipelineResult(False, "namespace_filter")
-        # same fallback key PhaseTracker derives itself (phase.py) — a
-        # 'default' placeholder here would diverge from pre-batching
-        # checkpointed phase keys for uid-less pods
-        uid = meta.get("uid") or f"{meta.get('namespace', '')}/{meta.get('name', '')}"
+        uid = pod_key(meta)
         phase = (pod.get("status") or {}).get("phase", "Unknown")
         ready_tuple = _ready_tuple(pod)
         chips = pod_accelerator_chips(pod, self.resource_key)

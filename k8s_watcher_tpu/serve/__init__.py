@@ -1,0 +1,31 @@
+"""Fleet-state serving plane: watch-cache materialized view + resumable
+snapshot/delta subscriptions (see ARCHITECTURE.md "Serving plane")."""
+
+from k8s_watcher_tpu.serve.server import ServePlane, ServeServer
+from k8s_watcher_tpu.serve.view import (
+    DELETE,
+    GONE,
+    INVALID,
+    OK,
+    UPSERT,
+    Delta,
+    FleetView,
+    ReadResult,
+    Subscription,
+    SubscriptionHub,
+)
+
+__all__ = [
+    "DELETE",
+    "GONE",
+    "INVALID",
+    "OK",
+    "UPSERT",
+    "Delta",
+    "FleetView",
+    "ReadResult",
+    "ServePlane",
+    "ServeServer",
+    "Subscription",
+    "SubscriptionHub",
+]

@@ -1,0 +1,373 @@
+"""HTTP surface of the serving plane: snapshot + resumable delta watch.
+
+kube-apiserver-style contract on one resource, ``/serve/fleet``:
+
+- ``GET /serve/fleet`` → ``{"rv": N, "view": "<id>", "objects": [...]}``
+  — the snapshot. ``view`` identifies this incarnation of the rv space
+  (rv restarts at 0 when the watcher restarts).
+- ``GET /serve/fleet?watch=1&rv=N`` → chunked stream of JSON-line delta
+  frames ``> N`` (UPSERT/DELETE, plus SYNC heartbeats that advance the
+  resume token on idle streams and a COMPACTED marker when lag shedding
+  collapsed a range). The stream closes cleanly after ``timeout``
+  seconds (default 30) with a final SYNC frame; the client reconnects
+  with ``rv=<last SYNC/delta rv>`` — that IS the resume protocol.
+- ``GET /serve/fleet?watch=1&rv=N&once=1`` → long-poll: one JSON body
+  ``{"from_rv", "to_rv", "compacted", "items"}`` (curl-friendly).
+- ``&limit=K`` is a **page bound** (kube ``limit``/``continue`` spirit):
+  at most K items per response, ``to_rv`` retreats to the last delivered
+  rv, and the client pages by resuming from it — never lossy. Lag
+  shedding (latest-wins compaction) is governed ONLY by the server-side
+  ``serve.queue_depth``, never by a client's page size.
+- A resume token behind the compaction horizon answers **410 Gone**
+  (pre-stream) or an in-band ``GONE`` frame (mid-stream); the consumer
+  re-snapshots and resubscribes from the new rv. Pass the snapshot's
+  ``view`` id back as ``&view=<id>`` and a watcher restart (new rv
+  space, rv reset to 0 — a bare rv could silently graft onto it) also
+  answers 410 instead of serving wrong deltas; long-poll bodies and
+  SYNC frames echo ``view`` so the loop can carry it.
+- ``once=1`` long-poll windows are capped at ``MAX_LONG_POLL_SECONDS``
+  (a dead long-poll socket is invisible until we write, and an orphaned
+  window pins a subscriber slot; streams heartbeat, so they may run the
+  full ``MAX_WATCH_SECONDS``).
+- ``GET /serve/healthz`` → open liveness (never needs the token, same
+  contract as the status server's /healthz).
+
+Auth reuses the status plane's bearer contract (metrics/server.py
+``bearer_authorized`` — constant-time compare): when the watcher runs
+with ``watcher.status_auth_token``, every /serve route except
+/serve/healthz requires ``Authorization: Bearer <token>`` — the serving
+plane must not be an unauthenticated side door to fleet state.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from k8s_watcher_tpu.metrics.server import (
+    QuietThreadingHTTPServer,
+    bearer_authorized,
+    send_json,
+)
+from k8s_watcher_tpu.serve.view import GONE, INVALID, FleetView, SubscriptionHub
+
+logger = logging.getLogger(__name__)
+
+#: server-side cap on one watch window; clients reconnect (resume) past it
+MAX_WATCH_SECONDS = 300.0
+#: tighter cap for once= long-polls: a dead long-poll socket is
+#: undetectable until we write (streams heartbeat every 2 s, so they may
+#: run the full window), and each orphaned window pins a subscriber slot
+#: + handler thread — a reconnect storm must not 503 the hub for 5 min
+MAX_LONG_POLL_SECONDS = 30.0
+#: idle heartbeat cadence: SYNC frames keep the resume token fresh and
+#: prove the stream is alive through proxies
+SYNC_INTERVAL_SECONDS = 2.0
+
+
+class _ServeHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    disable_nagle_algorithm = True
+    # socket timeout (reads AND writes): a stalled-but-alive consumer
+    # (paused container, zero-window proxy) must not block write_frames
+    # forever — TCP zero-window probes keep such a peer "connected"
+    # indefinitely, and a blocked write never re-checks the watch
+    # deadline, pinning one OS thread + one max_subscribers slot each.
+    # With this set, the blocked write raises and the finally-
+    # unsubscribe in _serve_watch frees the slot.
+    timeout = 30.0
+    view: FleetView
+    hub: SubscriptionHub
+    plane = None  # the owning ServePlane (health payload)
+    auth_token: Optional[str] = None
+
+    def log_message(self, *a):
+        pass
+
+    def _json(self, status: int, body: dict) -> None:
+        send_json(self, status, body)
+
+    def do_GET(self):  # noqa: N802
+        parsed = urlparse(self.path)
+        path = parsed.path
+        if path == "/serve/healthz":
+            health = self.plane.health() if self.plane is not None else {"healthy": True}
+            self._json(200 if health.get("healthy", True) else 503, health)
+            return
+        if not bearer_authorized(self.headers.get("Authorization"), self.auth_token):
+            self.send_response(401)
+            self.send_header("WWW-Authenticate", "Bearer")
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        if path != "/serve/fleet":
+            self._json(404, {"error": f"no route {path}"})
+            return
+        params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        if params.get("watch") in ("1", "true"):
+            self._serve_watch(params)
+            return
+        rv, objects = self.view.snapshot()
+        self._json(200, {"rv": rv, "view": self.view.instance, "objects": objects})
+
+    def _serve_watch(self, params: dict) -> None:
+        try:
+            rv = int(params["rv"])
+        except (KeyError, ValueError):
+            self._json(400, {"error": "watch requires an integer rv= (from a snapshot or a prior to_rv/SYNC)"})
+            return
+        try:
+            timeout = min(float(params.get("timeout", "30") or "30"), MAX_WATCH_SECONDS)
+            limit = int(params.get("limit", "0") or "0") or None
+        except ValueError:
+            self._json(400, {"error": "bad timeout=/limit="})
+            return
+        if limit is not None and limit < 0:
+            self._json(400, {"error": "limit= must be >= 0 (0 = unpaged)"})
+            return
+        client_view = params.get("view")
+        if client_view and client_view != self.view.instance:
+            # token minted by a previous incarnation of the rv space:
+            # same recovery as the compaction horizon — re-snapshot
+            self._json(
+                410,
+                {"error": "view instance changed (watcher restarted); re-snapshot",
+                 "view": self.view.instance},
+            )
+            return
+        sub = self.hub.subscribe(rv=rv)
+        if sub is None:
+            self._json(
+                503,
+                {"error": "max_subscribers reached", "max_subscribers": self.hub.max_subscribers},
+            )
+            return
+        try:
+            if params.get("once") in ("1", "true"):
+                self._long_poll(sub, min(timeout, MAX_LONG_POLL_SECONDS), limit)
+            else:
+                self._stream(sub, timeout, limit)
+        finally:
+            self.hub.unsubscribe(sub)
+
+    def _long_poll(self, sub, timeout: float, limit) -> None:
+        result = sub.pull(timeout=timeout, limit=limit)
+        if result.status == GONE:
+            self._json(
+                410,
+                {"error": "resume token compacted away; re-snapshot",
+                 "rv": result.from_rv, "oldest_rv": self.view.oldest_rv},
+            )
+            return
+        if result.status == INVALID:
+            # a token AHEAD of the view almost always means the watcher
+            # restarted into a fresh rv space and the client didn't send
+            # &view= — 410 so the documented resume loop (which only
+            # handles 410) recovers by re-snapshotting, instead of
+            # wedging on an error it never retries
+            self._json(
+                410,
+                {"error": "rv is ahead of this view (watcher restarted?); re-snapshot",
+                 "rv": result.from_rv, "view_rv": self.view.rv, "view": self.view.instance},
+            )
+            return
+        self._json(
+            200,
+            {
+                "from_rv": result.from_rv,
+                "to_rv": result.to_rv,
+                "view": self.view.instance,
+                "compacted": result.compacted,
+                "items": [d.to_wire() for d in result.deltas],
+            },
+        )
+
+    def _stream(self, sub, timeout: float, limit) -> None:
+        # pre-stream 410: a dead resume token must fail the REQUEST, not
+        # arrive as a frame the client has to dig out of a 200 stream
+        peek_status = self.view.token_status(sub.rv)
+        if peek_status == GONE:
+            self._json(
+                410,
+                {"error": "resume token compacted away; re-snapshot",
+                 "rv": sub.rv, "oldest_rv": self.view.oldest_rv},
+            )
+            return
+        if peek_status == INVALID:
+            # same restart heuristic as the long-poll path: recoverable 410
+            self._json(
+                410,
+                {"error": "rv is ahead of this view (watcher restarted?); re-snapshot",
+                 "rv": sub.rv, "view_rv": self.view.rv, "view": self.view.instance},
+            )
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def write_frames(frames: list) -> None:
+            data = "".join(json.dumps(f) + "\n" for f in frames).encode()
+            self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+            self.wfile.flush()
+
+        deadline = time.monotonic() + timeout
+        last_frame = time.monotonic()
+        try:
+            write_frames([{"type": "SYNC", "rv": sub.rv, "view": self.view.instance}])
+            while time.monotonic() < deadline:
+                result = sub.pull(
+                    timeout=min(0.5, max(0.0, deadline - time.monotonic())),
+                    limit=limit,
+                )
+                if result.status == GONE:
+                    # fell behind the horizon while blocked on a slow
+                    # client: in-band terminal frame, then close
+                    write_frames([{"type": "GONE", "rv": result.from_rv, "oldest_rv": self.view.oldest_rv}])
+                    break
+                if result.deltas:
+                    frames = []
+                    if result.compacted:
+                        frames.append({
+                            "type": "COMPACTED",
+                            "from_rv": result.from_rv,
+                            "to_rv": result.to_rv,
+                        })
+                    frames.extend(d.to_wire() for d in result.deltas)
+                    write_frames(frames)
+                    last_frame = time.monotonic()
+                elif time.monotonic() - last_frame >= SYNC_INTERVAL_SECONDS:
+                    write_frames([{"type": "SYNC", "rv": sub.rv, "view": self.view.instance}])
+                    last_frame = time.monotonic()
+            else:
+                # clean window end: final SYNC carries the resume token
+                write_frames([{"type": "SYNC", "rv": sub.rv, "view": self.view.instance}])
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, TimeoutError):
+            pass  # subscriber went away (or stalled past the socket
+            # timeout); unsubscribe happens in the caller
+
+
+class ServeServer:
+    """Owns the serving plane's HTTP thread (kube-style: one resource,
+    snapshot + watch on the same route)."""
+
+    def __init__(
+        self,
+        view: FleetView,
+        hub: SubscriptionHub,
+        *,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        auth_token: Optional[str] = None,
+        plane=None,
+    ):
+        handler = type(
+            "BoundServeHandler",
+            (_ServeHandler,),
+            {"view": view, "hub": hub, "auth_token": auth_token, "plane": plane},
+        )
+        self._server = QuietThreadingHTTPServer((host, port), handler)
+        self._server.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    @property
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> "ServeServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="serve-plane", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+
+
+class ServePlane:
+    """Bundle the app wires: view + hub + HTTP server + healthz verdict.
+
+    Built when ``serve.enabled``; the view exists from construction (the
+    pipeline publishes into it immediately) while the HTTP server starts
+    with the app's other servers in ``run()``.
+    """
+
+    def __init__(self, config, *, metrics=None, auth_token: Optional[str] = None):
+        self.config = config
+        self.metrics = metrics
+        self.view = FleetView(compact_horizon=config.compact_horizon, metrics=metrics)
+        self.hub = SubscriptionHub(
+            self.view,
+            max_subscribers=config.max_subscribers,
+            queue_depth=config.queue_depth,
+            metrics=metrics,
+        )
+        self.server: Optional[ServeServer] = None
+        self._auth_token = auth_token
+
+    def wrap_sink(self, sink):
+        """Tap a notification sink: every Notification folds into the view
+        (slices/probes; pods no-op — they ride ``publish_batch``) before
+        reaching the real sink."""
+        observe = self.view.observe_notification
+
+        def serving_sink(notification):
+            observe(notification)
+            sink(notification)
+
+        return serving_sink
+
+    def start(self) -> "ServePlane":
+        self.server = ServeServer(
+            self.view,
+            self.hub,
+            port=self.config.port,
+            auth_token=self._auth_token,
+            plane=self,
+        ).start()
+        logger.info(
+            "Serving plane on :%d (/serve/fleet snapshot+watch, max_subscribers=%d, "
+            "queue_depth=%d, compact_horizon=%d)",
+            self.server.port, self.config.max_subscribers,
+            self.config.queue_depth, self.config.compact_horizon,
+        )
+        return self
+
+    def stop(self) -> None:
+        if self.server is not None:
+            self.server.stop()
+            self.server = None
+
+    @property
+    def port(self) -> int:
+        return self.server.port if self.server is not None else 0
+
+    def health(self) -> dict:
+        """Serving-plane liveness, folded into /healthz: the plane is
+        unhealthy once its HTTP thread has died (subscribers silently get
+        nothing — as blind-making as a dead egress worker)."""
+        server = self.server  # racing stop(); read once
+        return {
+            "healthy": server is None or server.alive,
+            "started": server is not None,
+            "subscribers": self.hub.active_count,
+            "max_subscribers": self.hub.max_subscribers,
+            "view_rv": self.view.rv,
+            "oldest_rv": self.view.oldest_rv,
+            "objects": self.view.object_count(),
+        }

@@ -1,0 +1,483 @@
+"""Fleet-state materialized view: the serve-side watch cache.
+
+The pipeline makes the watcher fast at *pushing* one notify target; this
+module is the plane that lets many downstream consumers *read* fleet
+state — schedulers, dashboards, remediation controllers (the ARGUS/Guard
+class of consumers in PAPERS.md) — without each of them holding a watch
+against the apiserver.
+
+It mirrors the kube-apiserver watch cache, on the serve side of the
+pipeline instead of the ingest side:
+
+- ``FleetView`` is a materialized map of the pipeline's output — pod
+  phases, slice topology/health, probe verdicts — keyed by ``(kind,
+  key)`` with one monotonic **view resourceVersion**: every applied
+  delta bumps ``rv`` by exactly 1, so the rv space is *dense* and a
+  contiguous delta range ``(from_rv, to_rv]`` provably contains
+  ``to_rv - from_rv`` deltas (the property subscribers' gap/dup checkers
+  lean on).
+- A bounded **delta journal** (the last ``compact_horizon`` deltas)
+  backs resumable subscriptions: a consumer takes a snapshot at ``rv``,
+  then reads deltas ``> rv``; its resume token is just the last rv it
+  applied. Tokens survive reconnects for free — the journal, not the
+  connection, is the state.
+- **Compaction horizon**: the journal forgets history beyond
+  ``compact_horizon`` deltas. A resume token that falls behind the
+  horizon gets ``GONE`` (HTTP 410) and the consumer re-snapshots — the
+  exact semantics the in-repo mock apiserver implements on the ingest
+  side (``MockCluster.events_since`` returning None).
+- **Lag shedding**: a subscriber whose pending backlog exceeds its
+  ``queue_depth`` does not get the full history replayed; the pending
+  range is compacted **latest-wins per key** before delivery. The batch
+  is flagged ``compacted`` so sequence checkers know the rv jump is
+  sanctioned; per-key final state is still exact (state serving, not
+  event logging — same contract as the egress plane's coalescing).
+
+Concurrency: the view is written by the pipeline thread (pods, via the
+``publish_batch`` hook) and by sink taps (slices/probes, possibly from
+probe/node threads) under one lock; readers (``read_since``/``snapshot``)
+share that lock and long-polls wait on its condition. Deltas and objects
+are replaced, never mutated, so readers can hand out references without
+copies.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from bisect import bisect_right
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+
+from k8s_watcher_tpu.pipeline.phase import pod_key, pod_ready
+from k8s_watcher_tpu.pipeline.pipeline import NEVER_IN_VIEW as _NEVER_IN_VIEW
+from k8s_watcher_tpu.watch.source import EventType
+
+#: delivery record types on the wire (and in Delta.type)
+UPSERT = "UPSERT"
+DELETE = "DELETE"
+
+#: read_since verdicts
+OK = "ok"
+GONE = "gone"  # resume token fell behind the compaction horizon -> 410
+INVALID = "invalid"  # token ahead of the view (restart or client bug);
+# the HTTP layer answers 410 so bare-rv clients recover by re-snapshot
+
+
+class Delta(NamedTuple):
+    """One journaled view mutation. ``object`` is None for DELETE."""
+
+    rv: int
+    kind: str  # "pod" | "slice" | "probe"
+    key: str
+    type: str  # UPSERT | DELETE
+    object: Optional[Dict[str, Any]]
+    t: float  # monotonic append stamp (feeds the delta-lag histogram)
+
+    def to_wire(self) -> Dict[str, Any]:
+        out = {"type": self.type, "rv": self.rv, "kind": self.kind, "key": self.key}
+        if self.object is not None:
+            out["object"] = self.object
+        return out
+
+
+class ReadResult(NamedTuple):
+    """One ``read_since`` pull.
+
+    ``status == OK``: ``deltas`` covers exactly ``(from_rv, to_rv]``.
+    When ``compacted`` is False the deltas are the contiguous journal
+    range (``len(deltas) == to_rv - from_rv``, dense rv space); when True
+    they are the latest-wins per-key summary of that range — every key
+    touched in the range appears once, at its newest rv, so applying them
+    reproduces the view state at ``to_rv`` for those keys.
+    """
+
+    status: str
+    from_rv: int
+    to_rv: int
+    compacted: bool
+    deltas: List[Delta]
+
+
+class FleetView:
+    def __init__(
+        self,
+        *,
+        compact_horizon: int = 8192,
+        metrics=None,  # metrics.MetricsRegistry, optional
+    ):
+        self.compact_horizon = max(1, int(compact_horizon))
+        self.metrics = metrics
+        # This incarnation of the rv space. rv restarts at 0 with the
+        # process ("the journal is the state" — and the journal dies with
+        # it), so a resume token is only meaningful inside the instance
+        # that minted it: a pre-restart token grafted onto the new rv
+        # space would pass every dense-range gap check while silently
+        # merging two incarnations' states. Clients echo this id; the
+        # server answers 410 on mismatch (re-snapshot), same recovery as
+        # the compaction horizon.
+        self.instance = os.urandom(6).hex()
+        self._cond = threading.Condition()
+        self._rv = 0
+        self._oldest_rv = 0  # deltas with rv <= this are compacted away
+        self._objects: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        # parallel append-only arrays (trimmed together at the horizon):
+        # bisect over _delta_rvs finds a resume point in O(log n)
+        self._delta_rvs: List[int] = []
+        self._deltas: List[Delta] = []
+        self._publish_seconds = (
+            metrics.histogram("serve_publish_seconds") if metrics is not None else None
+        )
+        self._delta_lag = (
+            metrics.histogram("serve_delta_lag_seconds") if metrics is not None else None
+        )
+        self._deltas_published = (
+            metrics.counter("serve_deltas_published") if metrics is not None else None
+        )
+        self._rv_gauge = metrics.gauge("serve_view_rv") if metrics is not None else None
+
+    # -- writing (pipeline thread + sink taps) ----------------------------
+
+    def _apply_locked(self, kind: str, key: str, obj: Optional[Dict[str, Any]], now: float) -> bool:
+        """One delta under the lock. Returns False for no-ops (identical
+        upsert, delete of an absent key) — no rv burn, no journal entry."""
+        map_key = (kind, key)
+        if obj is None:
+            if self._objects.pop(map_key, None) is None:
+                return False
+            delta_type = DELETE
+        else:
+            if self._objects.get(map_key) == obj:
+                return False
+            self._objects[map_key] = obj
+            delta_type = UPSERT
+        self._rv += 1
+        self._delta_rvs.append(self._rv)
+        self._deltas.append(Delta(self._rv, kind, key, delta_type, obj, now))
+        return True
+
+    def _trim_locked(self) -> None:
+        """Enforce the compaction horizon; amortized — trims in quarter-
+        horizon chunks so steady publishing pays O(1) amortized."""
+        overflow = len(self._deltas) - self.compact_horizon
+        if overflow < max(1, self.compact_horizon // 4):
+            return
+        self._oldest_rv = self._delta_rvs[overflow - 1]
+        del self._delta_rvs[:overflow]
+        del self._deltas[:overflow]
+
+    def apply(self, kind: str, key: str, obj: Optional[Dict[str, Any]]) -> bool:
+        """Upsert (``obj``) or delete (``obj is None``) one object and wake
+        subscribers. Public single-delta shape (benches, sink taps)."""
+        now = time.monotonic()
+        with self._cond:
+            changed = self._apply_locked(kind, key, obj, now)
+            if changed:
+                self._trim_locked()
+                if self._rv_gauge is not None:
+                    self._rv_gauge.set(self._rv)
+                self._cond.notify_all()
+        if changed and self._deltas_published is not None:
+            self._deltas_published.inc()
+        return changed
+
+    def publish_batch(self, events, results) -> int:
+        """The pipeline hook: fold one processed batch into the view —
+        one lock hold, one subscriber wake, for the whole batch.
+
+        Only events that *passed the filters* enter the fleet view.
+        ``no_significant_change`` events are applied too: phase/readiness
+        significance gates *notification*, but fields the view serves and
+        the pipeline doesn't weigh — ``nodeName`` after the scheduler
+        binds a Pending pod, the pod resourceVersion — may still have
+        moved, and ``_apply_locked``'s identical-upsert dedup makes true
+        no-ops free (no rv burn, no wake). DELETED events drop the key.
+
+        Sampled journeys still OPEN here — not handed off to the
+        dispatcher, i.e. suppressed/insignificant events whose only
+        egress IS the serving plane — get a ``serve_fanout`` span
+        covering this batch's publish (the pipeline publishes before it
+        finishes those journeys). Handed-off traces belong to the
+        dispatcher's thread by now (finish() reads spans once), so they
+        are left alone.
+        """
+        t_start = time.monotonic()
+        changed = 0
+        stamp = []
+        with self._cond:
+            for event, result in zip(events, results):
+                if result.reason in _NEVER_IN_VIEW:
+                    continue
+                if event.type == EventType.DELETED:
+                    meta = (event.pod or {}).get("metadata") or {}
+                    applied = self._apply_locked("pod", pod_key(meta), None, t_start)
+                else:
+                    uid, obj = _pod_object(event)
+                    applied = self._apply_locked("pod", uid, obj, t_start)
+                if applied:
+                    changed += 1
+                trace = getattr(event, "trace", None)
+                if trace is not None and not trace.handed_off:
+                    stamp.append(trace)
+            if changed:
+                self._trim_locked()
+                if self._rv_gauge is not None:
+                    self._rv_gauge.set(self._rv)
+                self._cond.notify_all()
+        t_end = time.monotonic()
+        for trace in stamp:
+            trace.add_span("serve_fanout", t_start, t_end)
+        if changed:
+            if self._deltas_published is not None:
+                self._deltas_published.inc(changed)
+            if self._publish_seconds is not None:
+                self._publish_seconds.record(t_end - t_start)
+        return changed
+
+    def observe_notification(self, notification) -> None:
+        """Sink tap for the derived planes: slice aggregates and probe
+        verdicts ride the dispatcher sink; this folds them into the view.
+        Pod payloads are ignored — pods enter via ``publish_batch``, which
+        sees every post-filter event (the critical gate suppresses pod
+        *notifications*, never view state)."""
+        kind = notification.kind
+        payload = notification.payload
+        if kind == "slice":
+            key = payload.get("slice")
+            if not key:
+                return
+            transition = payload.get("phase_transition") or {}
+            if transition.get("to") == "Terminated":
+                self.apply("slice", key, None)
+            else:
+                self.apply("slice", key, {"kind": "slice", "key": key, **payload})
+        elif kind == "probe":
+            key = str(payload.get("host") or "local")
+            self.apply("probe", key, {"kind": "probe", "key": key, **payload})
+
+    # -- reading (serve plane / subscribers) ------------------------------
+
+    @property
+    def rv(self) -> int:
+        with self._cond:
+            return self._rv
+
+    @property
+    def oldest_rv(self) -> int:
+        with self._cond:
+            return self._oldest_rv
+
+    def token_status(self, rv: int) -> str:
+        """``OK``/``GONE``/``INVALID`` verdict for a resume token WITHOUT
+        reading deltas — the pre-stream check. A reconnect storm after a
+        consumer outage (the 410/resume scenario) must cost two compares
+        per connect, not a discarded O(pending) latest-wins walk."""
+        with self._cond:
+            if rv > self._rv:
+                return INVALID
+            if rv < self._oldest_rv:
+                return GONE
+            return OK
+
+    def snapshot(self) -> Tuple[int, List[Dict[str, Any]]]:
+        """``(rv, objects)`` — the GET-snapshot shape. Objects are the
+        live references (replaced on write, never mutated), so the copy
+        is shallow and O(objects)."""
+        with self._cond:
+            return self._rv, list(self._objects.values())
+
+    def object_count(self) -> int:
+        with self._cond:
+            return len(self._objects)
+
+    def read_since(
+        self,
+        rv: int,
+        *,
+        max_deltas: int = 128,
+        limit: Optional[int] = None,
+        timeout: float = 0.0,
+    ) -> ReadResult:
+        """Deltas ``> rv``, the subscription primitive.
+
+        - token behind the horizon -> ``GONE`` (client re-snapshots);
+        - token ahead of the view -> ``INVALID`` (client bug);
+        - backlog ``<= max_deltas`` -> the raw contiguous range;
+        - backlog ``> max_deltas`` (a lagging subscriber) -> the range
+          compacted latest-wins per key, flagged ``compacted`` — the
+          bounded per-connection queue materialized at read time;
+        - nothing pending -> block up to ``timeout`` seconds (long-poll),
+          then return an empty OK batch (``from_rv == to_rv``).
+
+        ``limit`` is a **page bound, never lossy**: at most ``limit``
+        deltas are returned and ``to_rv`` retreats to the last delivered
+        rv, so the client resumes from ``to_rv`` and pages through the
+        rest — nothing is dropped. It is deliberately a different knob
+        from ``max_deltas`` (the lag-shedding threshold): a healthy
+        subscriber asking for small pages must not be forced into the
+        latest-wins compaction path. Truncating a *compacted* batch at a
+        delta boundary is sound too — the batch is rv-sorted, so every
+        key whose newest rv is ``> to_rv`` is simply re-delivered by the
+        next page. Non-positive ``limit`` means unpaged (the HTTP layer
+        rejects negatives before they get here).
+        """
+        deadline = time.monotonic() + timeout if timeout > 0 else None
+        with self._cond:
+            while True:
+                if rv > self._rv:
+                    return ReadResult(INVALID, rv, rv, False, [])
+                if rv < self._oldest_rv:
+                    # covers falling behind *while waiting*, too
+                    return ReadResult(GONE, rv, rv, False, [])
+                pending = self._rv - rv
+                if pending:
+                    break
+                remaining = deadline - time.monotonic() if deadline is not None else 0.0
+                if remaining <= 0:
+                    return ReadResult(OK, rv, rv, False, [])
+                self._cond.wait(timeout=min(remaining, 0.5))
+            idx = bisect_right(self._delta_rvs, rv)
+            to_rv = self._rv
+            # ONLY the slice happens under the lock (an O(pending) ref
+            # copy of an append-only journal — front-trims mutate the
+            # shared list, so the slice is an independent snapshot); the
+            # latest-wins walk below must NOT hold the lock, or 5k lagging
+            # subscribers' compactions serialize every publish behind them
+            deltas = self._deltas[idx:]
+        oldest_pending_t = deltas[0].t
+        if pending <= max_deltas:
+            compacted = False
+        else:
+            latest: Dict[Tuple[str, str], Delta] = {}
+            for delta in deltas:
+                latest[(delta.kind, delta.key)] = delta
+            deltas = sorted(latest.values(), key=lambda d: d.rv)
+            compacted = True
+        if limit is not None and 0 < limit < len(deltas):
+            deltas = deltas[:limit]
+            to_rv = deltas[-1].rv
+        if self._delta_lag is not None:
+            # lag = how stale the oldest pending delta had become by the
+            # time this pull delivered it
+            self._delta_lag.record(time.monotonic() - oldest_pending_t)
+        return ReadResult(OK, rv, to_rv, compacted, deltas)
+
+
+def _pod_object(event) -> Tuple[str, Dict[str, Any]]:
+    """The compact pod view object — what a fleet-state consumer needs to
+    route/diagnose, not the whole manifest."""
+    pod = event.pod or {}
+    meta = pod.get("metadata") or {}
+    status = pod.get("status") or {}
+    uid = pod_key(meta)
+    return uid, {
+        "kind": "pod",
+        "key": uid,
+        "name": meta.get("name", ""),
+        "namespace": meta.get("namespace", ""),
+        "phase": status.get("phase", "Unknown"),
+        "ready": pod_ready(pod),
+        "node": (pod.get("spec") or {}).get("nodeName"),
+        "pod_resource_version": meta.get("resourceVersion"),
+    }
+
+
+class Subscription:
+    """One consumer's resumable cursor into the view.
+
+    A subscription is *just* the cursor plus accounting — the journal is
+    shared, so 5k subscribers cost 5k small objects, not 5k queues. Pull
+    from ONE thread at a time (each connection/poller owns its cursor;
+    the view itself is the thread-safe part).
+    """
+
+    __slots__ = ("view", "sub_id", "rv", "queue_depth", "pulls", "compacted_pulls", "resyncs")
+
+    def __init__(self, view: FleetView, sub_id: int, rv: int, queue_depth: int):
+        self.view = view
+        self.sub_id = sub_id
+        self.rv = rv
+        self.queue_depth = queue_depth
+        self.pulls = 0
+        self.compacted_pulls = 0
+        self.resyncs = 0
+
+    def pull(self, *, timeout: float = 0.0, limit: Optional[int] = None) -> ReadResult:
+        """One cursor advance. ``queue_depth`` (the subscription's
+        bounded-queue size) is the only lag-shedding trigger; ``limit``
+        only pages the response (non-lossy, see ``read_since``)."""
+        result = self.view.read_since(
+            self.rv,
+            max_deltas=self.queue_depth,
+            limit=limit,
+            timeout=timeout,
+        )
+        self.pulls += 1
+        if result.status == OK:
+            self.rv = result.to_rv
+            if result.compacted:
+                self.compacted_pulls += 1
+        return result
+
+    def rebase(self, rv: int) -> None:
+        """Reset the cursor after a GONE -> re-snapshot resync."""
+        self.rv = rv
+        self.resyncs += 1
+
+
+class SubscriptionHub:
+    """Registry + admission control for subscriptions.
+
+    Enforces ``max_subscribers`` (the fan-out budget — every active
+    subscriber costs journal reads on publish-adjacent paths) and owns
+    the subscriber-count gauge.
+    """
+
+    def __init__(
+        self,
+        view: FleetView,
+        *,
+        max_subscribers: int = 5000,
+        queue_depth: int = 128,
+        metrics=None,
+    ):
+        self.view = view
+        self.max_subscribers = max(1, int(max_subscribers))
+        self.queue_depth = max(1, int(queue_depth))
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._active: Dict[int, Subscription] = {}
+        self._next_id = 0
+        self._rejected = metrics.counter("serve_subscribers_rejected") if metrics else None
+        self._gauge = metrics.gauge("serve_subscribers") if metrics else None
+
+    def subscribe(self, rv: Optional[int] = None) -> Optional[Subscription]:
+        """A new subscription resuming from ``rv`` (default: the current
+        view rv, i.e. "deltas from now"). None when the hub is full."""
+        with self._lock:
+            if len(self._active) >= self.max_subscribers:
+                if self._rejected is not None:
+                    self._rejected.inc()
+                return None
+            self._next_id += 1
+            sub = Subscription(
+                self.view,
+                self._next_id,
+                rv if rv is not None else self.view.rv,
+                self.queue_depth,
+            )
+            self._active[sub.sub_id] = sub
+            if self._gauge is not None:
+                self._gauge.set(len(self._active))
+            return sub
+
+    def unsubscribe(self, sub: Subscription) -> None:
+        with self._lock:
+            self._active.pop(sub.sub_id, None)
+            if self._gauge is not None:
+                self._gauge.set(len(self._active))
+
+    @property
+    def active_count(self) -> int:
+        with self._lock:
+            return len(self._active)

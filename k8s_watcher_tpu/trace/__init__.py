@@ -1,7 +1,9 @@
 """Tracing plane public surface (see trace/trace.py for the design)."""
 
 from k8s_watcher_tpu.trace.trace import (
+    ALL_STAGES,
     ANOMALY_OUTCOMES,
+    SERVE_STAGE,
     STAGES,
     Trace,
     TraceRing,
@@ -17,7 +19,9 @@ from k8s_watcher_tpu.trace.trace import (
 )
 
 __all__ = [
+    "ALL_STAGES",
     "ANOMALY_OUTCOMES",
+    "SERVE_STAGE",
     "STAGES",
     "Trace",
     "TraceRing",

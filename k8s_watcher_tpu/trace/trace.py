@@ -61,6 +61,18 @@ STAGES = (
     "post",
 )
 
+#: The serving plane's fan-out stage (serve/view.py ``publish_batch``):
+#: stamped on sampled journeys that END at the view — suppressed or
+#: insignificant events whose only egress IS the serving plane — while
+#: the trace is still open (the pipeline publishes before finishing
+#: them). Handed-off journeys belong to the dispatcher thread by then
+#: (finish() reads spans once), so clean sent traces never carry it and
+#: the six REQUIRED hand-off stages stay exactly ``STAGES``. Appears
+#: only when ``serve.enabled``; ``ALL_STAGES`` is the query/validation
+#: vocabulary (/debug/trace).
+SERVE_STAGE = "serve_fanout"
+ALL_STAGES = STAGES + (SERVE_STAGE,)
+
 #: Egress terminal outcomes that mark a trace anomalous (always recorded,
 #: never head-sampled away): the notification's journey ended somewhere
 #: other than a completed POST. Pipeline dead-ends (filtered, insignificant,

@@ -1,0 +1,740 @@
+"""Serving-plane tests (serve/): the materialized fleet view, the
+snapshot+resumable-delta subscription protocol, and the HTTP surface.
+
+The contract under test is the one ARCHITECTURE.md "Serving plane"
+states:
+
+- the view's rv space is DENSE (every applied delta is exactly one rv),
+  so an uncompacted read of ``(from_rv, to_rv]`` carries exactly
+  ``to_rv - from_rv`` deltas — the property every gap checker leans on;
+- a resume token is just the last rv applied: it survives reconnects,
+  gets latest-wins per-key compaction when the backlog exceeds the
+  queue depth, and gets GONE (HTTP 410 → re-snapshot) once it falls
+  behind the compaction horizon;
+- under concurrent churn + compaction + reconnects, a subscriber that
+  follows the protocol converges on EXACTLY the publisher's state — no
+  gaps, no duplicates, no lost updates (the seeded randomized test).
+"""
+
+import json
+import random
+import threading
+import time
+
+import pytest
+import requests
+
+from k8s_watcher_tpu.config.schema import SchemaError, ServeConfig
+from k8s_watcher_tpu.metrics import MetricsRegistry
+from k8s_watcher_tpu.pipeline.pipeline import EventPipeline, Notification
+from k8s_watcher_tpu.serve import (
+    DELETE,
+    GONE,
+    INVALID,
+    OK,
+    UPSERT,
+    FleetView,
+    ServePlane,
+    ServeServer,
+    SubscriptionHub,
+)
+from k8s_watcher_tpu.watch.fake import build_pod
+from k8s_watcher_tpu.watch.source import EventType, WatchEvent
+
+
+def tpu_pod(name, phase="Running", **kw):
+    return build_pod(name, uid=f"uid-{name}", phase=phase, tpu_chips=4, **kw)
+
+
+def ev(pod, etype=EventType.ADDED):
+    return WatchEvent(type=etype, pod=pod)
+
+
+# -- FleetView core ---------------------------------------------------------
+
+
+class TestFleetView:
+    def test_rv_space_is_dense(self):
+        view = FleetView()
+        for i in range(10):
+            assert view.apply("pod", f"p{i}", {"seq": i})
+        assert view.rv == 10
+        result = view.read_since(0)
+        assert result.status == OK and not result.compacted
+        assert [d.rv for d in result.deltas] == list(range(1, 11))
+        assert len(result.deltas) == result.to_rv - result.from_rv
+
+    def test_identical_upsert_burns_no_rv(self):
+        view = FleetView()
+        assert view.apply("pod", "p", {"phase": "Running"})
+        assert not view.apply("pod", "p", {"phase": "Running"})
+        assert view.rv == 1
+
+    def test_delete_absent_key_is_noop(self):
+        view = FleetView()
+        assert not view.apply("pod", "ghost", None)
+        assert view.rv == 0
+
+    def test_delete_journals_delete_delta(self):
+        view = FleetView()
+        view.apply("pod", "p", {"phase": "Running"})
+        assert view.apply("pod", "p", None)
+        deltas = view.read_since(0).deltas
+        assert [d.type for d in deltas] == [UPSERT, DELETE]
+        assert deltas[-1].object is None
+        assert view.snapshot() == (2, [])
+
+    def test_snapshot_carries_rv_and_objects(self):
+        view = FleetView()
+        view.apply("pod", "a", {"k": "a"})
+        view.apply("slice", "s", {"k": "s"})
+        rv, objects = view.snapshot()
+        assert rv == 2 and sorted(o["k"] for o in objects) == ["a", "s"]
+
+    def test_read_ahead_of_view_is_invalid(self):
+        view = FleetView()
+        view.apply("pod", "p", {})
+        assert view.read_since(99).status == INVALID
+
+    def test_token_behind_horizon_gets_gone(self):
+        view = FleetView(compact_horizon=8)
+        for i in range(40):
+            view.apply("pod", f"p{i}", {"seq": i})
+        assert view.oldest_rv > 0
+        assert view.read_since(0).status == GONE
+        # a token at/after the horizon still reads fine
+        ok = view.read_since(view.oldest_rv)
+        assert ok.status == OK and ok.to_rv == 40
+
+    def test_lagging_read_compacts_latest_wins(self):
+        view = FleetView()
+        for i in range(50):
+            key = f"p{i % 5}"
+            view.apply("pod", key, {"kind": "pod", "key": key, "seq": i})
+        view.apply("pod", "p0", None)  # deletes survive compaction too
+        result = view.read_since(0, max_deltas=8)
+        assert result.compacted and result.to_rv == 51
+        # every touched key exactly once, at its newest rv, rv-ascending
+        keys = [d.key for d in result.deltas]
+        assert sorted(keys) == sorted(set(keys))
+        assert [d.rv for d in result.deltas] == sorted(d.rv for d in result.deltas)
+        # applying the compacted batch reproduces the exact view state
+        model = {}
+        for d in result.deltas:
+            if d.type == DELETE:
+                model.pop((d.kind, d.key), None)
+            else:
+                model[(d.kind, d.key)] = d.object
+        _, objects = view.snapshot()
+        assert model == {("pod", o["key"]): o for o in objects}
+
+    def test_limit_pages_without_loss(self):
+        # limit is a page bound, NOT a lag-shedding trigger: a healthy
+        # subscriber asking for small pages gets dense contiguous pages
+        view = FleetView()
+        for i in range(10):
+            view.apply("pod", f"p{i}", {"seq": i})
+        page = view.read_since(0, limit=3)
+        assert not page.compacted and page.to_rv == 3
+        assert [d.rv for d in page.deltas] == [1, 2, 3]
+        rest = view.read_since(page.to_rv)
+        assert [d.rv for d in rest.deltas] == list(range(4, 11))
+        # paging composes with latest-wins compaction: truncating the
+        # rv-sorted compacted batch at a delta boundary just re-delivers
+        # the tail keys next page — exactly-once per key overall
+        churn = FleetView()
+        for i in range(40):
+            key = f"k{i % 8}"
+            churn.apply("pod", key, {"kind": "pod", "key": key, "seq": i})
+        model, rv, compacted_pages = {}, 0, 0
+        while rv < churn.rv:
+            r = churn.read_since(rv, max_deltas=4, limit=3)
+            assert r.status == OK and len(r.deltas) <= 3
+            compacted_pages += r.compacted
+            for d in r.deltas:
+                model[(d.kind, d.key)] = d.object
+            rv = r.to_rv
+        assert compacted_pages > 0
+        _, objects = churn.snapshot()
+        assert model == {("pod", o["key"]): o for o in objects}
+        # non-positive limit = unpaged, never an empty-slice crash
+        assert view.read_since(0, limit=-1).to_rv == 10
+        assert view.read_since(0, limit=0).to_rv == 10
+
+    def test_long_poll_wakes_on_publish(self):
+        view = FleetView()
+        got = []
+        t = threading.Thread(
+            target=lambda: got.append(view.read_since(0, timeout=5.0)), daemon=True
+        )
+        t.start()
+        time.sleep(0.05)
+        view.apply("pod", "p", {"phase": "Running"})
+        t.join(timeout=5)
+        assert got and got[0].to_rv == 1 and got[0].deltas[0].key == "p"
+
+    def test_long_poll_times_out_empty(self):
+        view = FleetView()
+        result = view.read_since(0, timeout=0.05)
+        assert result.status == OK and result.deltas == [] and result.from_rv == result.to_rv
+
+    def test_subscriber_gauge_and_admission_cap(self):
+        metrics = MetricsRegistry()
+        hub = SubscriptionHub(FleetView(), max_subscribers=2, metrics=metrics)
+        a, b = hub.subscribe(), hub.subscribe()
+        assert a is not None and b is not None
+        assert hub.subscribe() is None  # full -> rejected
+        assert metrics.gauge("serve_subscribers").value == 2
+        assert metrics.counter("serve_subscribers_rejected").value == 1
+        hub.unsubscribe(a)
+        assert hub.subscribe() is not None
+
+
+# -- pipeline publish hook + sink taps --------------------------------------
+
+
+class TestViewFeeds:
+    def test_publish_batch_materializes_post_filter_pods(self):
+        view = FleetView()
+        pipe = EventPipeline(environment="development", sink=lambda n: None, view=view)
+        pipe.process_batch(
+            [ev(tpu_pod("a", phase="Pending")), ev(build_pod("plain"))]
+        )
+        rv, objects = view.snapshot()
+        # the non-TPU pod never entered the fleet; the TPU pod did
+        assert [o["key"] for o in objects] == ["uid-a"]
+        assert objects[0]["phase"] == "Pending" and objects[0]["namespace"] == "default"
+
+    def test_publish_batch_dedups_identical_and_applies_delete(self):
+        view = FleetView()
+        pipe = EventPipeline(environment="development", sink=lambda n: None, view=view)
+        pod = tpu_pod("a")
+        pipe.process_batch([ev(pod)])
+        rv_after_add = view.rv
+        # byte-identical MODIFIED: nothing the view serves moved, so the
+        # identical-upsert dedup burns no rv (no journal entry, no wake)
+        pipe.process_batch([ev(pod, EventType.MODIFIED)])
+        assert view.rv == rv_after_add
+        pipe.process_batch([ev(pod, EventType.DELETED)])
+        assert view.snapshot() == (rv_after_add + 1, [])
+
+    def test_insignificant_node_binding_still_updates_view(self):
+        # the scheduler binding a Pending pod flips no phase/readiness, so
+        # the pipeline calls it no_significant_change and notifies no one —
+        # but `node` is a field the VIEW serves, and consumers (schedulers,
+        # remediation controllers) must not see node=null for every
+        # scheduled-but-not-Running pod
+        view = FleetView()
+        pipe = EventPipeline(environment="development", sink=lambda n: None, view=view)
+        pipe.process_batch([ev(tpu_pod("a", phase="Pending"))])
+        results = pipe.process_batch(
+            [ev(tpu_pod("a", phase="Pending", node_name="tpu-node-7"), EventType.MODIFIED)]
+        )
+        assert results[0].reason == "no_significant_change"
+        _, objects = view.snapshot()
+        assert objects[0]["node"] == "tpu-node-7"
+
+    def test_gate_suppressed_pod_still_reaches_view(self):
+        # production's critical-events gate suppresses the NOTIFICATION for
+        # a routine transition; the serving plane still materializes it —
+        # the gate is about push traffic, never about fleet-state truth
+        from k8s_watcher_tpu.pipeline.filters import CriticalEventGate
+
+        view = FleetView()
+        notified = []
+        pipe = EventPipeline(
+            environment="production",
+            sink=notified.append,
+            critical_gate=CriticalEventGate("production", True),
+            view=view,
+        )
+        pipe.process_batch([ev(tpu_pod("a", phase="Pending"))])
+        results = pipe.process_batch(
+            [ev(tpu_pod("a", phase="Running"), EventType.MODIFIED)]
+        )
+        assert results[0].reason == "critical_gate"
+        assert notified == []
+        _, objects = view.snapshot()
+        assert objects and objects[0]["phase"] == "Running"
+
+    def test_serve_fanout_span_stamped_only_on_open_journeys(self):
+        # journeys that END at the view (insignificant/suppressed: the
+        # serving plane is their only egress) carry serve_fanout; handed-
+        # off journeys belong to the dispatcher thread (finish() reads
+        # spans once) and must NOT be touched by the publish hook
+        class FakeTrace:
+            queue_enter = 0.0  # the pipeline stamps queue_wait off this
+            handed_off = False
+
+            def __init__(self):
+                self.spans = []
+
+            def add_span(self, stage, start, end):
+                self.spans.append(stage)
+
+        view = FleetView()
+        pipe = EventPipeline(environment="development", sink=lambda n: None, view=view)
+        pipe.process_batch([ev(tpu_pod("a", phase="Pending"))])
+        open_journey = ev(
+            tpu_pod("a", phase="Pending", node_name="n1"), EventType.MODIFIED
+        )
+        open_journey.trace = FakeTrace()
+        handed_off = ev(tpu_pod("b"))
+        handed_off.trace = FakeTrace()
+        handed_off.trace.handed_off = True
+        pipe.process_batch([open_journey, handed_off])
+        assert "serve_fanout" in open_journey.trace.spans
+        assert "serve_fanout" not in handed_off.trace.spans
+
+    def test_observe_notification_slices_and_probes(self):
+        view = FleetView()
+        view.observe_notification(
+            Notification({"slice": "s0", "healthy": True}, 0.0, kind="slice")
+        )
+        view.observe_notification(
+            Notification({"host": "h0", "verdict": "ok"}, 0.0, kind="probe")
+        )
+        # pods ride publish_batch, not the sink tap
+        view.observe_notification(Notification({"pod_name": "a"}, 0.0, kind="pod"))
+        _, objects = view.snapshot()
+        assert sorted(o["kind"] for o in objects) == ["probe", "slice"]
+        # a Terminated slice transition drops the key
+        view.observe_notification(
+            Notification(
+                {"slice": "s0", "phase_transition": {"to": "Terminated"}},
+                0.0,
+                kind="slice",
+            )
+        )
+        _, objects = view.snapshot()
+        assert [o["kind"] for o in objects] == ["probe"]
+
+
+# -- fan-out ordering under concurrent subscribers --------------------------
+
+
+class TestFanoutOrdering:
+    N_SUBSCRIBERS = 6
+
+    def test_concurrent_subscribers_see_ordered_gapless_streams(self):
+        """4+ subscribers pulling concurrently while one publisher writes:
+        every subscriber sees rv strictly ascending, raw ranges dense, and
+        per-key seq numbers monotonic — and all converge to one state."""
+        view = FleetView(compact_horizon=100_000)
+        hub = SubscriptionHub(view, max_subscribers=16, queue_depth=64)
+        n_events, n_keys = 3000, 7
+        subs = [hub.subscribe(rv=0) for _ in range(self.N_SUBSCRIBERS)]
+        errors = []
+        models = [dict() for _ in subs]
+
+        def consume(sub, model):
+            last_key_seq = {}
+            while sub.rv < n_events:
+                result = sub.pull(timeout=5.0)
+                if result.status != OK:
+                    errors.append(f"unexpected status {result.status}")
+                    return
+                if not result.compacted and len(result.deltas) != result.to_rv - result.from_rv:
+                    errors.append("gap: short raw range")
+                prev = result.from_rv
+                for d in result.deltas:
+                    if d.rv <= prev:
+                        errors.append(f"dup/reorder: rv {d.rv} after {prev}")
+                    prev = d.rv
+                    seq = d.object["seq"]
+                    if last_key_seq.get(d.key, -1) >= seq:
+                        errors.append(f"per-key order broken on {d.key}")
+                    last_key_seq[d.key] = seq
+                    model[(d.kind, d.key)] = d.object
+
+        threads = [
+            threading.Thread(target=consume, args=(s, m), daemon=True)
+            for s, m in zip(subs, models)
+        ]
+        for t in threads:
+            t.start()
+        for i in range(n_events):
+            key = f"p{i % n_keys}"
+            view.apply("pod", key, {"kind": "pod", "key": key, "seq": i})
+        for t in threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads), "subscriber wedged"
+        assert errors == []
+        _, objects = view.snapshot()
+        truth = {("pod", o["key"]): o for o in objects}
+        assert all(m == truth for m in models)
+
+
+# -- the resume protocol, randomized ----------------------------------------
+
+
+class TestResumeProtocolProperty:
+    """Seeded randomized invariant test (hypothesis isn't installed in
+    this image; the driver is a seeded ``random.Random`` instead): under
+    concurrent churn, lagging, mid-run reconnects-with-token, and a small
+    compaction horizon, the protocol must deliver exactly-once per key —
+    zero gaps, zero dups, a clean 410 → re-snapshot on expiry — and every
+    subscriber's replayed model must equal the publisher's shadow."""
+
+    @pytest.mark.parametrize("seed", [7, 1337, 20260803])
+    def test_no_gaps_no_dups_under_churn_compaction_reconnects(self, seed):
+        rng = random.Random(seed)
+        # queue_depth 8 << horizon 512: a mildly lagging subscriber lands
+        # in the compaction window (backlog 9..512), a badly lagging one
+        # falls past the horizon (GONE) — both paths must run (asserted)
+        view = FleetView(compact_horizon=512)
+        hub = SubscriptionHub(view, max_subscribers=32, queue_depth=8)
+        n_events, n_keys, n_subs = 4000, 16, 6
+        shadow, shadow_lock = {}, threading.Lock()
+        publishing = threading.Event()
+        publishing.set()
+        stats_lock = threading.Lock()
+        stats = {"gaps": 0, "dups": 0, "resyncs": 0, "reconnects": 0, "compacted": 0}
+
+        def publisher():
+            prng = random.Random(seed ^ 0xFEED)
+            for i in range(n_events):
+                key = f"p{prng.randrange(n_keys)}"
+                if prng.random() < 0.1:
+                    view.apply("pod", key, None)
+                    with shadow_lock:
+                        shadow.pop(("pod", key), None)
+                else:
+                    obj = {"kind": "pod", "key": key, "seq": i}
+                    view.apply("pod", key, obj)
+                    with shadow_lock:
+                        shadow[("pod", key)] = obj
+                if i % 32 == 31:
+                    # fine-grained pacing: bursts smaller than the
+                    # compaction window, so lag lands IN it, not past it
+                    time.sleep(0.0005)
+            publishing.clear()
+
+        def subscriber(sub_seed):
+            prng = random.Random(sub_seed)
+            sub = hub.subscribe(rv=0)
+            model = {}
+            local = dict.fromkeys(stats, 0)
+
+            def resnapshot():
+                rv, objects = view.snapshot()
+                model.clear()
+                model.update({(o["kind"], o["key"]): o for o in objects})
+                sub.rebase(rv)
+
+            while publishing.is_set() or sub.rv < view.rv:
+                action = prng.random()
+                if publishing.is_set() and action < 0.15:
+                    time.sleep(prng.random() * 0.02)  # lag: backlog builds
+                    continue
+                if publishing.is_set() and action < 0.25:
+                    # reconnect: a NEW subscription resuming from the token
+                    nonlocal_sub = hub.subscribe(rv=sub.rv)
+                    if nonlocal_sub is not None:
+                        hub.unsubscribe(sub)
+                        sub = nonlocal_sub
+                        local["reconnects"] += 1
+                result = sub.pull(timeout=0.05)
+                if result.status == GONE:
+                    local["resyncs"] += 1
+                    resnapshot()
+                    continue
+                assert result.status == OK
+                if result.compacted:
+                    local["compacted"] += 1
+                elif len(result.deltas) != result.to_rv - result.from_rv:
+                    local["gaps"] += 1
+                prev = result.from_rv
+                for d in result.deltas:
+                    if d.rv <= prev:
+                        local["dups"] += 1
+                    prev = d.rv
+                    if d.type == DELETE:
+                        model.pop((d.kind, d.key), None)
+                    else:
+                        model[(d.kind, d.key)] = d.object
+            with stats_lock:
+                for k, v in local.items():
+                    stats[k] += v
+            with shadow_lock:
+                assert model == shadow, "subscriber model diverged from publisher shadow"
+
+        threads = [
+            threading.Thread(target=subscriber, args=(seed * 31 + i,), daemon=True)
+            for i in range(n_subs)
+        ]
+        pub = threading.Thread(target=publisher, daemon=True)
+        for t in threads:
+            t.start()
+        pub.start()
+        pub.join(timeout=60)
+        for t in threads:
+            t.join(timeout=60)
+        assert not pub.is_alive() and not any(t.is_alive() for t in threads)
+        assert stats["gaps"] == 0 and stats["dups"] == 0
+        # view itself agrees with the shadow
+        final_rv, objects = view.snapshot()
+        assert {(o["kind"], o["key"]): o for o in objects} == shadow
+        # The hard paths are exercised DETERMINISTICALLY, not left to
+        # thread scheduling (whether a random subscriber happens to lag
+        # past the horizon is a GIL artifact, not a property of the
+        # seed). After ~3.6k applied deltas with horizon 512, rv=0 is
+        # provably behind the trim point:
+        assert final_rv > 700, "churn profile too small to trim"
+        gone_sub = hub.subscribe(rv=0)
+        r = gone_sub.pull()
+        assert r.status == GONE, "410 resync path never ran"
+        # the documented recovery: re-snapshot, resume from its rv
+        snap_rv, snap_objects = view.snapshot()
+        assert {(o["kind"], o["key"]): o for o in snap_objects} == shadow
+        gone_sub.rebase(snap_rv)
+        r = gone_sub.pull()
+        assert r.status == OK and r.deltas == [] and r.to_rv == snap_rv
+        assert gone_sub.resyncs == 1
+        # Latest-wins compaction: resume INSIDE the journal (it retains
+        # >= compact_horizon entries) but > queue_depth behind
+        lag_sub = hub.subscribe(rv=final_rv - 100)
+        assert final_rv - 100 >= view.oldest_rv
+        r2 = lag_sub.pull()
+        assert r2.status == OK and r2.compacted, "latest-wins compaction never engaged"
+        assert r2.to_rv == final_rv
+        keys = [(d.kind, d.key) for d in r2.deltas]
+        assert len(keys) == len(set(keys)), "compacted batch repeated a key"
+        assert [d.rv for d in r2.deltas] == sorted(d.rv for d in r2.deltas)
+        # each key's newest delta in the suffix range IS its final state
+        for d in r2.deltas:
+            if d.type == DELETE:
+                assert (d.kind, d.key) not in shadow
+            else:
+                assert shadow[(d.kind, d.key)] == d.object
+
+
+# -- HTTP surface ------------------------------------------------------------
+
+
+@pytest.fixture
+def serve_http():
+    view = FleetView(compact_horizon=8)
+    hub = SubscriptionHub(view, max_subscribers=4, queue_depth=16)
+    server = ServeServer(view, hub, host="127.0.0.1", port=0).start()
+    try:
+        yield view, hub, f"http://127.0.0.1:{server.port}"
+    finally:
+        server.stop()
+
+
+class TestServeHttp:
+    def test_snapshot_route(self, serve_http):
+        view, _, base = serve_http
+        view.apply("pod", "a", {"kind": "pod", "key": "a", "phase": "Running"})
+        body = requests.get(f"{base}/serve/fleet", timeout=5).json()
+        assert body["rv"] == 1 and body["objects"][0]["key"] == "a"
+
+    def test_watch_requires_rv(self, serve_http):
+        _, _, base = serve_http
+        assert requests.get(f"{base}/serve/fleet?watch=1", timeout=5).status_code == 400
+
+    def test_long_poll_delivers_resumable_deltas(self, serve_http):
+        view, _, base = serve_http
+        view.apply("pod", "a", {"seq": 0})
+        first = requests.get(
+            f"{base}/serve/fleet", params={"watch": "1", "once": "1", "rv": 0}, timeout=5
+        ).json()
+        assert [i["rv"] for i in first["items"]] == [1]
+        view.apply("pod", "a", {"seq": 1})
+        # resume from to_rv on a FRESH connection: no gap, no dup
+        second = requests.get(
+            f"{base}/serve/fleet",
+            params={"watch": "1", "once": "1", "rv": first["to_rv"]},
+            timeout=5,
+        ).json()
+        assert second["from_rv"] == 1 and [i["rv"] for i in second["items"]] == [2]
+
+    def test_expired_token_gets_410_then_resnapshot_works(self, serve_http):
+        view, _, base = serve_http
+        for i in range(40):  # horizon is 8: rv 0 falls behind
+            view.apply("pod", f"p{i}", {"seq": i})
+        r = requests.get(
+            f"{base}/serve/fleet", params={"watch": "1", "once": "1", "rv": 0}, timeout=5
+        )
+        assert r.status_code == 410 and "oldest_rv" in r.json()
+        # the documented recovery: re-snapshot, watch from its rv
+        snap = requests.get(f"{base}/serve/fleet", timeout=5).json()
+        r = requests.get(
+            f"{base}/serve/fleet",
+            params={"watch": "1", "once": "1", "rv": snap["rv"], "timeout": "0.05"},
+            timeout=5,
+        )
+        assert r.status_code == 200 and r.json()["items"] == []
+
+    def test_long_poll_limit_pages_non_lossy(self, serve_http):
+        view, _, base = serve_http
+        for i in range(6):
+            view.apply("pod", f"p{i}", {"seq": i})
+        seen, rv = [], 0
+        while rv < 6:
+            body = requests.get(
+                f"{base}/serve/fleet",
+                params={"watch": "1", "once": "1", "rv": rv, "limit": 2, "timeout": "0.05"},
+                timeout=5,
+            ).json()
+            assert len(body["items"]) <= 2 and not body["compacted"]
+            seen.extend(i["rv"] for i in body["items"])
+            rv = body["to_rv"]
+        assert seen == [1, 2, 3, 4, 5, 6]
+
+    def test_rv_ahead_of_view_gets_410_resync(self, serve_http):
+        # a token ahead of the view = restarted watcher (fresh rv space)
+        # until proven otherwise: 410 so a bare-rv client re-snapshots
+        # instead of wedging on an error its resume loop never handles
+        _, _, base = serve_http
+        r = requests.get(
+            f"{base}/serve/fleet", params={"watch": "1", "once": "1", "rv": 999}, timeout=5
+        )
+        assert r.status_code == 410 and "view" in r.json()
+
+    def test_view_instance_epoch(self, serve_http):
+        view, _, base = serve_http
+        view.apply("pod", "a", {"seq": 0})
+        snap = requests.get(f"{base}/serve/fleet", timeout=5).json()
+        assert snap["view"] == view.instance
+        # echoing the current instance: normal service (body echoes it too)
+        ok = requests.get(
+            f"{base}/serve/fleet",
+            params={"watch": "1", "once": "1", "rv": 0, "view": snap["view"], "timeout": "0.05"},
+            timeout=5,
+        )
+        assert ok.status_code == 200 and ok.json()["view"] == view.instance
+        # a token minted by a previous incarnation (restart): 410, not
+        # silently-grafted deltas and not a 400 the resume loop can't recover
+        stale = requests.get(
+            f"{base}/serve/fleet",
+            params={"watch": "1", "once": "1", "rv": 0, "view": "deadbeef0000"},
+            timeout=5,
+        )
+        assert stale.status_code == 410
+
+    def test_negative_limit_gets_400(self, serve_http):
+        _, _, base = serve_http
+        r = requests.get(
+            f"{base}/serve/fleet",
+            params={"watch": "1", "once": "1", "rv": 0, "limit": -1},
+            timeout=5,
+        )
+        assert r.status_code == 400
+
+    def test_stream_frames_sync_upsert_delete(self, serve_http):
+        view, _, base = serve_http
+        view.apply("pod", "a", {"seq": 0})
+        frames = []
+        with requests.get(
+            f"{base}/serve/fleet",
+            params={"watch": "1", "rv": 0, "timeout": "1.5"},
+            stream=True,
+            timeout=5,
+        ) as r:
+            assert r.status_code == 200
+            publisher_done = threading.Event()
+
+            def churn():
+                time.sleep(0.1)
+                view.apply("pod", "b", {"seq": 1})
+                view.apply("pod", "a", None)
+                publisher_done.set()
+
+            threading.Thread(target=churn, daemon=True).start()
+            for line in r.iter_lines():
+                if line:
+                    frames.append(json.loads(line))
+        types = [f["type"] for f in frames]
+        assert types[0] == "SYNC"  # opening frame carries the resume token
+        assert "UPSERT" in types and "DELETE" in types
+        # the stream window closed cleanly with a final SYNC resume token
+        assert types[-1] == "SYNC" and frames[-1]["rv"] == view.rv
+
+    def test_max_subscribers_answers_503(self, serve_http):
+        view, hub, base = serve_http
+        holds = [hub.subscribe() for _ in range(hub.max_subscribers)]
+        r = requests.get(
+            f"{base}/serve/fleet", params={"watch": "1", "once": "1", "rv": 0}, timeout=5
+        )
+        assert r.status_code == 503 and r.json()["max_subscribers"] == 4
+        for h in holds:
+            hub.unsubscribe(h)
+
+    def test_unknown_route_404(self, serve_http):
+        _, _, base = serve_http
+        assert requests.get(f"{base}/serve/nope", timeout=5).status_code == 404
+
+
+class TestServeAuth:
+    def test_bearer_required_when_token_set_healthz_stays_open(self):
+        view = FleetView()
+        hub = SubscriptionHub(view)
+        server = ServeServer(
+            view, hub, host="127.0.0.1", port=0, auth_token="s3cret"
+        ).start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            assert requests.get(f"{base}/serve/fleet", timeout=5).status_code == 401
+            assert (
+                requests.get(
+                    f"{base}/serve/fleet",
+                    headers={"Authorization": "Bearer wrong"},
+                    timeout=5,
+                ).status_code
+                == 401
+            )
+            ok = requests.get(
+                f"{base}/serve/fleet",
+                headers={"Authorization": "Bearer s3cret"},
+                timeout=5,
+            )
+            assert ok.status_code == 200 and ok.json()["rv"] == 0
+            # liveness never needs the token (probe contract)
+            assert requests.get(f"{base}/serve/healthz", timeout=5).status_code == 200
+        finally:
+            server.stop()
+
+
+# -- ServePlane bundle + config schema ---------------------------------------
+
+
+class TestServePlane:
+    def test_plane_health_and_sink_tap(self):
+        plane = ServePlane(ServeConfig(enabled=True, port=0), metrics=MetricsRegistry())
+        seen = []
+        sink = plane.wrap_sink(seen.append)
+        note = Notification({"slice": "s0", "healthy": True}, 0.0, kind="slice")
+        sink(note)
+        assert seen == [note]  # the tap forwards to the real sink
+        _, objects = plane.view.snapshot()
+        assert objects and objects[0]["kind"] == "slice"
+        health = plane.health()
+        assert health["healthy"] and not health["started"]
+        plane.start()
+        try:
+            assert plane.port > 0 and plane.health()["started"]
+            assert requests.get(
+                f"http://127.0.0.1:{plane.port}/serve/healthz", timeout=5
+            ).json()["view_rv"] == 1
+        finally:
+            plane.stop()
+
+
+class TestServeConfigSchema:
+    def test_defaults_off(self):
+        cfg = ServeConfig.from_raw({})
+        assert not cfg.enabled and cfg.max_subscribers == 5000
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(SchemaError, match="serve"):
+            ServeConfig.from_raw({"qeue_depth": 1})
+
+    def test_horizon_must_cover_queue_depth(self):
+        with pytest.raises(SchemaError, match="compact_horizon"):
+            ServeConfig.from_raw({"queue_depth": 512, "compact_horizon": 256})
+
+    def test_port_range(self):
+        with pytest.raises(SchemaError, match="port"):
+            ServeConfig.from_raw({"port": 70000})

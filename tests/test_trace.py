@@ -324,9 +324,14 @@ class TestDebugTraceRoute:
         self._trace(tracer, "uid-c", "lane_wait")
         server = self._server(tracer.ring)
         try:
+            from k8s_watcher_tpu.trace import ALL_STAGES
+
             base = f"http://127.0.0.1:{server.port}/debug/trace"
             body = requests.get(base, timeout=5).json()
-            assert body["ring_size"] == 3 and body["stages"] == list(STAGES)
+            # the route's stage vocabulary includes the serving plane's
+            # serve_fanout (queryable via ?slowest= even though it is not
+            # one of the six required hand-off stages)
+            assert body["ring_size"] == 3 and body["stages"] == list(ALL_STAGES)
             # newest first
             assert [t["uid"] for t in body["traces"]] == ["uid-c", "uid-b", "uid-a"]
             assert [s["stage"] for s in body["traces"][0]["spans"]] == list(STAGES)
