@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: test check check-scale integration integration-kind integration-mock bench bench-smoke trace-smoke serve-smoke dryrun dryrun-128 accept
+.PHONY: test check check-scale integration integration-kind integration-mock bench bench-smoke trace-smoke serve-smoke history-smoke dryrun dryrun-128 accept
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -58,6 +58,17 @@ trace-smoke:
 # (bench_serve_fanout); this target gates the protocol.
 serve-smoke:
 	$(PY) scripts/serve_smoke.py
+
+# History-plane smoke: the full durable-history contract through the REAL
+# app wiring across a process-lifecycle boundary — capture a WAL under
+# churn, SIGTERM-shape shutdown, restart into the SAME rv line/instance,
+# resume with the pre-restart token (zero gaps/dups/410s), reconstruct a
+# pre-restart snapshot via ?at=, check the /debug/history inventory, then
+# byte-compare two offline replays of the capture. The WAL's ingest-side
+# overhead (<5%) is gated by bench-smoke (bench_wal_overhead).
+# Artifact: artifacts/history_smoke.json.
+history-smoke:
+	$(PY) scripts/history_smoke.py
 
 dryrun:
 	$(PY) __graft_entry__.py 8

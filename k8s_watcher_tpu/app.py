@@ -190,6 +190,29 @@ class WatcherApp:
                 metrics=self.metrics,
             )
         self.status_server: Optional[StatusServer] = None
+        # durable history plane (history/): a segmented delta WAL under
+        # the serving plane. Recovery runs HERE, before the view exists,
+        # so the ServePlane constructs its FleetView straight onto the
+        # previous incarnation's rv line (same instance id, preloaded
+        # journal tail — resume tokens survive the restart).
+        self.history = None
+        if config.history.enabled:
+            from k8s_watcher_tpu.history import HistoryStore
+
+            h = config.history
+            self.history = HistoryStore(
+                h.dir,
+                segment_max_bytes=h.segment_max_bytes,
+                segment_max_age_seconds=h.segment_max_age_seconds,
+                retain_segments=h.retain_segments,
+                fsync=h.fsync,
+                fsync_interval_seconds=h.fsync_interval_seconds,
+                metrics=self.metrics,
+            )
+            # the journal preload is bounded by the in-memory horizon:
+            # deeper history still serves ?at= reads, but resume reads
+            # come from memory — same ceiling as steady state
+            self.history.recover(journal_limit=config.serve.compact_horizon)
         # fleet-state serving plane (serve/): a materialized view of pod/
         # slice/probe state with resumable snapshot+delta subscriptions.
         # The view exists from construction (the pipeline publishes into
@@ -204,6 +227,7 @@ class WatcherApp:
                 # same bearer contract as the status plane: the serving
                 # plane must not be an unauthenticated side door
                 auth_token=config.watcher.status_auth_token,
+                history=self.history,
             )
         c = config.clusterapi
         self.dispatcher = Dispatcher(
@@ -343,6 +367,7 @@ class WatcherApp:
                     if self._probe_agent is not None else None
                 ),
                 checkpoint=self.checkpoint.stats if self.checkpoint is not None else None,
+                history=self.history.stats if self.history is not None else None,
                 auth_token=self.config.watcher.status_auth_token,
             ).start()
             routes = "/metrics, /healthz, /debug/slices" + (
@@ -355,6 +380,8 @@ class WatcherApp:
                 ", /debug/remediation" if remediation_state is not None else ""
             ) + (
                 ", /debug/checkpoint" if self.checkpoint is not None else ""
+            ) + (
+                ", /debug/history" if self.history is not None else ""
             )
             logger.info("Status endpoint on :%d (%s)", self.status_server.port, routes)
         if self.config.watcher.leader_election.enabled:
@@ -558,6 +585,11 @@ class WatcherApp:
         if self._probe_agent is not None:
             self._probe_agent.stop()
         self.dispatcher.stop()
+        if self.history is not None:
+            # after every delta producer stopped: drain the WAL queue,
+            # write the terminal snapshot anchor, fsync — the thing that
+            # makes the next boot's recovery instant
+            self.history.close()
         if self.checkpoint is not None:
             self._maybe_checkpoint(force=True)
             self.checkpoint.flush()

@@ -770,6 +770,93 @@ class ServeConfig:
         )
 
 
+#: accepted history.fsync policies (mirrored by history/wal.py)
+VALID_FSYNC_POLICIES = ("never", "interval", "always")
+
+
+@dataclasses.dataclass(frozen=True)
+class HistoryConfig:
+    """The ``history:`` section — net-new durable fleet history plane
+    (history/): a segmented, CRC-framed WAL under the serving plane's
+    delta journal. Every FleetView delta persists; recovery rebuilds the
+    view at boot (same instance id, same monotonic rv line) so resume
+    tokens survive restarts; ``GET /serve/fleet?at=rv`` reconstructs
+    historical snapshots; ``scripts/history_replay.py`` turns any
+    capture into a deterministic regression fixture (ARCHITECTURE.md
+    "History plane"). Requires ``serve.enabled`` (the WAL records the
+    serving plane's deltas).
+    """
+
+    enabled: bool = False
+    dir: Optional[str] = None  # required when enabled
+    # durability knob: "never" (page cache only — a lost checkpoint costs
+    # replayable history, not correctness), "interval" (default: one
+    # fsync per fsync_interval_seconds), "always" (per write batch)
+    fsync: str = "interval"
+    fsync_interval_seconds: float = 1.0
+    # rotation: the active segment seals once it outgrows either bound;
+    # every new segment opens with a full snapshot record
+    segment_max_bytes: int = 8 * 1024 * 1024
+    segment_max_age_seconds: float = 3600.0
+    # retention: newest N segments kept; the oldest retained segment's
+    # opening snapshot is the durable horizon (410 past it)
+    retain_segments: int = 8
+
+    @classmethod
+    def from_raw(cls, raw: Mapping[str, Any]) -> "HistoryConfig":
+        _check_known(
+            raw,
+            ("enabled", "dir", "fsync", "fsync_interval_seconds",
+             "segment_max_bytes", "segment_max_age_seconds", "retain_segments"),
+            "history",
+        )
+        enabled = _opt_bool(raw, "enabled", "history", False)
+        directory = _opt_str(raw, "dir", "history", None)
+        if enabled and not directory:
+            raise SchemaError(
+                "config key 'history.dir': required when history.enabled (the WAL "
+                "needs a directory to persist segments into)"
+            )
+        fsync = _opt_str(raw, "fsync", "history", "interval")
+        if fsync not in VALID_FSYNC_POLICIES:
+            raise SchemaError(
+                f"config key 'history.fsync': must be one of "
+                f"{', '.join(VALID_FSYNC_POLICIES)}, got {fsync!r}"
+            )
+        fsync_interval = _opt_num(raw, "fsync_interval_seconds", "history", 1.0)
+        if fsync_interval <= 0:
+            raise SchemaError(
+                f"config key 'history.fsync_interval_seconds': must be > 0, got {fsync_interval}"
+            )
+        segment_max_bytes = _opt_int(raw, "segment_max_bytes", "history", 8 * 1024 * 1024)
+        if segment_max_bytes < 4096:
+            raise SchemaError(
+                f"config key 'history.segment_max_bytes': must be >= 4096, got "
+                f"{segment_max_bytes} (a segment smaller than its own opening "
+                f"snapshot record rotates on every batch)"
+            )
+        segment_max_age = _opt_num(raw, "segment_max_age_seconds", "history", 3600.0)
+        if segment_max_age <= 0:
+            raise SchemaError(
+                f"config key 'history.segment_max_age_seconds': must be > 0, got {segment_max_age}"
+            )
+        retain = _opt_int(raw, "retain_segments", "history", 8)
+        if retain < 2:
+            raise SchemaError(
+                f"config key 'history.retain_segments': must be >= 2 (the active "
+                f"segment plus at least one sealed anchor), got {retain}"
+            )
+        return cls(
+            enabled=enabled,
+            dir=directory,
+            fsync=fsync,
+            fsync_interval_seconds=fsync_interval,
+            segment_max_bytes=segment_max_bytes,
+            segment_max_age_seconds=segment_max_age,
+            retain_segments=retain,
+        )
+
+
 @dataclasses.dataclass(frozen=True)
 class StateConfig:
     """The ``state:`` section — net-new checkpoint/resume (SURVEY.md §5).
@@ -804,13 +891,14 @@ class AppConfig:
     ingest: IngestConfig = dataclasses.field(default_factory=IngestConfig)
     trace: TraceConfig = dataclasses.field(default_factory=TraceConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
+    history: HistoryConfig = dataclasses.field(default_factory=HistoryConfig)
 
-    TOP_LEVEL_KEYS = ("environment", "watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest", "trace", "serve")
+    TOP_LEVEL_KEYS = ("environment", "watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest", "trace", "serve", "history")
 
     @classmethod
     def from_raw(cls, raw: Mapping[str, Any], environment: str) -> "AppConfig":
         _check_known(raw, cls.TOP_LEVEL_KEYS, "<root>")
-        for section in ("watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest", "trace", "serve"):
+        for section in ("watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest", "trace", "serve", "history"):
             _expect(raw.get(section) or {}, (dict,), section)
         # The reference's development.yaml declared `environment: local` while
         # the CLI only accepted development|staging|production, leaving the
@@ -819,6 +907,14 @@ class AppConfig:
         declared = raw.get("environment")
         if declared is not None:
             _expect(declared, (str,), "environment")
+        serve = ServeConfig.from_raw(raw.get("serve") or {})
+        history = HistoryConfig.from_raw(raw.get("history") or {})
+        if history.enabled and not serve.enabled:
+            raise SchemaError(
+                "config key 'history.enabled': requires serve.enabled (the WAL "
+                "persists the serving plane's FleetView deltas; without the "
+                "serving plane there is nothing to record)"
+            )
         return cls(
             environment=environment,
             watcher=WatcherConfig.from_raw(raw.get("watcher") or {}),
@@ -828,5 +924,6 @@ class AppConfig:
             state=StateConfig.from_raw(raw.get("state") or {}),
             ingest=IngestConfig.from_raw(raw.get("ingest") or {}),
             trace=TraceConfig.from_raw(raw.get("trace") or {}),
-            serve=ServeConfig.from_raw(raw.get("serve") or {}),
+            serve=serve,
+            history=history,
         )

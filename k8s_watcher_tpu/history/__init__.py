@@ -1,0 +1,31 @@
+"""Durable fleet history plane: segmented delta WAL + restart-surviving
+recovery + deterministic replay (see ARCHITECTURE.md "History plane")."""
+
+from k8s_watcher_tpu.history.recovery import (
+    RecoveredState,
+    journal_deltas,
+    reconstruct_at,
+    recover_state,
+)
+from k8s_watcher_tpu.history.replay import (
+    ReplayResult,
+    canonical_snapshot,
+    replay_digest,
+    replay_wal,
+    snapshot_sha256,
+)
+from k8s_watcher_tpu.history.wal import FSYNC_POLICIES, HistoryStore
+
+__all__ = [
+    "FSYNC_POLICIES",
+    "HistoryStore",
+    "RecoveredState",
+    "ReplayResult",
+    "canonical_snapshot",
+    "journal_deltas",
+    "reconstruct_at",
+    "recover_state",
+    "replay_digest",
+    "replay_wal",
+    "snapshot_sha256",
+]

@@ -65,14 +65,39 @@ def bearer_authorized(header: Optional[str], token: Optional[str]) -> bool:
     if token is None:
         return True
     scheme, _, presented = (header or "").partition(" ")
-    # compare bytes: compare_digest raises TypeError on non-ASCII str
-    # (http.server decodes headers as latin-1), which would drop the
-    # connection with a traceback instead of answering 401
     # auth schemes are case-insensitive (RFC 9110 §11.1); proxies and
     # some clients normalize to lowercase
-    return scheme.lower() == "bearer" and hmac.compare_digest(
-        presented.strip().encode("utf-8", "surrogateescape"),
-        token.encode("utf-8"),
+    if scheme.lower() != "bearer":
+        return False
+    # http.server decodes header bytes as LATIN-1, so re-encoding with
+    # latin-1 recovers the exact wire bytes; a client sending a UTF-8
+    # token then compares equal against token.encode("utf-8"). (The old
+    # utf-8 re-encode double-encoded any non-ASCII byte, so a VALID
+    # non-ASCII token could never authenticate.) Comparing bytes also
+    # keeps compare_digest from raising on non-ASCII str input.
+    try:
+        # ASCII OWS only (RFC 9110 §5.6.3): Python's bare strip() also
+        # removes U+00A0/U+0085, which are legitimate latin-1-decoded
+        # TOKEN bytes (e.g. the trailing byte of UTF-8 'à' is 0xA0) —
+        # stripping them would reject a valid non-ASCII token
+        presented_bytes = presented.strip(" \t").encode("latin-1")
+    except UnicodeEncodeError:
+        # codepoints > U+00FF cannot have come off an http.server wire
+        # decode and cannot match any wire encoding of the token
+        return False
+    # clients legitimately differ in how they put a non-ASCII token on
+    # the wire (curl sends UTF-8; urllib3 sends latin-1 when the string
+    # allows it) — accept either encoding of the configured token. The
+    # non-short-circuiting `|` runs both compares every time, keeping
+    # the check constant-time.
+    token_utf8 = token.encode("utf-8")
+    try:
+        token_latin1 = token.encode("latin-1")
+    except UnicodeEncodeError:
+        token_latin1 = token_utf8
+    return bool(
+        hmac.compare_digest(presented_bytes, token_utf8)
+        | hmac.compare_digest(presented_bytes, token_latin1)
     )
 
 
@@ -141,6 +166,9 @@ class _StatusHandler(BaseHTTPRequestHandler):
     # Callable[[], dict]: checkpoint store stats (journal depth, last
     # flush cost) — the persistence plane's health surface
     checkpoint = None
+    # Callable[[], dict]: history-WAL segment inventory (per-segment
+    # rv ranges/bytes, retention floor, writer liveness) -> /debug/history
+    history = None
     # Optional bearer token; when set, every route except /healthz requires
     # ``Authorization: Bearer <token>``. /healthz stays open so kubelet
     # liveness probes keep working without httpGet header plumbing — it
@@ -282,6 +310,11 @@ class _StatusHandler(BaseHTTPRequestHandler):
                 self._json(404, {"error": "checkpointing not enabled (state.checkpoint_path)"})
                 return
             self._json(200, {"checkpoint": self.checkpoint()})
+        elif parsed.path == "/debug/history":
+            if self.history is None:
+                self._json(404, {"error": "history plane not enabled (history.enabled)"})
+                return
+            self._json(200, {"history": self.history()})
         elif parsed.path == "/debug/remediation":
             if self.remediation is None:
                 self._json(404, {"error": "remediation not wired (tpu.remediation.enabled)"})
@@ -312,6 +345,7 @@ class StatusServer:
         remediation=None,  # Callable[[], Optional[dict]] -> /debug/remediation
         probes=None,  # Callable[[int], list] -> /debug/probes (cycle ring)
         checkpoint=None,  # Callable[[], dict] -> /debug/checkpoint (store stats)
+        history=None,  # Callable[[], dict] -> /debug/history (WAL segment inventory)
         auth_token: Optional[str] = None,  # bearer token; None = open (see RUNBOOK threat model)
     ):
         handler = type(
@@ -329,6 +363,7 @@ class StatusServer:
                 "remediation": staticmethod(remediation) if remediation else None,
                 "probes": staticmethod(probes) if probes else None,
                 "checkpoint": staticmethod(checkpoint) if checkpoint else None,
+                "history": staticmethod(history) if history else None,
                 "auth_token": auth_token,
             },
         )

@@ -202,9 +202,9 @@ class Dispatcher:
             self.metrics.counter("dispatch_dropped_stopping").inc()
             # the audit ring records UNtraced shutdown drops too — same
             # "what happened to my pod's notification" contract the
-            # overflow/abandon paths honor
-            if notification.trace is not None or self.audit is not None:
-                self._egress_terminal(notification, "dropped_stopping", lane=None)
+            # overflow/abandon paths honor (_egress_terminal itself no-ops
+            # when neither a trace nor a ring nor a tracer is wired)
+            self._egress_terminal(notification, "dropped_stopping", lane=None)
             return False
         if not self._started:
             self.start()
@@ -575,11 +575,13 @@ class Dispatcher:
         # sweep itself.)
         strays = 0
         for i, lane in enumerate(self._lanes):
-            abandoned: List[Notification] = []
+            # _claim resolves markers to their waiting payloads, so the
+            # sweep never needs its own entry-type dispatch
             with lane.cond:
-                while lane.entries:
-                    abandoned.append(self._claim(lane, lane.entries.popleft()))
-                    strays += 1
+                abandoned: List[Notification] = [
+                    self._claim(lane, lane.entries.popleft()) for _ in range(len(lane.entries))
+                ]
+            strays += len(abandoned)
             # terminal accounting outside lane.cond (ring lock + logging)
             for notification in abandoned:
                 self._egress_terminal(notification, "abandoned", lane=i)

@@ -84,6 +84,7 @@ class _ServeHandler(BaseHTTPRequestHandler):
     view: FleetView
     hub: SubscriptionHub
     plane = None  # the owning ServePlane (health payload)
+    history = None  # history.HistoryStore -> ?at= time-travel reads
     auth_token: Optional[str] = None
 
     def log_message(self, *a):
@@ -112,8 +113,58 @@ class _ServeHandler(BaseHTTPRequestHandler):
         if params.get("watch") in ("1", "true"):
             self._serve_watch(params)
             return
+        if "at" in params:
+            self._serve_at(params)
+            return
         rv, objects = self.view.snapshot()
         self._json(200, {"rv": rv, "view": self.view.instance, "objects": objects})
+
+    def _serve_at(self, params: dict) -> None:
+        """Time travel: ``GET /serve/fleet?at=N`` reconstructs the fleet
+        snapshot as of rv N from the history WAL (snapshot record +
+        deltas). 410 past the retention horizon — the same re-snapshot
+        recovery contract as a compacted resume token, one layer deeper."""
+        if self.history is None:
+            self._json(
+                400,
+                {"error": "time-travel reads need the history plane (history.enabled)"},
+            )
+            return
+        try:
+            at_rv = int(params["at"])
+        except ValueError:
+            self._json(400, {"error": "at= must be an integer rv"})
+            return
+        if at_rv < 0:
+            self._json(400, {"error": "at= must be >= 0"})
+            return
+        status, rv, objects = self.history.reconstruct(at_rv)
+        if status == "gone":
+            self._json(
+                410,
+                {"error": "rv is not reconstructible from retained history "
+                          "(behind the retention horizon, or inside a rebase/tear hole)",
+                 "rv": at_rv, "retention_floor_rv": rv},
+            )
+            return
+        if status == "future":
+            self._json(
+                400,
+                {"error": "rv is past the durable history (not yet written, or never minted)",
+                 "rv": at_rv, "durable_rv": rv},
+            )
+            return
+        self._json(
+            200,
+            {
+                "rv": at_rv,
+                "view": self.view.instance,
+                "historical": True,
+                # deterministic order (sorted (kind, key)) — reconstructions
+                # are compared byte-wise in the smoke/replay legs
+                "objects": [objects[k] for k in sorted(objects)],
+            },
+        )
 
     def _serve_watch(self, params: dict) -> None:
         try:
@@ -267,11 +318,13 @@ class ServeServer:
         port: int = 0,
         auth_token: Optional[str] = None,
         plane=None,
+        history=None,
     ):
         handler = type(
             "BoundServeHandler",
             (_ServeHandler,),
-            {"view": view, "hub": hub, "auth_token": auth_token, "plane": plane},
+            {"view": view, "hub": hub, "auth_token": auth_token, "plane": plane,
+             "history": history},
         )
         self._server = QuietThreadingHTTPServer((host, port), handler)
         self._server.daemon_threads = True
@@ -307,10 +360,50 @@ class ServePlane:
     with the app's other servers in ``run()``.
     """
 
-    def __init__(self, config, *, metrics=None, auth_token: Optional[str] = None):
+    def __init__(self, config, *, metrics=None, auth_token: Optional[str] = None, history=None):
         self.config = config
         self.metrics = metrics
         self.view = FleetView(compact_horizon=config.compact_horizon, metrics=metrics)
+        # durable history plane (history.HistoryStore, already recovered):
+        # restore the previous incarnation's rv line + instance + journal
+        # tail into the fresh view, then open the WAL writer on this
+        # (possibly inherited) instance and start persisting new deltas
+        self.history = history
+        if history is not None:
+            recovered = history.recovered
+            if recovered is not None and recovered.instance:
+                from k8s_watcher_tpu.history.recovery import journal_deltas
+
+                if recovered.clean:
+                    self.view.restore(
+                        instance=recovered.instance,
+                        rv=recovered.rv,
+                        objects=recovered.objects,
+                        journal=journal_deltas(recovered.journal),
+                    )
+                else:
+                    # UNCLEAN end (no final snapshot / torn tail): deltas
+                    # acked to subscribers beyond the durable rv may be
+                    # lost, and new churn would re-mint those rvs with
+                    # different contents — inheriting the instance would
+                    # let pre-crash tokens graft two divergent rv lines
+                    # into one token space. Keep the durable state + rv
+                    # line (history/?at= stay coherent) under a FRESH
+                    # instance: pre-crash tokens 410 into a re-snapshot,
+                    # the pre-PR contract, now only for unclean crashes.
+                    logger.warning(
+                        "History WAL ends uncleanly (crash?): resuming rv line at %d "
+                        "under a fresh view instance — pre-crash resume tokens will "
+                        "re-snapshot (410)", recovered.rv,
+                    )
+                    self.view.restore(
+                        instance=self.view.instance,
+                        rv=recovered.rv,
+                        objects=recovered.objects,
+                        journal=[],
+                    )
+            history.open(self.view.instance)
+            self.view.attach_history(history)
         self.hub = SubscriptionHub(
             self.view,
             max_subscribers=config.max_subscribers,
@@ -339,6 +432,7 @@ class ServePlane:
             port=self.config.port,
             auth_token=self._auth_token,
             plane=self,
+            history=self.history,
         ).start()
         logger.info(
             "Serving plane on :%d (/serve/fleet snapshot+watch, max_subscribers=%d, "
@@ -362,7 +456,7 @@ class ServePlane:
         unhealthy once its HTTP thread has died (subscribers silently get
         nothing — as blind-making as a dead egress worker)."""
         server = self.server  # racing stop(); read once
-        return {
+        body = {
             "healthy": server is None or server.alive,
             "started": server is not None,
             "subscribers": self.hub.active_count,
@@ -371,3 +465,13 @@ class ServePlane:
             "oldest_rv": self.view.oldest_rv,
             "objects": self.view.object_count(),
         }
+        if self.history is not None:
+            # a dead WAL writer silently stops persisting deltas — as
+            # blind-making for the restart story as a dead serve thread
+            # is for subscribers; only fold it while the plane runs (a
+            # closed writer after stop() is lifecycle, not a fault)
+            history_health = self.history.health()
+            body["history"] = history_health
+            if server is not None and not history_health["healthy"]:
+                body["healthy"] = False
+        return body

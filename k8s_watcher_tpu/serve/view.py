@@ -125,6 +125,11 @@ class FleetView:
         # bisect over _delta_rvs finds a resume point in O(log n)
         self._delta_rvs: List[int] = []
         self._deltas: List[Delta] = []
+        # durable history plane (history.HistoryStore), when enabled:
+        # every applied delta is handed off (O(1) enqueue) UNDER the
+        # publish lock — that lock ordering is what keeps the WAL
+        # rv-ordered across the pipeline thread and the sink-tap threads
+        self._history = None
         self._publish_seconds = (
             metrics.histogram("serve_publish_seconds") if metrics is not None else None
         )
@@ -135,6 +140,46 @@ class FleetView:
             metrics.counter("serve_deltas_published") if metrics is not None else None
         )
         self._rv_gauge = metrics.gauge("serve_view_rv") if metrics is not None else None
+
+    # -- durable history (restart-surviving rv line) -----------------------
+
+    def restore(
+        self,
+        *,
+        instance: str,
+        rv: int,
+        objects: Dict[Tuple[str, str], Dict[str, Any]],
+        journal: List[Delta],
+    ) -> None:
+        """Adopt WAL-recovered state: the previous incarnation's instance
+        id, its rv line (new deltas continue from ``rv``), its objects,
+        and the preloaded journal tail (rv-ascending, contiguous, ending
+        at ``rv``) so pre-restart resume tokens read straight from
+        memory. Call before any publish (app wiring does)."""
+        with self._cond:
+            self.instance = instance
+            self._rv = rv
+            self._objects = dict(objects)
+            self._deltas = list(journal)
+            self._delta_rvs = [d.rv for d in journal]
+            # tokens older than the preloaded tail 410 — the compaction-
+            # horizon contract, now spanning incarnations
+            self._oldest_rv = journal[0].rv - 1 if journal else rv
+            if self._rv_gauge is not None:
+                self._rv_gauge.set(self._rv)
+
+    def attach_history(self, history) -> None:
+        """Wire the durable WAL (history.HistoryStore): deltas flow to
+        it from every apply path; it reads the live state back only on
+        overrun rebase."""
+        history.state_provider = self.state_for_history
+        self._history = history
+
+    def state_for_history(self) -> Tuple[int, Dict[Tuple[str, str], Dict[str, Any]]]:
+        """``(rv, {(kind, key): obj})`` — the WAL writer's rebase anchor
+        (objects are replaced, never mutated, so the copy is shallow)."""
+        with self._cond:
+            return self._rv, dict(self._objects)
 
     # -- writing (pipeline thread + sink taps) ----------------------------
 
@@ -173,6 +218,10 @@ class FleetView:
         with self._cond:
             changed = self._apply_locked(kind, key, obj, now)
             if changed:
+                if self._history is not None:
+                    # BEFORE the trim: a horizon shorter than the burst
+                    # must never cost the WAL a delta
+                    self._history.publish(self._deltas[-1:])
                 self._trim_locked()
                 if self._rv_gauge is not None:
                     self._rv_gauge.set(self._rv)
@@ -219,7 +268,15 @@ class FleetView:
                 trace = getattr(event, "trace", None)
                 if trace is not None and not trace.handed_off:
                     stamp.append(trace)
+            t_wal = 0.0
             if changed:
+                if self._history is not None:
+                    # one O(1) hand-off for the whole batch, pre-trim;
+                    # the span below attributes the enqueue cost (disk
+                    # latency lives on the WAL writer thread — see
+                    # history_wal_write_seconds)
+                    t_wal = time.monotonic()
+                    self._history.publish(self._deltas[-changed:])
                 self._trim_locked()
                 if self._rv_gauge is not None:
                     self._rv_gauge.set(self._rv)
@@ -227,6 +284,8 @@ class FleetView:
         t_end = time.monotonic()
         for trace in stamp:
             trace.add_span("serve_fanout", t_start, t_end)
+            if t_wal:
+                trace.add_span("wal_append", t_wal, t_end)
         if changed:
             if self._deltas_published is not None:
                 self._deltas_published.inc(changed)

@@ -127,7 +127,21 @@ class JournaledMapStore:
         # in-progress sliced compaction (guarded by _io_lock): dict with
         # gen/snapshot/keys/idx/fh/delta, or None
         self._compacting: Optional[Dict[str, Any]] = None
+        # True once this map has EVER held state (a base/journal existed
+        # on disk, or replace() ran): distinguishes an empty-but-present
+        # map (every key legitimately deleted) from a never-populated one
+        # (CheckpointStore.get must fall back to its default only for
+        # the latter)
+        self._populated = False
+        # lock-free stats mirror: a dict REPLACED wholesale (atomic ref
+        # swap under the GIL) at every point gen/journal/compaction state
+        # changes, so a /debug/checkpoint scrape never blocks on _io_lock
+        # behind an in-flight compaction slice
+        self._io_shadow: Dict[str, Any] = {
+            "generation": 0, "journal_entries": 0, "compacting": None,
+        }
         self._load()
+        self._publish_io_shadow()  # _load's early returns skip the one inside
 
     def _load(self) -> None:
         try:
@@ -153,6 +167,7 @@ class JournaledMapStore:
             ):
                 self._map = data["map"]
                 self._gen = data.get("gen", 0)
+                self._populated = True
             else:
                 logger.warning("Journaled map %s has unknown schema; starting cold", self.base_path)
         except FileNotFoundError:
@@ -180,10 +195,28 @@ class JournaledMapStore:
                 logger.warning("Journal %s has a torn line; replay stopped there", self.journal_path)
                 break
             self._journal_entries += 1
+            self._populated = True
             if entry.get("d"):
                 self._map.pop(key, None)
             else:
                 self._map[key] = entry.get("v")
+        self._publish_io_shadow()
+
+    def _publish_io_shadow(self) -> None:
+        """Refresh the lock-free stats mirror. Call from every site that
+        mutates gen/journal depth/compaction progress (all run under
+        ``_io_lock``, so the build is consistent); readers just grab the
+        reference — no lock, no stall behind a compaction slice."""
+        comp = self._compacting
+        self._io_shadow = {
+            "generation": self._gen,
+            "journal_entries": self._journal_entries,
+            "compacting": (
+                {"target_gen": comp["gen"], "written": comp["idx"], "total": len(comp["keys"])}
+                if comp is not None
+                else None
+            ),
+        }
 
     # -- accessors ---------------------------------------------------------
 
@@ -192,22 +225,29 @@ class JournaledMapStore:
         with self._lock:
             return dict(self._map)
 
+    @property
+    def populated(self) -> bool:
+        """True once the map has ever held state (disk or replace()).
+        An empty-but-populated map means "every key deleted" — a real
+        answer, distinct from "nothing persisted yet"."""
+        with self._lock:
+            return self._populated
+
     def stats(self) -> Dict[str, Any]:
         """Observability snapshot for /debug/checkpoint: generation,
-        journal depth, live-map size, and on-disk byte counts."""
-        # gen/journal_entries mutate under _io_lock (the flush path);
-        # reading them without it could pair a post-compaction generation
-        # with the pre-compaction journal depth — a torn snapshot on the
-        # exact compaction-health signal this surface exists for
-        with self._io_lock:
-            gen = self._gen
-            journal_entries = self._journal_entries
-            comp = self._compacting
-            compacting = (
-                {"target_gen": comp["gen"], "written": comp["idx"], "total": len(comp["keys"])}
-                if comp is not None
-                else None
-            )
+        journal depth, live-map size, and on-disk byte counts.
+
+        Deliberately does NOT take ``_io_lock``: a scrape must never
+        stall behind an in-flight compaction slice (a 50k-map rewrite
+        holds that lock for tens of ms at a time). It reads the
+        ``_io_shadow`` mirror instead — replaced wholesale under
+        ``_io_lock`` by every mutator, so one reference read yields an
+        internally-consistent (gen, journal depth, compaction progress)
+        triple; it can be one flush stale, never torn."""
+        shadow = self._io_shadow
+        gen = shadow["generation"]
+        journal_entries = shadow["journal_entries"]
+        compacting = shadow["compacting"]
         with self._lock:
             map_size = len(self._map)
             pending = self._pending
@@ -244,6 +284,7 @@ class JournaledMapStore:
         correct for any caller, incremental only for hinting ones."""
         with self._lock:
             self._map = new_map
+            self._populated = True
             if changed_keys is None:
                 self._pending = None
             elif self._pending is not None:
@@ -335,6 +376,7 @@ class JournaledMapStore:
                 self._pending = None
             return False
         self._journal_entries += len(pending)
+        self._publish_io_shadow()
         return True
 
     # -- sliced compaction -------------------------------------------------
@@ -366,6 +408,7 @@ class JournaledMapStore:
             # journal replay to the LIVE state, not the snapshot
             "delta": set(),
         }
+        self._publish_io_shadow()
         self._advance_compaction(finalize=False)
 
     def _abort_compaction(self) -> None:
@@ -373,6 +416,7 @@ class JournaledMapStore:
         if comp is None:
             return
         self._compacting = None
+        self._publish_io_shadow()
         try:
             comp["fh"].close()
         except Exception:  # noqa: BLE001 — best-effort teardown
@@ -407,6 +451,7 @@ class JournaledMapStore:
                 self._compaction_failed("slice write", exc)
                 return
             comp["idx"] = end
+            self._publish_io_shadow()
         if comp["idx"] < len(keys):
             return  # more slices on later flushes
         self._finalize_compaction()
@@ -456,6 +501,7 @@ class JournaledMapStore:
         self._compacting = None
         self._gen = gen
         self._journal_entries = len(lines)
+        self._publish_io_shadow()
         # reclaim the old-gen (now fenced-out) journal lines; atomic so a
         # crash can't tear the delta lines we just made load-bearing
         _atomic_write(self.journal_path, "\n".join(lines) + "\n" if lines else "")
@@ -472,6 +518,7 @@ class JournaledMapStore:
             return
         self._gen = gen
         self._journal_entries = 0
+        self._publish_io_shadow()
         try:
             open(self.journal_path, "w").close()
         except OSError as exc:
@@ -532,7 +579,10 @@ class CheckpointStore:
                 "Discarding malformed legacy %r section during journaled-map migration", key
             )
             legacy = None
-        if legacy is not None and not store.current():
+        if legacy is not None and not store.populated:
+            # migrate only into a NEVER-populated store: an existing
+            # journaled map (even one emptied to {}) is newer truth than
+            # a stale legacy section
             store.replace(legacy)  # unknown delta -> full compaction on flush
         self._journaled[key] = store
         return store
@@ -565,7 +615,14 @@ class CheckpointStore:
     def get(self, key: str, default: Any = None) -> Any:
         journaled = self._journaled.get(key)
         if journaled is not None:
-            return journaled.current() or default
+            # an empty-but-present map is a real answer (every entry was
+            # legitimately deleted — e.g. a cluster drained to zero pods);
+            # conflating it with "missing" (the old `current() or default`)
+            # resurrected the caller's default state after a restart. The
+            # default applies only when the map was NEVER populated.
+            if journaled.populated:
+                return journaled.current()
+            return default
         with self._lock:
             return self._state.get(key, default)
 

@@ -5,6 +5,7 @@ from k8s_watcher_tpu.trace.trace import (
     ANOMALY_OUTCOMES,
     SERVE_STAGE,
     STAGES,
+    WAL_STAGE,
     Trace,
     TraceRing,
     TraceSampler,
@@ -23,6 +24,7 @@ __all__ = [
     "ANOMALY_OUTCOMES",
     "SERVE_STAGE",
     "STAGES",
+    "WAL_STAGE",
     "Trace",
     "TraceRing",
     "TraceSampler",

@@ -71,7 +71,15 @@ STAGES = (
 #: only when ``serve.enabled``; ``ALL_STAGES`` is the query/validation
 #: vocabulary (/debug/trace).
 SERVE_STAGE = "serve_fanout"
-ALL_STAGES = STAGES + (SERVE_STAGE,)
+
+#: The history plane's WAL hand-off (serve/view.py ``publish_batch``
+#: with ``history.enabled``): stamped alongside ``serve_fanout`` on the
+#: same still-open journeys, covering the O(1) enqueue to the WAL
+#: writer. Disk write/fsync latency deliberately does NOT ride event
+#: journeys (it happens on the dedicated writer thread, batched) — it
+#: is attributed by the ``history_wal_write_seconds`` histogram instead.
+WAL_STAGE = "wal_append"
+ALL_STAGES = STAGES + (SERVE_STAGE, WAL_STAGE)
 
 #: Egress terminal outcomes that mark a trace anomalous (always recorded,
 #: never head-sampled away): the notification's journey ended somewhere

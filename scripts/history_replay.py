@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Offline, deterministic replay of a captured history WAL.
+
+Feeds the WAL in ``--wal DIR`` back through a fresh FleetView (the real
+delta-apply machinery) and prints the terminal snapshot's digest —
+the sha256 of its canonical bytes. Run it twice on the same capture and
+the digests MUST match (``make history-smoke`` gates exactly that);
+``--verify`` does both passes in one invocation. ``--at RV`` stops the
+replay at a historical rv (the offline twin of ``GET /serve/fleet?at=``)
+and ``--out FILE`` writes the canonical snapshot for diffing two
+captures or pinning a regression fixture.
+
+    python scripts/history_replay.py --wal /var/lib/k8s-watcher-tpu/history
+    python scripts/history_replay.py --wal ./capture --at 48211 --out snap.json
+    python scripts/history_replay.py --wal ./capture --verify
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from k8s_watcher_tpu.history.replay import (  # noqa: E402
+    canonical_snapshot,
+    replay_digest,
+    replay_wal,
+)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--wal", required=True, help="WAL directory (wal-*.seg segments)")
+    parser.add_argument("--at", type=int, default=None, help="stop the replay at this rv (time travel)")
+    parser.add_argument("--out", default=None, help="write the canonical terminal snapshot here")
+    parser.add_argument(
+        "--verify", action="store_true",
+        help="replay twice and fail unless the terminal snapshots are byte-identical",
+    )
+    args = parser.parse_args()
+    wal_dir = Path(args.wal)
+    if not wal_dir.is_dir():
+        print(f"ERROR: {wal_dir} is not a directory", file=sys.stderr)
+        return 2
+
+    digest = replay_digest(wal_dir, at=args.at)
+    if digest["rv_mismatches"]:
+        print(
+            f"ERROR: {digest['rv_mismatches']} rv mismatch(es) — the WAL and the "
+            "view disagree about the delta algebra (corrupt capture or a real bug)",
+            file=sys.stderr,
+        )
+        print(json.dumps(digest, indent=2))
+        return 1
+    if args.verify:
+        second = replay_digest(wal_dir, at=args.at)
+        if second != digest:
+            print("ERROR: replay is nondeterministic:", file=sys.stderr)
+            print(json.dumps({"first": digest, "second": second}, indent=2))
+            return 1
+        digest["verified_deterministic"] = True
+    if args.out:
+        result = replay_wal(wal_dir, at=args.at)
+        Path(args.out).write_bytes(canonical_snapshot(result.rv, result.objects) + b"\n")
+        digest["out"] = args.out
+    print(json.dumps(digest, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

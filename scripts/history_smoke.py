@@ -1,0 +1,361 @@
+#!/usr/bin/env python
+"""History-plane smoke: restart-surviving resume + time travel + replay,
+end to end through the REAL app wiring (``make history-smoke``).
+
+Boots the in-repo mock apiserver, points a ``WatcherApp`` at it with
+``serve.enabled`` + ``history.enabled``, and drives the durable-history
+contract across a REAL process-lifecycle boundary:
+
+1. **capture** — churn pod phases while a consumer long-polls resumable
+   deltas (gap/dup-checked, model replayed), leaving a resume token
+   ``T`` + view instance id ``V`` and a WAL capture on disk;
+2. **SIGTERM** — stop the app (the exact code path cli.py routes
+   SIGTERM to), which drains the WAL and writes the terminal snapshot
+   anchor;
+3. **restart** — a brand-new ``WatcherApp`` on the same directories
+   recovers the view from the WAL: same instance id, same monotonic rv
+   line — and the consumer resumes from ``T`` (pre-restart!) with ZERO
+   gaps, dups or 410s while fresh churn flows (the serve-smoke restart
+   leg used to re-snapshot here; now it must not);
+4. **time travel** — ``GET /serve/fleet?at=T`` against the RESTARTED
+   process reconstructs the exact pre-restart snapshot the consumer's
+   model had at ``T``;
+5. **inventory** — ``/debug/history`` lists segments (bearer-gated like
+   every debug route);
+6. **replay** — after shutdown, two offline replays of the captured WAL
+   reduce to byte-identical terminal snapshots whose object map equals
+   the final live snapshot.
+
+Artifact: ``artifacts/history_smoke.json``. Exit 0 on PASS.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import json
+import socket
+import sys
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import requests
+
+from k8s_watcher_tpu.app import WatcherApp
+from k8s_watcher_tpu.config.loader import load_config
+from k8s_watcher_tpu.history.replay import replay_digest
+from k8s_watcher_tpu.k8s.mock_server import MockApiServer
+from k8s_watcher_tpu.watch.fake import build_pod
+
+ARTIFACTS = REPO / "artifacts"
+N_PODS = 8
+TOKEN = "history-smoke-token"
+DEADLINE_S = 60.0
+AUTH = {"Authorization": f"Bearer {TOKEN}"}
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _smoke_config(tmp: Path, server_url: str, status_port: int):
+    kc_path = tmp / "kubeconfig.json"
+    if not kc_path.exists():
+        kc_path.write_text(json.dumps({
+            "apiVersion": "v1", "kind": "Config",
+            "clusters": [{"name": "m", "cluster": {"server": server_url}}],
+            "contexts": [{"name": "m", "context": {"cluster": "m", "user": "m"}}],
+            "current-context": "m",
+            "users": [{"name": "m", "user": {"token": "t"}}],
+        }))
+    config = load_config("development", str(REPO / "config"), env={})
+    return dataclasses.replace(
+        config,
+        kubernetes=dataclasses.replace(
+            config.kubernetes, use_mock=False, config_file=str(kc_path),
+            watch_timeout_seconds=5,
+        ),
+        clusterapi=dataclasses.replace(config.clusterapi, base_url=server_url),
+        watcher=dataclasses.replace(
+            config.watcher, status_port=status_port, status_auth_token=TOKEN,
+        ),
+        serve=dataclasses.replace(
+            config.serve, enabled=True, port=0,
+            queue_depth=64, compact_horizon=4096,
+        ),
+        history=dataclasses.replace(
+            config.history, enabled=True, dir=str(tmp / "history"),
+            fsync="interval", fsync_interval_seconds=0.2,
+            segment_max_bytes=64 * 1024, retain_segments=16,
+        ),
+        state=dataclasses.replace(
+            config.state, checkpoint_path=str(tmp / "checkpoint.json"),
+            checkpoint_interval_seconds=0.5,
+        ),
+    )
+
+
+def _churn(server, rounds: int, flip_offset: int = 0) -> None:
+    phases = ("Running", "Pending")
+    for r in range(rounds):
+        for i in range(N_PODS):
+            server.cluster.set_phase(
+                "default", f"hist-pod-{i}", phases[(r + flip_offset) % 2]
+            )
+        time.sleep(0.05)
+
+
+def _apply(model: dict, items: list) -> None:
+    for d in items:
+        if d["type"] == "DELETE":
+            model.pop(d["key"], None)
+        else:
+            model[d["key"]] = d["object"]
+
+
+class _Consumer:
+    """One resume-protocol consumer: long-poll loop with the per-
+    subscriber sequence checker (dense ranges, ascending rvs)."""
+
+    def __init__(self, base: str, rv: int, view_id: str, model: dict):
+        self.base = base
+        self.rv = rv
+        self.view_id = view_id
+        self.model = model
+        self.gaps = self.dups = self.resyncs = self.delivered = self.polls = 0
+
+    def poll(self, timeout_s: str = "1") -> bool:
+        """One long-poll; False when a 410 forced a re-snapshot."""
+        resp = requests.get(
+            f"{self.base}/serve/fleet",
+            params={"watch": "1", "once": "1", "rv": self.rv,
+                    "view": self.view_id, "timeout": timeout_s},
+            headers=AUTH, timeout=10,
+        )
+        self.polls += 1
+        if resp.status_code == 410:
+            resnap = requests.get(f"{self.base}/serve/fleet", headers=AUTH, timeout=5).json()
+            self.model.clear()
+            self.model.update({o["key"]: o for o in resnap["objects"]})
+            self.rv, self.view_id = resnap["rv"], resnap["view"]
+            self.resyncs += 1
+            return False
+        body = resp.json()
+        items = body["items"]
+        self.delivered += len(items)
+        if not body["compacted"] and len(items) != body["to_rv"] - body["from_rv"]:
+            self.gaps += 1
+        prev = body["from_rv"]
+        for d in items:
+            if d["rv"] <= prev:
+                self.dups += 1
+            prev = d["rv"]
+        _apply(self.model, items)
+        self.rv = body["to_rv"]
+        return True
+
+    def drain(self, base: str) -> None:
+        self.base = base
+        for _ in range(30):
+            before = self.rv
+            self.poll(timeout_s="0.3")
+            if self.rv == before:
+                break
+
+
+def _wait_materialized(app, deadline_s: float) -> str:
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if app.serve is not None and app.serve.port:
+            base = f"http://127.0.0.1:{app.serve.port}"
+            try:
+                snap = requests.get(f"{base}/serve/fleet", headers=AUTH, timeout=5).json()
+                if len([o for o in snap.get("objects", []) if o.get("kind") == "pod"]) >= N_PODS:
+                    return base
+            except requests.RequestException:
+                pass
+        time.sleep(0.2)
+    raise RuntimeError("serving plane never materialized the fleet")
+
+
+def run_smoke() -> dict:
+    import tempfile
+
+    result: dict = {
+        "timestamp_utc": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "checks": {},
+    }
+    checks = result["checks"]
+    with tempfile.TemporaryDirectory(prefix="history-smoke-") as tmp_str, MockApiServer() as server:
+        tmp = Path(tmp_str)
+        for i in range(N_PODS):
+            server.cluster.add_pod(build_pod(
+                f"hist-pod-{i}", "default", uid=f"uid-{i}",
+                phase="Pending", tpu_chips=4,
+            ))
+
+        # ---- incarnation 1: capture --------------------------------------
+        app = WatcherApp(_smoke_config(tmp, server.url, _free_port()))
+        thread = threading.Thread(target=app.run, daemon=True)
+        thread.start()
+        try:
+            base = _wait_materialized(app, DEADLINE_S)
+            snap = requests.get(f"{base}/serve/fleet", headers=AUTH, timeout=5).json()
+            view_id = snap["view"]
+            consumer = _Consumer(base, snap["rv"], view_id, {o["key"]: o for o in snap["objects"]})
+            churner = threading.Thread(target=_churn, args=(server, 12), daemon=True)
+            churner.start()
+            while churner.is_alive() or consumer.polls == 0:
+                consumer.poll()
+            churner.join()
+            consumer.drain(base)
+            token = consumer.rv  # the resume token minted BEFORE "SIGTERM"
+            model_at_token = dict(consumer.model)
+            checks["capture_gapless"] = (
+                consumer.gaps == 0 and consumer.dups == 0 and consumer.delivered > 0
+            )
+            result["capture"] = {
+                "polls": consumer.polls, "delivered": consumer.delivered,
+                "gaps": consumer.gaps, "dups": consumer.dups,
+                "resyncs": consumer.resyncs, "token": token, "view": view_id,
+            }
+        finally:
+            # the SIGTERM leg: cli.py routes SIGTERM to app.stop(); the
+            # run loop then drives the full shutdown (WAL drain, terminal
+            # snapshot, fsync)
+            app.stop()
+            thread.join(timeout=15)
+        checks["first_shutdown_clean"] = not thread.is_alive()
+
+        # ---- incarnation 2: restart + resume -----------------------------
+        status_port2 = _free_port()
+        app2 = WatcherApp(_smoke_config(tmp, server.url, status_port2))
+        thread2 = threading.Thread(target=app2.run, daemon=True)
+        thread2.start()
+        try:
+            base2 = _wait_materialized(app2, DEADLINE_S)
+            snap2 = requests.get(f"{base2}/serve/fleet", headers=AUTH, timeout=5).json()
+            checks["view_instance_survives_restart"] = snap2["view"] == view_id
+            checks["rv_line_continues"] = snap2["rv"] >= token
+            result["restart"] = {"view": snap2["view"], "rv": snap2["rv"]}
+
+            # resume with the PRE-RESTART token against the new process:
+            # fresh churn flows and the sequence checker must see zero
+            # gaps/dups — and zero 410s (that re-snapshot storm is the
+            # failure mode this plane exists to kill)
+            consumer.base = base2
+            churner2 = threading.Thread(target=_churn, args=(server, 12, 1), daemon=True)
+            churner2.start()
+            resumed_polls_ok = True
+            while churner2.is_alive():
+                resumed_polls_ok &= consumer.poll()
+            churner2.join()
+            consumer.drain(base2)
+            final = requests.get(f"{base2}/serve/fleet", headers=AUTH, timeout=5).json()
+            truth = {o["key"]: o for o in final["objects"]}
+            checks["resume_across_restart_gapless"] = (
+                resumed_polls_ok
+                and consumer.gaps == 0 and consumer.dups == 0
+                and consumer.resyncs == 0
+                and consumer.model == truth
+            )
+            result["resume"] = {
+                "polls": consumer.polls, "delivered": consumer.delivered,
+                "gaps": consumer.gaps, "dups": consumer.dups,
+                "resyncs": consumer.resyncs, "final_rv": consumer.rv,
+                "model_matches_snapshot": consumer.model == truth,
+            }
+
+            # time travel: the RESTARTED process reconstructs the exact
+            # snapshot the consumer's model held at the pre-restart token
+            at = requests.get(
+                f"{base2}/serve/fleet", params={"at": token}, headers=AUTH, timeout=10,
+            )
+            at_body = at.json() if at.status_code == 200 else {}
+            at_model = {o["key"]: o for o in at_body.get("objects", [])}
+            checks["time_travel_matches_pre_restart_model"] = (
+                at.status_code == 200
+                and at_body.get("historical") is True
+                and at_model == model_at_token
+            )
+            result["time_travel"] = {
+                "status": at.status_code, "at": token,
+                "objects": len(at_model), "matches": at_model == model_at_token,
+            }
+
+            # a pre-retention rv answers 410 (not wrong data)
+            gone = requests.get(
+                f"{base2}/serve/fleet", params={"at": -1}, headers=AUTH, timeout=10,
+            )
+            checks["time_travel_validates_rv"] = gone.status_code == 400
+
+            # /debug/history: bearer-gated segment inventory
+            inv = requests.get(
+                f"http://127.0.0.1:{status_port2}/debug/history", headers=AUTH, timeout=5,
+            )
+            inv_open = requests.get(
+                f"http://127.0.0.1:{status_port2}/debug/history", timeout=5,
+            )
+            history = inv.json().get("history", {}) if inv.status_code == 200 else {}
+            checks["debug_history_inventory"] = (
+                inv.status_code == 200
+                and inv_open.status_code == 401
+                and bool(history.get("segments"))
+                and history.get("writer_alive") is True
+            )
+            result["inventory"] = {
+                "segments": len(history.get("segments", [])),
+                "total_bytes": history.get("total_bytes"),
+                "durable_rv": history.get("durable_rv"),
+                "retention_floor_rv": history.get("retention_floor_rv"),
+            }
+            final_rv = final["rv"]
+        finally:
+            app2.stop()
+            thread2.join(timeout=15)
+        checks["second_shutdown_clean"] = not thread2.is_alive()
+
+        # ---- offline: deterministic replay byte-compare ------------------
+        wal_dir = tmp / "history"
+        d1 = replay_digest(wal_dir)
+        d2 = replay_digest(wal_dir)
+        checks["replay_byte_identical"] = (
+            d1 == d2 and d1["sha256"] == d2["sha256"] and d1["rv_mismatches"] == 0
+        )
+        checks["replay_reaches_final_rv"] = d1["rv"] == final_rv
+        result["replay"] = {
+            "sha256": d1["sha256"], "rv": d1["rv"],
+            "deltas_applied": d1["deltas_applied"],
+            "snapshots_seen": d1["snapshots_seen"],
+            "segments": d1["segments"], "rv_mismatches": d1["rv_mismatches"],
+        }
+    result["ok"] = bool(checks) and all(checks.values())
+    return result
+
+
+def main() -> int:
+    result = run_smoke()
+    ARTIFACTS.mkdir(exist_ok=True)
+    out = ARTIFACTS / "history_smoke.json"
+    out.write_text(json.dumps(result, indent=2) + "\n")
+    checks = ", ".join(f"{k}={'PASS' if v else 'FAIL'}" for k, v in result["checks"].items())
+    print(f"{'PASS' if result['ok'] else 'FAIL'}: {checks}")
+    resume = result.get("resume") or {}
+    if resume:
+        print(
+            "resume across restart: %d polls, %d deltas, gaps=%d dups=%d resyncs=%d final_rv=%s"
+            % (resume["polls"], resume["delivered"], resume["gaps"],
+               resume["dups"], resume["resyncs"], resume["final_rv"])
+        )
+    print(f"artifact: {out}")
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,6 +56,10 @@ def test_bench_smoke_headline_within_budget():
     # the plane exists to produce)
     assert headline["trace_overhead_pct"] is not None, headline
     assert headline["watch_to_notify_p50_ms"] is not None, headline
+    # history plane: the WAL overhead gate ran and WAL-on ingest stayed
+    # within its 5% budget of WAL-off on the deterministic replay
+    assert headline["wal_overhead_pct"] is not None, headline
+    assert headline["wal_within_budget"] is True, headline
     # serving plane: the fan-out tier ran at full subscriber scale, the
     # paced publisher held >= 1k events/s, and the per-subscriber sequence
     # checkers found zero gaps/dups with every subscriber converged
@@ -72,6 +76,9 @@ def test_bench_smoke_headline_within_budget():
     trace = detail["details"]["trace_overhead"]
     assert trace["within_budget"], trace
     assert trace["watch_to_notify"]["count"] > 0, trace
+    wal = detail["details"]["wal_overhead"]
+    assert wal["within_budget"], wal
+    assert wal["events"] > 0, wal
     serve = detail["details"]["serve_fanout"]
     assert serve["gaps"] == 0 and serve["dups"] == 0, serve
     assert serve["view_matches_shadow"], serve

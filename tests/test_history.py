@@ -1,0 +1,691 @@
+"""Durable fleet history plane: WAL framing, rotation/retention,
+crash recovery (incl. the seeded kill-mid-append property test),
+restart-surviving resume tokens, time-travel reads, deterministic
+replay, and the HTTP surfaces (?at=, /debug/history)."""
+
+import json
+import random
+import threading
+import time
+
+import pytest
+import requests
+
+from k8s_watcher_tpu.history import (
+    HistoryStore,
+    journal_deltas,
+    reconstruct_at,
+    recover_state,
+    replay_digest,
+    replay_wal,
+)
+from k8s_watcher_tpu.history.wal import (
+    SNAP,
+    encode_record,
+    frame,
+    list_segments,
+    read_frames,
+    segment_path,
+)
+from k8s_watcher_tpu.metrics import MetricsRegistry
+from k8s_watcher_tpu.serve.view import OK, FleetView, SubscriptionHub
+
+
+def _obj(key, n):
+    return {"kind": "pod", "key": key, "phase": f"phase-{n}"}
+
+
+def _store(tmp_path, **kw):
+    kw.setdefault("fsync", "never")
+    store = HistoryStore(tmp_path / "wal", **kw)
+    store.recover()
+    return store
+
+
+def _view_with_store(store, *, compact_horizon=256):
+    view = FleetView(compact_horizon=compact_horizon)
+    recovered = store.recovered
+    if recovered is not None and recovered.instance:
+        view.restore(
+            instance=recovered.instance, rv=recovered.rv,
+            objects=recovered.objects, journal=journal_deltas(recovered.journal),
+        )
+    store.open(view.instance)
+    view.attach_history(store)
+    return view
+
+
+# -- framing -----------------------------------------------------------------
+
+
+class TestFraming:
+    def test_roundtrip(self):
+        records = [{"t": "delta", "rv": i, "kind": "pod", "key": f"p{i}"} for i in range(5)]
+        blob = b"".join(frame(encode_record(r)) for r in records)
+        decoded, clean, torn = read_frames(blob)
+        assert decoded == records and clean == len(blob) and not torn
+
+    def test_torn_tail_stops_at_tear(self):
+        records = [{"t": "delta", "rv": i} for i in range(4)]
+        blob = b"".join(frame(encode_record(r)) for r in records)
+        for cut in (1, 5, 9, len(blob) - 1):
+            decoded, clean, torn = read_frames(blob[:-cut])
+            assert torn
+            assert decoded == records[: len(decoded)]
+            # the clean prefix re-reads identically
+            again, clean2, _ = read_frames(blob[:clean])
+            assert again == decoded and clean2 == clean
+
+    def test_crc_corruption_detected(self):
+        blob = bytearray(frame(encode_record({"t": "delta", "rv": 1, "k": "x"})))
+        blob[-2] ^= 0xFF  # flip a payload byte; the crc no longer matches
+        decoded, clean, torn = read_frames(bytes(blob))
+        assert decoded == [] and clean == 0 and torn
+
+    def test_absurd_length_is_corruption_not_allocation(self):
+        blob = b"\xff\xff\xff\xff" + b"\x00" * 10
+        decoded, clean, torn = read_frames(blob)
+        assert decoded == [] and torn
+
+
+# -- WAL write path ----------------------------------------------------------
+
+
+class TestWalWriter:
+    def test_deltas_persist_and_recover(self, tmp_path):
+        store = _store(tmp_path)
+        view = _view_with_store(store)
+        for i in range(50):
+            view.apply("pod", f"p{i % 7}", _obj(f"p{i % 7}", i))
+        view.apply("pod", "p0", None)
+        assert store.flush(5.0)
+        store.close()
+        rec = recover_state(tmp_path / "wal")
+        rv, objects = view.state_for_history()
+        assert rec.rv == rv == 51
+        assert rec.objects == objects
+        assert rec.instance == view.instance
+
+    def test_rotation_opens_segments_with_snapshots(self, tmp_path):
+        store = _store(tmp_path, segment_max_bytes=4096)
+        view = _view_with_store(store)
+        for i in range(300):
+            view.apply("pod", f"p{i % 11}", _obj(f"p{i % 11}", i))
+            if i % 25 == 0:
+                store.flush(5.0)  # force drains so rotation points exist
+        store.flush(5.0)
+        store.close()
+        segments = list_segments(tmp_path / "wal")
+        assert len(segments) > 1, "segment_max_bytes never rotated"
+        for _seq, path in segments:
+            records, _clean, torn = read_frames(path.read_bytes())
+            assert not torn
+            assert records[0]["t"] == SNAP, "every segment must open with a snapshot"
+
+    def test_retention_deletes_oldest_and_moves_floor(self, tmp_path):
+        store = _store(tmp_path, segment_max_bytes=2048, retain_segments=3)
+        view = _view_with_store(store)
+        for i in range(400):
+            view.apply("pod", f"p{i % 5}", _obj(f"p{i % 5}", i))
+            if i % 20 == 0:
+                store.flush(5.0)
+        store.flush(5.0)
+        assert len(list_segments(tmp_path / "wal")) <= 3
+        floor = store.retention_floor_rv()
+        assert floor > 0, "retention never advanced the durable horizon"
+        status, rv, _ = store.reconstruct(max(0, floor - 1))
+        assert status == "gone" and rv == floor
+        store.close()
+
+    def test_fsync_policy_knob(self, tmp_path):
+        metrics = MetricsRegistry()
+        store = HistoryStore(tmp_path / "wal", fsync="always", metrics=metrics)
+        store.recover()
+        view = _view_with_store(store)
+        view.apply("pod", "a", _obj("a", 1))
+        assert store.flush(5.0)
+        assert metrics.counter("history_wal_fsyncs").value >= 1
+        store.close()
+
+        metrics2 = MetricsRegistry()
+        store2 = HistoryStore(tmp_path / "wal2", fsync="never", metrics=metrics2)
+        store2.recover()
+        view2 = _view_with_store(store2)
+        view2.apply("pod", "a", _obj("a", 1))
+        assert store2.flush(5.0)
+        store2.close()
+        assert metrics2.counter("history_wal_fsyncs").value == 0
+
+    def test_bad_fsync_policy_rejected(self, tmp_path):
+        with pytest.raises(ValueError):
+            HistoryStore(tmp_path / "wal", fsync="sometimes")
+
+    def test_stats_inventory(self, tmp_path):
+        store = _store(tmp_path, segment_max_bytes=2048)
+        view = _view_with_store(store)
+        for i in range(100):
+            view.apply("pod", f"p{i % 3}", _obj(f"p{i % 3}", i))
+            if i % 20 == 0:
+                store.flush(5.0)
+        store.flush(5.0)
+        stats = store.stats()
+        assert stats["writer_alive"] and stats["fsync"] == "never"
+        assert stats["durable_rv"] == view.rv
+        assert stats["segments"], "inventory must list segments"
+        seg = stats["segments"][-1]
+        assert set(seg) >= {"name", "bytes", "records", "first_rv", "last_rv"}
+        assert stats["total_bytes"] == sum(s["bytes"] for s in stats["segments"])
+        store.close()
+
+
+# -- recovery + restart-surviving resume -------------------------------------
+
+
+class TestRecovery:
+    def test_rv_line_and_instance_survive_restart(self, tmp_path):
+        store = _store(tmp_path)
+        view = _view_with_store(store)
+        for i in range(40):
+            view.apply("pod", f"p{i % 4}", _obj(f"p{i % 4}", i))
+        store.flush(5.0)
+        store.close()
+        instance, rv = view.instance, view.rv
+
+        store2 = _store(tmp_path)
+        view2 = _view_with_store(store2)
+        assert view2.instance == instance, "instance id must span incarnations"
+        assert view2.rv == rv, "the monotonic rv line must continue"
+        # new deltas continue the line, and persist
+        view2.apply("pod", "fresh", _obj("fresh", 1))
+        assert view2.rv == rv + 1
+        store2.flush(5.0)
+        store2.close()
+
+    def test_pre_restart_token_resumes_gaplessly(self, tmp_path):
+        store = _store(tmp_path)
+        view = _view_with_store(store)
+        for i in range(60):
+            view.apply("pod", f"p{i % 6}", _obj(f"p{i % 6}", i))
+        token = view.rv  # minted "before SIGTERM"
+        for i in range(60, 90):
+            view.apply("pod", f"p{i % 6}", _obj(f"p{i % 6}", i))
+        store.flush(5.0)
+        store.close()
+
+        store2 = _store(tmp_path)
+        view2 = _view_with_store(store2)
+        result = view2.read_since(token, max_deltas=10_000)
+        assert result.status == OK and not result.compacted
+        assert result.from_rv == token and result.to_rv == 90
+        rvs = [d.rv for d in result.deltas]
+        assert rvs == list(range(token + 1, 91)), "gap or dup across the restart"
+        # live publishes keep extending the same line for the subscriber
+        view2.apply("pod", "post-restart", _obj("post-restart", 1))
+        tail = view2.read_since(result.to_rv)
+        assert [d.rv for d in tail.deltas] == [91]
+        store2.close()
+
+    def test_token_past_preloaded_journal_gets_gone(self, tmp_path):
+        store = _store(tmp_path)
+        view = _view_with_store(store)
+        for i in range(50):
+            view.apply("pod", f"p{i}", _obj(f"p{i}", i))
+        store.flush(5.0)
+        store.close()
+        store2 = _store(tmp_path)
+        # journal preload bounded to 10 deltas: older tokens 410, newer resume
+        view2 = FleetView(compact_horizon=256)
+        rec = recover_state(tmp_path / "wal", journal_limit=10)
+        view2.restore(
+            instance=rec.instance, rv=rec.rv, objects=rec.objects,
+            journal=journal_deltas(rec.journal),
+        )
+        assert view2.oldest_rv == 40
+        assert view2.token_status(39) == "gone"
+        assert view2.token_status(40) == OK
+        assert [d.rv for d in view2.read_since(40).deltas] == list(range(41, 51))
+        store2.close()
+
+    def test_clean_flag_requires_final_snapshot(self, tmp_path):
+        store = _store(tmp_path)
+        view = _view_with_store(store)
+        view.apply("pod", "a", _obj("a", 1))
+        store.flush(5.0)
+        store.close()  # terminal (final) snapshot
+        assert recover_state(tmp_path / "wal").clean is True
+
+        store2 = _store(tmp_path / "crash")
+        view2 = _view_with_store(store2)
+        view2.apply("pod", "a", _obj("a", 1))
+        store2.flush(5.0)
+        store2.close(final_snapshot=False)  # crash shape
+        assert recover_state((tmp_path / "crash") / "wal").clean is False
+
+    def test_unclean_recovery_mints_fresh_serve_instance(self, tmp_path):
+        """Acked deltas beyond the durable rv may be lost in a crash; new
+        churn re-mints those rvs with different contents. Inheriting the
+        instance would graft two divergent rv lines into one token
+        space, so an unclean WAL must epoch-bump (pre-crash tokens 410
+        into a re-snapshot) while a clean shutdown inherits."""
+        from k8s_watcher_tpu.config.schema import ServeConfig
+        from k8s_watcher_tpu.serve.server import ServePlane
+
+        cfg = ServeConfig(enabled=True, port=0, max_subscribers=8,
+                          queue_depth=16, compact_horizon=256)
+        store = _store(tmp_path)
+        view = _view_with_store(store)
+        view.apply("pod", "a", _obj("a", 1))
+        store.flush(5.0)
+        store.close()  # CLEAN
+        old_instance = view.instance
+
+        store2 = _store(tmp_path)
+        plane = ServePlane(cfg, history=store2)
+        assert plane.view.instance == old_instance, "clean restart must inherit"
+        assert plane.view.rv == 1
+        plane.view.apply("pod", "b", _obj("b", 2))
+        store2.flush(5.0)
+        store2.close(final_snapshot=False)  # UNCLEAN
+
+        store3 = _store(tmp_path)
+        plane3 = ServePlane(cfg, history=store3)
+        assert plane3.view.instance != old_instance, "unclean restart must epoch-bump"
+        assert plane3.view.rv == 2, "the durable rv line still continues"
+        # pre-crash tokens are not servable from memory: journal empty
+        assert plane3.view.oldest_rv == 2
+        store3.close()
+
+    def test_torn_tail_truncated_on_open(self, tmp_path):
+        applied = []
+        store = _store(tmp_path)
+        view = _view_with_store(store)
+        for i in range(30):
+            view.apply("pod", f"p{i % 3}", _obj(f"p{i % 3}", i))
+            applied.append((view.rv, "pod", f"p{i % 3}", _obj(f"p{i % 3}", i)))
+            store.flush(5.0)  # one drain per delta -> many small records
+        store.close(final_snapshot=False)  # crash shape: no terminal anchor
+        segments = list_segments(tmp_path / "wal")
+        last = segments[-1][1]
+        blob = last.read_bytes()
+        last.write_bytes(blob[:-7])  # tear mid-frame
+        rec = recover_state(tmp_path / "wal", truncate_tail=True)
+        assert rec.truncated_bytes > 0
+        # recovery stops at the last intact record: a consistent prefix,
+        # losing ONLY the torn final record's deltas
+        assert rec.rv < 30
+        expected = _fold([d for d in applied if d[0] <= rec.rv])
+        assert rec.objects == expected
+        # the file itself was healed: a second scan sees a clean segment
+        _, clean, torn = read_frames(last.read_bytes())
+        assert not torn
+
+    def test_torn_sealed_segment_resyncs_at_next_snapshot(self, tmp_path):
+        store = _store(tmp_path, segment_max_bytes=4096)
+        view = _view_with_store(store)
+        for i in range(200):
+            view.apply("pod", f"p{i % 5}", _obj(f"p{i % 5}", i))
+            if i % 2 == 0:
+                store.flush(5.0)  # small records -> several segments
+        store.flush(5.0)
+        store.close()
+        segments = list_segments(tmp_path / "wal")
+        assert len(segments) >= 3
+        # damage a MIDDLE segment's tail (bit rot on a sealed file)
+        mid = segments[len(segments) // 2][1]
+        mid.write_bytes(mid.read_bytes()[:-11])
+        rec = recover_state(tmp_path / "wal")
+        # terminal state still recovers: later segments open with snapshots
+        rv, objects = view.state_for_history()
+        assert rec.rv == rv and rec.objects == objects
+
+
+# -- time travel + replay ----------------------------------------------------
+
+
+class TestTimeTravelAndReplay:
+    def test_reconstruct_ok_gone_future(self, tmp_path):
+        store = _store(tmp_path, segment_max_bytes=2048, retain_segments=3)
+        view = _view_with_store(store)
+        shadow_at = {}
+        shadow = {}
+        for i in range(300):
+            key = f"p{i % 9}"
+            view.apply("pod", key, _obj(key, i))
+            shadow[("pod", key)] = _obj(key, i)
+            shadow_at[view.rv] = dict(shadow)
+            if i % 20 == 0:
+                store.flush(5.0)
+        store.flush(5.0)
+        floor = store.retention_floor_rv()
+        assert floor > 0
+        probe_rv = max(floor + 5, view.rv - 50)
+        status, rv, objects = store.reconstruct(probe_rv)
+        assert status == "ok" and rv == probe_rv
+        assert objects == shadow_at[probe_rv]
+        status, _, _ = store.reconstruct(view.rv + 100)
+        assert status == "future"
+        status, rv, _ = store.reconstruct(max(0, floor - 1))
+        assert status == "gone" and rv == floor
+        store.close()
+
+    def test_reconstruct_inside_rebase_hole_is_gone_not_wrong(self, tmp_path):
+        """An rv inside a rebase/tear hole must answer gone (with a
+        reconstructible re-anchor rv), never an older state dressed up
+        as the historical snapshot at that rv."""
+        from k8s_watcher_tpu.history.wal import deltas_record, snapshot_record
+
+        class D:
+            def __init__(self, rv, key, obj):
+                self.rv, self.kind, self.key, self.object = rv, "pod", key, obj
+
+        wal = tmp_path / "wal"
+        wal.mkdir()
+        records = [
+            snapshot_record(0, "inst", {}),
+            deltas_record([D(i, f"p{i}", _obj(f"p{i}", i)) for i in range(1, 11)]),
+            # rebase snapshot: deltas 11..49 were dropped (overrun hole)
+            snapshot_record(50, "inst", {("pod", "rebased"): _obj("rebased", 50)}),
+            deltas_record([D(i, "rebased", _obj("rebased", i)) for i in range(51, 56)]),
+        ]
+        segment_path(wal, 1).write_bytes(
+            b"".join(frame(encode_record(r, sort=True)) for r in records)
+        )
+        status, anchor, objects = reconstruct_at(wal, 30)  # inside the hole
+        assert status == "gone" and anchor == 50 and objects is None
+        status, rv, objects = reconstruct_at(wal, 10)  # exactly at the edge
+        assert status == "ok" and rv == 10 and len(objects) == 10
+        status, rv, objects = reconstruct_at(wal, 52)  # past the rebase
+        assert status == "ok" and objects[("pod", "rebased")] == _obj("rebased", 52)
+
+    def test_replay_twice_is_byte_identical(self, tmp_path):
+        store = _store(tmp_path, segment_max_bytes=2048)
+        view = _view_with_store(store)
+        for i in range(250):
+            key = f"p{i % 13}"
+            if i % 17 == 0 and view.object_count():
+                view.apply("pod", f"p{(i // 17) % 13}", None)
+            else:
+                view.apply("pod", key, _obj(key, i))
+            if i % 30 == 0:
+                store.flush(5.0)
+        store.flush(5.0)
+        store.close()
+        d1 = replay_digest(tmp_path / "wal")
+        d2 = replay_digest(tmp_path / "wal")
+        assert d1 == d2
+        assert d1["sha256"] == d2["sha256"]
+        assert d1["rv_mismatches"] == 0, "the view re-minted a different rv line"
+        assert d1["rv"] == view.rv
+
+    def test_replay_at_matches_reconstruct(self, tmp_path):
+        store = _store(tmp_path)
+        view = _view_with_store(store)
+        for i in range(80):
+            view.apply("pod", f"p{i % 7}", _obj(f"p{i % 7}", i))
+        store.flush(5.0)
+        store.close()
+        result = replay_wal(tmp_path / "wal", at=40)
+        status, _, objects = reconstruct_at(tmp_path / "wal", 40)
+        assert status == "ok" and result.rv == 40
+        assert result.objects == objects
+
+
+# -- crash-recovery property test (satellite) --------------------------------
+
+
+def _fold(deltas_prefix):
+    state = {}
+    for _rv, kind, key, obj in deltas_prefix:
+        if obj is None:
+            state.pop((kind, key), None)
+        else:
+            state[(kind, key)] = obj
+    return state
+
+
+class TestCrashRecoveryProperty:
+    """Kill the WAL mid-append — torn tail, partial segment, vanished
+    unsynced tail segment — and the recovered view must equal the shadow
+    model at the recovered rv, with gapless resume across the restart."""
+
+    @pytest.mark.parametrize("seed", [11, 23, 47])
+    def test_recovered_view_equals_shadow_with_gapless_resume(self, seed, tmp_path):
+        rng = random.Random(seed)
+        store = _store(tmp_path, segment_max_bytes=2048, retain_segments=64)
+        view = _view_with_store(store, compact_horizon=4096)
+        keys = [f"pod-{i}" for i in range(12)]
+        applied = []  # (rv, kind, key, obj-or-None) for every BURNED rv
+        shadow = {}
+        n_ops = rng.randrange(150, 400)
+        for op in range(n_ops):
+            key = rng.choice(keys)
+            if rng.random() < 0.15 and ("pod", key) in shadow:
+                assert view.apply("pod", key, None)
+                shadow.pop(("pod", key))
+                applied.append((view.rv, "pod", key, None))
+            else:
+                obj = {"kind": "pod", "key": key, "phase": f"ph-{op}", "seq": op}
+                assert view.apply("pod", key, obj)
+                shadow[("pod", key)] = obj
+                applied.append((view.rv, "pod", key, obj))
+            if rng.random() < 0.08:
+                store.flush(5.0)
+        store.flush(5.0)
+        store.close(final_snapshot=False)  # crash: no terminal anchor
+
+        segments = list_segments(tmp_path / "wal")
+        mode = rng.choice(("torn_tail", "partial_segment", "missing_fsync"))
+        last = segments[-1][1]
+        if mode == "torn_tail":
+            blob = last.read_bytes()
+            last.write_bytes(blob[: len(blob) - rng.randrange(1, min(40, len(blob)))])
+        elif mode == "partial_segment":
+            blob = last.read_bytes()
+            last.write_bytes(blob[: rng.randrange(0, len(blob))])
+        elif len(segments) > 1:
+            last.unlink()  # the never-synced tail segment vanished whole
+
+        store2 = _store(tmp_path)
+        rec = store2.recovered
+        final_rv = applied[-1][0]
+        assert rec.rv <= final_rv
+        # the recovered state IS the shadow model folded to the recovered rv
+        prefix = [d for d in applied if d[0] <= rec.rv]
+        assert rec.objects == _fold(prefix), f"seed={seed} mode={mode} rv={rec.rv}"
+
+        # gapless resume across the restart: a pre-crash token within the
+        # preloaded journal reads a dense range up to the recovered rv,
+        # and live publishes continue the same line
+        view2 = _view_with_store(store2, compact_horizon=4096)
+        if rec.journal:
+            token = rng.randrange(rec.journal[0]["rv"] - 1, rec.rv + 1)
+            result = view2.read_since(token, max_deltas=100_000)
+            assert result.status == OK and not result.compacted
+            assert [d.rv for d in result.deltas] == list(range(token + 1, rec.rv + 1))
+            model = _fold([d for d in applied if d[0] <= token])
+            for d in result.deltas:
+                if d.object is None:
+                    model.pop((d.kind, d.key), None)
+                else:
+                    model[(d.kind, d.key)] = d.object
+            assert model == rec.objects
+        view2.apply("pod", "after-crash", {"kind": "pod", "key": "after-crash", "seq": -1})
+        assert view2.rv == rec.rv + 1
+        tail = view2.read_since(rec.rv)
+        assert [d.rv for d in tail.deltas] == [rec.rv + 1]
+        store2.close()
+
+
+# -- HTTP surfaces -----------------------------------------------------------
+
+
+class TestHttpSurfaces:
+    @pytest.fixture
+    def serve_with_history(self, tmp_path):
+        from k8s_watcher_tpu.serve.server import ServeServer
+
+        store = _store(tmp_path)
+        view = _view_with_store(store)
+        hub = SubscriptionHub(view, max_subscribers=8, queue_depth=16)
+        server = ServeServer(view, hub, host="127.0.0.1", port=0, history=store).start()
+        try:
+            yield view, store, f"http://127.0.0.1:{server.port}"
+        finally:
+            server.stop()
+            store.close()
+
+    def test_at_rv_serves_historical_snapshot(self, serve_with_history):
+        view, store, base = serve_with_history
+        view.apply("pod", "a", _obj("a", 1))
+        view.apply("pod", "b", _obj("b", 2))
+        at_rv = view.rv
+        view.apply("pod", "a", _obj("a", 3))
+        store.flush(5.0)
+        body = requests.get(f"{base}/serve/fleet", params={"at": at_rv}, timeout=5).json()
+        assert body["rv"] == at_rv and body["historical"] is True
+        objects = {o["key"]: o for o in body["objects"]}
+        assert objects["a"] == _obj("a", 1) and objects["b"] == _obj("b", 2)
+        live = requests.get(f"{base}/serve/fleet", timeout=5).json()
+        assert {o["key"]: o for o in live["objects"]}["a"] == _obj("a", 3)
+
+    def test_at_future_400_and_at_gone_410(self, serve_with_history):
+        view, store, base = serve_with_history
+        view.apply("pod", "a", _obj("a", 1))
+        store.flush(5.0)
+        r = requests.get(f"{base}/serve/fleet", params={"at": view.rv + 50}, timeout=5)
+        assert r.status_code == 400 and "durable_rv" in r.json()
+        r = requests.get(f"{base}/serve/fleet", params={"at": "x"}, timeout=5)
+        assert r.status_code == 400
+
+    def test_at_without_history_plane_400(self):
+        from k8s_watcher_tpu.serve.server import ServeServer
+
+        view = FleetView(compact_horizon=8)
+        hub = SubscriptionHub(view, max_subscribers=4, queue_depth=8)
+        server = ServeServer(view, hub, host="127.0.0.1", port=0).start()
+        try:
+            r = requests.get(
+                f"http://127.0.0.1:{server.port}/serve/fleet", params={"at": 1}, timeout=5
+            )
+            assert r.status_code == 400
+            assert "history" in r.json()["error"]
+        finally:
+            server.stop()
+
+    def test_debug_history_route(self, tmp_path):
+        from k8s_watcher_tpu.metrics.server import Liveness, StatusServer
+
+        store = _store(tmp_path)
+        view = _view_with_store(store)
+        view.apply("pod", "a", _obj("a", 1))
+        store.flush(5.0)
+        server = StatusServer(
+            MetricsRegistry(), Liveness(), host="127.0.0.1", port=0,
+            history=store.stats,
+        ).start()
+        try:
+            body = requests.get(
+                f"http://127.0.0.1:{server.port}/debug/history", timeout=5
+            ).json()
+            assert body["history"]["segments"]
+            assert body["history"]["durable_rv"] == view.rv
+        finally:
+            server.stop()
+            store.close()
+
+    def test_debug_history_404_when_disabled(self):
+        from k8s_watcher_tpu.metrics.server import Liveness, StatusServer
+
+        server = StatusServer(MetricsRegistry(), Liveness(), host="127.0.0.1", port=0).start()
+        try:
+            r = requests.get(f"http://127.0.0.1:{server.port}/debug/history", timeout=5)
+            assert r.status_code == 404
+        finally:
+            server.stop()
+
+
+# -- config + trace vocabulary ----------------------------------------------
+
+
+class TestHistoryConfig:
+    def test_defaults_off(self):
+        from k8s_watcher_tpu.config.schema import HistoryConfig
+
+        cfg = HistoryConfig.from_raw({})
+        assert not cfg.enabled and cfg.fsync == "interval" and cfg.retain_segments == 8
+
+    def test_enabled_requires_dir(self):
+        from k8s_watcher_tpu.config.schema import HistoryConfig, SchemaError
+
+        with pytest.raises(SchemaError, match="history.dir"):
+            HistoryConfig.from_raw({"enabled": True})
+
+    def test_fsync_vocabulary(self):
+        from k8s_watcher_tpu.config.schema import HistoryConfig, SchemaError
+
+        for policy in ("never", "interval", "always"):
+            assert HistoryConfig.from_raw({"fsync": policy}).fsync == policy
+        with pytest.raises(SchemaError, match="history.fsync"):
+            HistoryConfig.from_raw({"fsync": "sometimes"})
+
+    def test_bounds(self):
+        from k8s_watcher_tpu.config.schema import HistoryConfig, SchemaError
+
+        with pytest.raises(SchemaError, match="retain_segments"):
+            HistoryConfig.from_raw({"retain_segments": 1})
+        with pytest.raises(SchemaError, match="segment_max_bytes"):
+            HistoryConfig.from_raw({"segment_max_bytes": 100})
+        with pytest.raises(SchemaError, match="unknown"):
+            HistoryConfig.from_raw({"bogus": 1})
+
+    def test_history_requires_serve(self):
+        from k8s_watcher_tpu.config.schema import AppConfig, SchemaError
+
+        raw = {"history": {"enabled": True, "dir": "/tmp/x"}, "serve": {"enabled": False}}
+        with pytest.raises(SchemaError, match="serve.enabled"):
+            AppConfig.from_raw(raw, "development")
+        raw["serve"] = {"enabled": True}
+        cfg = AppConfig.from_raw(raw, "development")
+        assert cfg.history.enabled and cfg.history.dir == "/tmp/x"
+
+    def test_wal_append_in_trace_vocabulary(self):
+        from k8s_watcher_tpu.trace import ALL_STAGES, STAGES, WAL_STAGE
+
+        assert WAL_STAGE == "wal_append"
+        assert WAL_STAGE in ALL_STAGES
+        # the six REQUIRED hand-off stages are untouched
+        assert WAL_STAGE not in STAGES and len(STAGES) == 6
+
+    def test_wal_append_span_stamped_on_open_journeys(self, tmp_path):
+        """A sampled journey that ends at the view (publish_batch) carries
+        wal_append alongside serve_fanout when the history plane is on."""
+        from k8s_watcher_tpu.pipeline.pipeline import EventPipeline
+        from k8s_watcher_tpu.slices.tracker import SliceTracker
+        from k8s_watcher_tpu.trace import Tracer
+        from k8s_watcher_tpu.watch.fake import build_pod
+        from k8s_watcher_tpu.watch.source import EventType, WatchEvent
+
+        store = _store(tmp_path)
+        view = _view_with_store(store)
+        tracer = Tracer(sample_rate=1, ring_size=32)
+        pipeline = EventPipeline(
+            environment="development",
+            sink=lambda n: None,
+            slice_tracker=SliceTracker("development"),
+            tracer=tracer,
+            view=view,
+        )
+        pod = build_pod("w-0", "default", uid="u-0", phase="Pending", tpu_chips=4)
+        pipeline.process_batch([WatchEvent(EventType.ADDED, pod, time.monotonic())])
+        # a node binding with no phase/readiness change: insignificant for
+        # notification, so the journey ENDS at the view — the publish hook
+        # stamps it while the trace is still open (test_serve's pattern)
+        bound = build_pod("w-0", "default", uid="u-0", phase="Pending", tpu_chips=4)
+        bound["spec"]["nodeName"] = "node-7"
+        event = WatchEvent(EventType.MODIFIED, bound, time.monotonic())
+        event.trace = tracer.start(event)  # head-sampled "yes"
+        pipeline.process_batch([event])
+        store.flush(5.0)
+        store.close()
+        spans = {s[0] for s in event.trace.spans}
+        assert "serve_fanout" in spans and "wal_append" in spans

@@ -1207,6 +1207,61 @@ class TestJournaledMapStore:
         ck.attach_journaled_map("known_pods", **opts)
         return ck
 
+    def test_empty_but_present_map_is_not_missing(self, tmp_path):
+        """A journaled map persisted as {} (every pod legitimately gone —
+        a cluster drained to zero) must restore as {}, NOT the caller's
+        default: `current() or default` conflated the two and resurrected
+        default state after a restart."""
+        ck = self._attached(tmp_path)
+        ck.put("known_pods", {"u1": {"v": 1}})
+        ck.put("known_pods", {}, changed_keys={"u1"})  # drained to empty
+        ck.flush()
+        ck2 = self._attached(tmp_path)
+        sentinel = {"stale": True}
+        assert ck2.get("known_pods", sentinel) == {}
+        # a NEVER-populated map still falls back to the default
+        ck3 = self._attached(tmp_path / "fresh")
+        assert ck3.get("known_pods", sentinel) is sentinel
+
+    def test_stats_never_blocks_on_io_lock(self, tmp_path):
+        """/debug/checkpoint must answer while a compaction holds the
+        flush I/O lock: stats() reads the shadow mirror, lock-free."""
+        import threading as _threading
+
+        ck = self._attached(tmp_path)
+        ck.put("known_pods", {f"u{i}": {"v": i} for i in range(50)})
+        ck.flush()
+        store = ck._journaled["known_pods"]
+        acquired = store._io_lock.acquire()  # simulate an in-flight compaction
+        assert acquired
+        try:
+            result = {}
+
+            def scrape():
+                result["stats"] = ck.stats()
+
+            t = _threading.Thread(target=scrape)
+            t.start()
+            t.join(timeout=2.0)
+            assert not t.is_alive(), "stats() stalled behind _io_lock"
+            journaled = result["stats"]["journaled"]["known_pods"]
+            assert journaled["map_size"] == 50
+            assert journaled["generation"] == 1
+        finally:
+            store._io_lock.release()
+
+    def test_stats_shadow_tracks_compaction_generation(self, tmp_path):
+        ck = self._attached(tmp_path)
+        ck.put("known_pods", {f"u{i}": {"v": i} for i in range(10)})
+        ck.flush()  # full compaction -> gen 1, journal 0
+        s = ck.stats()["journaled"]["known_pods"]
+        assert s["generation"] == 1 and s["journal_entries"] == 0
+        ck.put("known_pods", {f"u{i}": {"v": i} for i in range(10)} | {"u3": {"v": 99}},
+               changed_keys={"u3"})
+        ck.flush()
+        s = ck.stats()["journaled"]["known_pods"]
+        assert s["journal_entries"] == 1
+
     def test_incremental_roundtrip_with_deletes(self, tmp_path):
         ck = self._attached(tmp_path)
         state = {f"u{i}": {"metadata": {"name": f"p{i}"}} for i in range(100)}

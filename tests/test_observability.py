@@ -128,6 +128,49 @@ class TestStatusServerAuth:
         finally:
             conn.close()
 
+    def test_valid_non_ascii_token_authenticates(self):
+        """A configured token with non-ASCII characters must ACCEPT the
+        matching wire bytes: http.server decodes headers as latin-1, so
+        the compare must re-encode latin-1 (recovering the exact wire
+        bytes) — the old utf-8 re-encode double-encoded them and a valid
+        non-ASCII token could never authenticate."""
+        import http.client
+
+        # ends in 'à': its UTF-8 trailing byte 0xA0 decodes (latin-1) to
+        # NBSP, which a bare str.strip() would eat — the regression the
+        # ASCII-OWS-only strip guards
+        token = "café-über-s3cretà"
+        server = StatusServer(
+            MetricsRegistry(), Liveness(stale_after_seconds=60.0),
+            host="127.0.0.1", auth_token=token,
+        ).start()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=5)
+            try:
+                conn.putrequest("GET", "/metrics")
+                # what a well-behaved client sends: the token's UTF-8 bytes
+                conn.putheader("Authorization", b"Bearer " + token.encode("utf-8"))
+                conn.endheaders()
+                assert conn.getresponse().status == 200
+            finally:
+                conn.close()
+            # and requests' header path (str headers) agrees
+            r = requests.get(
+                f"http://127.0.0.1:{server.port}/metrics",
+                headers={"Authorization": f"Bearer {token}"},
+                timeout=5,
+            )
+            assert r.status_code == 200
+        finally:
+            server.stop()
+
+    def test_bearer_authorized_handles_high_codepoints(self):
+        from k8s_watcher_tpu.metrics.server import bearer_authorized
+
+        # codepoints above U+00FF cannot be latin-1 wire bytes: reject,
+        # never raise
+        assert bearer_authorized("Bearer caf☃", "s3cret") is False
+
 
 class TestDebugCheckpointRoute:
     def test_route_serves_store_stats(self, tmp_path):
