@@ -214,9 +214,12 @@ class WatcherApp:
             # come from memory — same ceiling as steady state
             self.history.recover(journal_limit=config.serve.compact_horizon)
         # fleet-state serving plane (serve/): a materialized view of pod/
-        # slice/probe state with resumable snapshot+delta subscriptions.
-        # The view exists from construction (the pipeline publishes into
-        # it); its HTTP server starts in run() with the other servers.
+        # slice/probe state with resumable snapshot+delta subscriptions
+        # over an encode-once broadcast core (each delta's wire frame is
+        # serialized once at publish; serve.io_threads epoll loops write
+        # the shared bytes to every ?watch=1 stream). The view exists
+        # from construction (the pipeline publishes into it); its HTTP
+        # server + broadcast loops start in run() with the other servers.
         self.serve = None
         if config.serve.enabled:
             from k8s_watcher_tpu.serve import ServePlane

@@ -721,7 +721,11 @@ class ServeConfig:
     ``?watch=1&rv=N`` streams resumable deltas from that rv, with
     latest-wins per-key compaction once a subscriber's backlog exceeds
     ``queue_depth`` and 410-Gone resync once its resume token falls
-    behind ``compact_horizon`` journaled deltas (ARCHITECTURE.md
+    behind ``compact_horizon`` journaled deltas. Streams ride the
+    encode-once broadcast core: each delta's wire frame is serialized
+    once at publish and ``io_threads`` epoll loops write the shared
+    bytes to every subscriber, buffering slow clients up to
+    ``sub_buffer_bytes`` before lag shedding (ARCHITECTURE.md
     "Serving plane").
     """
 
@@ -734,12 +738,23 @@ class ServeConfig:
     # delta-journal length: resume tokens older than this many deltas get
     # 410 Gone and must re-snapshot (the serve-side etcd compaction)
     compact_horizon: int = 8192
+    # broadcast event-loop pool size: ?watch=1 streams are handed off the
+    # HTTP thread to selectors-based loops writing publish-time-encoded
+    # frame bytes (one loop drives thousands of streams; more loops
+    # spread send() syscall load). 0 = legacy thread-per-connection
+    # streaming (one OS thread per stream — debugging/comparison only)
+    io_threads: int = 1
+    # per-subscriber outbound buffer budget (bytes): a slow client's
+    # unsent frames queue up to this, then the loop stops pulling for it
+    # and its lag resolves through read-time latest-wins compaction
+    sub_buffer_bytes: int = 1 << 20
 
     @classmethod
     def from_raw(cls, raw: Mapping[str, Any]) -> "ServeConfig":
         _check_known(
             raw,
-            ("enabled", "port", "max_subscribers", "queue_depth", "compact_horizon"),
+            ("enabled", "port", "max_subscribers", "queue_depth", "compact_horizon",
+             "io_threads", "sub_buffer_bytes"),
             "serve",
         )
         port = _opt_int(raw, "port", "serve", 0)
@@ -761,12 +776,26 @@ class ServeConfig:
                 f"({queue_depth}), got {compact_horizon} (a horizon shorter than one "
                 f"subscriber queue would 410 subscribers before lag shedding could engage)"
             )
+        io_threads = _opt_int(raw, "io_threads", "serve", 1)
+        if io_threads < 0 or io_threads > 64:
+            raise SchemaError(
+                f"config key 'serve.io_threads': must be 0..64 (0 = legacy "
+                f"thread-per-connection streaming), got {io_threads}"
+            )
+        sub_buffer_bytes = _opt_int(raw, "sub_buffer_bytes", "serve", 1 << 20)
+        if sub_buffer_bytes < 4096:
+            raise SchemaError(
+                f"config key 'serve.sub_buffer_bytes': must be >= 4096 (one "
+                f"outbound buffer must hold at least a frame), got {sub_buffer_bytes}"
+            )
         return cls(
             enabled=_opt_bool(raw, "enabled", "serve", False),
             port=port,
             max_subscribers=max_subscribers,
             queue_depth=queue_depth,
             compact_horizon=compact_horizon,
+            io_threads=io_threads,
+            sub_buffer_bytes=sub_buffer_bytes,
         )
 
 

@@ -309,6 +309,13 @@ class HistoryStore:
         self._next_seq = 1
         self._last_fsync = time.monotonic()
         self._recovered = None  # recovery.RecoveredState after recover()
+        # bumped whenever retained history RESHAPES under existing rvs —
+        # overrun rebase (an rv hole opens) and retention deletion (the
+        # floor moves): the serve plane's ?at= reconstruction LRU keys on
+        # this, so anything that can change what an rv reconstructs to
+        # (or whether it still can) invalidates cached bodies by simply
+        # no longer matching their key
+        self._cache_epoch = 0
 
         if metrics is not None:
             self._deltas_counter = metrics.counter("history_wal_deltas")
@@ -532,6 +539,7 @@ class HistoryStore:
             # rebase: the dropped backlog left a hole, so re-anchor on a
             # snapshot of the LIVE view (the shadow is stale past the
             # hole); recovery clears its journal across the rv jump
+            self._cache_epoch += 1
             if self.state_provider is not None:
                 try:
                     self._rv, state = self.state_provider()
@@ -683,6 +691,9 @@ class HistoryStore:
         while len(self._segments) > self.retain_segments:
             with self._cond:
                 victim = self._segments.pop(0)
+            # the retention floor moved: rvs under it stop reconstructing,
+            # so cached ?at= bodies keyed on the old epoch must die
+            self._cache_epoch += 1
             try:
                 victim.path.unlink()
             except OSError as exc:
@@ -691,6 +702,13 @@ class HistoryStore:
             self._segments_gauge.set(len(self._segments))
 
     # -- read surface (time travel / debug) -------------------------------
+
+    @property
+    def cache_epoch(self) -> int:
+        """Monotonic counter naming the current shape of retained history
+        (bumped on overrun rebase and retention deletion) — the serve
+        plane's ``?at=`` LRU cache-key component."""
+        return self._cache_epoch
 
     def retention_floor_rv(self) -> int:
         """The oldest rv reconstructible from retained segments: the

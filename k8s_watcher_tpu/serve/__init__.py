@@ -1,6 +1,8 @@
 """Fleet-state serving plane: watch-cache materialized view + resumable
-snapshot/delta subscriptions (see ARCHITECTURE.md "Serving plane")."""
+snapshot/delta subscriptions over an encode-once broadcast core (see
+ARCHITECTURE.md "Serving plane")."""
 
+from k8s_watcher_tpu.serve.broadcast import BroadcastLoop
 from k8s_watcher_tpu.serve.server import ServePlane, ServeServer
 from k8s_watcher_tpu.serve.view import (
     DELETE,
@@ -10,9 +12,12 @@ from k8s_watcher_tpu.serve.view import (
     UPSERT,
     Delta,
     FleetView,
+    FrameReadResult,
     ReadResult,
     Subscription,
     SubscriptionHub,
+    chunk_frame,
+    frame_payload,
 )
 
 __all__ = [
@@ -21,11 +26,15 @@ __all__ = [
     "INVALID",
     "OK",
     "UPSERT",
+    "BroadcastLoop",
     "Delta",
     "FleetView",
+    "FrameReadResult",
     "ReadResult",
     "ServePlane",
     "ServeServer",
     "Subscription",
     "SubscriptionHub",
+    "chunk_frame",
+    "frame_payload",
 ]

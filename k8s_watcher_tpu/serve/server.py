@@ -37,6 +37,17 @@ Auth reuses the status plane's bearer contract (metrics/server.py
 with ``watcher.status_auth_token``, every /serve route except
 /serve/healthz requires ``Authorization: Bearer <token>`` — the serving
 plane must not be an unauthenticated side door to fleet state.
+
+The HTTP threads here are a FRONT, not the data plane: a ``?watch=1``
+stream's handshake (parse/auth/pre-stream 410/headers) runs on the
+per-connection thread, then the socket is handed off non-blocking to
+the broadcast event loop (serve/broadcast.py), which writes
+publish-time-encoded frame bytes to every stream — the thread returns
+to the pool immediately. Snapshots serve the view's rv-keyed byte
+cache; ``?at=`` reconstructions sit in a small LRU. With
+``serve.io_threads: 0`` the legacy thread-per-connection streamer
+(``_stream``) carries watches instead — the reference implementation
+the equivalence tests compare the loop against.
 """
 
 from __future__ import annotations
@@ -45,6 +56,7 @@ import json
 import logging
 import threading
 import time
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
@@ -54,6 +66,7 @@ from k8s_watcher_tpu.metrics.server import (
     bearer_authorized,
     send_json,
 )
+from k8s_watcher_tpu.serve.broadcast import BroadcastLoop
 from k8s_watcher_tpu.serve.view import GONE, INVALID, FleetView, SubscriptionHub
 
 logger = logging.getLogger(__name__)
@@ -68,6 +81,57 @@ MAX_LONG_POLL_SECONDS = 30.0
 #: idle heartbeat cadence: SYNC frames keep the resume token fresh and
 #: prove the stream is alive through proxies
 SYNC_INTERVAL_SECONDS = 2.0
+
+
+class _HandoffHTTPServer(QuietThreadingHTTPServer):
+    """ThreadingHTTPServer that can RELEASE a connection to the broadcast
+    event loop: a handler marks its socket handed off and the server's
+    per-request teardown (``shutdown(SHUT_WR)`` + ``close()``) skips it —
+    the loop owns the fd from then on. Without this, the handler thread
+    returning would FIN the stream the loop just adopted."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._handed_off = set()
+        self._handoff_lock = threading.Lock()
+
+    def hand_off(self, request) -> None:
+        with self._handoff_lock:
+            self._handed_off.add(request)
+
+    def shutdown_request(self, request) -> None:
+        with self._handoff_lock:
+            if request in self._handed_off:
+                self._handed_off.discard(request)
+                return
+        super().shutdown_request(request)
+
+
+class _AtCache:
+    """Tiny LRU for ``?at=rv`` reconstructions: dashboards polling the
+    same historical rv must not re-read WAL segments per request. Keys
+    carry the view instance id AND the history store's ``cache_epoch``
+    (bumped on overrun rebase and retention deletion), so anything that
+    can change what an rv reconstructs to simply stops matching."""
+
+    def __init__(self, capacity: int = 16):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._entries: OrderedDict = OrderedDict()
+
+    def get(self, key):
+        with self._lock:
+            body = self._entries.get(key)
+            if body is not None:
+                self._entries.move_to_end(key)
+            return body
+
+    def put(self, key, body: bytes) -> None:
+        with self._lock:
+            self._entries[key] = body
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
 
 
 class _ServeHandler(BaseHTTPRequestHandler):
@@ -85,6 +149,10 @@ class _ServeHandler(BaseHTTPRequestHandler):
     hub: SubscriptionHub
     plane = None  # the owning ServePlane (health payload)
     history = None  # history.HistoryStore -> ?at= time-travel reads
+    loop: Optional[BroadcastLoop] = None  # epoll core; None = threaded streams
+    at_cache: Optional[_AtCache] = None  # ?at= reconstruction LRU
+    at_hits = None  # metrics counters (bound by ServeServer when wired)
+    at_misses = None
     auth_token: Optional[str] = None
 
     def log_message(self, *a):
@@ -92,6 +160,15 @@ class _ServeHandler(BaseHTTPRequestHandler):
 
     def _json(self, status: int, body: dict) -> None:
         send_json(self, status, body)
+
+    def _json_bytes(self, status: int, data: bytes) -> None:
+        """A pre-serialized JSON body (snapshot byte cache / ?at= LRU):
+        the Content-Length framing of ``send_json`` without re-encoding."""
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
 
     def do_GET(self):  # noqa: N802
         parsed = urlparse(self.path)
@@ -116,8 +193,10 @@ class _ServeHandler(BaseHTTPRequestHandler):
         if "at" in params:
             self._serve_at(params)
             return
-        rv, objects = self.view.snapshot()
-        self._json(200, {"rv": rv, "view": self.view.instance, "objects": objects})
+        # rv-keyed snapshot byte cache: serialized at most once per rv
+        # (rebuilt on first read after a publish), so a polling dashboard
+        # tier costs one json.dumps per DELTA, not one per request
+        self._json_bytes(200, self.view.snapshot_bytes())
 
     def _serve_at(self, params: dict) -> None:
         """Time travel: ``GET /serve/fleet?at=N`` reconstructs the fleet
@@ -138,6 +217,26 @@ class _ServeHandler(BaseHTTPRequestHandler):
         if at_rv < 0:
             self._json(400, {"error": "at= must be >= 0"})
             return
+        # LRU over recent reconstructions: a WAL-segment fold is a
+        # forensic-grade read, and dashboards poll the same historical rv
+        # repeatedly. The key's instance + cache_epoch components make
+        # rebase/retention/restart invalidation automatic (stale keys
+        # just stop matching and age out of the LRU).
+        cache_key = None
+        if self.at_cache is not None:
+            cache_key = (
+                self.view.instance,
+                getattr(self.history, "cache_epoch", 0),
+                at_rv,
+            )
+            cached = self.at_cache.get(cache_key)
+            if cached is not None:
+                if self.at_hits is not None:
+                    self.at_hits.inc()
+                self._json_bytes(200, cached)
+                return
+            if self.at_misses is not None:
+                self.at_misses.inc()
         status, rv, objects = self.history.reconstruct(at_rv)
         if status == "gone":
             self._json(
@@ -154,8 +253,7 @@ class _ServeHandler(BaseHTTPRequestHandler):
                  "rv": at_rv, "durable_rv": rv},
             )
             return
-        self._json(
-            200,
+        body = json.dumps(
             {
                 "rv": at_rv,
                 "view": self.view.instance,
@@ -163,8 +261,11 @@ class _ServeHandler(BaseHTTPRequestHandler):
                 # deterministic order (sorted (kind, key)) — reconstructions
                 # are compared byte-wise in the smoke/replay legs
                 "objects": [objects[k] for k in sorted(objects)],
-            },
-        )
+            }
+        ).encode()
+        if self.at_cache is not None and cache_key is not None:
+            self.at_cache.put(cache_key, body)
+        self._json_bytes(200, body)
 
     def _serve_watch(self, params: dict) -> None:
         try:
@@ -198,13 +299,17 @@ class _ServeHandler(BaseHTTPRequestHandler):
                 {"error": "max_subscribers reached", "max_subscribers": self.hub.max_subscribers},
             )
             return
+        handed_off = False
         try:
             if params.get("once") in ("1", "true"):
                 self._long_poll(sub, min(timeout, MAX_LONG_POLL_SECONDS), limit)
+            elif self.loop is not None:
+                handed_off = self._stream_handoff(sub, timeout, limit)
             else:
                 self._stream(sub, timeout, limit)
         finally:
-            self.hub.unsubscribe(sub)
+            if not handed_off:
+                self.hub.unsubscribe(sub)
 
     def _long_poll(self, sub, timeout: float, limit) -> None:
         result = sub.pull(timeout=timeout, limit=limit)
@@ -238,9 +343,10 @@ class _ServeHandler(BaseHTTPRequestHandler):
             },
         )
 
-    def _stream(self, sub, timeout: float, limit) -> None:
-        # pre-stream 410: a dead resume token must fail the REQUEST, not
-        # arrive as a frame the client has to dig out of a 200 stream
+    def _pre_stream_410(self, sub) -> bool:
+        """Pre-stream 410: a dead resume token must fail the REQUEST, not
+        arrive as a frame the client has to dig out of a 200 stream.
+        Returns True when a 410 was answered (caller stops)."""
         peek_status = self.view.token_status(sub.rv)
         if peek_status == GONE:
             self._json(
@@ -248,7 +354,7 @@ class _ServeHandler(BaseHTTPRequestHandler):
                 {"error": "resume token compacted away; re-snapshot",
                  "rv": sub.rv, "oldest_rv": self.view.oldest_rv},
             )
-            return
+            return True
         if peek_status == INVALID:
             # same restart heuristic as the long-poll path: recoverable 410
             self._json(
@@ -256,6 +362,49 @@ class _ServeHandler(BaseHTTPRequestHandler):
                 {"error": "rv is ahead of this view (watcher restarted?); re-snapshot",
                  "rv": sub.rv, "view_rv": self.view.rv, "view": self.view.instance},
             )
+            return True
+        return False
+
+    def _stream_handoff(self, sub, timeout: float, limit) -> bool:
+        """The epoll path: handshake/auth/410 checks ran on THIS thread
+        (the HTTP front's job); write the response headers, then release
+        the socket to the broadcast loop and return the thread to the
+        pool. Returns True once the loop owns socket + subscription —
+        the caller must then NOT unsubscribe."""
+        if self._pre_stream_410(sub):
+            return False
+        if not self.loop.accepting:
+            # a dead loop's inbox is a black hole; serve this stream on
+            # the legacy threaded path instead (degraded but correct —
+            # /healthz is already reporting the loop unhealthy)
+            self._stream(sub, timeout, limit)
+            return False
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        self.wfile.flush()
+        # from here the loop writes the chunked body; the handler thread
+        # must neither FIN nor close the fd on return. submit() precedes
+        # hand_off so a raise (all workers died since the alive check)
+        # leaves the socket owned by the server, which then closes it
+        # normally and the finally-unsubscribe frees the slot.
+        self.close_connection = True
+        try:
+            self.loop.submit(
+                self.connection, sub,
+                timeout=timeout, limit=limit, view_id=self.view.instance,
+            )
+        except RuntimeError:
+            return False
+        self.server.hand_off(self.connection)
+        return True
+
+    def _stream(self, sub, timeout: float, limit) -> None:
+        # legacy thread-per-connection streamer (serve.io_threads: 0):
+        # kept as the PR-4 reference encoder the golden/equivalence tests
+        # compare the broadcast core against
+        if self._pre_stream_410(sub):
             return
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
@@ -319,14 +468,35 @@ class ServeServer:
         auth_token: Optional[str] = None,
         plane=None,
         history=None,
+        io_threads: int = 1,
+        sub_buffer_bytes: int = 1 << 20,
+        metrics=None,
     ):
+        # the broadcast event loop carries every ?watch=1 stream once the
+        # HTTP front hands the socket off; io_threads=0 keeps the legacy
+        # thread-per-connection streamer (the equivalence tests' reference)
+        self.loop: Optional[BroadcastLoop] = (
+            BroadcastLoop(
+                view, hub,
+                threads=io_threads,
+                sub_buffer_bytes=sub_buffer_bytes,
+                metrics=metrics,
+            )
+            if io_threads > 0
+            else None
+        )
         handler = type(
             "BoundServeHandler",
             (_ServeHandler,),
             {"view": view, "hub": hub, "auth_token": auth_token, "plane": plane,
-             "history": history},
+             "history": history, "loop": self.loop,
+             "at_cache": _AtCache() if history is not None else None,
+             "at_hits": metrics.counter("serve_at_cache_hits")
+             if metrics is not None and history is not None else None,
+             "at_misses": metrics.counter("serve_at_cache_misses")
+             if metrics is not None and history is not None else None},
         )
-        self._server = QuietThreadingHTTPServer((host, port), handler)
+        self._server = _HandoffHTTPServer((host, port), handler)
         self._server.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
 
@@ -339,6 +509,8 @@ class ServeServer:
         return self._thread is not None and self._thread.is_alive()
 
     def start(self) -> "ServeServer":
+        if self.loop is not None:
+            self.loop.start()
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="serve-plane", daemon=True
         )
@@ -350,6 +522,8 @@ class ServeServer:
         self._server.server_close()
         if self._thread:
             self._thread.join(timeout=2.0)
+        if self.loop is not None:
+            self.loop.stop()
 
 
 class ServePlane:
@@ -433,12 +607,16 @@ class ServePlane:
             auth_token=self._auth_token,
             plane=self,
             history=self.history,
+            io_threads=getattr(self.config, "io_threads", 1),
+            sub_buffer_bytes=getattr(self.config, "sub_buffer_bytes", 1 << 20),
+            metrics=self.metrics,
         ).start()
         logger.info(
             "Serving plane on :%d (/serve/fleet snapshot+watch, max_subscribers=%d, "
-            "queue_depth=%d, compact_horizon=%d)",
+            "queue_depth=%d, compact_horizon=%d, io_threads=%d)",
             self.server.port, self.config.max_subscribers,
             self.config.queue_depth, self.config.compact_horizon,
+            getattr(self.config, "io_threads", 1),
         )
         return self
 
@@ -465,6 +643,17 @@ class ServePlane:
             "oldest_rv": self.view.oldest_rv,
             "objects": self.view.object_count(),
         }
+        if server is not None and server.loop is not None:
+            # a dead broadcast loop starves every handed-off stream while
+            # the HTTP front keeps accepting — fold it like the thread
+            loop_alive = server.loop.alive
+            body["io_loop"] = {
+                "healthy": loop_alive,
+                "threads": server.loop.threads,
+                "streams": server.loop.client_count,
+            }
+            if not loop_alive:
+                body["healthy"] = False
         if self.history is not None:
             # a dead WAL writer silently stops persisting deltas — as
             # blind-making for the restart story as a dead serve thread

@@ -39,15 +39,28 @@ probe/node threads) under one lock; readers (``read_since``/``snapshot``)
 share that lock and long-polls wait on its condition. Deltas and objects
 are replaced, never mutated, so readers can hand out references without
 copies.
+
+Encode-once fan-out (the O(deltas) data plane): every applied delta's
+**wire frame** — its JSON line, already wrapped in HTTP chunked-transfer
+framing — is serialized to bytes exactly once, at publish time, into a
+parallel ``_frames`` array trimmed with the journal. 10k subscribers
+streaming the same delta all reference the *same* ``bytes`` object; the
+per-subscriber cost of a delivery is a buffer append, never a
+``json.dumps``. Compacted/paged batches reuse the per-delta frames and
+only synthesize the small COMPACTED/SYNC/GONE control frames.
+``GET /serve/fleet`` rides the same idea one level up: the whole
+snapshot body is serialized at most once per rv (``snapshot_bytes``,
+invalidated implicitly when a publish bumps rv).
 """
 
 from __future__ import annotations
 
+import json
 import os
 import threading
 import time
 from bisect import bisect_right
-from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+from typing import Any, Callable, Dict, List, Mapping, NamedTuple, Optional, Tuple
 
 from k8s_watcher_tpu.pipeline.phase import pod_key, pod_ready
 from k8s_watcher_tpu.pipeline.pipeline import NEVER_IN_VIEW as _NEVER_IN_VIEW
@@ -81,6 +94,27 @@ class Delta(NamedTuple):
         return out
 
 
+def chunk_frame(obj: Mapping[str, Any]) -> bytes:
+    """One wire frame: a JSON line wrapped in HTTP chunked-transfer
+    framing (``<hex len>\\r\\n<json>\\n\\r\\n``). The JSON payload is
+    byte-identical to what the PR-4 thread-per-connection encoder wrote
+    (default ``json.dumps`` separators) — chunk *boundaries* moved from
+    per-batch to per-frame, which dechunking erases; the de-chunked byte
+    stream a client sees is unchanged. Used for every frame on a watch
+    stream: per-delta frames (encoded once, at publish) and the small
+    per-connection SYNC/COMPACTED/GONE control frames."""
+    payload = (json.dumps(obj) + "\n").encode()
+    return b"%x\r\n" % len(payload) + payload + b"\r\n"
+
+
+def frame_payload(frame: bytes) -> bytes:
+    """Strip the chunked-transfer framing off one ``chunk_frame`` result
+    (test/debug helper — the inverse a dechunking client applies)."""
+    head, _, rest = frame.partition(b"\r\n")
+    size = int(head, 16)
+    return rest[:size]
+
+
 class ReadResult(NamedTuple):
     """One ``read_since`` pull.
 
@@ -97,6 +131,21 @@ class ReadResult(NamedTuple):
     to_rv: int
     compacted: bool
     deltas: List[Delta]
+
+
+class FrameReadResult(NamedTuple):
+    """One ``read_frames_since`` pull: ``read_since`` semantics plus the
+    publish-time wire frames, parallel to ``deltas`` (``frames[i]`` is
+    ``deltas[i]`` already chunk-framed). The bytes objects are SHARED
+    across every subscriber pulling the same rv range — append them,
+    never mutate them."""
+
+    status: str
+    from_rv: int
+    to_rv: int
+    compacted: bool
+    deltas: List[Delta]
+    frames: List[bytes]
 
 
 class FleetView:
@@ -122,9 +171,20 @@ class FleetView:
         self._oldest_rv = 0  # deltas with rv <= this are compacted away
         self._objects: Dict[Tuple[str, str], Dict[str, Any]] = {}
         # parallel append-only arrays (trimmed together at the horizon):
-        # bisect over _delta_rvs finds a resume point in O(log n)
+        # bisect over _delta_rvs finds a resume point in O(log n);
+        # _frames[i] is _deltas[i]'s wire frame, serialized EXACTLY ONCE
+        # at publish — the encode-once contract the fan-out bench gates
         self._delta_rvs: List[int] = []
         self._deltas: List[Delta] = []
+        self._frames: List[bytes] = []
+        # rv-keyed snapshot byte cache: (rv, body bytes) — rebuilt at
+        # most once per rv, served only while rv is still current (a
+        # publish invalidates it by bumping rv)
+        self._snapshot_cache: Optional[Tuple[int, bytes]] = None
+        # post-publish wakeups OUTSIDE the lock (the broadcast event
+        # loop's one-wakeup-per-publish signal; never the per-waiter
+        # notify_all herd)
+        self._wakeups: List[Callable[[], None]] = []
         # durable history plane (history.HistoryStore), when enabled:
         # every applied delta is handed off (O(1) enqueue) UNDER the
         # publish lock — that lock ordering is what keeps the WAL
@@ -140,6 +200,18 @@ class FleetView:
             metrics.counter("serve_deltas_published") if metrics is not None else None
         )
         self._rv_gauge = metrics.gauge("serve_view_rv") if metrics is not None else None
+        self._encode_seconds = (
+            metrics.histogram("serve_encode_seconds") if metrics is not None else None
+        )
+        self._frame_encodes = (
+            metrics.counter("serve_frame_encodes") if metrics is not None else None
+        )
+        self._snap_hits = (
+            metrics.counter("serve_snapshot_cache_hits") if metrics is not None else None
+        )
+        self._snap_misses = (
+            metrics.counter("serve_snapshot_cache_misses") if metrics is not None else None
+        )
 
     # -- durable history (restart-surviving rv line) -----------------------
 
@@ -162,6 +234,8 @@ class FleetView:
             self._objects = dict(objects)
             self._deltas = list(journal)
             self._delta_rvs = [d.rv for d in journal]
+            self._frames = [self._encode_locked(d) for d in journal]
+            self._snapshot_cache = None
             # tokens older than the preloaded tail 410 — the compaction-
             # horizon contract, now spanning incarnations
             self._oldest_rv = journal[0].rv - 1 if journal else rv
@@ -183,6 +257,37 @@ class FleetView:
 
     # -- writing (pipeline thread + sink taps) ----------------------------
 
+    def register_wakeup(self, fn: Callable[[], None]) -> None:
+        """Register a post-publish wakeup hook, called OUTSIDE the lock
+        after every publish that applied at least one delta. This is the
+        broadcast event loop's signal: one call per publish, not one
+        ``notify_all`` herd per blocked socket thread."""
+        self._wakeups.append(fn)
+
+    def unregister_wakeup(self, fn: Callable[[], None]) -> None:
+        """Withdraw a wakeup hook (loop shutdown): a stopped loop must
+        not keep being called per publish against torn-down pipes."""
+        try:
+            self._wakeups.remove(fn)
+        except ValueError:
+            pass
+
+    def _encode_locked(self, delta: Delta) -> bytes:
+        """Serialize ``delta``'s wire frame — the once in encode-once.
+        Called under the lock, before the delta becomes visible to any
+        reader, so memoization needs no CAS and the encode counter is
+        exact (the bench's amortization gate: encodes == publishes,
+        independent of subscriber count)."""
+        if self._encode_seconds is not None:
+            t0 = time.perf_counter()
+            frame = chunk_frame(delta.to_wire())
+            self._encode_seconds.record(time.perf_counter() - t0)
+        else:
+            frame = chunk_frame(delta.to_wire())
+        if self._frame_encodes is not None:
+            self._frame_encodes.inc()
+        return frame
+
     def _apply_locked(self, kind: str, key: str, obj: Optional[Dict[str, Any]], now: float) -> bool:
         """One delta under the lock. Returns False for no-ops (identical
         upsert, delete of an absent key) — no rv burn, no journal entry."""
@@ -197,8 +302,10 @@ class FleetView:
             self._objects[map_key] = obj
             delta_type = UPSERT
         self._rv += 1
+        delta = Delta(self._rv, kind, key, delta_type, obj, now)
         self._delta_rvs.append(self._rv)
-        self._deltas.append(Delta(self._rv, kind, key, delta_type, obj, now))
+        self._deltas.append(delta)
+        self._frames.append(self._encode_locked(delta))
         return True
 
     def _trim_locked(self) -> None:
@@ -210,6 +317,7 @@ class FleetView:
         self._oldest_rv = self._delta_rvs[overflow - 1]
         del self._delta_rvs[:overflow]
         del self._deltas[:overflow]
+        del self._frames[:overflow]
 
     def apply(self, kind: str, key: str, obj: Optional[Dict[str, Any]]) -> bool:
         """Upsert (``obj``) or delete (``obj is None``) one object and wake
@@ -226,8 +334,11 @@ class FleetView:
                 if self._rv_gauge is not None:
                     self._rv_gauge.set(self._rv)
                 self._cond.notify_all()
-        if changed and self._deltas_published is not None:
-            self._deltas_published.inc()
+        if changed:
+            if self._deltas_published is not None:
+                self._deltas_published.inc()
+            for fn in self._wakeups:
+                fn()
         return changed
 
     def publish_batch(self, events, results) -> int:
@@ -291,6 +402,8 @@ class FleetView:
                 self._deltas_published.inc(changed)
             if self._publish_seconds is not None:
                 self._publish_seconds.record(t_end - t_start)
+            for fn in self._wakeups:
+                fn()
         return changed
 
     def observe_notification(self, notification) -> None:
@@ -345,6 +458,33 @@ class FleetView:
         with self._cond:
             return self._rv, list(self._objects.values())
 
+    def snapshot_bytes(self) -> bytes:
+        """The serialized ``GET /serve/fleet`` body, rebuilt at most once
+        per rv: built on first read, served from cache while rv is
+        unchanged, invalidated implicitly by the next publish (cache is
+        keyed by rv; a bumped rv simply stops matching). A dashboard
+        tier polling snapshots between publishes costs one ``json.dumps``
+        per *delta*, not one per *request*."""
+        with self._cond:
+            cached = self._snapshot_cache
+            if cached is not None and cached[0] == self._rv:
+                if self._snap_hits is not None:
+                    self._snap_hits.inc()
+                return cached[1]
+            rv, objects = self._rv, list(self._objects.values())
+            instance = self.instance
+        # serialize OUTSIDE the lock (O(fleet) work must not stall
+        # publishes); objects are replaced-never-mutated, so the shallow
+        # copy above is a consistent snapshot
+        data = json.dumps({"rv": rv, "view": instance, "objects": objects}).encode()
+        with self._cond:
+            # store keyed by the rv it was built at; if a publish landed
+            # meanwhile, the next read sees the mismatch and rebuilds
+            self._snapshot_cache = (rv, data)
+        if self._snap_misses is not None:
+            self._snap_misses.inc()
+        return data
+
     def object_count(self) -> int:
         with self._cond:
             return len(self._objects)
@@ -380,21 +520,59 @@ class FleetView:
         next page. Non-positive ``limit`` means unpaged (the HTTP layer
         rejects negatives before they get here).
         """
+        status, from_rv, to_rv, compacted, deltas, _ = self._read(
+            rv, max_deltas, limit, timeout, want_frames=False
+        )
+        return ReadResult(status, from_rv, to_rv, compacted, deltas)
+
+    def read_frames_since(
+        self,
+        rv: int,
+        *,
+        max_deltas: int = 128,
+        limit: Optional[int] = None,
+        timeout: float = 0.0,
+    ) -> FrameReadResult:
+        """``read_since`` plus the publish-time wire frames — the
+        broadcast path. ``frames[i]`` is ``deltas[i]``'s chunk-framed
+        JSON line, encoded ONCE at publish and shared by reference
+        across every subscriber pulling this range (compacted and paged
+        batches included — they subset the same bytes objects)."""
+        return FrameReadResult(
+            *self._read(rv, max_deltas, limit, timeout, want_frames=True)
+        )
+
+    def _read(
+        self,
+        rv: int,
+        max_deltas: int,
+        limit: Optional[int],
+        timeout: float,
+        want_frames: bool,
+    ) -> Tuple[str, int, int, bool, List[Delta], List[bytes]]:
         deadline = time.monotonic() + timeout if timeout > 0 else None
+        frames: List[bytes] = []
         with self._cond:
             while True:
                 if rv > self._rv:
-                    return ReadResult(INVALID, rv, rv, False, [])
+                    return (INVALID, rv, rv, False, [], [])
                 if rv < self._oldest_rv:
                     # covers falling behind *while waiting*, too
-                    return ReadResult(GONE, rv, rv, False, [])
+                    return (GONE, rv, rv, False, [], [])
                 pending = self._rv - rv
                 if pending:
                     break
                 remaining = deadline - time.monotonic() if deadline is not None else 0.0
                 if remaining <= 0:
-                    return ReadResult(OK, rv, rv, False, [])
-                self._cond.wait(timeout=min(remaining, 0.5))
+                    return (OK, rv, rv, False, [], [])
+                # wait the FULL remaining window: publishes notify the
+                # condition, GONE/INVALID can only change on a publish,
+                # and the deadline re-check above handles spurious wakes
+                # — so an idle long-poll sleeps once, instead of the old
+                # 0.5 s self-tick that woke every parked waiter (5k idle
+                # once=1 pollers = 10k wasted wakeups/s) to discover
+                # nothing happened
+                self._cond.wait(timeout=remaining)
             idx = bisect_right(self._delta_rvs, rv)
             to_rv = self._rv
             # ONLY the slice happens under the lock (an O(pending) ref
@@ -403,23 +581,33 @@ class FleetView:
             # latest-wins walk below must NOT hold the lock, or 5k lagging
             # subscribers' compactions serialize every publish behind them
             deltas = self._deltas[idx:]
+            if want_frames:
+                frames = self._frames[idx:]
         oldest_pending_t = deltas[0].t
         if pending <= max_deltas:
             compacted = False
         else:
-            latest: Dict[Tuple[str, str], Delta] = {}
-            for delta in deltas:
-                latest[(delta.kind, delta.key)] = delta
-            deltas = sorted(latest.values(), key=lambda d: d.rv)
+            # latest-wins per key over the slice; the journal is
+            # rv-ascending, so keeping each key's last INDEX and sorting
+            # indices preserves rv order and keeps deltas/frames parallel
+            latest: Dict[Tuple[str, str], int] = {}
+            for i, delta in enumerate(deltas):
+                latest[(delta.kind, delta.key)] = i
+            order = sorted(latest.values())
+            deltas = [deltas[i] for i in order]
+            if want_frames:
+                frames = [frames[i] for i in order]
             compacted = True
         if limit is not None and 0 < limit < len(deltas):
             deltas = deltas[:limit]
+            if want_frames:
+                frames = frames[:limit]
             to_rv = deltas[-1].rv
         if self._delta_lag is not None:
             # lag = how stale the oldest pending delta had become by the
             # time this pull delivered it
             self._delta_lag.record(time.monotonic() - oldest_pending_t)
-        return ReadResult(OK, rv, to_rv, compacted, deltas)
+        return (OK, rv, to_rv, compacted, deltas, frames)
 
 
 def _pod_object(event) -> Tuple[str, Dict[str, Any]]:
@@ -461,22 +649,35 @@ class Subscription:
         self.compacted_pulls = 0
         self.resyncs = 0
 
-    def pull(self, *, timeout: float = 0.0, limit: Optional[int] = None) -> ReadResult:
-        """One cursor advance. ``queue_depth`` (the subscription's
-        bounded-queue size) is the only lag-shedding trigger; ``limit``
-        only pages the response (non-lossy, see ``read_since``)."""
-        result = self.view.read_since(
-            self.rv,
-            max_deltas=self.queue_depth,
-            limit=limit,
-            timeout=timeout,
-        )
+    def _advance(self, result):
+        """ONE cursor-advance rule for both pull shapes — the threaded
+        and broadcast paths must never diverge on resume semantics."""
         self.pulls += 1
         if result.status == OK:
             self.rv = result.to_rv
             if result.compacted:
                 self.compacted_pulls += 1
         return result
+
+    def pull(self, *, timeout: float = 0.0, limit: Optional[int] = None) -> ReadResult:
+        """One cursor advance. ``queue_depth`` (the subscription's
+        bounded-queue size) is the only lag-shedding trigger; ``limit``
+        only pages the response (non-lossy, see ``read_since``)."""
+        return self._advance(
+            self.view.read_since(
+                self.rv, max_deltas=self.queue_depth, limit=limit, timeout=timeout
+            )
+        )
+
+    def pull_frames(self, *, timeout: float = 0.0, limit: Optional[int] = None) -> FrameReadResult:
+        """``pull`` returning the publish-time wire frames alongside the
+        deltas — the broadcast core's (and fan-out bench's) shape; the
+        frames are shared bytes, a delivery is a buffer append."""
+        return self._advance(
+            self.view.read_frames_since(
+                self.rv, max_deltas=self.queue_depth, limit=limit, timeout=timeout
+            )
+        )
 
     def rebase(self, rv: int) -> None:
         """Reset the cursor after a GONE -> re-snapshot resync."""

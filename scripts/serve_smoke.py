@@ -22,7 +22,11 @@ through every leg of the subscription protocol:
    (re-snapshot, watch from its rv) works;
 5. **auth** — /serve routes answer 401 without the bearer token while
    /serve/healthz stays open, and the status server's /healthz folds
-   the serving plane's verdict in.
+   the serving plane's verdict in;
+6. **encode-once plumbing** — the broadcast data plane's metrics are
+   live after the legs above: frames were encoded (once per delta, at
+   publish), fan-out bytes moved through the event loop, and
+   back-to-back snapshots hit the rv-keyed byte cache.
 
 Artifact: ``artifacts/serve_smoke.json``. Exit 0 on PASS.
 
@@ -296,6 +300,32 @@ def run_smoke() -> dict:
                 and healthz["serve"]["subscribers"] == 0
             )
             result["healthz_serve"] = healthz.get("serve")
+
+            # 6. encode-once plumbing: frames encoded at publish, bytes
+            # fanned out by the event loop, snapshot byte cache hitting
+            # (two back-to-back snapshots with no churn = a guaranteed
+            # same-rv second read)
+            requests.get(f"{base}/serve/fleet", headers=AUTH, timeout=5)
+            requests.get(f"{base}/serve/fleet", headers=AUTH, timeout=5)
+            metrics = requests.get(
+                f"http://127.0.0.1:{status_port}/metrics", headers=AUTH, timeout=5
+            ).json()
+            checks["encode_once_metrics"] = (
+                metrics.get("serve_frame_encodes", {}).get("count", 0) > 0
+                and metrics.get("serve_fanout_bytes", {}).get("count", 0) > 0
+                and metrics.get("serve_snapshot_cache_hits", {}).get("count", 0) > 0
+                and metrics.get("serve_encode_seconds", {}).get("count", 0) > 0
+            )
+            result["encode_once"] = {
+                k: metrics.get(k, {}).get("count")
+                for k in (
+                    "serve_frame_encodes", "serve_fanout_bytes",
+                    "serve_snapshot_cache_hits", "serve_snapshot_cache_misses",
+                )
+            }
+            io_loop = healthz.get("serve", {}).get("io_loop")
+            checks["io_loop_healthy"] = bool(io_loop) and io_loop.get("healthy") is True
+            result["io_loop"] = io_loop
         finally:
             app.stop()
             thread.join(timeout=10)

@@ -18,6 +18,7 @@ states:
 
 import json
 import random
+import socket
 import threading
 import time
 
@@ -33,10 +34,12 @@ from k8s_watcher_tpu.serve import (
     INVALID,
     OK,
     UPSERT,
+    BroadcastLoop,
     FleetView,
     ServePlane,
     ServeServer,
     SubscriptionHub,
+    frame_payload,
 )
 from k8s_watcher_tpu.watch.fake import build_pod
 from k8s_watcher_tpu.watch.source import EventType, WatchEvent
@@ -738,3 +741,351 @@ class TestServeConfigSchema:
     def test_port_range(self):
         with pytest.raises(SchemaError, match="port"):
             ServeConfig.from_raw({"port": 70000})
+
+    def test_io_threads_default_and_bounds(self):
+        assert ServeConfig.from_raw({}).io_threads == 1
+        assert ServeConfig.from_raw({"io_threads": 0}).io_threads == 0  # legacy mode
+        with pytest.raises(SchemaError, match="io_threads"):
+            ServeConfig.from_raw({"io_threads": -1})
+        with pytest.raises(SchemaError, match="io_threads"):
+            ServeConfig.from_raw({"io_threads": 65})
+
+    def test_sub_buffer_bytes_floor(self):
+        assert ServeConfig.from_raw({}).sub_buffer_bytes == 1 << 20
+        with pytest.raises(SchemaError, match="sub_buffer_bytes"):
+            ServeConfig.from_raw({"sub_buffer_bytes": 100})
+
+
+# -- encode-once frames ------------------------------------------------------
+
+
+class TestEncodeOnceFrames:
+    def test_frame_payload_golden_vs_pr4_encoder(self):
+        """Byte-identical golden: the publish-time frame's dechunked
+        payload must equal what the PR-4 thread-per-connection streamer
+        wrote for the same delta (default json.dumps separators + one
+        trailing newline), and the chunk framing must be the standard
+        ``<hex>\\r\\n<payload>\\r\\n``."""
+        view = FleetView()
+        view.apply("pod", "a", {"kind": "pod", "key": "a", "phase": "Running"})
+        view.apply("pod", "a", None)
+        r = view.read_frames_since(0, max_deltas=16)
+        assert r.status == OK and len(r.frames) == len(r.deltas) == 2
+        for d, f in zip(r.deltas, r.frames):
+            # the PR-4 encoder, byte for byte (serve/server.py _stream)
+            expected = (json.dumps(d.to_wire()) + "\n").encode()
+            assert frame_payload(f) == expected
+            assert f == b"%x\r\n" % len(expected) + expected + b"\r\n"
+
+    def test_frames_are_shared_objects_across_pulls(self):
+        view = FleetView()
+        hub = SubscriptionHub(view, max_subscribers=4, queue_depth=64)
+        for i in range(8):
+            view.apply("pod", f"p{i}", {"seq": i})
+        a, b = hub.subscribe(rv=0), hub.subscribe(rv=0)
+        fa = a.pull_frames().frames
+        fb = b.pull_frames().frames
+        assert len(fa) == 8
+        # encode-once: 10k subscribers write the SAME bytes objects — a
+        # delivery is a buffer append, never a re-serialization
+        assert all(x is y for x, y in zip(fa, fb))
+
+    def test_encode_counter_exactly_once_per_publish(self):
+        reg = MetricsRegistry()
+        view = FleetView(metrics=reg)
+        hub = SubscriptionHub(view, max_subscribers=8, queue_depth=64)
+        subs = [hub.subscribe(rv=0) for _ in range(4)]
+        for i in range(5):
+            view.apply("pod", "a", {"seq": i})
+        view.apply("pod", "a", {"seq": 4})  # identical upsert: no-op, no encode
+        for sub in subs:
+            sub.pull_frames()
+        assert reg.counter("serve_frame_encodes").value == 5
+        assert reg.counter("serve_deltas_published").value == 5
+
+    def test_compacted_and_paged_batches_reuse_frames(self):
+        view = FleetView()
+        for i in range(20):
+            view.apply("pod", f"p{i % 4}", {"seq": i})
+        raw = view.read_frames_since(0, max_deltas=10**6)
+        by_rv = {d.rv: f for d, f in zip(raw.deltas, raw.frames)}
+        compacted = view.read_frames_since(0, max_deltas=4)
+        assert compacted.compacted and len(compacted.deltas) == 4
+        for d, f in zip(compacted.deltas, compacted.frames):
+            assert f is by_rv[d.rv]  # reuse, not re-encode
+        paged = view.read_frames_since(0, max_deltas=10**6, limit=3)
+        assert len(paged.frames) == 3 and paged.to_rv == paged.deltas[-1].rv
+        for d, f in zip(paged.deltas, paged.frames):
+            assert f is by_rv[d.rv]
+
+
+class TestSnapshotByteCache:
+    def test_rebuilt_at_most_once_per_rv(self):
+        reg = MetricsRegistry()
+        view = FleetView(metrics=reg)
+        view.apply("pod", "a", {"kind": "pod", "key": "a", "seq": 0})
+        b1 = view.snapshot_bytes()
+        b2 = view.snapshot_bytes()
+        assert b1 is b2  # the cached bytes object itself
+        assert reg.counter("serve_snapshot_cache_misses").value == 1
+        assert reg.counter("serve_snapshot_cache_hits").value == 1
+        body = json.loads(b1)
+        rv, objects = view.snapshot()
+        assert body == {"rv": rv, "view": view.instance, "objects": objects}
+        # a publish invalidates (rv-keyed: the bumped rv stops matching)
+        view.apply("pod", "a", {"kind": "pod", "key": "a", "seq": 1})
+        b3 = view.snapshot_bytes()
+        assert b3 is not b1 and json.loads(b3)["rv"] == rv + 1
+        assert reg.counter("serve_snapshot_cache_misses").value == 2
+
+    def test_http_snapshot_rides_the_cache(self):
+        reg = MetricsRegistry()
+        view = FleetView(metrics=reg)
+        hub = SubscriptionHub(view, max_subscribers=4, queue_depth=16)
+        server = ServeServer(view, hub, host="127.0.0.1", port=0, metrics=reg).start()
+        try:
+            view.apply("pod", "a", {"kind": "pod", "key": "a", "seq": 0})
+            base = f"http://127.0.0.1:{server.port}"
+            first = requests.get(f"{base}/serve/fleet", timeout=5).json()
+            second = requests.get(f"{base}/serve/fleet", timeout=5).json()
+            assert first == second and first["rv"] == 1
+            assert reg.counter("serve_snapshot_cache_hits").value >= 1
+        finally:
+            server.stop()
+
+
+# -- ?at= reconstruction LRU -------------------------------------------------
+
+
+class _FakeHistory:
+    """reconstruct() call counter with the cache_epoch invalidation knob."""
+
+    def __init__(self):
+        self.calls = 0
+        self.cache_epoch = 0
+
+    def reconstruct(self, at_rv):
+        self.calls += 1
+        return "ok", at_rv, {("pod", "a"): {"kind": "pod", "key": "a", "at": at_rv}}
+
+
+class TestAtReconstructionCache:
+    def test_repeat_at_reads_hit_the_lru(self):
+        reg = MetricsRegistry()
+        view = FleetView(metrics=reg)
+        hub = SubscriptionHub(view, max_subscribers=4, queue_depth=16)
+        history = _FakeHistory()
+        server = ServeServer(
+            view, hub, host="127.0.0.1", port=0, history=history, metrics=reg
+        ).start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            first = requests.get(f"{base}/serve/fleet", params={"at": 5}, timeout=5)
+            again = requests.get(f"{base}/serve/fleet", params={"at": 5}, timeout=5)
+            assert first.status_code == again.status_code == 200
+            assert first.content == again.content  # cached body, byte-equal
+            assert history.calls == 1  # the WAL fold ran ONCE
+            assert requests.get(
+                f"{base}/serve/fleet", params={"at": 7}, timeout=5
+            ).json()["rv"] == 7  # distinct rv = distinct key
+            assert history.calls == 2
+            # rebase/retention bumps the epoch: cached bodies stop matching
+            history.cache_epoch += 1
+            requests.get(f"{base}/serve/fleet", params={"at": 5}, timeout=5)
+            assert history.calls == 3
+            assert reg.counter("serve_at_cache_hits").value == 1
+            assert reg.counter("serve_at_cache_misses").value == 3
+        finally:
+            server.stop()
+
+
+# -- idle long-poll wakeup storm (satellite) ---------------------------------
+
+
+class TestIdleLongPollWait:
+    def test_idle_wait_sleeps_once_for_the_full_window(self):
+        """The pre-PR loop re-woke every waiter on a 0.5 s self-tick even
+        with nothing pending; the wait must now cover the whole remaining
+        window in ONE sleep and rely on publish notify (wake-on-publish
+        is pinned by test_long_poll_wakes_on_publish)."""
+        view = FleetView()
+        waits = []
+        orig_wait = view._cond.wait
+
+        def counting_wait(timeout=None):
+            waits.append(timeout)
+            return orig_wait(timeout=timeout)
+
+        view._cond.wait = counting_wait
+        t0 = time.monotonic()
+        r = view.read_since(0, timeout=0.8)
+        elapsed = time.monotonic() - t0
+        assert r.status == OK and r.deltas == [] and r.to_rv == 0
+        assert elapsed >= 0.75
+        assert len(waits) == 1, f"idle long-poll self-ticked: waits={waits}"
+        assert waits[0] == pytest.approx(0.8, abs=0.05)
+
+
+# -- broadcast event-loop edge cases -----------------------------------------
+
+
+def _read_chunked_frames(sock, deadline_s=10.0):
+    """Dechunk a raw watch-stream socket until the terminal chunk (or
+    deadline); returns (frames, saw_terminal)."""
+    sock.settimeout(0.5)
+    buf = b""
+    frames = []
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        # parse complete chunks off the front of buf
+        progressed = True
+        while progressed:
+            progressed = False
+            head, sep, rest = buf.partition(b"\r\n")
+            if not sep:
+                break
+            size = int(head, 16)
+            if size == 0:
+                return frames, True
+            if len(rest) >= size + 2:
+                frames.append(json.loads(rest[:size]))
+                buf = rest[size + 2:]
+                progressed = True
+        try:
+            data = sock.recv(65536)
+        except socket.timeout:
+            continue
+        except OSError:
+            break
+        if not data:
+            break
+        buf += data
+    return frames, False
+
+
+class TestBroadcastLoopEdgeCases:
+    def test_mid_frame_disconnect_unsubscribes_and_frees_cursor(self):
+        view = FleetView()
+        hub = SubscriptionHub(view, max_subscribers=4, queue_depth=1024)
+        server = ServeServer(view, hub, host="127.0.0.1", port=0).start()
+        try:
+            view.apply("pod", "big", {"kind": "pod", "key": "big", "blob": "x" * 65536})
+            s = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+            s.sendall(
+                b"GET /serve/fleet?watch=1&rv=0&timeout=30 HTTP/1.1\r\n"
+                b"Host: t\r\n\r\n"
+            )
+            s.settimeout(5)
+            assert s.recv(64)  # the stream is live (headers and/or SYNC)
+            deadline = time.monotonic() + 5
+            while hub.active_count != 1 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert hub.active_count == 1
+            # drop the connection mid-stream while more frames are in
+            # flight: the loop must detect EOF and free the slot NOW,
+            # not at window end 30 s later
+            s.close()
+            for i in range(4):
+                view.apply("pod", f"more-{i}", {"blob": "y" * 65536})
+            deadline = time.monotonic() + 5
+            while hub.active_count and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert hub.active_count == 0, "disconnect did not free the subscriber slot"
+        finally:
+            server.stop()
+
+    def test_partial_writes_resume_through_tiny_kernel_buffer(self):
+        """Kernel-buffer-full mid-frame: the loop keeps the unsent suffix
+        and resumes on writability — the client still receives every
+        frame, gapless and byte-intact, through a socket whose send
+        buffer is far smaller than the backlog."""
+        view = FleetView(compact_horizon=8192)
+        hub = SubscriptionHub(view, max_subscribers=4, queue_depth=4096)
+        loop = BroadcastLoop(view, hub, threads=1, sub_buffer_bytes=64 << 20).start()
+        server_sock, client_sock = socket.socketpair()
+        try:
+            server_sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 8192)
+            sub = hub.subscribe(rv=0)
+            loop.submit(
+                server_sock, sub, timeout=8.0, limit=None, view_id=view.instance
+            )
+            n = 40
+            for i in range(n):  # ~40 x 32 KiB >> the 8 KiB send buffer
+                view.apply("pod", f"p{i}", {"kind": "pod", "key": f"p{i}",
+                                            "seq": i, "blob": "z" * 32768})
+            # let the loop run into the full kernel buffer before the
+            # reader drains anything — partial writes must now be pending
+            time.sleep(0.3)
+            frames, _ = _read_chunked_frames(client_sock, deadline_s=10.0)
+            deltas = [f for f in frames if f["type"] == "UPSERT"]
+            assert [f["rv"] for f in deltas] == list(range(1, n + 1))
+            assert all(f["object"]["blob"] == "z" * 32768 for f in deltas)
+            assert not any(f["type"] in ("GONE", "COMPACTED") for f in frames)
+        finally:
+            client_sock.close()
+            loop.stop()
+            hub_count = hub.active_count
+            assert hub_count == 0  # the loop freed the cursor on teardown
+
+    @pytest.mark.parametrize("seed", [7, 23, 41])
+    def test_epoll_and_threaded_paths_deliver_identical_sequences(self, seed):
+        """Seeded equivalence property: one view, one churn script, two
+        transports — the epoll broadcast core and the legacy PR-4
+        thread-per-connection streamer — must deliver the exact same
+        gapless delta sequence (payload-for-payload), half served from
+        journal history, half published live mid-stream."""
+        rng = random.Random(seed)
+        view = FleetView(compact_horizon=8192)
+        hub = SubscriptionHub(view, max_subscribers=8, queue_depth=8192)
+        epoll_srv = ServeServer(view, hub, host="127.0.0.1", port=0, io_threads=1).start()
+        legacy_srv = ServeServer(view, hub, host="127.0.0.1", port=0, io_threads=0).start()
+        try:
+            def churn(n):
+                for _ in range(n):
+                    key = f"p{rng.randrange(24)}"
+                    if rng.random() < 0.2:
+                        view.apply("pod", key, None)
+                    else:
+                        view.apply("pod", key, {"kind": "pod", "key": key,
+                                                "seq": rng.randrange(1 << 20)})
+
+            churn(60)  # journal history before either stream connects
+            results = {}
+
+            def consume(name, port):
+                frames = []
+                with requests.get(
+                    f"http://127.0.0.1:{port}/serve/fleet",
+                    params={"watch": "1", "rv": 0, "timeout": "1.5"},
+                    stream=True, timeout=10,
+                ) as r:
+                    assert r.status_code == 200
+                    for line in r.iter_lines():
+                        if line:
+                            frames.append(json.loads(line))
+                results[name] = frames
+
+            threads = [
+                threading.Thread(target=consume, args=("epoll", epoll_srv.port), daemon=True),
+                threading.Thread(target=consume, args=("legacy", legacy_srv.port), daemon=True),
+            ]
+            for t in threads:
+                t.start()
+            time.sleep(0.3)
+            churn(60)  # live mid-stream publishes
+            for t in threads:
+                t.join(timeout=15)
+            assert set(results) == {"epoll", "legacy"}
+            final_rv = view.rv
+            sequences = {}
+            for name, frames in results.items():
+                assert not any(f["type"] in ("GONE", "COMPACTED") for f in frames), name
+                deltas = [f for f in frames if f["type"] in ("UPSERT", "DELETE")]
+                # dense rv space: the full journal, gapless, in order
+                assert [d["rv"] for d in deltas] == list(range(1, final_rv + 1)), name
+                assert frames[-1]["type"] == "SYNC" and frames[-1]["rv"] == final_rv, name
+                sequences[name] = deltas
+            assert sequences["epoll"] == sequences["legacy"]
+        finally:
+            epoll_srv.stop()
+            legacy_srv.stop()
