@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: test check check-scale integration integration-kind integration-mock bench bench-smoke trace-smoke serve-smoke history-smoke dryrun dryrun-128 accept
+.PHONY: test check check-scale integration integration-kind integration-mock bench bench-smoke trace-smoke serve-smoke history-smoke federation-smoke dryrun dryrun-128 accept
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -69,6 +69,19 @@ serve-smoke:
 # Artifact: artifacts/history_smoke.json.
 history-smoke:
 	$(PY) scripts/history_smoke.py
+
+# Federation-plane smoke: two mock-backed WatcherApps (serve + history
+# each) + one federator merging both into a global view. Kills and
+# restarts one upstream mid-churn: the global consumer must stay gapless
+# (zero gaps/dups/resyncs), /healthz must degrade while the upstream is
+# dark and recover after it rejoins, the upstream's subscriber must
+# resume on its held token (zero resyncs — the PR-5 restart-surviving rv
+# line across cluster boundaries), and the merged terminal state must
+# equal the union of the upstream snapshots. The fan-in LATENCY gate
+# (3-upstream pod-event->global-view p50) runs in bench-smoke
+# (bench_federation). Artifact: artifacts/federation_smoke.json.
+federation-smoke:
+	$(PY) scripts/federation_smoke.py
 
 dryrun:
 	$(PY) __graft_entry__.py 8

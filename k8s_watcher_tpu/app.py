@@ -9,6 +9,7 @@ the notifier fully async.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from typing import Optional
 
@@ -232,6 +233,52 @@ class WatcherApp:
                 auth_token=config.watcher.status_auth_token,
                 history=self.history,
             )
+        # multi-cluster federation plane (federate/): N upstream serving
+        # planes subscribed (resume-protocol consumers with durable
+        # tokens) and merged into THIS process's FleetView under
+        # (kind, "<cluster>/<key>") keys — the serve/history planes above
+        # then republish the global fleet for free. The subscribers start
+        # in run() (after the serve plane binds) and stop before the WAL
+        # closes (they are view producers).
+        self.federation = None
+        if config.federation.enabled:
+            from k8s_watcher_tpu.federate import FederationPlane
+
+            # durable resume tokens ONLY when the merged view itself is
+            # durable (history WAL): a persisted token would otherwise
+            # resume delta-only into an EMPTY post-restart view and
+            # silently serve a partial global fleet (every upstream
+            # object that never churns again stays missing). The tokens
+            # ride next to the other persistent state: the checkpoint's
+            # directory, else the WAL's. And they are only VALID when
+            # recovery was a clean continuation of the prior rv line —
+            # an unclean crash (torn WAL tail) can leave the recovered
+            # view BEHIND the synchronously-written token, so the plane
+            # clears the tokens then and re-snapshots instead of
+            # resuming over the lost window.
+            token_dir = None
+            tokens_valid = False
+            if config.history.enabled:
+                recovered = self.history.recovered if self.history is not None else None
+                tokens_valid = (
+                    recovered is not None
+                    and bool(recovered.instance)
+                    and recovered.clean
+                )
+                if config.state.checkpoint_path:
+                    token_dir = os.path.join(
+                        os.path.dirname(os.path.abspath(config.state.checkpoint_path)),
+                        "federation-tokens",
+                    )
+                elif config.history.dir:
+                    token_dir = os.path.join(config.history.dir, "federation-tokens")
+            self.federation = FederationPlane(
+                config.federation,
+                self.serve.view,
+                metrics=self.metrics,
+                token_dir=token_dir,
+                resume_tokens_valid=tokens_valid,
+            )
         c = config.clusterapi
         self.dispatcher = Dispatcher(
             self.notifier.update_pod_status,
@@ -336,6 +383,10 @@ class WatcherApp:
             # before the status server so /healthz's serve verdict always
             # reflects a STARTED plane (never a transiently-absent server)
             self.serve.start()
+        if self.federation is not None:
+            # after the serve plane (the merged view republishes through
+            # it), before the status server (same always-started contract)
+            self.federation.start()
         if self.config.watcher.status_port:
             agent_trend = (
                 self._probe_agent.trend.snapshot
@@ -362,6 +413,9 @@ class WatcherApp:
                 # /healthz covers the serving plane too: a dead serve
                 # thread silently starves every subscriber
                 serve=self.serve.health if self.serve is not None else None,
+                # ... and the federation plane: a stale upstream means a
+                # slice of the global view has gone dark
+                federation=self.federation.health if self.federation is not None else None,
                 slices=self.slice_tracker.debug_snapshot,
                 trend=agent_trend,
                 remediation=remediation_state,
@@ -385,6 +439,8 @@ class WatcherApp:
                 ", /debug/checkpoint" if self.checkpoint is not None else ""
             ) + (
                 ", /debug/history" if self.history is not None else ""
+            ) + (
+                ", /debug/federation" if self.federation is not None else ""
             )
             logger.info("Status endpoint on :%d (%s)", self.status_server.port, routes)
         if self.config.watcher.leader_election.enabled:
@@ -583,6 +639,11 @@ class WatcherApp:
         if self.status_server is not None:
             self.status_server.stop()
             self.status_server = None
+        if self.federation is not None:
+            # before the serve plane and the WAL close: the upstream
+            # subscribers are view producers, and the terminal history
+            # snapshot must anchor AFTER their last delta
+            self.federation.stop()
         if self.serve is not None:
             self.serve.stop()
         if self._probe_agent is not None:

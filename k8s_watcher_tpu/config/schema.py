@@ -35,6 +35,7 @@ and probe cadence/thresholds.
 from __future__ import annotations
 
 import dataclasses
+import re
 from typing import Any, Mapping, Optional, Sequence
 
 
@@ -886,6 +887,132 @@ class HistoryConfig:
         )
 
 
+def metric_safe_name(name: str) -> str:
+    """Cluster/upstream name -> metric-name- and filename-safe form
+    (Prometheus charset). The ONE sanitizer the federation plane uses for
+    per-upstream gauge suffixes and resume-token filenames — the schema
+    validates uniqueness against exactly this mapping, so two upstreams
+    can never alias one gauge or one token file."""
+    return re.sub(r"[^0-9a-zA-Z_]", "_", name)
+
+
+@dataclasses.dataclass(frozen=True)
+class FederationUpstream:
+    """One upstream serving plane the federation tier subscribes to."""
+
+    url: str
+    name: str
+    token: Optional[str] = None  # upstream bearer (watcher.status_auth_token there)
+
+
+@dataclasses.dataclass(frozen=True)
+class FederationConfig:
+    """The ``federation:`` section — net-new multi-cluster fan-in plane
+    (federate/): one resume-protocol subscriber per upstream serving
+    plane (e.g. the watchers of several GKE clusters / v5p pod-slices),
+    merged into the LOCAL FleetView under ``(kind, "<cluster>/<key>")``
+    keys so the existing serve/history planes republish the global fleet
+    (encode-once fan-out, restart-surviving resume tokens, ?at= time
+    travel — all on the merged view). Requires ``serve.enabled``.
+    See ARCHITECTURE.md "Federation plane".
+    """
+
+    enabled: bool = False
+    upstreams: tuple = ()  # tuple[FederationUpstream, ...]
+    # an upstream with no frame (delta or SYNC heartbeat) for this long is
+    # stale: /healthz degrades, and drop_stale decides its objects' fate
+    stale_after_seconds: float = 10.0
+    # reconnect/resync backoff base (jittered, exponential to ~30 s)
+    resync_backoff_seconds: float = 1.0
+    # True: a dark upstream's objects are DELETED from the global view
+    # (consumers see only live state; recovery re-snapshots them back).
+    # False (default): keep last-known state, surface staleness via
+    # /healthz + federation_upstream_stale — zero rv churn on a blip.
+    drop_stale: bool = False
+
+    @classmethod
+    def from_raw(cls, raw: Mapping[str, Any]) -> "FederationConfig":
+        path = "federation"
+        _check_known(
+            raw,
+            ("enabled", "upstreams", "stale_after_seconds",
+             "resync_backoff_seconds", "drop_stale"),
+            path,
+        )
+        enabled = _opt_bool(raw, "enabled", path, False)
+        raw_upstreams = raw.get("upstreams") or ()
+        _expect(raw_upstreams, (list, tuple), f"{path}.upstreams")
+        upstreams = []
+        seen_names = set()
+        for i, entry in enumerate(raw_upstreams):
+            entry_path = f"{path}.upstreams[{i}]"
+            _expect(entry, (dict,), entry_path)
+            _check_known(entry, ("name", "url", "token"), entry_path)
+            url = _opt_str(entry, "url", entry_path, None)
+            if not url:
+                raise SchemaError(f"config key '{entry_path}.url': required (the upstream serving plane's base URL)")
+            name = _opt_str(entry, "name", entry_path, None)
+            if not name:
+                # stable default: the URL's host:port (metric/key-safe
+                # sanitization happens at the metrics layer)
+                from urllib.parse import urlsplit
+
+                parts = urlsplit(url if "//" in url else f"http://{url}")
+                name = parts.netloc or f"upstream{i}"
+            if "/" in name:
+                # "/" separates the cluster prefix in merged keys
+                # ("<cluster>/<key>"): a name containing it would make
+                # split_global_key misattribute the cluster, and two
+                # names like "us" and "us/east" could mint the SAME
+                # global key from different upstreams
+                raise SchemaError(
+                    f"config key '{entry_path}.name': {name!r} must not contain '/' "
+                    f"(it is the cluster/key separator in merged global keys)"
+                )
+            if name in seen_names:
+                raise SchemaError(
+                    f"config key '{entry_path}.name': duplicate upstream name {name!r} "
+                    f"(names key the merged view's cluster prefix — they must be unique)"
+                )
+            seen_names.add(name)
+            # distinct raw names can still collapse to one sanitized form
+            # ("us-east.1" and "us-east_1" -> "us_east_1"), which would
+            # alias their lag/stale gauges AND their resume-token files
+            # (each restart resuming with the OTHER cluster's token)
+            sanitized = metric_safe_name(name)
+            if sanitized in (metric_safe_name(n) for n in seen_names - {name}):
+                raise SchemaError(
+                    f"config key '{entry_path}.name': {name!r} collides with another "
+                    f"upstream after metric/filename sanitization (both become "
+                    f"{sanitized!r}); pick names that differ in [a-zA-Z0-9_]"
+                )
+            upstreams.append(FederationUpstream(
+                url=url, name=name, token=_opt_str(entry, "token", entry_path, None) or None,
+            ))
+        if enabled and not upstreams:
+            raise SchemaError(
+                "config key 'federation.upstreams': at least one upstream is required "
+                "when federation.enabled (a federator with nothing to federate)"
+            )
+        stale_after = _opt_num(raw, "stale_after_seconds", path, 10.0)
+        if stale_after <= 0:
+            raise SchemaError(
+                f"config key '{path}.stale_after_seconds': must be > 0, got {stale_after}"
+            )
+        backoff = _opt_num(raw, "resync_backoff_seconds", path, 1.0)
+        if backoff <= 0:
+            raise SchemaError(
+                f"config key '{path}.resync_backoff_seconds': must be > 0, got {backoff}"
+            )
+        return cls(
+            enabled=enabled,
+            upstreams=tuple(upstreams),
+            stale_after_seconds=stale_after,
+            resync_backoff_seconds=backoff,
+            drop_stale=_opt_bool(raw, "drop_stale", path, False),
+        )
+
+
 @dataclasses.dataclass(frozen=True)
 class StateConfig:
     """The ``state:`` section — net-new checkpoint/resume (SURVEY.md §5).
@@ -921,13 +1048,14 @@ class AppConfig:
     trace: TraceConfig = dataclasses.field(default_factory=TraceConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     history: HistoryConfig = dataclasses.field(default_factory=HistoryConfig)
+    federation: FederationConfig = dataclasses.field(default_factory=FederationConfig)
 
-    TOP_LEVEL_KEYS = ("environment", "watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest", "trace", "serve", "history")
+    TOP_LEVEL_KEYS = ("environment", "watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest", "trace", "serve", "history", "federation")
 
     @classmethod
     def from_raw(cls, raw: Mapping[str, Any], environment: str) -> "AppConfig":
         _check_known(raw, cls.TOP_LEVEL_KEYS, "<root>")
-        for section in ("watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest", "trace", "serve", "history"):
+        for section in ("watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest", "trace", "serve", "history", "federation"):
             _expect(raw.get(section) or {}, (dict,), section)
         # The reference's development.yaml declared `environment: local` while
         # the CLI only accepted development|staging|production, leaving the
@@ -944,6 +1072,13 @@ class AppConfig:
                 "persists the serving plane's FleetView deltas; without the "
                 "serving plane there is nothing to record)"
             )
+        federation = FederationConfig.from_raw(raw.get("federation") or {})
+        if federation.enabled and not serve.enabled:
+            raise SchemaError(
+                "config key 'federation.enabled': requires serve.enabled (the "
+                "merged global view republishes through the serving plane's "
+                "FleetView; without it the fan-in has nowhere to land)"
+            )
         return cls(
             environment=environment,
             watcher=WatcherConfig.from_raw(raw.get("watcher") or {}),
@@ -955,4 +1090,5 @@ class AppConfig:
             trace=TraceConfig.from_raw(raw.get("trace") or {}),
             serve=serve,
             history=history,
+            federation=federation,
         )

@@ -1,0 +1,47 @@
+"""Multi-cluster federation plane: a first-class consumer of the serve
+wire protocol (client), a merged global fleet view (merge), and the
+fan-in plane that runs N upstream subscriptions and republishes through
+the existing serving plane (plane). See ARCHITECTURE.md "Federation
+plane"."""
+
+from k8s_watcher_tpu.federate.client import (
+    AuthRejected,
+    Batch,
+    FleetClient,
+    FleetSubscriber,
+    ResumeLoop,
+    ResyncRequired,
+    SequenceChecker,
+    ServeProtocolError,
+    Snapshot,
+    TokenStore,
+    apply_wire_delta,
+    model_from_objects,
+)
+from k8s_watcher_tpu.federate.merge import (
+    GlobalMerge,
+    global_key,
+    merged_equals_union,
+    split_global_key,
+)
+from k8s_watcher_tpu.federate.plane import FederationPlane
+
+__all__ = [
+    "AuthRejected",
+    "Batch",
+    "FederationPlane",
+    "FleetClient",
+    "FleetSubscriber",
+    "GlobalMerge",
+    "ResumeLoop",
+    "ResyncRequired",
+    "SequenceChecker",
+    "ServeProtocolError",
+    "Snapshot",
+    "TokenStore",
+    "apply_wire_delta",
+    "global_key",
+    "merged_equals_union",
+    "model_from_objects",
+    "split_global_key",
+]

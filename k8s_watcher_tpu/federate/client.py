@@ -1,0 +1,742 @@
+"""First-class Python consumer of the serve wire protocol.
+
+PRs 4-7 built the serving plane's wire contract (``serve/server.py``):
+snapshot at ``GET /serve/fleet``, resumable deltas over ``?watch=1``
+chunked JSON-line frames or ``&once=1`` long-polls, SYNC heartbeats,
+COMPACTED lag-shedding markers, 410/GONE -> re-snapshot recovery, and a
+``view`` instance id that fences resume tokens to one incarnation of the
+rv space. Until this module, every consumer of that contract hand-rolled
+its own loop (serve_smoke, history_smoke, bench's fan-out checkers, the
+README's curl script). This is the ONE implementation they all share —
+and the building block the federation plane stacks N-wide.
+
+Three layers, lowest first:
+
+- ``FleetClient``: one upstream's HTTP surface on a persistent-free
+  stdlib ``http.client`` connection per request (the package's notify
+  idiom; no external deps). ``snapshot()``, ``long_poll()``, and
+  ``watch()`` — a generator of decoded frames off the chunked stream
+  (``http.client`` erases the transfer chunking; frames are JSON lines).
+  410 raises ``ResyncRequired`` (the documented recovery), 401 raises
+  ``AuthRejected``, everything else transient raises ``OSError``-family
+  for the caller's backoff.
+- ``ResumeLoop``: the long-poll consumer shape (what the smokes and the
+  README loop run): poll -> sequence-check -> apply -> carry ``to_rv``;
+  410 re-snapshots and keeps going.
+- ``FleetSubscriber``: the streaming consumer loop the federation plane
+  runs per upstream: snapshot -> ``?watch=1`` windows -> reconnect with
+  jittered exponential backoff, SYNC-heartbeat staleness detection (a
+  stream that stops heartbeating is treated as dead and reconnected),
+  in-band GONE / pre-stream 410 -> re-snapshot resync, and resume-token
+  persistence (``TokenStore``) so the CONSUMER process also survives its
+  own restarts — against a history-enabled upstream the persisted token
+  rides PR-5's restart-surviving rv line and resumes gapless through an
+  upstream restart too.
+
+``SequenceChecker`` is the shared gap/dup accountant: the view's rv
+space is dense (every applied delta is exactly one rv), so a raw
+(uncompacted) batch must carry exactly ``to_rv - from_rv`` deltas and
+rvs must strictly ascend; COMPACTED sanctions the jump but never a
+repeat. One implementation, used by the bench fan-out checkers, both
+smokes, the federation subscribers, and the tests.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import random
+import socket
+import ssl
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, Iterator, List, NamedTuple, Optional, Tuple
+from urllib.parse import urlencode, urlsplit
+
+#: wire frame / delta types (mirrors serve.view — kept literal here so the
+#: client stays importable without dragging the serve plane in)
+UPSERT = "UPSERT"
+DELETE = "DELETE"
+SYNC = "SYNC"
+COMPACTED = "COMPACTED"
+GONE = "GONE"
+
+
+class ServeProtocolError(RuntimeError):
+    """A non-transient serve-protocol answer (carries status + body)."""
+
+    def __init__(self, message: str, *, status: int = 0, body: Optional[dict] = None):
+        super().__init__(message)
+        self.status = status
+        self.body = body or {}
+
+
+class ResyncRequired(ServeProtocolError):
+    """410 (token compacted / ahead-of-view / stale view instance) or an
+    in-band GONE frame: the documented recovery is re-snapshot."""
+
+
+class AuthRejected(ServeProtocolError):
+    """401: bearer token missing or wrong — retrying cannot help."""
+
+
+class Snapshot(NamedTuple):
+    rv: int
+    view: str
+    objects: List[Dict[str, Any]]
+
+
+class Batch(NamedTuple):
+    """One long-poll answer (``?watch=1&once=1``)."""
+
+    from_rv: int
+    to_rv: int
+    view: str
+    compacted: bool
+    items: List[Dict[str, Any]]
+
+
+class SequenceChecker:
+    """Gap/dup accounting over one subscriber's resume stream.
+
+    The rv space is dense, so the checks are exact, not heuristic:
+
+    - a raw batch covering ``(from_rv, to_rv]`` with fewer than
+      ``to_rv - from_rv`` items LOST a delta (gap);
+    - any rv <= its predecessor is a repeat (dup) — compaction may skip
+      rvs, never repeat them.
+    """
+
+    __slots__ = ("gaps", "dups", "delivered", "batches", "compacted_batches")
+
+    def __init__(self):
+        self.gaps = 0
+        self.dups = 0
+        self.delivered = 0
+        self.batches = 0
+        self.compacted_batches = 0
+
+    @property
+    def clean(self) -> bool:
+        return self.gaps == 0 and self.dups == 0
+
+    def observe(self, from_rv: int, to_rv: int, compacted: bool, rvs: Iterable[int]) -> bool:
+        """Full per-delta scan of one batch. Returns True when clean."""
+        bad = False
+        n = 0
+        prev = from_rv
+        for rv in rvs:
+            n += 1
+            if rv <= prev:
+                self.dups += 1
+                bad = True
+            prev = rv
+        if not compacted and n != to_rv - from_rv:
+            self.gaps += 1
+            bad = True
+        self.delivered += n
+        self.batches += 1
+        if compacted:
+            self.compacted_batches += 1
+        return not bad
+
+    def observe_bounds(
+        self,
+        from_rv: int,
+        to_rv: int,
+        compacted: bool,
+        count: int,
+        first_rv: int,
+        last_rv: int,
+    ) -> bool:
+        """O(1) endpoints-only variant for hot paths that cannot afford a
+        per-delta walk (the bench's 10k unchecked subscribers): the first
+        rv must be past the resume token, the last must land on ``to_rv``
+        (the cursor's next token), and a raw batch must be exactly the
+        dense range."""
+        bad = False
+        if count:
+            if first_rv <= from_rv or last_rv != to_rv:
+                self.dups += 1
+                bad = True
+            if not compacted and count != to_rv - from_rv:
+                self.gaps += 1
+                bad = True
+        self.delivered += count
+        self.batches += 1
+        if compacted:
+            self.compacted_batches += 1
+        return not bad
+
+    def observe_stream_rv(self, prev_rv: int, rv: int, sanctioned: bool) -> bool:
+        """One streamed delta frame: ``sanctioned`` means a COMPACTED
+        marker covers this range, so a skip is legal (a repeat never is)."""
+        self.delivered += 1
+        if rv <= prev_rv:
+            self.dups += 1
+            return False
+        if rv != prev_rv + 1 and not sanctioned:
+            self.gaps += 1
+            return False
+        return True
+
+    def to_dict(self) -> Dict[str, int]:
+        return {
+            "gaps": self.gaps,
+            "dups": self.dups,
+            "delivered": self.delivered,
+            "batches": self.batches,
+            "compacted_batches": self.compacted_batches,
+        }
+
+
+def apply_wire_delta(model: Dict[Tuple[str, str], Dict[str, Any]], item: Dict[str, Any]) -> None:
+    """Fold one wire delta (UPSERT/DELETE dict) into a ``(kind, key)``-
+    keyed model map — the replay every sequence-checked consumer runs."""
+    key = (item["kind"], item["key"])
+    if item["type"] == DELETE:
+        model.pop(key, None)
+    else:
+        model[key] = item["object"]
+
+
+def apply_wire_deltas(model: Dict[Tuple[str, str], Dict[str, Any]], items: Iterable[Dict[str, Any]]) -> None:
+    for item in items:
+        apply_wire_delta(model, item)
+
+
+def model_from_objects(objects: Iterable[Dict[str, Any]]) -> Dict[Tuple[str, str], Dict[str, Any]]:
+    """A snapshot's objects as the same ``(kind, key)``-keyed map shape
+    ``apply_wire_delta`` maintains — so ``model == model_from_objects(
+    snapshot)`` is the end-to-end replay check."""
+    return {(o["kind"], o["key"]): o for o in objects}
+
+
+class FleetClient:
+    """HTTP client for ONE serving plane (``/serve/fleet``).
+
+    Stdlib ``http.client`` only (the package's hand-rolled-HTTP idiom —
+    notify/client.py): one connection per request for snapshot/long-poll
+    (they are rare and bounded), one connection per ``watch()`` window
+    (held open for the whole chunked stream). ``retarget()`` repoints an
+    existing client (an upstream that restarted onto a new address)."""
+
+    def __init__(
+        self,
+        base_url: str,
+        *,
+        token: Optional[str] = None,
+        timeout: float = 10.0,
+        verify_tls: bool = True,
+    ):
+        self.token = token
+        self.timeout = timeout
+        self.verify_tls = verify_tls
+        self.base_url = ""
+        self._scheme = "http"
+        self._host = ""
+        self._port = 80
+        self.retarget(base_url)
+
+    def retarget(self, base_url: str) -> None:
+        parts = urlsplit(base_url if "//" in base_url else f"http://{base_url}")
+        if parts.scheme not in ("http", "https"):
+            raise ValueError(f"unsupported scheme {parts.scheme!r} in {base_url!r}")
+        self.base_url = base_url.rstrip("/")
+        self._scheme = parts.scheme
+        self._host = parts.hostname or "127.0.0.1"
+        self._port = parts.port or (443 if parts.scheme == "https" else 80)
+        # a path component is a reverse-proxy prefix: every request rides
+        # under it ("http://gw/cluster-a" -> GET /cluster-a/serve/fleet);
+        # silently dropping it would 404 the upstream with no hint why
+        self._prefix = parts.path.rstrip("/")
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _connect(self, timeout: float) -> http.client.HTTPConnection:
+        if self._scheme == "https":
+            ctx = ssl.create_default_context()
+            if not self.verify_tls:
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            return http.client.HTTPSConnection(self._host, self._port, timeout=timeout, context=ctx)
+        return http.client.HTTPConnection(self._host, self._port, timeout=timeout)
+
+    def _headers(self) -> Dict[str, str]:
+        headers = {"Accept": "application/json", "Connection": "close"}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        return headers
+
+    @staticmethod
+    def _body_json(resp: http.client.HTTPResponse) -> dict:
+        try:
+            return json.loads(resp.read() or b"{}")
+        except (ValueError, OSError):
+            return {}
+
+    def _raise_for_status(self, resp: http.client.HTTPResponse) -> None:
+        if resp.status == 200:
+            return
+        body = self._body_json(resp)
+        message = body.get("error") or f"HTTP {resp.status}"
+        if resp.status == 410:
+            raise ResyncRequired(message, status=410, body=body)
+        if resp.status == 401:
+            raise AuthRejected(message, status=401, body=body)
+        # 503 (admission full) and everything else transient: OSError so
+        # callers' one except-arm handles "back off and retry"
+        raise ConnectionError(f"{self.base_url}: {message} (HTTP {resp.status})")
+
+    def _get_json(self, path: str, timeout: float) -> dict:
+        conn = self._connect(timeout)
+        try:
+            conn.request("GET", self._prefix + path, headers=self._headers())
+            resp = conn.getresponse()
+            self._raise_for_status(resp)
+            return json.loads(resp.read())
+        finally:
+            conn.close()
+
+    # -- protocol ----------------------------------------------------------
+
+    def snapshot(self) -> Snapshot:
+        body = self._get_json("/serve/fleet", self.timeout)
+        return Snapshot(body["rv"], body.get("view", ""), body.get("objects", []))
+
+    def snapshot_at(self, rv: int) -> Snapshot:
+        """Time-travel read (``?at=rv``; needs the upstream's history plane)."""
+        body = self._get_json(f"/serve/fleet?at={int(rv)}", self.timeout)
+        return Snapshot(body["rv"], body.get("view", ""), body.get("objects", []))
+
+    def healthz(self) -> dict:
+        """``/serve/healthz`` (open route; also tolerates non-200 — the
+        body is the point)."""
+        conn = self._connect(self.timeout)
+        try:
+            conn.request("GET", self._prefix + "/serve/healthz", headers={"Accept": "application/json"})
+            return self._body_json(conn.getresponse())
+        finally:
+            conn.close()
+
+    def long_poll(
+        self,
+        rv: int,
+        *,
+        view: Optional[str] = None,
+        timeout: float = 1.0,
+        limit: Optional[int] = None,
+    ) -> Batch:
+        """One ``?watch=1&once=1`` long-poll. Raises ``ResyncRequired``
+        on 410 (token expired / view instance changed / rv ahead)."""
+        params = {"watch": "1", "once": "1", "rv": rv, "timeout": timeout}
+        if view:
+            params["view"] = view
+        if limit:
+            params["limit"] = limit
+        body = self._get_json(
+            f"/serve/fleet?{urlencode(params)}",
+            # the HTTP read must outlive the server-side poll window
+            timeout + self.timeout,
+        )
+        return Batch(
+            body["from_rv"], body["to_rv"], body.get("view", ""),
+            bool(body.get("compacted")), body.get("items", []),
+        )
+
+    def watch(
+        self,
+        rv: int,
+        *,
+        view: Optional[str] = None,
+        window_seconds: float = 30.0,
+        read_timeout: Optional[float] = None,
+        limit: Optional[int] = None,
+        on_conn: Optional[Callable[[http.client.HTTPConnection], None]] = None,
+    ) -> Iterator[Dict[str, Any]]:
+        """One ``?watch=1`` stream window: yields decoded frames (SYNC /
+        UPSERT / DELETE / COMPACTED / GONE dicts) until the server closes
+        the window. ``read_timeout`` bounds the wait for EACH frame — the
+        SYNC heartbeat cadence is 2 s, so a read that outwaits
+        ``read_timeout`` means the upstream stalled (socket.timeout
+        propagates; the caller reconnects). Pre-stream 410 raises
+        ``ResyncRequired`` before any frame is yielded. ``on_conn``
+        receives the live connection before the request is sent — a
+        stopper can close it to abort a blocked read immediately."""
+        params = {"watch": "1", "rv": rv, "timeout": window_seconds}
+        if view:
+            params["view"] = view
+        if limit:
+            params["limit"] = limit
+        conn = self._connect(read_timeout if read_timeout is not None else self.timeout)
+        if on_conn is not None:
+            on_conn(conn)
+        try:
+            conn.request("GET", f"{self._prefix}/serve/fleet?{urlencode(params)}", headers=self._headers())
+            resp = conn.getresponse()
+            self._raise_for_status(resp)
+            # http.client strips the chunked-transfer framing; what is
+            # left is exactly the JSON-line frame stream
+            while True:
+                line = resp.readline()
+                if not line:
+                    return  # clean window end (terminal chunk)
+                line = line.strip()
+                if line:
+                    yield json.loads(line)
+        finally:
+            conn.close()
+
+
+class TokenStore:
+    """Durable resume token: ``{rv, view}``, written atomically (tmp +
+    rename) so a crash never leaves a torn token. This is the consumer-
+    side half of PR-5's restart story: the upstream's WAL keeps the rv
+    line alive across ITS restarts; this file keeps the cursor alive
+    across OURS."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+
+    def load(self) -> Optional[Tuple[int, str]]:
+        try:
+            with open(self.path) as f:
+                body = json.load(f)
+            return int(body["rv"]), str(body["view"])
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+
+    def save(self, rv: int, view: str) -> None:
+        tmp = f"{self.path}.tmp"
+        directory = os.path.dirname(self.path)
+        if directory:
+            os.makedirs(directory, exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump({"rv": int(rv), "view": view}, f)
+        os.replace(tmp, self.path)
+
+    def clear(self) -> None:
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class ResumeLoop:
+    """The long-poll resume-protocol consumer (the README loop, now as
+    code): snapshot -> poll -> sequence-check -> apply -> carry ``to_rv``;
+    a 410 runs the documented recovery (re-snapshot) and keeps going.
+    Both smokes drive their consumers through this."""
+
+    def __init__(self, client: FleetClient, *, checker: Optional[SequenceChecker] = None):
+        self.client = client
+        self.checker = checker or SequenceChecker()
+        self.model: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self.rv = 0
+        self.view = ""
+        self.polls = 0
+        self.resyncs = 0
+
+    def start(self) -> Snapshot:
+        snap = self.client.snapshot()
+        self.rv, self.view = snap.rv, snap.view
+        self.model = model_from_objects(snap.objects)
+        return snap
+
+    def poll(self, *, timeout: float = 1.0, limit: Optional[int] = None) -> bool:
+        """One long-poll; False when a 410 forced a re-snapshot."""
+        self.polls += 1
+        try:
+            batch = self.client.long_poll(self.rv, view=self.view, timeout=timeout, limit=limit)
+        except ResyncRequired:
+            self.start()
+            self.resyncs += 1
+            return False
+        self.checker.observe(
+            batch.from_rv, batch.to_rv, batch.compacted, (i["rv"] for i in batch.items)
+        )
+        apply_wire_deltas(self.model, batch.items)
+        self.rv = batch.to_rv
+        return True
+
+    def drain(self, *, polls: int = 30, timeout: float = 0.3) -> None:
+        """Poll with short windows until a poll delivers nothing (or the
+        budget runs out) — the catch-up tail after churn stops."""
+        for _ in range(polls):
+            before = self.rv
+            self.poll(timeout=timeout)
+            if self.rv == before:
+                break
+
+
+class FleetSubscriber:
+    """The streaming consumer loop one federation upstream runs.
+
+    ``run()`` blocks until ``stop()``: it snapshots (or resumes from the
+    persisted token), streams ``?watch=1`` windows, and survives every
+    documented failure mode —
+
+    - transient errors / refused connections / heartbeat stalls (no
+      frame within ``stale_after_seconds``): reconnect with jittered
+      exponential backoff, resume from the carried token;
+    - pre-stream 410 or in-band GONE: re-snapshot (``on_snapshot`` gets
+      the full state; the resync counter ticks);
+    - a clean window end: reconnect immediately (the resume protocol).
+
+    Callbacks run on the subscriber's thread: ``on_snapshot(Snapshot)``
+    replaces downstream state wholesale, ``on_delta(frame)`` folds one
+    UPSERT/DELETE. The ``SequenceChecker`` rides every delivery."""
+
+    def __init__(
+        self,
+        client: FleetClient,
+        *,
+        on_snapshot: Optional[Callable[[Snapshot], None]] = None,
+        on_delta: Optional[Callable[[Dict[str, Any]], None]] = None,
+        token_store: Optional[TokenStore] = None,
+        stale_after_seconds: float = 10.0,
+        backoff_seconds: float = 1.0,
+        max_backoff_seconds: float = 30.0,
+        window_seconds: float = 30.0,
+        checker: Optional[SequenceChecker] = None,
+        rng: Optional[random.Random] = None,
+        name: str = "",
+    ):
+        self.client = client
+        self.on_snapshot = on_snapshot
+        self.on_delta = on_delta
+        self.token_store = token_store
+        # the stream heartbeats every 2 s when idle; anything sub-3s
+        # would call a healthy idle stream dead
+        self.stale_after_seconds = max(3.0, stale_after_seconds)
+        self.backoff_seconds = max(0.05, backoff_seconds)
+        self.max_backoff_seconds = max(self.backoff_seconds, max_backoff_seconds)
+        self.window_seconds = window_seconds
+        self.checker = checker or SequenceChecker()
+        self.rng = rng or random.Random()
+        self.name = name
+        self.rv: Optional[int] = None
+        self.view: Optional[str] = None
+        # wire_rv: the newest rv SEEN on the wire (SYNC included) even if
+        # not yet folded downstream — feeds the per-upstream lag-rv gauge
+        self.wire_rv = 0
+        self.reconnects = 0
+        self.resyncs = 0
+        self.snapshots = 0
+        self.stalls = 0
+        self.frames = 0
+        self.connected = False
+        self.last_error: Optional[str] = None
+        self._last_frame_t = 0.0  # 0 = never
+        self._saved_token: Optional[Tuple[int, str]] = None  # last persisted (rv, view)
+        self._stop = threading.Event()
+        self._invalidate = threading.Event()
+        # the live watch connection, so stop() can abort a read blocked
+        # up to stale_after_seconds instead of outwaiting it — the
+        # plane's join must reliably finish BEFORE the history WAL
+        # writes its terminal snapshot
+        self._conn_lock = threading.Lock()
+        self._conn: Optional[http.client.HTTPConnection] = None
+
+    # -- external surface --------------------------------------------------
+
+    def stop(self) -> None:
+        self._stop.set()
+        # abort an in-flight blocked read NOW: without this the run loop
+        # can sit in readline() up to stale_after_seconds, outliving the
+        # caller's join and racing whatever shutdown step follows it
+        with self._conn_lock:
+            conn = self._conn
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    @property
+    def stopped(self) -> bool:
+        return self._stop.is_set()
+
+    def invalidate(self) -> None:
+        """Force a re-snapshot on the next (re)connect — the drop-stale
+        policy uses this after it deleted a dark upstream's objects, so a
+        later token-resume can't skip re-materializing them."""
+        self._invalidate.set()
+
+    def last_frame_age(self) -> Optional[float]:
+        """Seconds since the last frame (None before the first)."""
+        t = self._last_frame_t
+        return None if t == 0.0 else time.monotonic() - t
+
+    # -- the loop ----------------------------------------------------------
+
+    def _save_token(self, rv: int, view: str) -> None:
+        """Persist (rv, view) iff it changed — an idle upstream's SYNC
+        heartbeats must not rewrite the token file every 2 s forever."""
+        if self.token_store is None or self._saved_token == (rv, view):
+            return
+        self.token_store.save(rv, view)
+        self._saved_token = (rv, view)
+
+    def run(self) -> None:
+        if self.rv is None and self.token_store is not None:
+            token = self.token_store.load()
+            if token is not None:
+                self.rv, self.view = token
+                self._saved_token = token
+        backoff = self.backoff_seconds
+        while not self._stop.is_set():
+            try:
+                if self._invalidate.is_set():
+                    self._invalidate.clear()
+                    self.rv = None
+                if self.rv is None or self.view is None:
+                    self._resnapshot()
+                self._watch_window()
+                self.connected = False
+                backoff = self.backoff_seconds  # a completed window resets it
+            except ResyncRequired as exc:
+                self.connected = False
+                self.resyncs += 1
+                self.last_error = str(exc)
+                self.rv = None  # next iteration re-snapshots
+                # the documented resync backoff (jittered, escalating): a
+                # GONE storm — this consumer slower than the upstream's
+                # churn — must not hot-loop O(fleet) snapshot reads
+                # against an already-overloaded upstream, and N federators
+                # losing the same horizon must not herd their re-snapshots
+                if self._sleep(backoff):
+                    return
+                backoff = min(backoff * 2, self.max_backoff_seconds)
+            except AuthRejected as exc:
+                # wrong credentials never fix themselves by retrying fast:
+                # surface via health (connected=False + last_error) and
+                # retry at the MAX backoff in case the token gets rotated
+                self.connected = False
+                self.last_error = f"auth rejected: {exc}"
+                if self._sleep(self.max_backoff_seconds):
+                    return
+            except (socket.timeout, TimeoutError) as exc:
+                self.connected = False
+                self.stalls += 1
+                self.reconnects += 1
+                self.last_error = f"heartbeat stall: {exc!r}"
+                # a stall is not a refused connection: retry promptly
+                if self._sleep(self.backoff_seconds):
+                    return
+            except (OSError, http.client.HTTPException, ValueError) as exc:
+                self.connected = False
+                if self._stop.is_set():
+                    return  # stop()'s connection abort, not a real fault
+                self.reconnects += 1
+                self.last_error = f"{type(exc).__name__}: {exc}"
+                if self._sleep(backoff):
+                    return
+                backoff = min(backoff * 2, self.max_backoff_seconds)
+            except Exception:
+                # stop() closes the live connection from another thread;
+                # http.client then fails at whatever it was doing (e.g.
+                # AttributeError reading a None fp) — exit quietly when
+                # stopping, re-raise genuine bugs
+                self.connected = False
+                if self._stop.is_set():
+                    return
+                raise
+
+    def _sleep(self, seconds: float) -> bool:
+        """Jittered wait (0.5x..1.5x) — N federation subscribers losing
+        the same upstream must not reconnect in lockstep. True = stopped."""
+        return self._stop.wait(seconds * (0.5 + self.rng.random()))
+
+    def _resnapshot(self) -> None:
+        snap = self.client.snapshot()
+        self.rv, self.view = snap.rv, snap.view
+        self.wire_rv = max(self.wire_rv, snap.rv)
+        self.snapshots += 1
+        self._last_frame_t = time.monotonic()
+        self._save_token(snap.rv, snap.view)
+        if self.on_snapshot is not None:
+            self.on_snapshot(snap)
+
+    def _register_conn(self, conn) -> None:
+        with self._conn_lock:
+            self._conn = conn
+        if self._stop.is_set():
+            # stop() may have read a stale None just before we registered:
+            # close here so the abort can never be missed
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _watch_window(self) -> None:
+        assert self.rv is not None
+        compacted_until = -1  # COMPACTED sanctions skips up to this rv
+        deltas_since_save = 0
+        for frame in self.client.watch(
+            self.rv,
+            view=self.view,
+            window_seconds=self.window_seconds,
+            read_timeout=self.stale_after_seconds,
+            on_conn=self._register_conn,
+        ):
+            if self._stop.is_set():
+                # BEFORE applying: a frame racing stop() must not reach
+                # the downstream view after the caller's join returned
+                # (e.g. after the history WAL's terminal snapshot)
+                return
+            self._last_frame_t = time.monotonic()
+            self.connected = True
+            self.frames += 1
+            ftype = frame.get("type")
+            if ftype in (UPSERT, DELETE):
+                rv = frame["rv"]
+                self.checker.observe_stream_rv(self.rv, rv, rv <= compacted_until)
+                self.wire_rv = max(self.wire_rv, rv)
+                if self.on_delta is not None:
+                    self.on_delta(frame)
+                self.rv = max(self.rv, rv)
+                deltas_since_save += 1
+                if deltas_since_save >= 256:
+                    # periodic persistence bounds replay-after-crash; the
+                    # per-SYNC save below covers the idle/stream-end cases
+                    self._save_token(self.rv, self.view or "")
+                    deltas_since_save = 0
+            elif ftype == SYNC:
+                rv = frame.get("rv", self.rv)
+                self.wire_rv = max(self.wire_rv, rv)
+                if rv > self.rv:
+                    self.rv = rv  # idle SYNC advances the resume token
+                self._save_token(self.rv, frame.get("view") or self.view or "")
+                deltas_since_save = 0
+            elif ftype == COMPACTED:
+                compacted_until = max(compacted_until, frame.get("to_rv", -1))
+                self.checker.compacted_batches += 1
+            elif ftype == GONE:
+                raise ResyncRequired(
+                    "in-band GONE (fell behind the horizon mid-stream)",
+                    status=410, body=frame,
+                )
+        if deltas_since_save:
+            self._save_token(self.rv, self.view or "")
+
+    def status(self) -> Dict[str, Any]:
+        age = self.last_frame_age()
+        return {
+            "name": self.name,
+            "connected": self.connected,
+            "rv": self.rv,
+            "wire_rv": self.wire_rv,
+            "view": self.view,
+            "last_frame_age_seconds": round(age, 3) if age is not None else None,
+            "frames": self.frames,
+            "snapshots": self.snapshots,
+            "reconnects": self.reconnects,
+            "resyncs": self.resyncs,
+            "stalls": self.stalls,
+            "gaps": self.checker.gaps,
+            "dups": self.checker.dups,
+            "delivered": self.checker.delivered,
+            "last_error": self.last_error,
+        }

@@ -1,0 +1,200 @@
+"""Merged global fleet view: N upstream clusters folded into ONE FleetView.
+
+The federation plane does not grow a second serving stack — it REUSES
+the one that already exists. Each upstream's objects land in the local
+``FleetView`` under a namespaced key, ``(kind, "<cluster>/<key>")``, so
+everything built on the view comes along for free: the encode-once
+broadcast fan-out serves the global view to 10k subscribers, the history
+WAL persists it (global resume tokens survive federator restarts), and
+``?at=`` time travel reconstructs the GLOBAL fleet as of any retained rv.
+
+Semantics:
+
+- **Keying**: ``(cluster, kind, key) -> (kind, "cluster/key")``. Merged
+  objects carry ``cluster`` and ``origin_key`` fields; ``key`` is the
+  global key (consistent with the view's objects-carry-their-key
+  convention). Cluster names cannot collide with local objects because
+  local producers never put ``/`` in a pod uid / slice name.
+- **Global rv line**: the local view's own dense monotonic rv. It
+  guarantees total order of APPLICATION (and per-(cluster,key) order,
+  because one upstream subscriber applies its deltas in upstream rv
+  order) — it does NOT encode cross-cluster happens-before; two
+  clusters' concurrent transitions interleave in arrival order.
+- **Epochs**: each upstream's ``view`` instance id is its epoch. A
+  changed epoch (upstream restarted into a fresh rv space) or any 410
+  resync funnels through ``reset_cluster`` — a full-snapshot reconcile:
+  upsert everything current, delete what vanished. The FleetView dedups
+  identical upserts (no rv burn), so a clean reconcile after a blip
+  costs exactly the deltas that actually happened.
+- **Stale-vs-drop** (``federation.drop_stale``): when an upstream goes
+  dark past ``stale_after_seconds``, ``drop_stale: true`` deletes its
+  objects from the global view (consumers see only live state; the
+  subscriber is invalidated so recovery re-snapshots them back in).
+  The default (``false``) KEEPS last-known state — cheap (zero rv
+  churn on a blip) and usually right for fleet dashboards — with the
+  staleness surfaced per upstream in /healthz and the
+  ``federation_upstream_stale`` gauge, not rewritten into every object.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Set, Tuple
+
+from k8s_watcher_tpu.federate.client import DELETE
+
+#: separator between the cluster name and the upstream key in a global key
+CLUSTER_SEP = "/"
+
+
+def global_key(cluster: str, key: str) -> str:
+    return f"{cluster}{CLUSTER_SEP}{key}"
+
+
+def split_global_key(gkey: str) -> Tuple[str, str]:
+    """``(cluster, upstream_key)`` — inverse of ``global_key``."""
+    cluster, _, key = gkey.partition(CLUSTER_SEP)
+    return cluster, key
+
+
+def merged_equals_union(merged_objects, upstream_objects: Dict[str, Any]) -> bool:
+    """The federation gates' convergence check, in ONE place (bench and
+    the smoke both gate on it): the merged view's federated objects must
+    equal the union of the upstream snapshots under cluster-prefixed
+    keys, with the decoration the merge adds (the rewritten ``key``;
+    ``cluster``/``origin_key`` are additive) excluded from the compare.
+
+    ``merged_objects``: the federator snapshot's object list (non-
+    federated local objects are ignored). ``upstream_objects``: mapping
+    of cluster name -> that upstream snapshot's object list."""
+    expected = {}
+    for cluster, objects in upstream_objects.items():
+        for obj in objects:
+            expected[(obj["kind"], global_key(cluster, obj["key"]))] = obj
+    merged = {
+        (obj["kind"], obj["key"]): obj for obj in merged_objects if obj.get("cluster")
+    }
+    if merged.keys() != expected.keys():
+        return False
+    return all(
+        all(merged[k].get(field) == v for field, v in exp.items() if field != "key")
+        for k, exp in expected.items()
+    )
+
+
+class GlobalMerge:
+    """Write-side fold of upstream events into the shared FleetView.
+
+    One upstream subscriber thread per cluster calls in; the per-cluster
+    key registry is lock-guarded so ``object_count``/health reads and the
+    monitor thread's ``drop_cluster`` stay consistent with it. The
+    FleetView does its own locking — per-key last-writer-wins is exactly
+    the state-serving contract."""
+
+    def __init__(self, view, *, drop_stale: bool = False, metrics=None):
+        self.view = view
+        self.drop_stale = drop_stale
+        self._lock = threading.Lock()
+        self._keys: Dict[str, Set[Tuple[str, str]]] = {}  # cluster -> {(kind, upstream key)}
+        self._merged_gauge = (
+            metrics.gauge("federation_merged_objects") if metrics is not None else None
+        )
+
+    def _set_gauge_locked(self) -> None:
+        if self._merged_gauge is not None:
+            self._merged_gauge.set(sum(len(k) for k in self._keys.values()))
+
+    def seed_from_view(self) -> int:
+        """Adopt federated objects ALREADY in the view (a history-recovered
+        federator restart): the per-cluster key registry must mirror the
+        recovered view, or the first reconcile cannot delete objects that
+        vanished upstream during the outage (ghost objects served forever),
+        ``drop_cluster`` pops an empty set, and the merged-object gauge
+        reads 0 against a populated view. Returns the seeded count."""
+        _, objects = self.view.snapshot()
+        seeded = 0
+        with self._lock:
+            for obj in objects:
+                cluster = obj.get("cluster")
+                origin = obj.get("origin_key")
+                if not cluster or not origin:
+                    continue  # the local watcher's own (non-federated) objects
+                self._keys.setdefault(cluster, set()).add((obj.get("kind") or "pod", origin))
+                seeded += 1
+            self._set_gauge_locked()
+        return seeded
+
+    @staticmethod
+    def _decorate(cluster: str, kind: str, key: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        return {**obj, "kind": kind, "key": global_key(cluster, key),
+                "cluster": cluster, "origin_key": key}
+
+    def reset_cluster(self, cluster: str, objects) -> int:
+        """Adopt a full upstream snapshot (initial connect, epoch change,
+        every 410 resync): upsert all current objects, delete the global
+        keys that vanished. Returns the number of view deltas actually
+        minted (identical upserts are free)."""
+        changed = 0
+        fresh: Set[Tuple[str, str]] = set()
+        for obj in objects:
+            kind = obj.get("kind") or "pod"
+            key = obj.get("key")
+            if not key:
+                continue
+            fresh.add((kind, key))
+            if self.view.apply(kind, global_key(cluster, key),
+                               self._decorate(cluster, kind, key, obj)):
+                changed += 1
+        with self._lock:
+            stale = self._keys.get(cluster, set()) - fresh
+            self._keys[cluster] = fresh
+            self._set_gauge_locked()
+        for kind, key in stale:
+            if self.view.apply(kind, global_key(cluster, key), None):
+                changed += 1
+        return changed
+
+    def apply_delta(self, cluster: str, item: Dict[str, Any]) -> bool:
+        """Fold one wire delta (UPSERT/DELETE frame dict) from ``cluster``.
+        Returns True when the global view actually changed."""
+        kind = item.get("kind") or "pod"
+        key = item["key"]
+        gkey = global_key(cluster, key)
+        if item["type"] == DELETE:
+            changed = self.view.apply(kind, gkey, None)
+            with self._lock:
+                self._keys.setdefault(cluster, set()).discard((kind, key))
+                self._set_gauge_locked()
+            return changed
+        changed = self.view.apply(
+            kind, gkey, self._decorate(cluster, kind, key, item.get("object") or {})
+        )
+        with self._lock:
+            self._keys.setdefault(cluster, set()).add((kind, key))
+            self._set_gauge_locked()
+        return changed
+
+    def drop_cluster(self, cluster: str) -> int:
+        """The ``drop_stale: true`` policy arm: remove a dark upstream's
+        objects from the global view. Returns deltas minted."""
+        with self._lock:
+            keys = self._keys.pop(cluster, set())
+            self._set_gauge_locked()
+        dropped = 0
+        for kind, key in keys:
+            if self.view.apply(kind, global_key(cluster, key), None):
+                dropped += 1
+        return dropped
+
+    def cluster_object_count(self, cluster: str) -> int:
+        with self._lock:
+            return len(self._keys.get(cluster, ()))
+
+    def object_count(self) -> int:
+        with self._lock:
+            return sum(len(k) for k in self._keys.values())
+
+    def snapshot_cluster(self, cluster: str) -> Optional[Set[Tuple[str, str]]]:
+        with self._lock:
+            keys = self._keys.get(cluster)
+            return set(keys) if keys is not None else None

@@ -1,0 +1,366 @@
+"""FederationPlane: N upstream watchers fanned into one global view.
+
+Guard (arxiv 2605.17879) argues fleet-level health management needs one
+aggregated control plane over per-cluster collectors; Podracer (arxiv
+2104.06272) shows the scale shape — many single-responsibility workers
+behind one fan-in tier. This module is that tier for k8s-watcher-tpu:
+one ``FleetSubscriber`` thread per upstream serving plane (each a full
+resume-protocol consumer: snapshot, streamed deltas, heartbeat staleness,
+410 resync, jittered backoff, durable resume tokens), all folding through
+``GlobalMerge`` into the LOCAL FleetView — so the existing serving plane
+republishes the merged fleet with encode-once fan-out, the history WAL
+makes global resume tokens restart-surviving, and ``?at=`` time travel
+works on the global view, all for free.
+
+A monitor thread (one tick per ~second) owns the cross-cutting
+bookkeeping no single subscriber can: per-upstream staleness verdicts
+(and the drop-stale policy arm), the lag gauges, and syncing subscriber
+counts into the metrics registry. ``health()`` folds per-upstream
+liveness into the status plane's /healthz — a federator serving a
+half-dark global view must say so.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from k8s_watcher_tpu.config.schema import metric_safe_name as _metric_suffix
+from k8s_watcher_tpu.federate.client import (
+    FleetClient,
+    FleetSubscriber,
+    ResyncRequired,
+    Snapshot,
+    TokenStore,
+)
+from k8s_watcher_tpu.federate.merge import GlobalMerge
+
+logger = logging.getLogger(__name__)
+
+
+class _Upstream:
+    """One upstream's subscriber + bookkeeping the monitor reads."""
+
+    def __init__(self, plane: "FederationPlane", cfg, index: int):
+        self.cfg = cfg
+        self.name = cfg.name
+        self.epoch: Optional[str] = None
+        self.epoch_changes = 0
+        self.stale = False
+        self.dropped = False  # drop_stale already removed our objects
+        # serializes the monitor's drop against the subscriber thread's
+        # snapshot-reconcile/delta-apply: without it a drop could land
+        # just after a reconcile repopulated the cluster (or a delta
+        # could slip in between flag and drop), leaving untouched
+        # objects missing for up to a watch window
+        self.drop_lock = threading.Lock()
+        self._synced: Dict[str, int] = {}  # counter diff-sync state
+        # request timeout floored well above the staleness knob: a tight
+        # stale_after must not shrink the snapshot-read budget with it
+        self.client = FleetClient(
+            cfg.url, token=cfg.token,
+            timeout=max(5.0, plane.config.stale_after_seconds),
+        )
+        self.subscriber = FleetSubscriber(
+            self.client,
+            on_snapshot=self._on_snapshot,
+            on_delta=self._on_delta,
+            token_store=plane.token_store_for(self.name),
+            stale_after_seconds=plane.config.stale_after_seconds,
+            backoff_seconds=plane.config.resync_backoff_seconds,
+            # deterministic jitter spread across upstreams; reseeded per
+            # process via the index + pid mix
+            rng=random.Random((os.getpid() << 8) ^ index),
+            name=self.name,
+        )
+        self.thread = threading.Thread(
+            target=self.subscriber.run, name=f"federate-{self.name}", daemon=True
+        )
+        self._plane = plane
+        suffix = _metric_suffix(self.name)
+        metrics = plane.metrics
+        self.lag_rv_gauge = (
+            metrics.gauge(f"federation_upstream_lag_rv_{suffix}") if metrics else None
+        )
+        self.lag_seconds_gauge = (
+            metrics.gauge(f"federation_upstream_lag_seconds_{suffix}") if metrics else None
+        )
+        self.stale_gauge = (
+            metrics.gauge(f"federation_upstream_stale_{suffix}") if metrics else None
+        )
+
+    def _on_snapshot(self, snap: Snapshot) -> None:
+        if self.epoch is not None and snap.view != self.epoch:
+            # the upstream restarted into a fresh rv space (unclean end,
+            # or history off): epochs fence its resume tokens; the full
+            # reconcile below re-bases our copy of its state
+            self.epoch_changes += 1
+            logger.warning(
+                "Federation upstream %s changed view epoch %s -> %s (restart); reconciling",
+                self.name, self.epoch, snap.view,
+            )
+        self.epoch = snap.view
+        with self.drop_lock:
+            self.dropped = False
+            self._plane.merge.reset_cluster(self.name, snap.objects)
+        if self._plane.snapshots_counter is not None:
+            self._plane.snapshots_counter.inc()
+
+    def _on_delta(self, frame: Dict[str, Any]) -> None:
+        with self.drop_lock:
+            if self.dropped:
+                # drop_stale removed our objects while this stream was
+                # stalled but still open; a delta-only resume would leave
+                # every untouched object missing — force the full
+                # reconcile instead
+                raise ResyncRequired("objects dropped while stale; re-snapshot to reconcile")
+            self._plane.merge.apply_delta(self.name, frame)
+        if self._plane.deltas_counter is not None:
+            self._plane.deltas_counter.inc()
+
+    def sync_counters(self, plane: "FederationPlane") -> None:
+        """Diff-sync the subscriber's monotonic counts into the registry
+        (counters only move forward, so diffing is exact)."""
+        sub = self.subscriber
+        for field, counter in (
+            ("reconnects", plane.reconnects_counter),
+            ("resyncs", plane.resyncs_counter),
+            ("stalls", plane.stalls_counter),
+        ):
+            if counter is None:
+                continue
+            current = getattr(sub, field)
+            delta = current - self._synced.get(field, 0)
+            if delta > 0:
+                counter.inc(delta)
+                self._synced[field] = current
+
+    def update_gauges(self) -> None:
+        sub = self.subscriber
+        if self.lag_rv_gauge is not None:
+            self.lag_rv_gauge.set(max(0, sub.wire_rv - (sub.rv or 0)))
+        if self.lag_seconds_gauge is not None:
+            age = sub.last_frame_age()
+            if age is not None:
+                self.lag_seconds_gauge.set(age)
+        if self.stale_gauge is not None:
+            self.stale_gauge.set(1.0 if self.stale else 0.0)
+
+    def status(self) -> Dict[str, Any]:
+        body = self.subscriber.status()
+        body.update(
+            {
+                "url": self.cfg.url,
+                "stale": self.stale,
+                "epoch": self.epoch,
+                "epoch_changes": self.epoch_changes,
+                "objects": self._plane.merge.cluster_object_count(self.name),
+                "thread_alive": self.thread.is_alive(),
+            }
+        )
+        return body
+
+
+class FederationPlane:
+    """Runs the upstream subscriber fleet against the app's FleetView.
+
+    Built when ``federation.enabled``; the app starts it after the serve
+    plane (the view exists from construction, so ordering is about log
+    hygiene, not correctness) and stops it before the history WAL closes
+    (the plane is a view producer)."""
+
+    def __init__(
+        self,
+        config,
+        view,
+        *,
+        metrics=None,
+        token_dir: Optional[str] = None,
+        resume_tokens_valid: bool = True,
+    ):
+        self.config = config
+        self.metrics = metrics
+        self.token_dir = token_dir
+        # False when the merged view did NOT restart as a clean
+        # continuation of the rv line the tokens were minted against
+        # (unclean WAL end, cold/wiped WAL dir): a persisted token would
+        # then resume delta-only AHEAD of the recovered state and the
+        # lost window's objects would serve stale forever. start()
+        # clears the stale tokens so every subscriber re-snapshots and
+        # reconciles instead.
+        self.resume_tokens_valid = resume_tokens_valid
+        self.merge = GlobalMerge(view, drop_stale=config.drop_stale, metrics=metrics)
+        # a history-recovered view already holds federated objects: the
+        # registry must mirror them or the first reconcile can't delete
+        # what vanished upstream while we were down (the app constructs
+        # the serve plane — and runs WAL recovery — before this plane)
+        seeded = self.merge.seed_from_view()
+        if seeded:
+            logger.info(
+                "Federation registry seeded with %d recovered merged object(s)", seeded
+            )
+        self.reconnects_counter = metrics.counter("federation_reconnects") if metrics else None
+        self.resyncs_counter = metrics.counter("federation_resyncs") if metrics else None
+        self.stalls_counter = metrics.counter("federation_heartbeat_stalls") if metrics else None
+        self.snapshots_counter = metrics.counter("federation_snapshots") if metrics else None
+        self.deltas_counter = metrics.counter("federation_deltas_applied") if metrics else None
+        self.stale_transitions_counter = (
+            metrics.counter("federation_stale_transitions") if metrics else None
+        )
+        self.connected_gauge = (
+            metrics.gauge("federation_upstreams_connected") if metrics else None
+        )
+        self.upstreams: List[_Upstream] = [
+            _Upstream(self, u, i) for i, u in enumerate(config.upstreams)
+        ]
+        # staleness floor mirrors FleetSubscriber's: the wire heartbeats
+        # every 2 s when idle, so a sub-3s threshold would call every
+        # healthy idle upstream dead between SYNCs
+        self.stale_threshold = max(3.0, config.stale_after_seconds)
+        self._started = False
+        self._started_t = 0.0
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+
+    def token_store_for(self, name: str) -> Optional[TokenStore]:
+        if not self.token_dir:
+            return None
+        return TokenStore(os.path.join(self.token_dir, f"{_metric_suffix(name)}.token"))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "FederationPlane":
+        self._stop.clear()
+        self._started = True
+        self._started_t = time.monotonic()
+        if not self.resume_tokens_valid:
+            for upstream in self.upstreams:
+                store = upstream.subscriber.token_store
+                if store is not None:
+                    store.clear()
+            if self.token_dir:
+                logger.warning(
+                    "Merged view did not restart cleanly on its prior rv line; "
+                    "cleared %d federation resume token(s) — upstream subscribers "
+                    "will re-snapshot and reconcile", len(self.upstreams),
+                )
+        for upstream in self.upstreams:
+            upstream.thread.start()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="federate-monitor", daemon=True
+        )
+        self._monitor.start()
+        logger.info(
+            "Federation plane started: %d upstream(s) [%s] (stale_after=%.1fs, drop_stale=%s)",
+            len(self.upstreams),
+            ", ".join(u.name for u in self.upstreams),
+            self.config.stale_after_seconds,
+            self.config.drop_stale,
+        )
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for upstream in self.upstreams:
+            upstream.subscriber.stop()
+        for upstream in self.upstreams:
+            if upstream.thread.is_alive():
+                upstream.thread.join(timeout=5.0)
+                if upstream.thread.is_alive():
+                    # subscriber.stop() aborts the blocking read, so this
+                    # should never fire; if it does, the caller's next
+                    # shutdown step (e.g. the WAL's terminal snapshot)
+                    # may race a late delta — say so loudly
+                    logger.warning(
+                        "Federation subscriber %s did not stop within the join budget",
+                        upstream.name,
+                    )
+        if self._monitor is not None:
+            self._monitor.join(timeout=2.0)
+            self._monitor = None
+        for upstream in self.upstreams:
+            upstream.sync_counters(self)
+        self._started = False
+
+    # -- the monitor tick --------------------------------------------------
+
+    def _monitor_loop(self) -> None:
+        interval = max(0.1, min(1.0, self.stale_threshold / 4.0))
+        while not self._stop.wait(interval):
+            self._tick()
+
+    def _tick(self) -> None:
+        now = time.monotonic()
+        grace_over = now - self._started_t > self.stale_threshold
+        connected = 0
+        for upstream in self.upstreams:
+            sub = upstream.subscriber
+            age = sub.last_frame_age()
+            if sub.connected:
+                connected += 1
+            fresh = age is not None and age <= self.stale_threshold
+            if fresh:
+                upstream.stale = False
+            elif grace_over or age is not None:
+                # dark past the threshold (or never reached at all once
+                # the startup grace lapses)
+                if not upstream.stale:
+                    upstream.stale = True
+                    if self.stale_transitions_counter is not None:
+                        self.stale_transitions_counter.inc()
+                    logger.warning(
+                        "Federation upstream %s went stale (last frame %s ago)",
+                        upstream.name, f"{age:.1f}s" if age is not None else "never",
+                    )
+                if self.config.drop_stale and not upstream.dropped:
+                    # under the per-upstream lock (serialized against the
+                    # subscriber's apply/reconcile) and with staleness
+                    # RE-validated inside it: a reconcile racing this tick
+                    # refreshes last_frame_age, so the drop backs off
+                    # instead of deleting a just-repopulated cluster.
+                    # Flagging before the delete makes any in-between
+                    # delta raise ResyncRequired into a full reconcile;
+                    # invalidate() makes the next (re)connect re-snapshot
+                    # the objects back in — a token-resume must not skip
+                    # re-materializing them.
+                    with upstream.drop_lock:
+                        age_now = sub.last_frame_age()
+                        if age_now is None or age_now > self.stale_threshold:
+                            upstream.dropped = True
+                            sub.invalidate()
+                            dropped = self.merge.drop_cluster(upstream.name)
+                            logger.warning(
+                                "Dropped %d stale object(s) of upstream %s from the global view",
+                                dropped, upstream.name,
+                            )
+            upstream.sync_counters(self)
+            upstream.update_gauges()
+        if self.connected_gauge is not None:
+            self.connected_gauge.set(connected)
+
+    # -- health ------------------------------------------------------------
+
+    def health(self) -> Dict[str, Any]:
+        """Per-upstream liveness folded into the /healthz BODY: the plane
+        is unhealthy while any upstream is stale (its slice of the global
+        view is dark) or any subscriber thread died. The status server
+        deliberately keeps this out of the liveness verdict (no 503) —
+        restarting the federator cannot revive a dark remote cluster, and
+        a liveness kill would wipe the last-known state the keep policy
+        serves. Readiness probes and alerts key off ``healthy`` here."""
+        upstreams = {u.name: u.status() for u in self.upstreams}
+        healthy = not self._started or all(
+            not u.stale and u.thread.is_alive() for u in self.upstreams
+        )
+        return {
+            "healthy": healthy,
+            "started": self._started,
+            "upstreams": upstreams,
+            "merged_objects": self.merge.object_count(),
+            "drop_stale": self.config.drop_stale,
+            "stale_after_seconds": self.stale_threshold,
+        }

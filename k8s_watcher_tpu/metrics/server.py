@@ -155,6 +155,10 @@ class _StatusHandler(BaseHTTPRequestHandler):
     # Callable[[], dict]: serving-plane liveness (ServePlane.health);
     # folded into /healthz when the serve plane is enabled
     serve = None
+    # Callable[[], dict]: federation-plane liveness (FederationPlane.health,
+    # per-upstream staleness/connectivity); folded into /healthz and
+    # served in full at /debug/federation when federation is enabled
+    federation = None
     slices = None  # Callable[[], dict]: live slice states, optional
     trend = None  # Callable[[], dict]: probe trend anchors/windows, optional
     # Callable[[], Optional[dict]]: remediation policy state; the callable
@@ -220,11 +224,19 @@ class _StatusHandler(BaseHTTPRequestHandler):
             watch_alive = self.liveness.alive()
             egress = self.egress() if self.egress is not None else None
             serve = self.serve() if self.serve is not None else None
+            federation = self.federation() if self.federation is not None else None
             # overall liveness = watch-loop freshness AND egress progress
             # AND (when enabled) the serving plane's HTTP thread: a watcher
             # whose workers are all dead, or whose serve plane silently
             # stopped answering 5k subscribers, is as blind-making as one
-            # that lost its watch
+            # that lost its watch — and all of those are LOCAL faults a
+            # kubelet restart can fix. Federation staleness is deliberately
+            # NOT folded into `alive`: /healthz is the liveness surface,
+            # and restarting the federator cannot revive a dark REMOTE
+            # cluster — a 503 here would crash-loop the federator, wiping
+            # the last-known state the keep policy exists to serve. The
+            # verdict still rides the body (`federation.healthy`) for
+            # readiness probes, alerting and /debug/federation.
             alive = (
                 watch_alive
                 and (egress is None or bool(egress.get("healthy", True)))
@@ -239,6 +251,8 @@ class _StatusHandler(BaseHTTPRequestHandler):
                 body["egress"] = egress
             if serve is not None:
                 body["serve"] = serve
+            if federation is not None:
+                body["federation"] = federation
             self._json(200 if alive else 503, body)
         elif parsed.path == "/debug/events":
             if self.audit is None:
@@ -315,6 +329,11 @@ class _StatusHandler(BaseHTTPRequestHandler):
                 self._json(404, {"error": "history plane not enabled (history.enabled)"})
                 return
             self._json(200, {"history": self.history()})
+        elif parsed.path == "/debug/federation":
+            if self.federation is None:
+                self._json(404, {"error": "federation plane not enabled (federation.enabled)"})
+                return
+            self._json(200, {"federation": self.federation()})
         elif parsed.path == "/debug/remediation":
             if self.remediation is None:
                 self._json(404, {"error": "remediation not wired (tpu.remediation.enabled)"})
@@ -340,6 +359,7 @@ class StatusServer:
         trace=None,  # trace.TraceRing -> serves /debug/trace
         egress=None,  # Callable[[], dict] -> egress liveness folded into /healthz
         serve=None,  # Callable[[], dict] -> serving-plane liveness folded into /healthz
+        federation=None,  # Callable[[], dict] -> federation liveness, /healthz + /debug/federation
         slices=None,  # Callable[[], dict] -> serves /debug/slices
         trend=None,  # Callable[[], dict] -> serves /debug/trend
         remediation=None,  # Callable[[], Optional[dict]] -> /debug/remediation
@@ -358,6 +378,7 @@ class StatusServer:
                 "trace": trace,
                 "egress": staticmethod(egress) if egress else None,
                 "serve": staticmethod(serve) if serve else None,
+                "federation": staticmethod(federation) if federation else None,
                 "slices": staticmethod(slices) if slices else None,
                 "trend": staticmethod(trend) if trend else None,
                 "remediation": staticmethod(remediation) if remediation else None,

@@ -47,6 +47,7 @@ import requests
 
 from k8s_watcher_tpu.app import WatcherApp
 from k8s_watcher_tpu.config.loader import load_config
+from k8s_watcher_tpu.federate import FleetClient, ResumeLoop, model_from_objects
 from k8s_watcher_tpu.history.replay import replay_digest
 from k8s_watcher_tpu.k8s.mock_server import MockApiServer
 from k8s_watcher_tpu.watch.fake import build_pod
@@ -111,64 +112,6 @@ def _churn(server, rounds: int, flip_offset: int = 0) -> None:
         time.sleep(0.05)
 
 
-def _apply(model: dict, items: list) -> None:
-    for d in items:
-        if d["type"] == "DELETE":
-            model.pop(d["key"], None)
-        else:
-            model[d["key"]] = d["object"]
-
-
-class _Consumer:
-    """One resume-protocol consumer: long-poll loop with the per-
-    subscriber sequence checker (dense ranges, ascending rvs)."""
-
-    def __init__(self, base: str, rv: int, view_id: str, model: dict):
-        self.base = base
-        self.rv = rv
-        self.view_id = view_id
-        self.model = model
-        self.gaps = self.dups = self.resyncs = self.delivered = self.polls = 0
-
-    def poll(self, timeout_s: str = "1") -> bool:
-        """One long-poll; False when a 410 forced a re-snapshot."""
-        resp = requests.get(
-            f"{self.base}/serve/fleet",
-            params={"watch": "1", "once": "1", "rv": self.rv,
-                    "view": self.view_id, "timeout": timeout_s},
-            headers=AUTH, timeout=10,
-        )
-        self.polls += 1
-        if resp.status_code == 410:
-            resnap = requests.get(f"{self.base}/serve/fleet", headers=AUTH, timeout=5).json()
-            self.model.clear()
-            self.model.update({o["key"]: o for o in resnap["objects"]})
-            self.rv, self.view_id = resnap["rv"], resnap["view"]
-            self.resyncs += 1
-            return False
-        body = resp.json()
-        items = body["items"]
-        self.delivered += len(items)
-        if not body["compacted"] and len(items) != body["to_rv"] - body["from_rv"]:
-            self.gaps += 1
-        prev = body["from_rv"]
-        for d in items:
-            if d["rv"] <= prev:
-                self.dups += 1
-            prev = d["rv"]
-        _apply(self.model, items)
-        self.rv = body["to_rv"]
-        return True
-
-    def drain(self, base: str) -> None:
-        self.base = base
-        for _ in range(30):
-            before = self.rv
-            self.poll(timeout_s="0.3")
-            if self.rv == before:
-                break
-
-
 def _wait_materialized(app, deadline_s: float) -> str:
     deadline = time.monotonic() + deadline_s
     while time.monotonic() < deadline:
@@ -206,23 +149,27 @@ def run_smoke() -> dict:
         thread.start()
         try:
             base = _wait_materialized(app, DEADLINE_S)
-            snap = requests.get(f"{base}/serve/fleet", headers=AUTH, timeout=5).json()
-            view_id = snap["view"]
-            consumer = _Consumer(base, snap["rv"], view_id, {o["key"]: o for o in snap["objects"]})
+            # the shared resume-protocol consumer (federate/client.py):
+            # long-poll loop + sequence checker + model replay — the one
+            # implementation this smoke used to hand-roll
+            consumer = ResumeLoop(FleetClient(base, token=TOKEN))
+            consumer.start()
+            view_id = consumer.view
             churner = threading.Thread(target=_churn, args=(server, 12), daemon=True)
             churner.start()
             while churner.is_alive() or consumer.polls == 0:
-                consumer.poll()
+                consumer.poll(timeout=1.0)
             churner.join()
-            consumer.drain(base)
+            consumer.drain(timeout=0.3)
             token = consumer.rv  # the resume token minted BEFORE "SIGTERM"
             model_at_token = dict(consumer.model)
             checks["capture_gapless"] = (
-                consumer.gaps == 0 and consumer.dups == 0 and consumer.delivered > 0
+                consumer.checker.gaps == 0 and consumer.checker.dups == 0
+                and consumer.checker.delivered > 0
             )
             result["capture"] = {
-                "polls": consumer.polls, "delivered": consumer.delivered,
-                "gaps": consumer.gaps, "dups": consumer.dups,
+                "polls": consumer.polls, "delivered": consumer.checker.delivered,
+                "gaps": consumer.checker.gaps, "dups": consumer.checker.dups,
                 "resyncs": consumer.resyncs, "token": token, "view": view_id,
             }
         finally:
@@ -249,25 +196,25 @@ def run_smoke() -> dict:
             # fresh churn flows and the sequence checker must see zero
             # gaps/dups — and zero 410s (that re-snapshot storm is the
             # failure mode this plane exists to kill)
-            consumer.base = base2
+            consumer.client.retarget(base2)
             churner2 = threading.Thread(target=_churn, args=(server, 12, 1), daemon=True)
             churner2.start()
             resumed_polls_ok = True
             while churner2.is_alive():
-                resumed_polls_ok &= consumer.poll()
+                resumed_polls_ok &= consumer.poll(timeout=1.0)
             churner2.join()
-            consumer.drain(base2)
-            final = requests.get(f"{base2}/serve/fleet", headers=AUTH, timeout=5).json()
-            truth = {o["key"]: o for o in final["objects"]}
+            consumer.drain(timeout=0.3)
+            final = consumer.client.snapshot()
+            truth = model_from_objects(final.objects)
             checks["resume_across_restart_gapless"] = (
                 resumed_polls_ok
-                and consumer.gaps == 0 and consumer.dups == 0
+                and consumer.checker.gaps == 0 and consumer.checker.dups == 0
                 and consumer.resyncs == 0
                 and consumer.model == truth
             )
             result["resume"] = {
-                "polls": consumer.polls, "delivered": consumer.delivered,
-                "gaps": consumer.gaps, "dups": consumer.dups,
+                "polls": consumer.polls, "delivered": consumer.checker.delivered,
+                "gaps": consumer.checker.gaps, "dups": consumer.checker.dups,
                 "resyncs": consumer.resyncs, "final_rv": consumer.rv,
                 "model_matches_snapshot": consumer.model == truth,
             }
@@ -278,7 +225,7 @@ def run_smoke() -> dict:
                 f"{base2}/serve/fleet", params={"at": token}, headers=AUTH, timeout=10,
             )
             at_body = at.json() if at.status_code == 200 else {}
-            at_model = {o["key"]: o for o in at_body.get("objects", [])}
+            at_model = model_from_objects(at_body.get("objects", []))
             checks["time_travel_matches_pre_restart_model"] = (
                 at.status_code == 200
                 and at_body.get("historical") is True
@@ -315,7 +262,7 @@ def run_smoke() -> dict:
                 "durable_rv": history.get("durable_rv"),
                 "retention_floor_rv": history.get("retention_floor_rv"),
             }
-            final_rv = final["rv"]
+            final_rv = final.rv
         finally:
             app2.stop()
             thread2.join(timeout=15)

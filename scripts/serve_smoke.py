@@ -4,25 +4,27 @@ REAL app wiring (``make serve-smoke``).
 
 Boots the in-repo mock apiserver (doubling as the clusterapi notify
 target), points a ``WatcherApp`` at it with ``serve.enabled`` and a
-bearer token, churns pod phases, and drives N real HTTP consumers
-through every leg of the subscription protocol:
+bearer token, churns pod phases, and drives real HTTP consumers —
+built on the ONE serve-protocol implementation, ``federate/client.py``
+(``FleetClient`` + ``ResumeLoop`` + ``SequenceChecker``) — through every
+leg of the subscription protocol:
 
 1. **snapshot** — ``GET /serve/fleet`` answers ``{rv, objects}`` with
    the churned pods materialized;
-2. **resumable deltas** — a long-poll loop (``?watch=1&once=1&rv=N``)
-   across SEPARATE connections: raw ranges must be dense (the rv space
-   has no gaps), rvs strictly ascending (no dups), and the replayed
-   model must equal a final snapshot;
-3. **streaming watch** — one chunked ``?watch=1`` window delivers SYNC
+2. **resumable deltas** — a long-poll resume loop across SEPARATE
+   connections: raw ranges must be dense (the rv space has no gaps),
+   rvs strictly ascending (no dups), and the replayed model must equal
+   a final snapshot;
+3. **streaming watch** — one chunked ``?watch=1`` window decodes SYNC
    + UPSERT frames and closes with a final SYNC resume token;
 4. **410 resync** — a resume token left behind the compaction horizon
-   (the config shrinks it to force this) answers 410 Gone, a token
-   echoing a stale ``view`` instance id (a "previous incarnation" of
-   the rv space) answers 410 too, and the documented recovery
-   (re-snapshot, watch from its rv) works;
-5. **auth** — /serve routes answer 401 without the bearer token while
-   /serve/healthz stays open, and the status server's /healthz folds
-   the serving plane's verdict in;
+   (the config shrinks it to force this) raises ``ResyncRequired``, a
+   token echoing a stale ``view`` instance id (a "previous incarnation"
+   of the rv space) does too, and the documented recovery (re-snapshot,
+   watch from its rv) works;
+5. **auth** — /serve routes raise ``AuthRejected`` without the bearer
+   token while /serve/healthz stays open, and the status server's
+   /healthz folds the serving plane's verdict in;
 6. **encode-once plumbing** — the broadcast data plane's metrics are
    live after the legs above: frames were encoded (once per delta, at
    publish), fan-out bytes moved through the event loop, and
@@ -53,6 +55,13 @@ import requests
 
 from k8s_watcher_tpu.app import WatcherApp
 from k8s_watcher_tpu.config.loader import load_config
+from k8s_watcher_tpu.federate import (
+    AuthRejected,
+    FleetClient,
+    ResumeLoop,
+    ResyncRequired,
+    model_from_objects,
+)
 from k8s_watcher_tpu.k8s.mock_server import MockApiServer
 from k8s_watcher_tpu.watch.fake import build_pod
 
@@ -111,14 +120,6 @@ def _churn(server, rounds: int, flip_offset: int = 0) -> None:
         time.sleep(0.05)
 
 
-def _apply(model: dict, items: list) -> None:
-    for d in items:
-        if d["type"] == "DELETE":
-            model.pop(d["key"], None)
-        else:
-            model[d["key"]] = d["object"]
-
-
 def run_smoke() -> dict:
     import tempfile
 
@@ -141,17 +142,16 @@ def run_smoke() -> dict:
         try:
             # wait for the serving plane to bind + the relist to materialize
             deadline = time.monotonic() + DEADLINE_S
-            base = None
+            client = None
             while time.monotonic() < deadline:
                 if app.serve is not None and app.serve.port:
                     base = f"http://127.0.0.1:{app.serve.port}"
+                    client = FleetClient(base, token=TOKEN)
                     try:
-                        snap = requests.get(
-                            f"{base}/serve/fleet", headers=AUTH, timeout=5
-                        ).json()
-                        if len(snap.get("objects", [])) >= N_PODS:
+                        snap = client.snapshot()
+                        if len(snap.objects) >= N_PODS:
                             break
-                    except requests.RequestException:
+                    except (OSError, ResyncRequired):
                         pass
                 time.sleep(0.2)
             else:
@@ -159,138 +159,92 @@ def run_smoke() -> dict:
             result["serve_port"] = app.serve.port
 
             # 1. snapshot
-            snap = requests.get(f"{base}/serve/fleet", headers=AUTH, timeout=5).json()
-            pods = [o for o in snap["objects"] if o.get("kind") == "pod"]
-            checks["snapshot_served"] = snap["rv"] > 0 and len(pods) == N_PODS
-            result["snapshot"] = {"rv": snap["rv"], "objects": len(snap["objects"])}
+            snap = client.snapshot()
+            pods = [o for o in snap.objects if o.get("kind") == "pod"]
+            checks["snapshot_served"] = snap.rv > 0 and len(pods) == N_PODS
+            result["snapshot"] = {"rv": snap.rv, "objects": len(snap.objects)}
 
             # 2. resumable delta long-poll loop across separate connections
-            # (carrying the snapshot's view instance id, as a consumer would)
-            view_id = snap["view"]
-            model = {o["key"]: o for o in pods}
-            rv, gaps, dups, delivered, polls = snap["rv"], 0, 0, 0, 0
-            loop_resyncs = 0
+            # — the shared ResumeLoop (carrying the snapshot's view
+            # instance id and sequence-checking every batch, exactly what
+            # the federation plane's consumers run)
+            consumer = ResumeLoop(client)
+            consumer.start()
             churner = threading.Thread(target=_churn, args=(server, 12), daemon=True)
             churner.start()
-            while churner.is_alive() or polls == 0:
-                resp = requests.get(
-                    f"{base}/serve/fleet",
-                    params={"watch": "1", "once": "1", "rv": rv, "view": view_id, "timeout": "1"},
-                    headers=AUTH, timeout=10,
-                )
-                polls += 1
-                if resp.status_code == 410:
-                    # the horizon is deliberately tiny (64): a slow-CI
-                    # stall CAN expire a live token mid-loop. That is the
-                    # protocol working, not the smoke failing — run the
-                    # documented recovery and keep checking.
-                    resnap = requests.get(
-                        f"{base}/serve/fleet", headers=AUTH, timeout=5
-                    ).json()
-                    model = {o["key"]: o for o in resnap["objects"]}
-                    rv, view_id = resnap["rv"], resnap["view"]
-                    loop_resyncs += 1
-                    continue
-                body = resp.json()
-                items = body["items"]
-                delivered += len(items)
-                if not body["compacted"] and len(items) != body["to_rv"] - body["from_rv"]:
-                    gaps += 1
-                prev = body["from_rv"]
-                for d in items:
-                    if d["rv"] <= prev:
-                        dups += 1
-                    prev = d["rv"]
-                _apply(model, items)
-                rv = body["to_rv"]
+            while churner.is_alive() or consumer.polls == 0:
+                consumer.poll(timeout=1.0)
             churner.join()
-            # drain the tail, then the replayed model must equal a fresh snapshot
-            for _ in range(20):
-                resp = requests.get(
-                    f"{base}/serve/fleet",
-                    params={"watch": "1", "once": "1", "rv": rv, "view": view_id, "timeout": "0.3"},
-                    headers=AUTH, timeout=10,
-                )
-                if resp.status_code == 410:
-                    resnap = requests.get(
-                        f"{base}/serve/fleet", headers=AUTH, timeout=5
-                    ).json()
-                    model = {o["key"]: o for o in resnap["objects"]}
-                    rv, view_id = resnap["rv"], resnap["view"]
-                    loop_resyncs += 1
-                    continue
-                body = resp.json()
-                _apply(model, body["items"])
-                rv = body["to_rv"]
-                if not body["items"]:
-                    break
-            final = requests.get(f"{base}/serve/fleet", headers=AUTH, timeout=5).json()
-            truth = {o["key"]: o for o in final["objects"]}
+            consumer.drain(polls=20, timeout=0.3)
+            truth = model_from_objects(client.snapshot().objects)
+            checker = consumer.checker
             checks["resume_loop_gapless"] = (
-                gaps == 0 and dups == 0 and delivered > 0 and model == truth
+                checker.gaps == 0 and checker.dups == 0
+                and checker.delivered > 0 and consumer.model == truth
             )
             result["resume_loop"] = {
-                "polls": polls, "delivered": delivered, "gaps": gaps,
-                "dups": dups, "resyncs": loop_resyncs, "final_rv": rv,
-                "model_matches_snapshot": model == truth,
+                "polls": consumer.polls, "delivered": checker.delivered,
+                "gaps": checker.gaps, "dups": checker.dups,
+                "resyncs": consumer.resyncs, "final_rv": consumer.rv,
+                "model_matches_snapshot": consumer.model == truth,
             }
 
-            # 3. one chunked streaming-watch window
-            frames = []
+            # 3. one chunked streaming-watch window, decoded by the shared
+            # client (open the stream — first frame is the opening SYNC —
+            # before churning into it)
+            stream = client.watch(consumer.rv, view=consumer.view, window_seconds=2)
+            frames = [next(stream)]
             streamer = threading.Thread(target=_churn, args=(server, 4, 1), daemon=True)
-            with requests.get(
-                f"{base}/serve/fleet",
-                params={"watch": "1", "rv": rv, "timeout": "2"},
-                headers=AUTH, stream=True, timeout=10,
-            ) as r:
-                streamer.start()
-                for line in r.iter_lines():
-                    if line:
-                        frames.append(json.loads(line))
+            streamer.start()
+            frames.extend(stream)
             streamer.join()
             types = [f["type"] for f in frames]
             checks["stream_watch"] = (
-                types and types[0] == "SYNC" and "UPSERT" in types
+                bool(types) and types[0] == "SYNC" and "UPSERT" in types
                 and types[-1] == "SYNC"
             )
             result["stream"] = {"frames": len(frames), "types": sorted(set(types))}
 
             # 4. 410 on an expired token, then the documented resync
             _churn(server, 12)  # > compact_horizon deltas: rv 1 expires
-            r410 = requests.get(
-                f"{base}/serve/fleet",
-                params={"watch": "1", "once": "1", "rv": 1},
-                headers=AUTH, timeout=10,
-            )
-            resnap = requests.get(f"{base}/serve/fleet", headers=AUTH, timeout=5).json()
-            recovered = requests.get(
-                f"{base}/serve/fleet",
-                params={"watch": "1", "once": "1", "rv": resnap["rv"], "timeout": "0.2"},
-                headers=AUTH, timeout=10,
-            )
+            gone_410 = False
+            oldest_rv = None
+            try:
+                client.long_poll(1, timeout=1.0)
+            except ResyncRequired as exc:
+                gone_410 = True
+                oldest_rv = exc.body.get("oldest_rv")
+            resnap = client.snapshot()
+            recovered_ok = False
+            try:
+                client.long_poll(resnap.rv, timeout=0.2)
+                recovered_ok = True
+            except ResyncRequired:
+                pass
             # a token minted by a "previous incarnation" (stale view id)
             # must 410 the same way — never graft onto the new rv space
-            stale_epoch = requests.get(
-                f"{base}/serve/fleet",
-                params={"watch": "1", "once": "1", "rv": resnap["rv"], "view": "0" * 12},
-                headers=AUTH, timeout=10,
-            )
-            checks["gone_resync"] = (
-                r410.status_code == 410
-                and stale_epoch.status_code == 410
-                and recovered.status_code == 200
-            )
+            stale_410 = False
+            try:
+                client.long_poll(resnap.rv, view="0" * 12, timeout=0.2)
+            except ResyncRequired:
+                stale_410 = True
+            checks["gone_resync"] = gone_410 and stale_410 and recovered_ok
             result["gone"] = {
-                "status": r410.status_code,
-                "stale_epoch_status": stale_epoch.status_code,
-                "oldest_rv": r410.json().get("oldest_rv"),
-                "resnapshot_rv": resnap["rv"],
+                "gone_410": gone_410,
+                "stale_epoch_410": stale_410,
+                "oldest_rv": oldest_rv,
+                "resnapshot_rv": resnap.rv,
             }
 
             # 5. auth posture + /healthz folding
+            auth_rejected = False
+            try:
+                FleetClient(client.base_url).snapshot()  # no token
+            except AuthRejected:
+                auth_rejected = True
             checks["auth_enforced"] = (
-                requests.get(f"{base}/serve/fleet", timeout=5).status_code == 401
-                and requests.get(f"{base}/serve/healthz", timeout=5).status_code == 200
+                auth_rejected
+                and client.healthz().get("healthy") is True
             )
             healthz = requests.get(
                 f"http://127.0.0.1:{status_port}/healthz", timeout=5
@@ -305,8 +259,8 @@ def run_smoke() -> dict:
             # fanned out by the event loop, snapshot byte cache hitting
             # (two back-to-back snapshots with no churn = a guaranteed
             # same-rv second read)
-            requests.get(f"{base}/serve/fleet", headers=AUTH, timeout=5)
-            requests.get(f"{base}/serve/fleet", headers=AUTH, timeout=5)
+            client.snapshot()
+            client.snapshot()
             metrics = requests.get(
                 f"http://127.0.0.1:{status_port}/metrics", headers=AUTH, timeout=5
             ).json()

@@ -72,6 +72,11 @@ def test_bench_smoke_headline_within_budget():
     # and publisher-side CPU per delta stayed flat vs the 1k reference
     assert headline["serve_encode_once_ok"] is True, headline
     assert headline["serve_cpu_flat_ok"] is True, headline
+    # federation plane: 3 upstream serving planes fanned into one merged
+    # global view over real HTTP — pod-event->global-view p50 inside its
+    # budget, merged state == union of upstreams, zero gaps/dups
+    assert headline["federation_ok"] is True, headline
+    assert headline["federation_p50_ms"] is not None, headline
     detail = json.loads((REPO_ROOT / "artifacts" / "bench_smoke.json").read_text())
     assert detail["details"]["relist_10k"]["events"] == detail["details"]["relist_10k"]["n_pods"]
     egress = detail["details"]["egress_saturation"]
@@ -97,3 +102,8 @@ def test_bench_smoke_headline_within_budget():
     # re-runs co-tenant-starved throughput, never a gap/dup (a race that
     # passes 2-in-3 must not ship green via best-of-N)
     assert all(a["correctness_ok"] for a in serve["attempts"]), serve["attempts"]
+    fed = detail["details"]["federation"]
+    assert fed["merged_matches"], fed
+    assert fed["gaps"] == 0 and fed["dups"] == 0, fed
+    assert fed["deltas_applied"] > 0 and fed["latency_samples"] > 0, fed
+    assert all(a["correctness_ok"] for a in fed["attempts"]), fed["attempts"]

@@ -1,0 +1,800 @@
+"""Federation plane: serve-protocol client edge cases against a live
+FleetView HTTP surface, the merged global view, the fan-in plane, and
+the federation config schema.
+
+The hard legs the ISSUE names ride here: 410 mid-stream resync, COMPACTED
+batch handling, heartbeat-stall reconnect, bearer auth, and a seeded
+kill/restart property test proving zero gaps/dups through an upstream
+restart (PR-5's restart-surviving resume tokens, end to end over HTTP).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler
+
+import pytest
+
+from k8s_watcher_tpu.config.schema import (
+    AppConfig,
+    FederationConfig,
+    SchemaError,
+    ServeConfig,
+)
+from k8s_watcher_tpu.federate import (
+    AuthRejected,
+    FederationPlane,
+    FleetClient,
+    FleetSubscriber,
+    GlobalMerge,
+    ResumeLoop,
+    ResyncRequired,
+    SequenceChecker,
+    TokenStore,
+    apply_wire_delta,
+    global_key,
+    model_from_objects,
+    split_global_key,
+)
+from k8s_watcher_tpu.history import HistoryStore
+from k8s_watcher_tpu.metrics import MetricsRegistry
+from k8s_watcher_tpu.metrics.server import Liveness, QuietThreadingHTTPServer, StatusServer
+from k8s_watcher_tpu.serve import FleetView, ServePlane, ServeServer, SubscriptionHub, chunk_frame
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_for(predicate, timeout=10.0, interval=0.02, message="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {message}")
+
+
+# -- SequenceChecker ----------------------------------------------------------
+
+
+class TestSequenceChecker:
+    def test_dense_raw_batch_is_clean(self):
+        c = SequenceChecker()
+        assert c.observe(5, 8, False, [6, 7, 8])
+        assert c.clean and c.delivered == 3 and c.batches == 1
+
+    def test_short_raw_batch_is_a_gap(self):
+        c = SequenceChecker()
+        assert not c.observe(5, 8, False, [6, 8])
+        assert c.gaps == 1 and c.dups == 0
+
+    def test_repeated_rv_is_a_dup_even_compacted(self):
+        c = SequenceChecker()
+        assert not c.observe(5, 9, True, [7, 7, 9])
+        assert c.dups == 1
+        # compaction sanctions skips, never repeats; the skip itself is fine
+        c2 = SequenceChecker()
+        assert c2.observe(5, 9, True, [7, 9])
+        assert c2.clean and c2.compacted_batches == 1
+
+    def test_bounds_variant_matches_full_scan_verdicts(self):
+        full, cheap = SequenceChecker(), SequenceChecker()
+        batches = [
+            (0, 3, False, [1, 2, 3]),
+            (3, 6, False, [4, 6]),  # gap
+            (6, 9, True, [7, 9]),  # compacted skip: fine
+        ]
+        for from_rv, to_rv, compacted, rvs in batches:
+            full.observe(from_rv, to_rv, compacted, rvs)
+            cheap.observe_bounds(from_rv, to_rv, compacted, len(rvs), rvs[0], rvs[-1])
+        assert (full.gaps, full.delivered) == (cheap.gaps, cheap.delivered) == (1, 7)
+
+    def test_stream_rv_checks(self):
+        c = SequenceChecker()
+        assert c.observe_stream_rv(4, 5, False)
+        assert not c.observe_stream_rv(5, 5, False)  # dup
+        assert not c.observe_stream_rv(5, 8, False)  # unsanctioned skip
+        assert c.observe_stream_rv(5, 8, True)  # sanctioned skip
+        assert (c.gaps, c.dups) == (1, 1)
+
+    def test_apply_helpers(self):
+        model = model_from_objects([{"kind": "pod", "key": "a", "seq": 0}])
+        apply_wire_delta(model, {"type": "UPSERT", "rv": 2, "kind": "pod", "key": "b",
+                                 "object": {"kind": "pod", "key": "b", "seq": 1}})
+        apply_wire_delta(model, {"type": "DELETE", "rv": 3, "kind": "pod", "key": "a"})
+        assert model == {("pod", "b"): {"kind": "pod", "key": "b", "seq": 1}}
+
+
+# -- TokenStore ---------------------------------------------------------------
+
+
+class TestTokenStore:
+    def test_round_trip_and_clear(self, tmp_path):
+        store = TokenStore(tmp_path / "t.json")
+        assert store.load() is None
+        store.save(42, "abc")
+        assert store.load() == (42, "abc")
+        store.clear()
+        assert store.load() is None
+
+    def test_corrupt_token_reads_as_absent(self, tmp_path):
+        path = tmp_path / "t.json"
+        path.write_text("{not json")
+        assert TokenStore(path).load() is None
+
+    def test_subscriber_skips_redundant_saves(self, tmp_path):
+        # an idle upstream SYNCs every 2 s with an unchanged token; the
+        # subscriber must not rewrite the token file per heartbeat
+        writes = []
+
+        class Recording(TokenStore):
+            def save(self, rv, view):
+                writes.append((rv, view))
+                super().save(rv, view)
+
+        sub = FleetSubscriber(
+            FleetClient("http://127.0.0.1:1"),
+            token_store=Recording(tmp_path / "t.json"),
+        )
+        sub._save_token(7, "v")
+        sub._save_token(7, "v")
+        sub._save_token(7, "v")
+        sub._save_token(8, "v")
+        assert writes == [(7, "v"), (8, "v")]
+
+
+# -- FleetClient against a LIVE FleetView HTTP surface ------------------------
+
+
+@pytest.fixture
+def live_serve():
+    view = FleetView(compact_horizon=64)
+    hub = SubscriptionHub(view, max_subscribers=16, queue_depth=8)
+    server = ServeServer(view, hub, host="127.0.0.1", port=0).start()
+    try:
+        yield view, hub, f"http://127.0.0.1:{server.port}"
+    finally:
+        server.stop()
+
+
+class TestFleetClientLive:
+    def test_snapshot_and_dense_long_poll_resume(self, live_serve):
+        view, _, base = live_serve
+        view.apply("pod", "a", {"kind": "pod", "key": "a", "seq": 0})
+        client = FleetClient(base)
+        snap = client.snapshot()
+        assert snap.rv == 1 and snap.view == view.instance
+        view.apply("pod", "b", {"kind": "pod", "key": "b", "seq": 1})
+        batch = client.long_poll(snap.rv, view=snap.view, timeout=1.0)
+        assert [i["rv"] for i in batch.items] == [2] and not batch.compacted
+
+    def test_expired_token_raises_resync_required(self, live_serve):
+        view, _, base = live_serve
+        for i in range(200):  # horizon 64: rv 1 expires
+            view.apply("pod", f"p{i}", {"kind": "pod", "key": f"p{i}", "seq": i})
+        client = FleetClient(base)
+        with pytest.raises(ResyncRequired):
+            client.long_poll(1, timeout=0.2)
+        # a stale view instance id 410s the same way
+        with pytest.raises(ResyncRequired):
+            client.long_poll(view.rv, view="0" * 12, timeout=0.2)
+
+    def test_compacted_long_poll_rides_resume_loop_model_exact(self, live_serve):
+        # hub queue_depth=8: >8 pending deltas compact latest-wins; the
+        # checker must sanction the rv jump and the replayed model must
+        # still equal the view (per-key final state is exact)
+        view, _, base = live_serve
+        view.apply("pod", "seed", {"kind": "pod", "key": "seed", "seq": -1})
+        loop = ResumeLoop(FleetClient(base))
+        loop.start()
+        for i in range(40):
+            view.apply("pod", f"p{i % 5}", {"kind": "pod", "key": f"p{i % 5}", "seq": i})
+        assert loop.poll(timeout=1.0)
+        assert loop.checker.compacted_batches >= 1
+        assert loop.checker.clean
+        assert loop.model == model_from_objects(view.snapshot()[1])
+
+    def test_bearer_auth(self):
+        view = FleetView()
+        hub = SubscriptionHub(view)
+        server = ServeServer(view, hub, host="127.0.0.1", port=0, auth_token="s3cret").start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            with pytest.raises(AuthRejected):
+                FleetClient(base).snapshot()
+            with pytest.raises(AuthRejected):
+                FleetClient(base, token="wrong").snapshot()
+            assert FleetClient(base, token="s3cret").snapshot().rv == 0
+            # the open route stays open
+            assert FleetClient(base).healthz().get("healthy") is True
+        finally:
+            server.stop()
+
+    def test_url_path_is_a_request_prefix(self):
+        # a reverse-proxy prefix in the upstream URL must ride every
+        # request ("http://gw/cluster-a" -> GET /cluster-a/serve/fleet),
+        # not be silently dropped into opaque 404s
+        assert FleetClient("http://127.0.0.1:1/cluster-a/")._prefix == "/cluster-a"
+        assert FleetClient("http://127.0.0.1:1")._prefix == ""
+
+    def test_watch_stream_decodes_frames(self, live_serve):
+        view, _, base = live_serve
+        view.apply("pod", "a", {"kind": "pod", "key": "a", "seq": 0})
+        client = FleetClient(base)
+        stream = client.watch(0, window_seconds=1.5)
+        frames = [next(stream)]  # opening SYNC before churning in
+        view.apply("pod", "b", {"kind": "pod", "key": "b", "seq": 1})
+        view.apply("pod", "a", None)
+        frames.extend(stream)
+        types = [f["type"] for f in frames]
+        assert types[0] == "SYNC" and types[-1] == "SYNC"
+        assert "UPSERT" in types and "DELETE" in types
+
+
+# -- scripted wire-level edge cases (stall, in-band GONE, COMPACTED) ----------
+
+
+class _ScriptedHandler(BaseHTTPRequestHandler):
+    """Speaks just enough of the serve wire protocol to script exact
+    frame sequences a real lightly-loaded server won't produce on cue:
+    heartbeat silence, in-band GONE, COMPACTED ranges."""
+
+    protocol_version = "HTTP/1.1"
+    script = None  # list of ("frame", dict) | ("sleep", s) | ("hang", s) per watch request
+    snapshot_body = None  # dict served on the non-watch route
+    watch_requests = None  # append-only log of watch hits
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):  # noqa: N802
+        if "watch=1" in self.path:
+            self.watch_requests.append(self.path)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            steps = self.script.pop(0) if self.script else [("sleep", 0.0)]
+            try:
+                for op, arg in steps:
+                    if op == "frame":
+                        self.wfile.write(chunk_frame(arg))
+                        self.wfile.flush()
+                    elif op == "sleep":
+                        time.sleep(arg)
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+            self.close_connection = True
+            return
+        body = json.dumps(self.snapshot_body).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def _scripted_server(script, snapshot_body):
+    handler = type(
+        "BoundScripted",
+        (_ScriptedHandler,),
+        {"script": script, "snapshot_body": snapshot_body, "watch_requests": []},
+    )
+    server = QuietThreadingHTTPServer(("127.0.0.1", 0), handler)
+    server.daemon_threads = True
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, handler
+
+
+class TestSubscriberWireEdges:
+    def _run_subscriber(self, base, **kw):
+        deltas = []
+        snapshots = []
+        sub = FleetSubscriber(
+            FleetClient(base),
+            on_snapshot=snapshots.append,
+            on_delta=deltas.append,
+            backoff_seconds=0.05,
+            rng=random.Random(0),
+            **kw,
+        )
+        thread = threading.Thread(target=sub.run, daemon=True)
+        thread.start()
+        return sub, thread, deltas, snapshots
+
+    def test_in_band_gone_triggers_resnapshot_resync(self):
+        # window 1: one delta then GONE; window 2 (post-resync): a delta
+        snap = {"rv": 10, "view": "v1", "objects": [{"kind": "pod", "key": "a", "seq": 0}]}
+        script = [
+            [("frame", {"type": "SYNC", "rv": 10, "view": "v1"}),
+             ("frame", {"type": "UPSERT", "rv": 11, "kind": "pod", "key": "b",
+                        "object": {"kind": "pod", "key": "b", "seq": 1}}),
+             ("frame", {"type": "GONE", "rv": 11, "oldest_rv": 50})],
+            [("frame", {"type": "SYNC", "rv": 10, "view": "v1"}), ("sleep", 0.3)],
+        ]
+        server, handler = _scripted_server(script, snap)
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        sub, thread, deltas, snapshots = self._run_subscriber(base)
+        try:
+            _wait_for(lambda: sub.resyncs >= 1 and sub.snapshots >= 2,
+                      message="GONE -> re-snapshot resync")
+            assert [d["key"] for d in deltas] == ["b"]
+            assert len(snapshots) >= 2  # initial + post-GONE
+        finally:
+            sub.stop()
+            server.shutdown()
+            server.server_close()
+            thread.join(timeout=5)
+
+    def test_compacted_range_sanctions_skip_no_gap(self):
+        snap = {"rv": 10, "view": "v1", "objects": []}
+        script = [
+            [("frame", {"type": "SYNC", "rv": 10, "view": "v1"}),
+             ("frame", {"type": "COMPACTED", "from_rv": 10, "to_rv": 40}),
+             ("frame", {"type": "UPSERT", "rv": 25, "kind": "pod", "key": "a",
+                        "object": {"kind": "pod", "key": "a", "seq": 25}}),
+             ("frame", {"type": "UPSERT", "rv": 40, "kind": "pod", "key": "b",
+                        "object": {"kind": "pod", "key": "b", "seq": 40}})],
+            [("frame", {"type": "SYNC", "rv": 40, "view": "v1"}), ("sleep", 0.3)],
+        ]
+        server, handler = _scripted_server(script, snap)
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        sub, thread, deltas, _ = self._run_subscriber(base)
+        try:
+            _wait_for(lambda: len(deltas) == 2, message="compacted deltas delivered")
+            assert sub.checker.gaps == 0 and sub.checker.dups == 0
+            assert sub.checker.compacted_batches >= 1
+            assert sub.rv == 40
+            # an UNsanctioned skip past the compacted range WOULD gap
+            assert not SequenceChecker().observe_stream_rv(40, 45, False)
+        finally:
+            sub.stop()
+            server.shutdown()
+            server.server_close()
+            thread.join(timeout=5)
+
+    def test_heartbeat_stall_reconnects(self):
+        # window 1 sends one SYNC then goes silent far past stale_after;
+        # the subscriber must declare the stream dead and reconnect
+        snap = {"rv": 5, "view": "v1", "objects": []}
+        script = [
+            [("frame", {"type": "SYNC", "rv": 5, "view": "v1"}), ("sleep", 30.0)],
+            [("frame", {"type": "SYNC", "rv": 5, "view": "v1"}), ("sleep", 0.2)],
+        ]
+        server, handler = _scripted_server(script, snap)
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        sub, thread, _, _ = self._run_subscriber(base, stale_after_seconds=3.0)
+        try:
+            _wait_for(lambda: sub.stalls >= 1 and len(handler.watch_requests) >= 2,
+                      timeout=15.0, message="stall detection + reconnect")
+            assert sub.reconnects >= 1
+            assert sub.resyncs == 0  # a stall resumes the token, never re-snapshots
+        finally:
+            sub.stop()
+            server.shutdown()
+            server.server_close()
+            thread.join(timeout=5)
+
+
+# -- the PR-5 leg: seeded kill/restart property test --------------------------
+
+
+class TestRestartResumeProperty:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_zero_gaps_dups_through_upstream_restart(self, tmp_path, seed):
+        """Churn -> CLEAN upstream shutdown (WAL drained, terminal
+        snapshot) -> restart on the same port -> more churn. The
+        subscriber holds its token across the outage and must resume on
+        the recovered rv line with zero gaps, zero dups and ZERO resyncs
+        — the restart-surviving-resume-token contract, exercised through
+        the real HTTP surface."""
+        rng = random.Random(seed)
+        port = _free_port()
+        cfg = ServeConfig(enabled=True, port=port, max_subscribers=8,
+                          queue_depth=4096, compact_horizon=8192)
+
+        def boot():
+            store = HistoryStore(tmp_path / "wal", fsync="never")
+            store.recover(journal_limit=8192)
+            plane = ServePlane(cfg, history=store)
+            plane.start()
+            return plane
+
+        plane = boot()
+        shadow = {}
+
+        def churn(n):
+            for _ in range(n):
+                key = f"p{rng.randrange(16)}"
+                if rng.random() < 0.15:
+                    plane.view.apply("pod", key, None)
+                    shadow.pop(("pod", key), None)
+                else:
+                    obj = {"kind": "pod", "key": key, "seq": rng.randrange(1 << 30)}
+                    plane.view.apply("pod", key, obj)
+                    shadow[("pod", key)] = obj
+
+        model = {}
+
+        def on_snapshot(snap):
+            model.clear()
+            model.update(model_from_objects(snap.objects))
+
+        sub = FleetSubscriber(
+            FleetClient(f"http://127.0.0.1:{port}"),
+            on_snapshot=on_snapshot,
+            on_delta=lambda frame: apply_wire_delta(model, frame),
+            token_store=TokenStore(tmp_path / "token.json"),
+            backoff_seconds=0.05,
+            stale_after_seconds=3.0,
+            rng=random.Random(seed),
+        )
+        thread = threading.Thread(target=sub.run, daemon=True)
+        thread.start()
+        try:
+            churn(120 + seed * 17)
+            _wait_for(lambda: sub.rv == plane.view.rv, message="catch-up before kill")
+            rv_before, instance_before = plane.view.rv, plane.view.instance
+            # the kill: clean SIGTERM shape (history closes with the
+            # terminal snapshot -> the next boot inherits the instance)
+            plane.stop()
+            plane.history.close()
+            time.sleep(0.3)  # subscriber cycles against the dead port
+            plane = boot()
+            assert plane.view.instance == instance_before
+            assert plane.view.rv == rv_before
+            churn(120 + seed * 13)
+            _wait_for(
+                lambda: sub.rv == plane.view.rv and model == shadow,
+                timeout=20.0,
+                message="post-restart convergence",
+            )
+            assert sub.checker.gaps == 0, sub.status()
+            assert sub.checker.dups == 0, sub.status()
+            assert sub.resyncs == 0, "resume must ride the recovered rv line, not re-snapshot"
+            assert sub.snapshots == 1, "only the initial snapshot"
+            assert sub.reconnects >= 1, "the outage must actually have been seen"
+        finally:
+            sub.stop()
+            thread.join(timeout=5)
+            plane.stop()
+            plane.history.close()
+
+
+# -- GlobalMerge --------------------------------------------------------------
+
+
+class TestGlobalMerge:
+    def test_key_namespacing_round_trip(self):
+        assert global_key("c1", "uid-9") == "c1/uid-9"
+        assert split_global_key("c1/uid-9") == ("c1", "uid-9")
+
+    def test_apply_delta_decorates_and_deletes(self):
+        view = FleetView()
+        merge = GlobalMerge(view)
+        merge.apply_delta("east", {"type": "UPSERT", "rv": 1, "kind": "pod", "key": "a",
+                                   "object": {"kind": "pod", "key": "a", "phase": "Running"}})
+        _, objects = view.snapshot()
+        assert objects[0]["key"] == "east/a"
+        assert objects[0]["cluster"] == "east" and objects[0]["origin_key"] == "a"
+        assert objects[0]["phase"] == "Running"
+        merge.apply_delta("east", {"type": "DELETE", "rv": 2, "kind": "pod", "key": "a"})
+        assert view.object_count() == 0 and merge.object_count() == 0
+
+    def test_reset_cluster_reconciles_vanished_keys(self):
+        view = FleetView()
+        merge = GlobalMerge(view)
+        merge.reset_cluster("c", [{"kind": "pod", "key": "a", "seq": 0},
+                                  {"kind": "pod", "key": "b", "seq": 0}])
+        assert view.object_count() == 2
+        # second snapshot: b vanished, a unchanged (no rv burn), c new
+        rv_before = view.rv
+        changed = merge.reset_cluster("c", [{"kind": "pod", "key": "a", "seq": 0},
+                                            {"kind": "pod", "key": "c", "seq": 1}])
+        assert changed == 2  # +c, -b; a was an identical-upsert no-op
+        assert view.rv == rv_before + 2
+        keys = {o["key"] for o in view.snapshot()[1]}
+        assert keys == {"c/a", "c/c"}
+
+    def test_clusters_do_not_collide(self):
+        view = FleetView()
+        merge = GlobalMerge(view)
+        for cluster in ("east", "west"):
+            merge.reset_cluster(cluster, [{"kind": "pod", "key": "a", "seq": cluster}])
+        assert view.object_count() == 2
+        merge.drop_cluster("east")
+        keys = {o["key"] for o in view.snapshot()[1]}
+        assert keys == {"west/a"}
+
+    def test_merged_object_gauge(self):
+        reg = MetricsRegistry()
+        merge = GlobalMerge(FleetView(), metrics=reg)
+        merge.reset_cluster("c", [{"kind": "pod", "key": "a"}])
+        assert reg.gauge("federation_merged_objects").value == 1.0
+
+    def test_seed_from_recovered_view_enables_ghost_deletion(self):
+        # a history-recovered federator restarts with federated objects
+        # ALREADY in the view; the registry must mirror them, or the
+        # first reconcile can't delete what vanished upstream while the
+        # federator was down (ghost objects served forever)
+        view = FleetView()
+        merge0 = GlobalMerge(view)
+        merge0.reset_cluster("c", [{"kind": "pod", "key": "a", "seq": 0},
+                                   {"kind": "pod", "key": "b", "seq": 0}])
+        # "restart": a fresh GlobalMerge over the same (recovered) view
+        merge = GlobalMerge(view)
+        assert merge.object_count() == 0  # the bug's shape, pre-seed
+        assert merge.seed_from_view() == 2
+        assert merge.cluster_object_count("c") == 2
+        # upstream deleted "b" during the outage: the reconcile must
+        # remove it from the global view
+        merge.reset_cluster("c", [{"kind": "pod", "key": "a", "seq": 0}])
+        assert {o["key"] for o in view.snapshot()[1]} == {"c/a"}
+        # and a dark-cluster drop actually drops recovered objects too
+        merge.drop_cluster("c")
+        assert view.object_count() == 0
+
+    def test_merged_equals_union_helper(self):
+        from k8s_watcher_tpu.federate import merged_equals_union
+
+        view = FleetView()
+        merge = GlobalMerge(view)
+        merge.reset_cluster("east", [{"kind": "pod", "key": "a", "phase": "Running"}])
+        merge.reset_cluster("west", [{"kind": "pod", "key": "a", "phase": "Pending"}])
+        upstreams = {
+            "east": [{"kind": "pod", "key": "a", "phase": "Running"}],
+            "west": [{"kind": "pod", "key": "a", "phase": "Pending"}],
+        }
+        assert merged_equals_union(view.snapshot()[1], upstreams)
+        # a drifted field fails it
+        upstreams["west"][0]["phase"] = "Running"
+        assert not merged_equals_union(view.snapshot()[1], upstreams)
+        # a missing object fails it
+        upstreams["west"][0]["phase"] = "Pending"
+        upstreams["east"].append({"kind": "pod", "key": "b", "phase": "Running"})
+        assert not merged_equals_union(view.snapshot()[1], upstreams)
+
+
+# -- FederationPlane over live upstreams --------------------------------------
+
+
+def _upstream_stack(port=0):
+    view = FleetView(compact_horizon=4096)
+    hub = SubscriptionHub(view, max_subscribers=8, queue_depth=1024)
+    server = ServeServer(view, hub, host="127.0.0.1", port=port).start()
+    return view, server
+
+
+def _fed_config(urls, **kw):
+    raw = {
+        "enabled": True,
+        "upstreams": [{"name": f"c{i}", "url": u} for i, u in enumerate(urls)],
+        "stale_after_seconds": kw.pop("stale_after_seconds", 1.0),
+        "resync_backoff_seconds": 0.1,
+    }
+    raw.update(kw)
+    return FederationConfig.from_raw(raw)
+
+
+class TestFederationPlaneLive:
+    def test_merges_two_upstreams_and_tracks_deltas(self):
+        (v1, s1), (v2, s2) = _upstream_stack(), _upstream_stack()
+        reg = MetricsRegistry()
+        gview = FleetView(metrics=reg)
+        plane = FederationPlane(
+            _fed_config([f"http://127.0.0.1:{s1.port}", f"http://127.0.0.1:{s2.port}"],
+                        stale_after_seconds=5.0),
+            gview, metrics=reg,
+        ).start()
+        try:
+            # churn only AFTER every subscriber snapshotted: otherwise the
+            # objects can all arrive via the initial reset_cluster and no
+            # watch DELTA ever flows (the deltas_applied assert below)
+            _wait_for(
+                lambda: all(u.subscriber.snapshots > 0 for u in plane.upstreams),
+                message="initial snapshots",
+            )
+            for i, v in enumerate((v1, v2)):
+                for j in range(4):
+                    v.apply("pod", f"p{j}", {"kind": "pod", "key": f"p{j}", "seq": i * 10 + j})
+            _wait_for(lambda: gview.object_count() == 8, message="merge convergence")
+            keys = {o["key"] for o in gview.snapshot()[1]}
+            assert keys == {f"c{i}/p{j}" for i in range(2) for j in range(4)}
+            _wait_for(lambda: plane.health()["healthy"], message="health convergence")
+            health = plane.health()
+            assert health["merged_objects"] == 8
+            assert all(u["gaps"] == 0 and u["dups"] == 0 for u in health["upstreams"].values())
+            assert reg.counter("federation_deltas_applied").value > 0
+        finally:
+            plane.stop()
+            s1.stop()
+            s2.stop()
+
+    def test_dark_upstream_degrades_health_keep_policy_retains_objects(self):
+        (v1, s1), (v2, s2) = _upstream_stack(), _upstream_stack()
+        gview = FleetView()
+        plane = FederationPlane(
+            _fed_config([f"http://127.0.0.1:{s1.port}", f"http://127.0.0.1:{s2.port}"]),
+            gview,
+        ).start()
+        try:
+            v1.apply("pod", "a", {"kind": "pod", "key": "a", "seq": 0})
+            v2.apply("pod", "b", {"kind": "pod", "key": "b", "seq": 0})
+            _wait_for(lambda: gview.object_count() == 2, message="merge convergence")
+            s1.stop()  # cluster c0 goes dark
+            _wait_for(lambda: plane.health()["healthy"] is False, timeout=15.0,
+                      message="staleness degradation")
+            health = plane.health()
+            assert health["upstreams"]["c0"]["stale"] is True
+            assert health["upstreams"]["c1"]["stale"] is False
+            # keep policy (drop_stale=False): last-known state stays served
+            assert {o["key"] for o in gview.snapshot()[1]} == {"c0/a", "c1/b"}
+        finally:
+            plane.stop()
+            s2.stop()
+
+    def test_drop_stale_removes_objects_and_recovery_restores(self):
+        port = _free_port()
+        v1, s1 = _upstream_stack(port)
+        gview = FleetView()
+        plane = FederationPlane(
+            _fed_config([f"http://127.0.0.1:{port}"], drop_stale=True),
+            gview,
+        ).start()
+        try:
+            v1.apply("pod", "a", {"kind": "pod", "key": "a", "seq": 0})
+            _wait_for(lambda: gview.object_count() == 1, message="merge convergence")
+            s1.stop()
+            _wait_for(lambda: gview.object_count() == 0, timeout=15.0,
+                      message="drop-stale removal")
+            # recovery: a fresh upstream on the same port (new instance —
+            # the epoch change forces the reconcile) restores the objects
+            v1b, s1b = _upstream_stack(port)
+            v1b.apply("pod", "a", {"kind": "pod", "key": "a", "seq": 1})
+            try:
+                _wait_for(lambda: gview.object_count() == 1, timeout=20.0,
+                          message="post-recovery restore")
+                _wait_for(lambda: plane.health()["healthy"], timeout=15.0,
+                          message="health recovery")
+            finally:
+                s1b.stop()
+        finally:
+            plane.stop()
+
+    def test_invalid_resume_tokens_cleared_at_start(self, tmp_path):
+        # unclean merged-view recovery (torn WAL / wiped dir): a persisted
+        # token could be AHEAD of the recovered state, so the plane must
+        # clear tokens and force re-snapshot reconciles instead of
+        # resuming over the lost window
+        store = TokenStore(tmp_path / "c0.token")
+        store.save(999, "old-epoch")
+        plane = FederationPlane(
+            _fed_config(["http://127.0.0.1:1"], stale_after_seconds=5.0),
+            FleetView(),
+            token_dir=str(tmp_path),
+            resume_tokens_valid=False,
+        )
+        plane.start()
+        try:
+            assert store.load() is None, "stale token must not survive an unclean restart"
+        finally:
+            plane.stop()
+        # and a CLEAN restart keeps them (the rollout fast path)
+        store.save(7, "epoch")
+        plane2 = FederationPlane(
+            _fed_config(["http://127.0.0.1:1"], stale_after_seconds=5.0),
+            FleetView(),
+            token_dir=str(tmp_path),
+            resume_tokens_valid=True,
+        )
+        plane2.start()
+        try:
+            assert store.load() == (7, "epoch")
+        finally:
+            plane2.stop()
+
+    def test_healthz_and_debug_route_fold_federation(self):
+        # StatusServer integration: the federation verdict rides the
+        # /healthz BODY (readiness/alerting) but deliberately does NOT
+        # flip liveness to 503 — /healthz is the kubelet livenessProbe
+        # target, and restarting the federator cannot revive a dark
+        # REMOTE cluster (a 503 would crash-loop it, wiping the
+        # last-known state the keep policy serves). /debug/federation
+        # carries the full detail.
+        import requests
+
+        verdict = {"healthy": False, "upstreams": {"c0": {"stale": True}}}
+        status = StatusServer(
+            MetricsRegistry(), Liveness(900.0), host="127.0.0.1", port=0,
+            federation=lambda: verdict,
+        ).start()
+        base = f"http://127.0.0.1:{status.port}"
+        try:
+            r = requests.get(f"{base}/healthz", timeout=5)
+            assert r.status_code == 200, "remote staleness must not kill liveness"
+            assert r.json()["alive"] is True
+            assert r.json()["federation"]["healthy"] is False
+            dbg = requests.get(f"{base}/debug/federation", timeout=5)
+            assert dbg.status_code == 200
+            assert dbg.json()["federation"]["upstreams"]["c0"]["stale"] is True
+        finally:
+            status.stop()
+
+
+# -- config schema ------------------------------------------------------------
+
+
+class TestFederationConfigSchema:
+    def test_defaults_off(self):
+        cfg = FederationConfig.from_raw({})
+        assert cfg.enabled is False and cfg.upstreams == ()
+        assert cfg.stale_after_seconds == 10.0 and cfg.drop_stale is False
+
+    def test_enabled_requires_upstreams(self):
+        with pytest.raises(SchemaError, match="at least one upstream"):
+            FederationConfig.from_raw({"enabled": True, "upstreams": []})
+
+    def test_upstream_requires_url(self):
+        with pytest.raises(SchemaError, match="url.*required"):
+            FederationConfig.from_raw({"upstreams": [{"name": "a"}]})
+
+    def test_duplicate_names_rejected(self):
+        with pytest.raises(SchemaError, match="duplicate upstream name"):
+            FederationConfig.from_raw({
+                "upstreams": [{"name": "a", "url": "http://x:1"},
+                              {"name": "a", "url": "http://y:2"}],
+            })
+
+    def test_name_defaults_to_netloc(self):
+        cfg = FederationConfig.from_raw({"upstreams": [{"url": "http://host.example:8090"}]})
+        assert cfg.upstreams[0].name == "host.example:8090"
+
+    def test_name_with_slash_rejected(self):
+        # "/" is the cluster/key separator in merged global keys: a name
+        # containing it would make split_global_key misattribute the
+        # cluster, and "us" vs "us/east" could mint colliding global keys
+        with pytest.raises(SchemaError, match="must not contain '/'"):
+            FederationConfig.from_raw({
+                "upstreams": [{"name": "us/east", "url": "http://x:1"}],
+            })
+
+    def test_sanitized_name_collision_rejected(self):
+        # "us-east.1" and "us-east_1" both sanitize to "us_east_1": they
+        # would alias one resume-token file (each restart resuming with
+        # the OTHER cluster's token) and one set of lag/stale gauges
+        with pytest.raises(SchemaError, match="sanitization"):
+            FederationConfig.from_raw({
+                "upstreams": [{"name": "us-east.1", "url": "http://x:1"},
+                              {"name": "us-east_1", "url": "http://y:2"}],
+            })
+
+    def test_non_positive_timings_rejected(self):
+        with pytest.raises(SchemaError, match="stale_after_seconds"):
+            FederationConfig.from_raw({"stale_after_seconds": 0})
+        with pytest.raises(SchemaError, match="resync_backoff_seconds"):
+            FederationConfig.from_raw({"resync_backoff_seconds": -1})
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(SchemaError, match="unknown config key"):
+            FederationConfig.from_raw({"bogus": 1})
+
+    def test_requires_serve_enabled(self):
+        raw = {
+            "federation": {"enabled": True,
+                           "upstreams": [{"url": "http://x:1"}]},
+        }
+        with pytest.raises(SchemaError, match="requires serve.enabled"):
+            AppConfig.from_raw(raw, "development")
+        raw["serve"] = {"enabled": True}
+        cfg = AppConfig.from_raw(raw, "development")
+        assert cfg.federation.enabled and len(cfg.federation.upstreams) == 1
