@@ -896,6 +896,11 @@ def metric_safe_name(name: str) -> str:
     return re.sub(r"[^0-9a-zA-Z_]", "_", name)
 
 
+#: serve wire codec preferences a federation subscriber may be pinned to
+#: (mirrors federate/client.py: "auto" offers msgpack + JSON fallback)
+VALID_SERVE_CODECS = ("auto", "json", "msgpack")
+
+
 @dataclasses.dataclass(frozen=True)
 class FederationUpstream:
     """One upstream serving plane the federation tier subscribes to."""
@@ -929,6 +934,14 @@ class FederationConfig:
     # False (default): keep last-known state, surface staleness via
     # /healthz + federation_upstream_stale — zero rv churn on a blip.
     drop_stale: bool = False
+    # serve wire codec preference for the upstream subscribers: "auto"
+    # (default) offers msgpack and falls back transparently to JSON when
+    # the peer or the local import lacks it (the downgrade is logged
+    # once per upstream); "msgpack" is the same offer with a WARNING
+    # posture; "json" never offers msgpack (debugging / byte-stable
+    # wire captures). The codec changes wire bytes only — decoded
+    # frames are identical.
+    codec: str = "auto"
 
     @classmethod
     def from_raw(cls, raw: Mapping[str, Any]) -> "FederationConfig":
@@ -936,7 +949,7 @@ class FederationConfig:
         _check_known(
             raw,
             ("enabled", "upstreams", "stale_after_seconds",
-             "resync_backoff_seconds", "drop_stale"),
+             "resync_backoff_seconds", "drop_stale", "codec"),
             path,
         )
         enabled = _opt_bool(raw, "enabled", path, False)
@@ -1004,12 +1017,19 @@ class FederationConfig:
             raise SchemaError(
                 f"config key '{path}.resync_backoff_seconds': must be > 0, got {backoff}"
             )
+        codec = _opt_str(raw, "codec", path, "auto")
+        if codec not in VALID_SERVE_CODECS:
+            raise SchemaError(
+                f"config key '{path}.codec': must be one of "
+                f"{', '.join(VALID_SERVE_CODECS)}, got {codec!r}"
+            )
         return cls(
             enabled=enabled,
             upstreams=tuple(upstreams),
             stale_after_seconds=stale_after,
             resync_backoff_seconds=backoff,
             drop_stale=_opt_bool(raw, "drop_stale", path, False),
+            codec=codec,
         )
 
 

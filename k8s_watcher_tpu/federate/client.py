@@ -45,14 +45,26 @@ from __future__ import annotations
 
 import http.client
 import json
+import logging
 import os
 import random
+import select
 import socket
 import ssl
 import threading
 import time
 from typing import Any, Callable, Dict, Iterable, Iterator, List, NamedTuple, Optional, Tuple
 from urllib.parse import urlencode, urlsplit
+
+logger = logging.getLogger(__name__)
+
+# msgpack: the compact wire codec (the image bakes it in; a stripped
+# environment downgrades to JSON — the serve protocol is negotiated, so
+# a codec mismatch can never fail a request, only widen it)
+try:
+    import msgpack as _msgpack
+except ImportError:  # pragma: no cover - the image bakes msgpack in
+    _msgpack = None
 
 #: wire frame / delta types (mirrors serve.view — kept literal here so the
 #: client stays importable without dragging the serve plane in)
@@ -61,6 +73,16 @@ DELETE = "DELETE"
 SYNC = "SYNC"
 COMPACTED = "COMPACTED"
 GONE = "GONE"
+
+#: wire codec names + content types (mirrors serve.view, same reason)
+CODEC_JSON = "json"
+CODEC_MSGPACK = "msgpack"
+CODEC_AUTO = "auto"
+JSON_CONTENT_TYPE = "application/json"
+MSGPACK_CONTENT_TYPE = "application/x-msgpack"
+#: bytes per chunked read off a watch stream: one read's decoded frames
+#: form ONE delivery batch downstream (the fan-in batching unit)
+WATCH_READ_BYTES = 1 << 16
 
 
 class ServeProtocolError(RuntimeError):
@@ -220,7 +242,15 @@ class FleetClient:
     notify/client.py): one connection per request for snapshot/long-poll
     (they are rare and bounded), one connection per ``watch()`` window
     (held open for the whole chunked stream). ``retarget()`` repoints an
-    existing client (an upstream that restarted onto a new address)."""
+    existing client (an upstream that restarted onto a new address).
+
+    Wire codec: ``codec`` is the *preference* — ``auto`` (the default)
+    offers ``application/x-msgpack`` and falls back transparently to
+    JSON when the peer (or this process's import) lacks it; ``msgpack``
+    is the same offer with a louder posture (the downgrade is WARNING,
+    not DEBUG); ``json`` never offers msgpack. The peer's Content-Type
+    decides what actually rides the wire (``active_codec``); a downgrade
+    is logged ONCE per client, not once per reconnect."""
 
     def __init__(
         self,
@@ -229,10 +259,25 @@ class FleetClient:
         token: Optional[str] = None,
         timeout: float = 10.0,
         verify_tls: bool = True,
+        codec: str = CODEC_AUTO,
     ):
         self.token = token
         self.timeout = timeout
         self.verify_tls = verify_tls
+        if codec not in (CODEC_AUTO, CODEC_JSON, CODEC_MSGPACK):
+            raise ValueError(f"unknown serve wire codec {codec!r}")
+        self.codec_preference = codec
+        #: what the LAST response actually used (observability + smokes)
+        self.active_codec = CODEC_JSON
+        self._downgrade_logged = False
+        if codec == CODEC_MSGPACK and _msgpack is None:
+            # the local import, not the peer, is the limiting side: say so
+            # now, once, instead of per request
+            logger.warning(
+                "msgpack wire codec requested but msgpack is not importable; "
+                "downgrading to JSON for %s", base_url,
+            )
+            self._downgrade_logged = True
         self.base_url = ""
         self._scheme = "http"
         self._host = ""
@@ -263,17 +308,71 @@ class FleetClient:
             return http.client.HTTPSConnection(self._host, self._port, timeout=timeout, context=ctx)
         return http.client.HTTPConnection(self._host, self._port, timeout=timeout)
 
+    def _wants_msgpack(self) -> bool:
+        return (
+            self.codec_preference in (CODEC_AUTO, CODEC_MSGPACK)
+            and _msgpack is not None
+        )
+
     def _headers(self) -> Dict[str, str]:
-        headers = {"Accept": "application/json", "Connection": "close"}
+        accept = JSON_CONTENT_TYPE
+        if self._wants_msgpack():
+            # preference order left to right; the server picks the first
+            # content type it can actually encode
+            accept = f"{MSGPACK_CONTENT_TYPE}, {JSON_CONTENT_TYPE}"
+        headers = {"Accept": accept, "Connection": "close"}
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
         return headers
 
+    def _note_codec(self, served: str) -> None:
+        """Record what the peer actually served; log the msgpack->JSON
+        downgrade ONCE per client (a reconnecting subscriber must not
+        repeat it every backoff cycle)."""
+        self.active_codec = served
+        if (
+            served == CODEC_JSON
+            and self._wants_msgpack()
+            and not self._downgrade_logged
+        ):
+            self._downgrade_logged = True
+            log = logger.warning if self.codec_preference == CODEC_MSGPACK else logger.info
+            log(
+                "Upstream %s does not speak msgpack; serving JSON instead "
+                "(logged once per client)", self.base_url,
+            )
+
     @staticmethod
-    def _body_json(resp: http.client.HTTPResponse) -> dict:
+    def _response_codec(resp: http.client.HTTPResponse) -> str:
+        ctype = (resp.getheader("Content-Type") or "").lower()
+        return CODEC_MSGPACK if MSGPACK_CONTENT_TYPE in ctype else CODEC_JSON
+
+    def _decode_body(self, resp: http.client.HTTPResponse) -> dict:
+        """Decode one bounded response body by its Content-Type (the
+        negotiation's answer), tracking the active codec."""
+        data = resp.read()
+        codec = self._response_codec(resp)
+        self._note_codec(codec)
+        if codec == CODEC_MSGPACK:
+            return _msgpack.unpackb(data, raw=False, strict_map_key=False)
+        return json.loads(data)
+
+    def _body_json(self, resp: http.client.HTTPResponse) -> dict:
+        """Best-effort body decode for error paths (either codec; a
+        non-body answer decodes to {})."""
         try:
-            return json.loads(resp.read() or b"{}")
-        except (ValueError, OSError):
+            data = resp.read() or b"{}"
+        except OSError:
+            return {}
+        if self._response_codec(resp) == CODEC_MSGPACK:
+            try:
+                body = _msgpack.unpackb(data, raw=False, strict_map_key=False)
+                return body if isinstance(body, dict) else {}
+            except Exception:  # noqa: BLE001 - error bodies are advisory
+                return {}
+        try:
+            return json.loads(data)
+        except ValueError:
             return {}
 
     def _raise_for_status(self, resp: http.client.HTTPResponse) -> None:
@@ -295,7 +394,7 @@ class FleetClient:
             conn.request("GET", self._prefix + path, headers=self._headers())
             resp = conn.getresponse()
             self._raise_for_status(resp)
-            return json.loads(resp.read())
+            return self._decode_body(resp)
         finally:
             conn.close()
 
@@ -345,7 +444,7 @@ class FleetClient:
             bool(body.get("compacted")), body.get("items", []),
         )
 
-    def watch(
+    def watch_batches(
         self,
         rv: int,
         *,
@@ -354,16 +453,37 @@ class FleetClient:
         read_timeout: Optional[float] = None,
         limit: Optional[int] = None,
         on_conn: Optional[Callable[[http.client.HTTPConnection], None]] = None,
-    ) -> Iterator[Dict[str, Any]]:
-        """One ``?watch=1`` stream window: yields decoded frames (SYNC /
-        UPSERT / DELETE / COMPACTED / GONE dicts) until the server closes
-        the window. ``read_timeout`` bounds the wait for EACH frame — the
+    ) -> Iterator[List[Dict[str, Any]]]:
+        """One ``?watch=1`` stream window, yielding frame BATCHES: every
+        chunked read off the socket (``read1``, up to ``WATCH_READ_BYTES``)
+        decodes into one list of frames (SYNC / UPSERT / DELETE /
+        COMPACTED / GONE dicts) — the fan-in batching unit. A publisher
+        batch the server wrote in one pass arrives in one read and is
+        handed downstream in one call, so the consumer amortizes its own
+        apply cost the same way the server amortized its encode cost.
+
+        The serve wire frames each delta as its own chunked-transfer
+        chunk (the encode-once frame bytes INCLUDE the chunk framing, so
+        the server cannot coalesce them without re-encoding), and
+        ``http.client``'s ``read1`` returns at most ONE chunk — so one
+        blocking read is followed by a zero-timeout drain of every chunk
+        already queued on the socket (up to ``WATCH_READ_BYTES``). Under
+        a trickle each batch is ~1 frame; when the consumer falls behind
+        a churn storm the backlog arrives queued and batches grow to
+        exactly the size the amortization needs.
+
+        ``read_timeout`` bounds the wait for EACH blocking read — the
         SYNC heartbeat cadence is 2 s, so a read that outwaits
         ``read_timeout`` means the upstream stalled (socket.timeout
         propagates; the caller reconnects). Pre-stream 410 raises
         ``ResyncRequired`` before any frame is yielded. ``on_conn``
         receives the live connection before the request is sent — a
-        stopper can close it to abort a blocked read immediately."""
+        stopper can close it to abort a blocked read immediately.
+
+        Codec: negotiated per the client preference; msgpack frames are
+        self-delimiting (fed through a streaming unpacker), JSON frames
+        are newline-delimited lines — either way one read yields one
+        batch, and the decoded dicts are identical across codecs."""
         params = {"watch": "1", "rv": rv, "timeout": window_seconds}
         if view:
             params["view"] = view
@@ -377,16 +497,90 @@ class FleetClient:
             resp = conn.getresponse()
             self._raise_for_status(resp)
             # http.client strips the chunked-transfer framing; what is
-            # left is exactly the JSON-line frame stream
-            while True:
-                line = resp.readline()
-                if not line:
-                    return  # clean window end (terminal chunk)
-                line = line.strip()
-                if line:
-                    yield json.loads(line)
+            # left is the codec's raw frame stream
+            codec = self._response_codec(resp)
+            self._note_codec(codec)
+            if codec == CODEC_MSGPACK:
+                unpacker = _msgpack.Unpacker(raw=False, strict_map_key=False)
+                while True:
+                    chunks, eof = self._drain_chunks(resp, conn.sock)
+                    for data in chunks:
+                        unpacker.feed(data)
+                    batch = [frame for frame in unpacker]
+                    if batch:
+                        yield batch
+                    if eof:
+                        return  # clean window end (terminal chunk)
+            else:
+                buf = b""
+                while True:
+                    chunks, eof = self._drain_chunks(resp, conn.sock)
+                    data = b"".join(chunks)
+                    buf += data
+                    if b"\n" in data:
+                        lines = buf.split(b"\n")
+                        buf = lines.pop()  # partial tail carries over
+                        batch = [json.loads(line) for line in lines if line.strip()]
+                        if batch:
+                            yield batch
+                    if eof:
+                        # leftover partial line = the peer died mid-frame;
+                        # there is nothing decodable left to deliver
+                        return
         finally:
             conn.close()
+
+    @staticmethod
+    def _drain_chunks(resp, sock) -> Tuple[List[bytes], bool]:
+        """One blocking ``read1`` (bounded by the socket timeout), then a
+        zero-timeout drain of every further chunk the kernel already has
+        — up to ``WATCH_READ_BYTES`` total, so a deep backlog paces into
+        bounded batches instead of one giant buffer. Returns
+        ``(chunks, eof)``. A chunk sitting in the response's own buffered
+        reader when the socket shows nothing new just lands at the head
+        of the NEXT batch (the following blocking read returns it without
+        waiting) — fragmentation, never a stall."""
+        data = resp.read1(WATCH_READ_BYTES)
+        if not data:
+            return [], True
+        chunks = [data]
+        total = len(data)
+        while total < WATCH_READ_BYTES:
+            if sock is None:
+                break
+            try:
+                if not select.select([sock], [], [], 0)[0]:
+                    break
+            except (OSError, ValueError):
+                break  # racing close (stop()); the next read raises
+            more = resp.read1(WATCH_READ_BYTES - total)
+            if not more:
+                return chunks, True
+            chunks.append(more)
+            total += len(more)
+        return chunks, False
+
+    def watch(
+        self,
+        rv: int,
+        *,
+        view: Optional[str] = None,
+        window_seconds: float = 30.0,
+        read_timeout: Optional[float] = None,
+        limit: Optional[int] = None,
+        on_conn: Optional[Callable[[http.client.HTTPConnection], None]] = None,
+    ) -> Iterator[Dict[str, Any]]:
+        """``watch_batches`` flattened to one frame per yield — the
+        per-frame shape for consumers that don't batch."""
+        for batch in self.watch_batches(
+            rv,
+            view=view,
+            window_seconds=window_seconds,
+            read_timeout=read_timeout,
+            limit=limit,
+            on_conn=on_conn,
+        ):
+            yield from batch
 
 
 class TokenStore:
@@ -485,8 +679,11 @@ class FleetSubscriber:
     - a clean window end: reconnect immediately (the resume protocol).
 
     Callbacks run on the subscriber's thread: ``on_snapshot(Snapshot)``
-    replaces downstream state wholesale, ``on_delta(frame)`` folds one
-    UPSERT/DELETE. The ``SequenceChecker`` rides every delivery."""
+    replaces downstream state wholesale; ``on_batch(frames)`` folds one
+    wire-read's worth of UPSERT/DELETE frames in one call (the fan-in
+    batching unit — the federation plane folds it under one lock), or
+    ``on_delta(frame)`` folds them one at a time when no batch handler
+    is given. The ``SequenceChecker`` rides every delivery either way."""
 
     def __init__(
         self,
@@ -494,6 +691,7 @@ class FleetSubscriber:
         *,
         on_snapshot: Optional[Callable[[Snapshot], None]] = None,
         on_delta: Optional[Callable[[Dict[str, Any]], None]] = None,
+        on_batch: Optional[Callable[[List[Dict[str, Any]]], None]] = None,
         token_store: Optional[TokenStore] = None,
         stale_after_seconds: float = 10.0,
         backoff_seconds: float = 1.0,
@@ -506,6 +704,7 @@ class FleetSubscriber:
         self.client = client
         self.on_snapshot = on_snapshot
         self.on_delta = on_delta
+        self.on_batch = on_batch
         self.token_store = token_store
         # the stream heartbeats every 2 s when idle; anything sub-3s
         # would call a healthy idle stream dead
@@ -526,6 +725,7 @@ class FleetSubscriber:
         self.snapshots = 0
         self.stalls = 0
         self.frames = 0
+        self.batches = 0  # wire-read batches delivered (frames/batches = fan-in batch size)
         self.connected = False
         self.last_error: Optional[str] = None
         self._last_frame_t = 0.0  # 0 = never
@@ -670,11 +870,24 @@ class FleetSubscriber:
             except OSError:
                 pass
 
+    def _deliver(self, run: List[Dict[str, Any]]) -> None:
+        """Hand one contiguous UPSERT/DELETE run downstream: one
+        ``on_batch`` call (the batched fan-in path) or per-frame
+        ``on_delta`` fallback. Sequence checking and cursor advance
+        already happened — delivery is pure application."""
+        if not run:
+            return
+        if self.on_batch is not None:
+            self.on_batch(run)
+        elif self.on_delta is not None:
+            for frame in run:
+                self.on_delta(frame)
+
     def _watch_window(self) -> None:
         assert self.rv is not None
         compacted_until = -1  # COMPACTED sanctions skips up to this rv
         deltas_since_save = 0
-        for frame in self.client.watch(
+        for batch in self.client.watch_batches(
             self.rv,
             view=self.view,
             window_seconds=self.window_seconds,
@@ -682,42 +895,64 @@ class FleetSubscriber:
             on_conn=self._register_conn,
         ):
             if self._stop.is_set():
-                # BEFORE applying: a frame racing stop() must not reach
+                # BEFORE applying: a batch racing stop() must not reach
                 # the downstream view after the caller's join returned
                 # (e.g. after the history WAL's terminal snapshot)
                 return
             self._last_frame_t = time.monotonic()
             self.connected = True
-            self.frames += 1
-            ftype = frame.get("type")
-            if ftype in (UPSERT, DELETE):
-                rv = frame["rv"]
-                self.checker.observe_stream_rv(self.rv, rv, rv <= compacted_until)
-                self.wire_rv = max(self.wire_rv, rv)
-                if self.on_delta is not None:
-                    self.on_delta(frame)
-                self.rv = max(self.rv, rv)
-                deltas_since_save += 1
-                if deltas_since_save >= 256:
-                    # periodic persistence bounds replay-after-crash; the
-                    # per-SYNC save below covers the idle/stream-end cases
-                    self._save_token(self.rv, self.view or "")
+            self.frames += len(batch)
+            self.batches += 1
+            # one wire read = one delivery batch; control frames split a
+            # batch into contiguous delta runs so apply order matches
+            # wire order exactly. The resume cursor (self.rv) advances
+            # only AFTER a run is delivered: if a downstream callback
+            # raises a retried exception class mid-apply, the reconnect
+            # resumes from the last delivered rv and the run is simply
+            # redelivered — never silently skipped.
+            run: List[Dict[str, Any]] = []
+            prev_rv = self.rv or 0
+
+            def commit_run() -> None:
+                nonlocal run
+                if run:
+                    self._deliver(run)
+                    run = []
+                self.rv = max(self.rv, prev_rv)
+
+            for frame in batch:
+                ftype = frame.get("type")
+                if ftype in (UPSERT, DELETE):
+                    rv = frame["rv"]
+                    self.checker.observe_stream_rv(prev_rv, rv, rv <= compacted_until)
+                    self.wire_rv = max(self.wire_rv, rv)
+                    run.append(frame)
+                    prev_rv = max(prev_rv, rv)
+                    deltas_since_save += 1
+                    continue
+                commit_run()
+                if ftype == SYNC:
+                    rv = frame.get("rv", self.rv)
+                    self.wire_rv = max(self.wire_rv, rv)
+                    if rv > self.rv:
+                        self.rv = rv  # idle SYNC advances the resume token
+                    prev_rv = max(prev_rv, self.rv)
+                    self._save_token(self.rv, frame.get("view") or self.view or "")
                     deltas_since_save = 0
-            elif ftype == SYNC:
-                rv = frame.get("rv", self.rv)
-                self.wire_rv = max(self.wire_rv, rv)
-                if rv > self.rv:
-                    self.rv = rv  # idle SYNC advances the resume token
-                self._save_token(self.rv, frame.get("view") or self.view or "")
+                elif ftype == COMPACTED:
+                    compacted_until = max(compacted_until, frame.get("to_rv", -1))
+                    self.checker.compacted_batches += 1
+                elif ftype == GONE:
+                    raise ResyncRequired(
+                        "in-band GONE (fell behind the horizon mid-stream)",
+                        status=410, body=frame,
+                    )
+            commit_run()
+            if deltas_since_save >= 256:
+                # periodic persistence bounds replay-after-crash; the
+                # per-SYNC save above covers the idle/stream-end cases
+                self._save_token(self.rv, self.view or "")
                 deltas_since_save = 0
-            elif ftype == COMPACTED:
-                compacted_until = max(compacted_until, frame.get("to_rv", -1))
-                self.checker.compacted_batches += 1
-            elif ftype == GONE:
-                raise ResyncRequired(
-                    "in-band GONE (fell behind the horizon mid-stream)",
-                    status=410, body=frame,
-                )
         if deltas_since_save:
             self._save_token(self.rv, self.view or "")
 
@@ -731,6 +966,8 @@ class FleetSubscriber:
             "view": self.view,
             "last_frame_age_seconds": round(age, 3) if age is not None else None,
             "frames": self.frames,
+            "batches": self.batches,
+            "codec": self.client.active_codec,
             "snapshots": self.snapshots,
             "reconnects": self.reconnects,
             "resyncs": self.resyncs,

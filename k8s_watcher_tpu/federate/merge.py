@@ -96,13 +96,19 @@ class GlobalMerge:
         self.drop_stale = drop_stale
         self._lock = threading.Lock()
         self._keys: Dict[str, Set[Tuple[str, str]]] = {}  # cluster -> {(kind, upstream key)}
+        # running registry size, maintained incrementally on every
+        # add/discard/reset/drop: the merged-object gauge used to
+        # recompute sum(len(k)) per DELTA — O(clusters) work inside the
+        # fan-in hot path for a number that only moves by what the
+        # mutation itself changed
+        self._count = 0
         self._merged_gauge = (
             metrics.gauge("federation_merged_objects") if metrics is not None else None
         )
 
     def _set_gauge_locked(self) -> None:
         if self._merged_gauge is not None:
-            self._merged_gauge.set(sum(len(k) for k in self._keys.values()))
+            self._merged_gauge.set(self._count)
 
     def seed_from_view(self) -> int:
         """Adopt federated objects ALREADY in the view (a history-recovered
@@ -119,7 +125,11 @@ class GlobalMerge:
                 origin = obj.get("origin_key")
                 if not cluster or not origin:
                     continue  # the local watcher's own (non-federated) objects
-                self._keys.setdefault(cluster, set()).add((obj.get("kind") or "pod", origin))
+                keys = self._keys.setdefault(cluster, set())
+                entry = (obj.get("kind") or "pod", origin)
+                if entry not in keys:
+                    keys.add(entry)
+                    self._count += 1
                 seeded += 1
             self._set_gauge_locked()
         return seeded
@@ -132,59 +142,100 @@ class GlobalMerge:
     def reset_cluster(self, cluster: str, objects) -> int:
         """Adopt a full upstream snapshot (initial connect, epoch change,
         every 410 resync): upsert all current objects, delete the global
-        keys that vanished. Returns the number of view deltas actually
-        minted (identical upserts are free)."""
-        changed = 0
+        keys that vanished — ONE registry-lock acquisition and ONE view
+        publish-lock hold for the whole reconcile. Returns the number of
+        view deltas actually minted (identical upserts are free, so a
+        clean reconcile after a blip costs exactly the real deltas)."""
         fresh: Set[Tuple[str, str]] = set()
+        items: list = []
         for obj in objects:
             kind = obj.get("kind") or "pod"
             key = obj.get("key")
             if not key:
                 continue
             fresh.add((kind, key))
-            if self.view.apply(kind, global_key(cluster, key),
-                               self._decorate(cluster, kind, key, obj)):
-                changed += 1
+            items.append((kind, global_key(cluster, key),
+                          self._decorate(cluster, kind, key, obj)))
         with self._lock:
             stale = self._keys.get(cluster, set()) - fresh
+            self._count += len(fresh) - len(self._keys.get(cluster, ()))
             self._keys[cluster] = fresh
             self._set_gauge_locked()
-        for kind, key in stale:
-            if self.view.apply(kind, global_key(cluster, key), None):
-                changed += 1
-        return changed
+        items.extend((kind, global_key(cluster, key), None) for kind, key in stale)
+        return self.view.apply_batch(items)
 
     def apply_delta(self, cluster: str, item: Dict[str, Any]) -> bool:
         """Fold one wire delta (UPSERT/DELETE frame dict) from ``cluster``.
-        Returns True when the global view actually changed."""
+        Returns True when the global view actually changed. The per-delta
+        shape — one publish-lock hold, one wakeup, one registry-lock
+        acquisition per frame; ``apply_batch`` is the amortized path the
+        subscriber loop feeds (this stays as the bench's per-delta-apply
+        baseline and the one-off-mutation convenience)."""
         kind = item.get("kind") or "pod"
         key = item["key"]
         gkey = global_key(cluster, key)
         if item["type"] == DELETE:
             changed = self.view.apply(kind, gkey, None)
             with self._lock:
-                self._keys.setdefault(cluster, set()).discard((kind, key))
+                keys = self._keys.setdefault(cluster, set())
+                if (kind, key) in keys:
+                    keys.discard((kind, key))
+                    self._count -= 1
                 self._set_gauge_locked()
             return changed
         changed = self.view.apply(
             kind, gkey, self._decorate(cluster, kind, key, item.get("object") or {})
         )
         with self._lock:
-            self._keys.setdefault(cluster, set()).add((kind, key))
+            keys = self._keys.setdefault(cluster, set())
+            if (kind, key) not in keys:
+                keys.add((kind, key))
+                self._count += 1
+            self._set_gauge_locked()
+        return changed
+
+    def apply_batch(self, cluster: str, items) -> int:
+        """Fold one decoded wire-frame batch (the subscriber loop hands
+        over whatever one chunked read carried) under ONE registry-lock
+        acquisition and ONE view publish-lock hold — the fan-in analogue
+        of the pipeline's ``publish_batch``. Frames apply in wire order,
+        so per-(cluster,key) last-writer-wins is preserved; the view
+        dedups identical upserts exactly as the per-delta path does.
+        Returns the number of global-view deltas minted."""
+        view_items: list = []
+        for item in items:
+            kind = item.get("kind") or "pod"
+            key = item["key"]
+            if item["type"] == DELETE:
+                view_items.append((kind, global_key(cluster, key), None))
+            else:
+                view_items.append((kind, global_key(cluster, key),
+                                   self._decorate(cluster, kind, key, item.get("object") or {})))
+        changed = self.view.apply_batch(view_items)
+        with self._lock:
+            keys = self._keys.setdefault(cluster, set())
+            before = len(keys)
+            for item, (kind, _gkey, obj) in zip(items, view_items):
+                entry = (kind, item["key"])
+                if obj is None:
+                    keys.discard(entry)
+                else:
+                    keys.add(entry)
+            self._count += len(keys) - before
             self._set_gauge_locked()
         return changed
 
     def drop_cluster(self, cluster: str) -> int:
         """The ``drop_stale: true`` policy arm: remove a dark upstream's
-        objects from the global view. Returns deltas minted."""
+        objects from the global view (one batched publish). Returns
+        deltas minted."""
         with self._lock:
             keys = self._keys.pop(cluster, set())
+            self._count -= len(keys)
             self._set_gauge_locked()
-        dropped = 0
-        for kind, key in keys:
-            if self.view.apply(kind, global_key(cluster, key), None):
-                dropped += 1
-        return dropped
+        return self.view.apply_batch(
+            [(kind, global_key(cluster, key), None) for kind, key in keys]
+        )
 
     def cluster_object_count(self, cluster: str) -> int:
         with self._lock:
@@ -192,7 +243,7 @@ class GlobalMerge:
 
     def object_count(self) -> int:
         with self._lock:
-            return sum(len(k) for k in self._keys.values())
+            return self._count
 
     def snapshot_cluster(self, cluster: str) -> Optional[Set[Tuple[str, str]]]:
         with self._lock:

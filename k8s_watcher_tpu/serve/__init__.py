@@ -5,9 +5,14 @@ ARCHITECTURE.md "Serving plane")."""
 from k8s_watcher_tpu.serve.broadcast import BroadcastLoop
 from k8s_watcher_tpu.serve.server import ServePlane, ServeServer
 from k8s_watcher_tpu.serve.view import (
+    CODEC_JSON,
+    CODEC_MSGPACK,
+    CODECS,
     DELETE,
     GONE,
     INVALID,
+    JSON_CONTENT_TYPE,
+    MSGPACK_CONTENT_TYPE,
     OK,
     UPSERT,
     Delta,
@@ -17,13 +22,20 @@ from k8s_watcher_tpu.serve.view import (
     Subscription,
     SubscriptionHub,
     chunk_frame,
+    frame_body,
     frame_payload,
+    msgpack_available,
 )
 
 __all__ = [
+    "CODEC_JSON",
+    "CODEC_MSGPACK",
+    "CODECS",
     "DELETE",
     "GONE",
     "INVALID",
+    "JSON_CONTENT_TYPE",
+    "MSGPACK_CONTENT_TYPE",
     "OK",
     "UPSERT",
     "BroadcastLoop",
@@ -36,5 +48,7 @@ __all__ = [
     "Subscription",
     "SubscriptionHub",
     "chunk_frame",
+    "frame_body",
     "frame_payload",
+    "msgpack_available",
 ]

@@ -47,6 +47,7 @@ from collections import deque
 from typing import Deque, Dict, List, Optional, Union
 
 from k8s_watcher_tpu.serve.view import (
+    CODEC_JSON,
     GONE,
     OK,
     FleetView,
@@ -79,7 +80,7 @@ class _StreamClient:
     __slots__ = (
         "sock", "fd", "sub", "limit", "deadline", "hard_deadline",
         "last_frame", "buf", "buf_bytes", "closing", "view_id",
-        "want_write",
+        "want_write", "codec",
     )
 
     def __init__(
@@ -90,6 +91,7 @@ class _StreamClient:
         deadline: float,
         limit: Optional[int],
         view_id: str,
+        codec: str = CODEC_JSON,
     ):
         self.sock = sock
         self.fd = sock.fileno()
@@ -106,6 +108,10 @@ class _StreamClient:
         self.closing = False  # terminal bytes queued; close once drained
         self.view_id = view_id
         self.want_write = False
+        # negotiated wire codec: frames pulled (and control frames
+        # synthesized) in this codec; the per-codec frame arrays are
+        # shared across every subscriber on the same codec
+        self.codec = codec
 
 
 class _LoopWorker(threading.Thread):
@@ -274,7 +280,7 @@ class _LoopWorker(threading.Thread):
                 continue
             if client.sub.rv >= view_rv:
                 continue
-            result = client.sub.pull_frames(limit=client.limit)
+            result = client.sub.pull_frames(limit=client.limit, codec=client.codec)
             if result.status == GONE:
                 self._queue_control(
                     client,
@@ -362,7 +368,7 @@ class _LoopWorker(threading.Thread):
             self.loop.fanout_bytes.inc(total)
 
     def _queue_control(self, client: _StreamClient, obj: dict) -> None:
-        frame = chunk_frame(obj)
+        frame = chunk_frame(obj, client.codec)
         client.buf.append(frame)
         client.buf_bytes += len(frame)
         if self.loop.fanout_bytes is not None:
@@ -542,6 +548,7 @@ class BroadcastLoop:
         timeout: float,
         limit: Optional[int],
         view_id: str,
+        codec: str = CODEC_JSON,
     ) -> None:
         """Adopt a handed-off socket (headers already written by the HTTP
         front). The loop owns the socket AND the subscription from here —
@@ -551,6 +558,7 @@ class BroadcastLoop:
             deadline=time.monotonic() + timeout,
             limit=limit,
             view_id=view_id,
+            codec=codec,
         )
         # round-robin across LIVE workers only: a dead loop's inbox is a
         # black hole (stream never admitted, slot never freed) — the

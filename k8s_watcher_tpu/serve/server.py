@@ -31,6 +31,15 @@ kube-apiserver-style contract on one resource, ``/serve/fleet``:
   full ``MAX_WATCH_SECONDS``).
 - ``GET /serve/healthz`` → open liveness (never needs the token, same
   contract as the status server's /healthz).
+- **Codec negotiation**: ``Accept: application/x-msgpack`` selects the
+  compact msgpack codec on every ``/serve/fleet`` shape — snapshot,
+  ``?watch=1`` streams, ``&once=1`` long-polls and ``?at=`` time travel
+  (response bodies, stream frames, and the 410/400 recovery bodies all
+  ride the negotiated codec; Content-Type says which one won). The
+  decoded payloads are identical across codecs; only JSON bodies are
+  byte-stable (the golden contract). A server without msgpack — or any
+  other Accept value — serves JSON; the fallback can only widen the
+  wire, never fail a request.
 
 Auth reuses the status plane's bearer contract (metrics/server.py
 ``bearer_authorized`` — constant-time compare): when the watcher runs
@@ -67,7 +76,18 @@ from k8s_watcher_tpu.metrics.server import (
     send_json,
 )
 from k8s_watcher_tpu.serve.broadcast import BroadcastLoop
-from k8s_watcher_tpu.serve.view import GONE, INVALID, FleetView, SubscriptionHub
+from k8s_watcher_tpu.serve.view import (
+    CODEC_CONTENT_TYPES,
+    CODEC_JSON,
+    CODEC_MSGPACK,
+    GONE,
+    INVALID,
+    MSGPACK_CONTENT_TYPE,
+    FleetView,
+    SubscriptionHub,
+    frame_body,
+    msgpack_available,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -161,14 +181,36 @@ class _ServeHandler(BaseHTTPRequestHandler):
     def _json(self, status: int, body: dict) -> None:
         send_json(self, status, body)
 
-    def _json_bytes(self, status: int, data: bytes) -> None:
-        """A pre-serialized JSON body (snapshot byte cache / ?at= LRU):
-        the Content-Length framing of ``send_json`` without re-encoding."""
+    def _body_bytes(self, status: int, data: bytes, content_type: str = "application/json") -> None:
+        """A pre-serialized body (snapshot byte cache / ?at= LRU /
+        msgpack): the Content-Length framing of ``send_json`` without
+        re-encoding."""
         self.send_response(status)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
+
+    def _send_obj(self, status: int, body: dict, codec: str) -> None:
+        """One bounded response body in the negotiated codec (errors
+        included — a msgpack consumer's one decode path must cover the
+        410/400 bodies it recovers from, not just the 200s)."""
+        if codec == CODEC_MSGPACK:
+            self._body_bytes(status, frame_body(body, CODEC_MSGPACK), MSGPACK_CONTENT_TYPE)
+        else:
+            self._json(status, body)
+
+    def _codec(self) -> str:
+        """Content negotiation: ``Accept: application/x-msgpack`` (and a
+        server that can encode it) selects the compact codec; everything
+        else — including a stripped no-msgpack build — serves JSON. The
+        fallback is silent and lossless by design: codecs carry the same
+        frame dicts, so a consumer that offered msgpack and got JSON
+        just runs its JSON decode path."""
+        accept = (self.headers.get("Accept") or "").lower()
+        if msgpack_available() and MSGPACK_CONTENT_TYPE in accept:
+            return CODEC_MSGPACK
+        return CODEC_JSON
 
     def do_GET(self):  # noqa: N802
         parsed = urlparse(self.path)
@@ -187,137 +229,151 @@ class _ServeHandler(BaseHTTPRequestHandler):
             self._json(404, {"error": f"no route {path}"})
             return
         params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        codec = self._codec()
         if params.get("watch") in ("1", "true"):
-            self._serve_watch(params)
+            self._serve_watch(params, codec)
             return
         if "at" in params:
-            self._serve_at(params)
+            self._serve_at(params, codec)
             return
-        # rv-keyed snapshot byte cache: serialized at most once per rv
-        # (rebuilt on first read after a publish), so a polling dashboard
-        # tier costs one json.dumps per DELTA, not one per request
-        self._json_bytes(200, self.view.snapshot_bytes())
+        # (rv, codec)-keyed snapshot byte cache: serialized at most once
+        # per rv per codec (rebuilt on first read after a publish), so a
+        # polling dashboard tier costs one serialization per DELTA, not
+        # one per request
+        self._body_bytes(
+            200, self.view.snapshot_bytes(codec=codec), CODEC_CONTENT_TYPES[codec]
+        )
 
-    def _serve_at(self, params: dict) -> None:
+    def _serve_at(self, params: dict, codec: str = CODEC_JSON) -> None:
         """Time travel: ``GET /serve/fleet?at=N`` reconstructs the fleet
         snapshot as of rv N from the history WAL (snapshot record +
         deltas). 410 past the retention horizon — the same re-snapshot
         recovery contract as a compacted resume token, one layer deeper."""
         if self.history is None:
-            self._json(
+            self._send_obj(
                 400,
                 {"error": "time-travel reads need the history plane (history.enabled)"},
+                codec,
             )
             return
         try:
             at_rv = int(params["at"])
         except ValueError:
-            self._json(400, {"error": "at= must be an integer rv"})
+            self._send_obj(400, {"error": "at= must be an integer rv"}, codec)
             return
         if at_rv < 0:
-            self._json(400, {"error": "at= must be >= 0"})
+            self._send_obj(400, {"error": "at= must be >= 0"}, codec)
             return
         # LRU over recent reconstructions: a WAL-segment fold is a
         # forensic-grade read, and dashboards poll the same historical rv
         # repeatedly. The key's instance + cache_epoch components make
         # rebase/retention/restart invalidation automatic (stale keys
-        # just stop matching and age out of the LRU).
+        # just stop matching and age out of the LRU); the codec component
+        # keeps a msgpack read from evicting the JSON reconstruction.
         cache_key = None
         if self.at_cache is not None:
             cache_key = (
                 self.view.instance,
                 getattr(self.history, "cache_epoch", 0),
                 at_rv,
+                codec,
             )
             cached = self.at_cache.get(cache_key)
             if cached is not None:
                 if self.at_hits is not None:
                     self.at_hits.inc()
-                self._json_bytes(200, cached)
+                self._body_bytes(200, cached, CODEC_CONTENT_TYPES[codec])
                 return
             if self.at_misses is not None:
                 self.at_misses.inc()
         status, rv, objects = self.history.reconstruct(at_rv)
         if status == "gone":
-            self._json(
+            self._send_obj(
                 410,
                 {"error": "rv is not reconstructible from retained history "
                           "(behind the retention horizon, or inside a rebase/tear hole)",
                  "rv": at_rv, "retention_floor_rv": rv},
+                codec,
             )
             return
         if status == "future":
-            self._json(
+            self._send_obj(
                 400,
                 {"error": "rv is past the durable history (not yet written, or never minted)",
                  "rv": at_rv, "durable_rv": rv},
+                codec,
             )
             return
-        body = json.dumps(
-            {
-                "rv": at_rv,
-                "view": self.view.instance,
-                "historical": True,
-                # deterministic order (sorted (kind, key)) — reconstructions
-                # are compared byte-wise in the smoke/replay legs
-                "objects": [objects[k] for k in sorted(objects)],
-            }
-        ).encode()
+        reconstruction = {
+            "rv": at_rv,
+            "view": self.view.instance,
+            "historical": True,
+            # deterministic order (sorted (kind, key)) — reconstructions
+            # are compared byte-wise in the smoke/replay legs
+            "objects": [objects[k] for k in sorted(objects)],
+        }
+        if codec == CODEC_MSGPACK:
+            body = frame_body(reconstruction, CODEC_MSGPACK)
+        else:
+            body = json.dumps(reconstruction).encode()
         if self.at_cache is not None and cache_key is not None:
             self.at_cache.put(cache_key, body)
-        self._json_bytes(200, body)
+        self._body_bytes(200, body, CODEC_CONTENT_TYPES[codec])
 
-    def _serve_watch(self, params: dict) -> None:
+    def _serve_watch(self, params: dict, codec: str = CODEC_JSON) -> None:
         try:
             rv = int(params["rv"])
         except (KeyError, ValueError):
-            self._json(400, {"error": "watch requires an integer rv= (from a snapshot or a prior to_rv/SYNC)"})
+            self._send_obj(400, {"error": "watch requires an integer rv= (from a snapshot or a prior to_rv/SYNC)"}, codec)
             return
         try:
             timeout = min(float(params.get("timeout", "30") or "30"), MAX_WATCH_SECONDS)
             limit = int(params.get("limit", "0") or "0") or None
         except ValueError:
-            self._json(400, {"error": "bad timeout=/limit="})
+            self._send_obj(400, {"error": "bad timeout=/limit="}, codec)
             return
         if limit is not None and limit < 0:
-            self._json(400, {"error": "limit= must be >= 0 (0 = unpaged)"})
+            self._send_obj(400, {"error": "limit= must be >= 0 (0 = unpaged)"}, codec)
             return
         client_view = params.get("view")
         if client_view and client_view != self.view.instance:
             # token minted by a previous incarnation of the rv space:
             # same recovery as the compaction horizon — re-snapshot
-            self._json(
+            self._send_obj(
                 410,
                 {"error": "view instance changed (watcher restarted); re-snapshot",
                  "view": self.view.instance},
+                codec,
             )
             return
         sub = self.hub.subscribe(rv=rv)
         if sub is None:
-            self._json(
+            self._send_obj(
                 503,
                 {"error": "max_subscribers reached", "max_subscribers": self.hub.max_subscribers},
+                codec,
             )
             return
         handed_off = False
         try:
             if params.get("once") in ("1", "true"):
-                self._long_poll(sub, min(timeout, MAX_LONG_POLL_SECONDS), limit)
+                self._long_poll(sub, min(timeout, MAX_LONG_POLL_SECONDS), limit, codec)
             elif self.loop is not None:
-                handed_off = self._stream_handoff(sub, timeout, limit)
+                handed_off = self._stream_handoff(sub, timeout, limit, codec)
             else:
-                self._stream(sub, timeout, limit)
+                self._stream(sub, timeout, limit, codec)
         finally:
             if not handed_off:
                 self.hub.unsubscribe(sub)
 
-    def _long_poll(self, sub, timeout: float, limit) -> None:
+    def _long_poll(self, sub, timeout: float, limit, codec: str = CODEC_JSON) -> None:
         result = sub.pull(timeout=timeout, limit=limit)
         if result.status == GONE:
-            self._json(
+            self._send_obj(
                 410,
                 {"error": "resume token compacted away; re-snapshot",
                  "rv": result.from_rv, "oldest_rv": self.view.oldest_rv},
+                codec,
             )
             return
         if result.status == INVALID:
@@ -326,13 +382,14 @@ class _ServeHandler(BaseHTTPRequestHandler):
             # &view= — 410 so the documented resume loop (which only
             # handles 410) recovers by re-snapshotting, instead of
             # wedging on an error it never retries
-            self._json(
+            self._send_obj(
                 410,
                 {"error": "rv is ahead of this view (watcher restarted?); re-snapshot",
                  "rv": result.from_rv, "view_rv": self.view.rv, "view": self.view.instance},
+                codec,
             )
             return
-        self._json(
+        self._send_obj(
             200,
             {
                 "from_rv": result.from_rv,
@@ -341,46 +398,49 @@ class _ServeHandler(BaseHTTPRequestHandler):
                 "compacted": result.compacted,
                 "items": [d.to_wire() for d in result.deltas],
             },
+            codec,
         )
 
-    def _pre_stream_410(self, sub) -> bool:
+    def _pre_stream_410(self, sub, codec: str = CODEC_JSON) -> bool:
         """Pre-stream 410: a dead resume token must fail the REQUEST, not
         arrive as a frame the client has to dig out of a 200 stream.
         Returns True when a 410 was answered (caller stops)."""
         peek_status = self.view.token_status(sub.rv)
         if peek_status == GONE:
-            self._json(
+            self._send_obj(
                 410,
                 {"error": "resume token compacted away; re-snapshot",
                  "rv": sub.rv, "oldest_rv": self.view.oldest_rv},
+                codec,
             )
             return True
         if peek_status == INVALID:
             # same restart heuristic as the long-poll path: recoverable 410
-            self._json(
+            self._send_obj(
                 410,
                 {"error": "rv is ahead of this view (watcher restarted?); re-snapshot",
                  "rv": sub.rv, "view_rv": self.view.rv, "view": self.view.instance},
+                codec,
             )
             return True
         return False
 
-    def _stream_handoff(self, sub, timeout: float, limit) -> bool:
+    def _stream_handoff(self, sub, timeout: float, limit, codec: str = CODEC_JSON) -> bool:
         """The epoll path: handshake/auth/410 checks ran on THIS thread
         (the HTTP front's job); write the response headers, then release
         the socket to the broadcast loop and return the thread to the
         pool. Returns True once the loop owns socket + subscription —
         the caller must then NOT unsubscribe."""
-        if self._pre_stream_410(sub):
+        if self._pre_stream_410(sub, codec):
             return False
         if not self.loop.accepting:
             # a dead loop's inbox is a black hole; serve this stream on
             # the legacy threaded path instead (degraded but correct —
             # /healthz is already reporting the loop unhealthy)
-            self._stream(sub, timeout, limit)
+            self._stream(sub, timeout, limit, codec)
             return False
         self.send_response(200)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", CODEC_CONTENT_TYPES[codec])
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
         self.wfile.flush()
@@ -394,27 +454,34 @@ class _ServeHandler(BaseHTTPRequestHandler):
             self.loop.submit(
                 self.connection, sub,
                 timeout=timeout, limit=limit, view_id=self.view.instance,
+                codec=codec,
             )
         except RuntimeError:
             return False
         self.server.hand_off(self.connection)
         return True
 
-    def _stream(self, sub, timeout: float, limit) -> None:
+    def _stream(self, sub, timeout: float, limit, codec: str = CODEC_JSON) -> None:
         # legacy thread-per-connection streamer (serve.io_threads: 0):
         # kept as the PR-4 reference encoder the golden/equivalence tests
         # compare the broadcast core against
-        if self._pre_stream_410(sub):
+        if self._pre_stream_410(sub, codec):
             return
         self.send_response(200)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", CODEC_CONTENT_TYPES[codec])
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
 
-        def write_frames(frames: list) -> None:
-            data = "".join(json.dumps(f) + "\n" for f in frames).encode()
-            self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
-            self.wfile.flush()
+        if codec == CODEC_MSGPACK:
+            def write_frames(frames: list) -> None:
+                data = b"".join(frame_body(f, CODEC_MSGPACK) for f in frames)
+                self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                self.wfile.flush()
+        else:
+            def write_frames(frames: list) -> None:
+                data = "".join(json.dumps(f) + "\n" for f in frames).encode()
+                self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                self.wfile.flush()
 
         deadline = time.monotonic() + timeout
         last_frame = time.monotonic()

@@ -41,16 +41,37 @@ are replaced, never mutated, so readers can hand out references without
 copies.
 
 Encode-once fan-out (the O(deltas) data plane): every applied delta's
-**wire frame** — its JSON line, already wrapped in HTTP chunked-transfer
-framing — is serialized to bytes exactly once, at publish time, into a
-parallel ``_frames`` array trimmed with the journal. 10k subscribers
-streaming the same delta all reference the *same* ``bytes`` object; the
-per-subscriber cost of a delivery is a buffer append, never a
-``json.dumps``. Compacted/paged batches reuse the per-delta frames and
-only synthesize the small COMPACTED/SYNC/GONE control frames.
-``GET /serve/fleet`` rides the same idea one level up: the whole
-snapshot body is serialized at most once per rv (``snapshot_bytes``,
-invalidated implicitly when a publish bumps rv).
+**wire frame** — its serialized payload, already wrapped in HTTP
+chunked-transfer framing — is serialized to bytes at most once *per
+codec*, into per-codec frame arrays parallel to the journal (trimmed
+together). 10k subscribers streaming the same delta in the same codec
+all reference the *same* ``bytes`` object; the per-subscriber cost of a
+delivery is a buffer append, never a re-serialization. Compacted/paged
+batches reuse the per-delta frames and only synthesize the small
+COMPACTED/SYNC/GONE control frames. ``GET /serve/fleet`` rides the same
+idea one level up: the whole snapshot body is serialized at most once
+per ``(rv, codec)`` (``snapshot_bytes``, invalidated implicitly when a
+publish bumps rv; one codec's read never evicts the other's body).
+
+Two wire codecs share the frame contract:
+
+- ``json`` (the default, and the PR-4/PR-7 golden contract): one JSON
+  line per frame, byte-identical to what the thread-per-connection
+  encoder wrote. Local publish paths (``apply``/``publish_batch``)
+  encode it eagerly at publish — the PR-7 encodes==publishes invariant
+  the fan-out bench gates.
+- ``msgpack`` (``Accept: application/x-msgpack``): the same frame dicts
+  msgpack-packed — self-delimiting, so the stream needs no line framing
+  and a consumer feeds raw reads into a streaming unpacker. Frames are
+  built lazily, on the first read that needs them, and memoized into
+  the parallel array (still at most one encode per delta per codec).
+
+The merge-facing ``apply_batch`` (federation fan-in) appends *unencoded*
+journal entries for BOTH codecs: a federator folding three clusters'
+churn storms must not pay a ``json.dumps`` per delta inside its publish
+lock for frames no subscriber may ever pull in that codec. The first
+subscriber read in a given codec fills the holes (off the publish lock)
+and every later read shares the memoized bytes.
 """
 
 from __future__ import annotations
@@ -66,9 +87,35 @@ from k8s_watcher_tpu.pipeline.phase import pod_key, pod_ready
 from k8s_watcher_tpu.pipeline.pipeline import NEVER_IN_VIEW as _NEVER_IN_VIEW
 from k8s_watcher_tpu.watch.source import EventType
 
+# msgpack is baked into the image (history/wal.py measured it packing a
+# batch ~3x faster than json.dumps in this tree); a stripped environment
+# falls back to JSON-only serving — content negotiation simply never
+# selects a codec the process cannot encode.
+try:
+    import msgpack as _msgpack
+except ImportError:  # pragma: no cover - the image bakes msgpack in
+    _msgpack = None
+
 #: delivery record types on the wire (and in Delta.type)
 UPSERT = "UPSERT"
 DELETE = "DELETE"
+
+#: wire codecs (the ``Accept`` negotiation vocabulary)
+CODEC_JSON = "json"
+CODEC_MSGPACK = "msgpack"
+CODECS = (CODEC_JSON, CODEC_MSGPACK)
+JSON_CONTENT_TYPE = "application/json"
+MSGPACK_CONTENT_TYPE = "application/x-msgpack"
+CODEC_CONTENT_TYPES = {
+    CODEC_JSON: JSON_CONTENT_TYPE,
+    CODEC_MSGPACK: MSGPACK_CONTENT_TYPE,
+}
+
+
+def msgpack_available() -> bool:
+    """Whether this process can encode/decode the msgpack wire codec
+    (the server advertises/falls back to JSON when it cannot)."""
+    return _msgpack is not None
 
 #: read_since verdicts
 OK = "ok"
@@ -94,16 +141,28 @@ class Delta(NamedTuple):
         return out
 
 
-def chunk_frame(obj: Mapping[str, Any]) -> bytes:
-    """One wire frame: a JSON line wrapped in HTTP chunked-transfer
-    framing (``<hex len>\\r\\n<json>\\n\\r\\n``). The JSON payload is
+def frame_body(obj: Mapping[str, Any], codec: str = CODEC_JSON) -> bytes:
+    """One frame's wire payload, pre-chunk-framing. JSON: the PR-4
+    golden line (default ``json.dumps`` separators + trailing newline).
+    msgpack: ``packb`` of the same dict — self-delimiting, no line
+    framing needed (the decoded dict equals the decoded JSON line)."""
+    if codec == CODEC_MSGPACK:
+        if _msgpack is None:
+            raise RuntimeError("msgpack codec requested but msgpack is not importable")
+        return _msgpack.packb(obj, use_bin_type=True)
+    return (json.dumps(obj) + "\n").encode()
+
+
+def chunk_frame(obj: Mapping[str, Any], codec: str = CODEC_JSON) -> bytes:
+    """One wire frame: the codec payload wrapped in HTTP chunked-transfer
+    framing (``<hex len>\\r\\n<payload>\\r\\n``). For JSON the payload is
     byte-identical to what the PR-4 thread-per-connection encoder wrote
-    (default ``json.dumps`` separators) — chunk *boundaries* moved from
-    per-batch to per-frame, which dechunking erases; the de-chunked byte
-    stream a client sees is unchanged. Used for every frame on a watch
-    stream: per-delta frames (encoded once, at publish) and the small
-    per-connection SYNC/COMPACTED/GONE control frames."""
-    payload = (json.dumps(obj) + "\n").encode()
+    — chunk *boundaries* moved from per-batch to per-frame, which
+    dechunking erases; the de-chunked byte stream a client sees is
+    unchanged. Used for every frame on a watch stream: per-delta frames
+    (encoded at most once per codec) and the small per-connection
+    SYNC/COMPACTED/GONE control frames."""
+    payload = frame_body(obj, codec)
     return b"%x\r\n" % len(payload) + payload + b"\r\n"
 
 
@@ -172,15 +231,24 @@ class FleetView:
         self._objects: Dict[Tuple[str, str], Dict[str, Any]] = {}
         # parallel append-only arrays (trimmed together at the horizon):
         # bisect over _delta_rvs finds a resume point in O(log n);
-        # _frames[i] is _deltas[i]'s wire frame, serialized EXACTLY ONCE
-        # at publish — the encode-once contract the fan-out bench gates
+        # _frames[codec][i] is _deltas[i]'s wire frame in that codec,
+        # serialized AT MOST ONCE per codec — eagerly at publish for
+        # JSON on the local paths (the encode-once contract the fan-out
+        # bench gates), lazily on first read everywhere else (msgpack
+        # frames, and everything appended by the merge-facing
+        # apply_batch). A ``None`` entry is a hole the next read in
+        # that codec fills and memoizes.
         self._delta_rvs: List[int] = []
         self._deltas: List[Delta] = []
-        self._frames: List[bytes] = []
-        # rv-keyed snapshot byte cache: (rv, body bytes) — rebuilt at
-        # most once per rv, served only while rv is still current (a
-        # publish invalidates it by bumping rv)
-        self._snapshot_cache: Optional[Tuple[int, bytes]] = None
+        self._frames: Dict[str, List[Optional[bytes]]] = {
+            CODEC_JSON: [],
+            CODEC_MSGPACK: [],
+        }
+        # (rv, codec)-keyed snapshot byte cache: rebuilt at most once per
+        # rv PER CODEC, served only while rv is still current (a publish
+        # invalidates by bumping rv) — a msgpack snapshot read must not
+        # evict the JSON body, or an A/B-consuming tier would thrash both
+        self._snapshot_cache: Dict[str, Tuple[int, bytes]] = {}
         # post-publish wakeups OUTSIDE the lock (the broadcast event
         # loop's one-wakeup-per-publish signal; never the per-waiter
         # notify_all herd)
@@ -206,11 +274,27 @@ class FleetView:
         self._frame_encodes = (
             metrics.counter("serve_frame_encodes") if metrics is not None else None
         )
+        self._frame_encodes_mp = (
+            metrics.counter("serve_frame_encodes_msgpack") if metrics is not None else None
+        )
         self._snap_hits = (
             metrics.counter("serve_snapshot_cache_hits") if metrics is not None else None
         )
         self._snap_misses = (
             metrics.counter("serve_snapshot_cache_misses") if metrics is not None else None
+        )
+        # per-codec labels on the snapshot cache counters (the registry
+        # is label-free, so labels are name suffixes — the federation
+        # plane's per-upstream gauge idiom)
+        self._snap_hits_by_codec = (
+            {c: metrics.counter(f"serve_snapshot_cache_hits_{c}") for c in CODECS}
+            if metrics is not None
+            else None
+        )
+        self._snap_misses_by_codec = (
+            {c: metrics.counter(f"serve_snapshot_cache_misses_{c}") for c in CODECS}
+            if metrics is not None
+            else None
         )
 
     # -- durable history (restart-surviving rv line) -----------------------
@@ -234,8 +318,11 @@ class FleetView:
             self._objects = dict(objects)
             self._deltas = list(journal)
             self._delta_rvs = [d.rv for d in journal]
-            self._frames = [self._encode_locked(d) for d in journal]
-            self._snapshot_cache = None
+            # holes, not eager re-encodes: a restart must not pay
+            # O(journal) json.dumps before serving — the first resumed
+            # subscriber's read fills (and memoizes) exactly what it pulls
+            self._frames = {codec: [None] * len(journal) for codec in CODECS}
+            self._snapshot_cache = {}
             # tokens older than the preloaded tail 410 — the compaction-
             # horizon contract, now spanning incarnations
             self._oldest_rv = journal[0].rv - 1 if journal else rv
@@ -273,11 +360,12 @@ class FleetView:
             pass
 
     def _encode_locked(self, delta: Delta) -> bytes:
-        """Serialize ``delta``'s wire frame — the once in encode-once.
-        Called under the lock, before the delta becomes visible to any
-        reader, so memoization needs no CAS and the encode counter is
-        exact (the bench's amortization gate: encodes == publishes,
-        independent of subscriber count)."""
+        """Serialize ``delta``'s JSON wire frame — the once in
+        encode-once for the local publish paths. Called under the lock,
+        before the delta becomes visible to any reader, so memoization
+        needs no CAS and the encode counter is exact (the bench's
+        amortization gate: encodes == publishes, independent of
+        subscriber count)."""
         if self._encode_seconds is not None:
             t0 = time.perf_counter()
             frame = chunk_frame(delta.to_wire())
@@ -288,9 +376,19 @@ class FleetView:
             self._frame_encodes.inc()
         return frame
 
-    def _apply_locked(self, kind: str, key: str, obj: Optional[Dict[str, Any]], now: float) -> bool:
+    def _apply_locked(
+        self,
+        kind: str,
+        key: str,
+        obj: Optional[Dict[str, Any]],
+        now: float,
+        encode: bool = True,
+    ) -> bool:
         """One delta under the lock. Returns False for no-ops (identical
-        upsert, delete of an absent key) — no rv burn, no journal entry."""
+        upsert, delete of an absent key) — no rv burn, no journal entry.
+        ``encode=False`` (the merge-facing batch path) journals a hole in
+        every codec's frame array instead of paying json.dumps here; the
+        first read in a codec fills it."""
         map_key = (kind, key)
         if obj is None:
             if self._objects.pop(map_key, None) is None:
@@ -305,7 +403,10 @@ class FleetView:
         delta = Delta(self._rv, kind, key, delta_type, obj, now)
         self._delta_rvs.append(self._rv)
         self._deltas.append(delta)
-        self._frames.append(self._encode_locked(delta))
+        self._frames[CODEC_JSON].append(self._encode_locked(delta) if encode else None)
+        # msgpack frames are ALWAYS lazy: most deployments never attach a
+        # msgpack subscriber, and the ones that do pay once, at read time
+        self._frames[CODEC_MSGPACK].append(None)
         return True
 
     def _trim_locked(self) -> None:
@@ -317,7 +418,8 @@ class FleetView:
         self._oldest_rv = self._delta_rvs[overflow - 1]
         del self._delta_rvs[:overflow]
         del self._deltas[:overflow]
-        del self._frames[:overflow]
+        for frames in self._frames.values():
+            del frames[:overflow]
 
     def apply(self, kind: str, key: str, obj: Optional[Dict[str, Any]]) -> bool:
         """Upsert (``obj``) or delete (``obj is None``) one object and wake
@@ -337,6 +439,44 @@ class FleetView:
         if changed:
             if self._deltas_published is not None:
                 self._deltas_published.inc()
+            for fn in self._wakeups:
+                fn()
+        return changed
+
+    def apply_batch(self, items) -> int:
+        """Fold a batch of ``(kind, key, obj_or_None)`` mutations under
+        ONE publish-lock hold, with one history hand-off, one gauge set,
+        one ``notify_all`` and one coalesced wakeup for the whole batch —
+        the merge-facing mirror of the pipeline's ``publish_batch``, so a
+        federation fan-in storm costs per-batch, not per-delta, locking.
+
+        Frames are journaled as holes (``encode=False``): the fan-in hot
+        path must not pay a per-delta ``json.dumps`` inside the lock for
+        bytes no subscriber may ever pull in that codec; the first read
+        in each codec fills and memoizes them (still at most one encode
+        per delta per codec). Returns the number of deltas minted
+        (identical upserts and absent-key deletes are free)."""
+        now = time.monotonic()
+        changed = 0
+        with self._cond:
+            for kind, key, obj in items:
+                if self._apply_locked(kind, key, obj, now, encode=False):
+                    changed += 1
+            if changed:
+                if self._history is not None:
+                    # pre-trim, one O(1) hand-off for the whole batch —
+                    # the deltas are the journal tail (appended under
+                    # THIS lock hold, so they are contiguous)
+                    self._history.publish(self._deltas[-changed:])
+                self._trim_locked()
+                if self._rv_gauge is not None:
+                    self._rv_gauge.set(self._rv)
+                self._cond.notify_all()
+        if changed:
+            if self._deltas_published is not None:
+                self._deltas_published.inc(changed)
+            if self._publish_seconds is not None:
+                self._publish_seconds.record(time.monotonic() - now)
             for fn in self._wakeups:
                 fn()
         return changed
@@ -458,31 +598,41 @@ class FleetView:
         with self._cond:
             return self._rv, list(self._objects.values())
 
-    def snapshot_bytes(self) -> bytes:
+    def snapshot_bytes(self, codec: str = CODEC_JSON) -> bytes:
         """The serialized ``GET /serve/fleet`` body, rebuilt at most once
-        per rv: built on first read, served from cache while rv is
-        unchanged, invalidated implicitly by the next publish (cache is
-        keyed by rv; a bumped rv simply stops matching). A dashboard
-        tier polling snapshots between publishes costs one ``json.dumps``
-        per *delta*, not one per *request*."""
+        per ``(rv, codec)``: built on first read, served from cache while
+        rv is unchanged, invalidated implicitly by the next publish (the
+        cache entry is keyed by the rv it was built at; a bumped rv
+        simply stops matching). The per-codec entries are independent —
+        a msgpack read never evicts the JSON body (and vice versa), so a
+        mixed-codec dashboard tier still costs one serialization per
+        delta per codec, not one per request."""
         with self._cond:
-            cached = self._snapshot_cache
+            cached = self._snapshot_cache.get(codec)
             if cached is not None and cached[0] == self._rv:
                 if self._snap_hits is not None:
                     self._snap_hits.inc()
+                    self._snap_hits_by_codec[codec].inc()
                 return cached[1]
             rv, objects = self._rv, list(self._objects.values())
             instance = self.instance
         # serialize OUTSIDE the lock (O(fleet) work must not stall
         # publishes); objects are replaced-never-mutated, so the shallow
         # copy above is a consistent snapshot
-        data = json.dumps({"rv": rv, "view": instance, "objects": objects}).encode()
+        body = {"rv": rv, "view": instance, "objects": objects}
+        if codec == CODEC_MSGPACK:
+            if _msgpack is None:
+                raise RuntimeError("msgpack codec requested but msgpack is not importable")
+            data = _msgpack.packb(body, use_bin_type=True)
+        else:
+            data = json.dumps(body).encode()
         with self._cond:
             # store keyed by the rv it was built at; if a publish landed
             # meanwhile, the next read sees the mismatch and rebuilds
-            self._snapshot_cache = (rv, data)
+            self._snapshot_cache[codec] = (rv, data)
         if self._snap_misses is not None:
             self._snap_misses.inc()
+            self._snap_misses_by_codec[codec].inc()
         return data
 
     def object_count(self) -> int:
@@ -532,15 +682,60 @@ class FleetView:
         max_deltas: int = 128,
         limit: Optional[int] = None,
         timeout: float = 0.0,
+        codec: str = CODEC_JSON,
     ) -> FrameReadResult:
-        """``read_since`` plus the publish-time wire frames — the
-        broadcast path. ``frames[i]`` is ``deltas[i]``'s chunk-framed
-        JSON line, encoded ONCE at publish and shared by reference
-        across every subscriber pulling this range (compacted and paged
-        batches included — they subset the same bytes objects)."""
+        """``read_since`` plus the wire frames in ``codec`` — the
+        broadcast path. ``frames[i]`` is ``deltas[i]`` chunk-framed in
+        that codec, encoded AT MOST ONCE per delta per codec and shared
+        by reference across every subscriber pulling this range
+        (compacted and paged batches included — they subset the same
+        bytes objects). Holes left by lazy paths (msgpack, the merge's
+        ``apply_batch``) are filled off the publish lock and memoized."""
         return FrameReadResult(
-            *self._read(rv, max_deltas, limit, timeout, want_frames=True)
+            *self._read(rv, max_deltas, limit, timeout, want_frames=True, codec=codec)
         )
+
+    def _fill_frames(self, deltas: List[Delta], frames: List[Optional[bytes]], codec: str) -> None:
+        """Encode the ``None`` holes in one pulled frame slice (OFF the
+        publish lock — a large catch-up read must not stall publishers
+        behind O(pending) serialization), then memoize the results back
+        into the master array under a short lock hold. The journal's rv
+        space is dense, so a delta's position is ``rv - base`` — front
+        trims that happened while we encoded just shift ``base``; an
+        already-trimmed delta simply isn't memoized. Two racing readers
+        may both encode the same hole (identical bytes; last write wins)
+        — the eager JSON publish path never races because its frames are
+        minted under the lock, before the delta is readable.
+
+        Cost note: on the broadcast path this runs on the epoll worker
+        thread, like the latest-wins compaction walk always has (PR-7
+        deliberately moved O(pending) read work off the publish lock and
+        onto the puller). The fill is bounded by what the pull DELIVERS
+        — ``max_deltas``/``queue_depth`` raw, unique-keys-in-range
+        compacted — and is paid once per delta per codec ever."""
+        t0 = time.perf_counter() if self._encode_seconds is not None else 0.0
+        encoded: List[Tuple[int, bytes]] = []
+        for i, frame in enumerate(frames):
+            if frame is None:
+                frame = chunk_frame(deltas[i].to_wire(), codec)
+                frames[i] = frame
+                encoded.append((deltas[i].rv, frame))
+        if not encoded:
+            return
+        if self._encode_seconds is not None:
+            self._encode_seconds.record(time.perf_counter() - t0)
+        counter = self._frame_encodes if codec == CODEC_JSON else self._frame_encodes_mp
+        if counter is not None:
+            counter.inc(len(encoded))
+        with self._cond:
+            master = self._frames[codec]
+            if not self._delta_rvs:
+                return
+            base = self._delta_rvs[0]
+            for frame_rv, frame in encoded:
+                pos = frame_rv - base
+                if 0 <= pos < len(master) and master[pos] is None:
+                    master[pos] = frame
 
     def _read(
         self,
@@ -549,6 +744,7 @@ class FleetView:
         limit: Optional[int],
         timeout: float,
         want_frames: bool,
+        codec: str = CODEC_JSON,
     ) -> Tuple[str, int, int, bool, List[Delta], List[bytes]]:
         deadline = time.monotonic() + timeout if timeout > 0 else None
         frames: List[bytes] = []
@@ -582,7 +778,7 @@ class FleetView:
             # subscribers' compactions serialize every publish behind them
             deltas = self._deltas[idx:]
             if want_frames:
-                frames = self._frames[idx:]
+                frames = self._frames[codec][idx:]
         oldest_pending_t = deltas[0].t
         if pending <= max_deltas:
             compacted = False
@@ -603,6 +799,11 @@ class FleetView:
             if want_frames:
                 frames = frames[:limit]
             to_rv = deltas[-1].rv
+        if want_frames:
+            # fill lazy holes for exactly what this pull delivers (after
+            # compaction/paging subset the range — never for deltas the
+            # subscriber won't receive)
+            self._fill_frames(deltas, frames, codec)
         if self._delta_lag is not None:
             # lag = how stale the oldest pending delta had become by the
             # time this pull delivered it
@@ -669,13 +870,20 @@ class Subscription:
             )
         )
 
-    def pull_frames(self, *, timeout: float = 0.0, limit: Optional[int] = None) -> FrameReadResult:
-        """``pull`` returning the publish-time wire frames alongside the
+    def pull_frames(
+        self,
+        *,
+        timeout: float = 0.0,
+        limit: Optional[int] = None,
+        codec: str = CODEC_JSON,
+    ) -> FrameReadResult:
+        """``pull`` returning the wire frames in ``codec`` alongside the
         deltas — the broadcast core's (and fan-out bench's) shape; the
         frames are shared bytes, a delivery is a buffer append."""
         return self._advance(
             self.view.read_frames_since(
-                self.rv, max_deltas=self.queue_depth, limit=limit, timeout=timeout
+                self.rv, max_deltas=self.queue_depth, limit=limit, timeout=timeout,
+                codec=codec,
             )
         )
 

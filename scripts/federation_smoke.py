@@ -365,6 +365,29 @@ def run_smoke() -> dict:
                 time.sleep(0.3)
             checks["merged_equals_union_of_upstreams"] = converged
 
+            # codec negotiation on the fan-in wire: the federator's
+            # upstream subscribers (config codec: auto) and the global
+            # consumer both negotiated msgpack when available — and the
+            # gapless/merged==union verdicts above all rode that wire
+            from k8s_watcher_tpu.serve.view import msgpack_available
+
+            _, body = _healthz(status_f)
+            upstream_codecs = {
+                name: up.get("codec")
+                for name, up in body.get("federation", {}).get("upstreams", {}).items()
+            }
+            expected_codec = "msgpack" if msgpack_available() else "json"
+            checks["fanin_codec_negotiated"] = bool(upstream_codecs) and all(
+                c == expected_codec for c in upstream_codecs.values()
+            )
+            checks["consumer_codec_negotiated"] = (
+                consumer.client.active_codec == expected_codec
+            )
+            result["codecs"] = {
+                "upstreams": upstream_codecs,
+                "consumer": consumer.client.active_codec,
+            }
+
             metrics = requests.get(
                 f"http://127.0.0.1:{status_f}/metrics", headers=AUTH, timeout=5
             ).json()

@@ -164,6 +164,34 @@ def run_smoke() -> dict:
             checks["snapshot_served"] = snap.rv > 0 and len(pods) == N_PODS
             result["snapshot"] = {"rv": snap.rv, "objects": len(snap.objects)}
 
+            # 1b. codec negotiation: the default (auto) client negotiated
+            # msgpack when available, and a JSON-pinned client decodes the
+            # IDENTICAL snapshot — the codec changes wire bytes, never
+            # content
+            from k8s_watcher_tpu.serve.view import msgpack_available
+
+            json_client = FleetClient(base, token=TOKEN, codec="json")
+            cross_codec_equal = False
+            for _ in range(10):
+                mp_snap = client.snapshot()
+                json_snap = json_client.snapshot()
+                if mp_snap.rv != json_snap.rv:
+                    continue  # a delta landed between the two reads; retry
+                cross_codec_equal = model_from_objects(
+                    mp_snap.objects
+                ) == model_from_objects(json_snap.objects)
+                break
+            expected_codec = "msgpack" if msgpack_available() else "json"
+            checks["codec_negotiated"] = (
+                client.active_codec == expected_codec
+                and json_client.active_codec == "json"
+                and cross_codec_equal
+            )
+            result["codecs"] = {
+                "default_client": client.active_codec,
+                "json_client": json_client.active_codec,
+            }
+
             # 2. resumable delta long-poll loop across separate connections
             # — the shared ResumeLoop (carrying the snapshot's view
             # instance id and sequence-checking every batch, exactly what

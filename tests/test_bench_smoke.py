@@ -77,6 +77,16 @@ def test_bench_smoke_headline_within_budget():
     # budget, merged state == union of upstreams, zero gaps/dups
     assert headline["federation_ok"] is True, headline
     assert headline["federation_p50_ms"] is not None, headline
+    # batched fan-in: GlobalMerge.apply_batch sustained >= 3x the
+    # per-delta-apply baseline on merged-deltas/s (measured in the same
+    # run), and the live churn-doubling ramp kept the merged view caught
+    # up with zero gaps/dups
+    assert headline["federation_fanin_ok"] is True, headline
+    assert headline["federation_fanin_deltas_per_sec"] is not None, headline
+    # codec negotiation: msgpack-decoded content == JSON-decoded content
+    # on snapshot/long-poll/stream over the real wire, with msgpack
+    # actually negotiated by an Accept: application/x-msgpack client
+    assert headline["serve_codec_ok"] is True, headline
     detail = json.loads((REPO_ROOT / "artifacts" / "bench_smoke.json").read_text())
     assert detail["details"]["relist_10k"]["events"] == detail["details"]["relist_10k"]["n_pods"]
     egress = detail["details"]["egress_saturation"]
@@ -107,3 +117,19 @@ def test_bench_smoke_headline_within_budget():
     assert fed["gaps"] == 0 and fed["dups"] == 0, fed
     assert fed["deltas_applied"] > 0 and fed["latency_samples"] > 0, fed
     assert all(a["correctness_ok"] for a in fed["attempts"]), fed["attempts"]
+    # the fan-in A/B's own correctness legs: the batched terminal view is
+    # IDENTICAL to the per-delta one and the merged-object gauge stayed
+    # exact (the >=3x speedup must never ship on a divergent state)
+    ab = fed["fanin_ab"]
+    assert ab["views_identical"] and ab["gauge_exact"], ab
+    assert ab["speedup"] >= 3.0, ab
+    ramp = fed["fanin_ramp"]
+    assert ramp["gaps"] == 0 and ramp["dups"] == 0 and ramp["merged_matches"], ramp
+    assert ramp["max_sustained_deltas_per_sec"] > 0, ramp
+    # wire-batching existence proof: under the unpaced burst the consumer
+    # falls behind, so chunked reads MUST carry multi-frame batches — a
+    # regression to per-frame delivery fails here, not just in theory
+    assert ramp["burst_avg_batch_size"] >= 2.0, ramp
+    codec = fed["codec_ab"]
+    assert codec["snapshot_equal"] and codec["long_poll_equal"] and codec["stream_equal"], codec
+    assert codec["msgpack_negotiated"], codec

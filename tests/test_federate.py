@@ -798,3 +798,307 @@ class TestFederationConfigSchema:
         raw["serve"] = {"enabled": True}
         cfg = AppConfig.from_raw(raw, "development")
         assert cfg.federation.enabled and len(cfg.federation.upstreams) == 1
+
+
+# -- batched fan-in + wire codec ---------------------------------------------
+
+
+import logging as _logging
+
+from k8s_watcher_tpu.federate import client as _client_mod
+from k8s_watcher_tpu.serve import server as _server_mod
+
+
+def _wire_upsert(key, **fields):
+    return {"type": "UPSERT", "kind": "pod", "key": key,
+            "object": {"kind": "pod", "key": key, **fields}}
+
+
+def _wire_delete(key):
+    return {"type": "DELETE", "kind": "pod", "key": key}
+
+
+class TestMergeGaugeExact:
+    def test_gauge_exact_through_reconcile_and_drop(self):
+        """Regression for the O(clusters) per-delta recompute: the
+        merged-object gauge is now maintained incrementally and must stay
+        EXACT (== a full recount of the registry) through every mutation
+        shape — per-delta apply, batched apply, reconcile shrink/grow,
+        drop_cluster, and the no-op edges (re-upsert, double delete,
+        dropping an unknown cluster)."""
+        reg = MetricsRegistry()
+        view = FleetView()
+        merge = GlobalMerge(view, metrics=reg)
+
+        def check():
+            recount = sum(len(k) for k in merge._keys.values())
+            assert reg.gauge("federation_merged_objects").value == recount
+            assert merge.object_count() == recount
+
+        merge.reset_cluster("a", [{"kind": "pod", "key": f"p{i}", "seq": i} for i in range(5)])
+        check()
+        merge.apply_delta("a", _wire_upsert("p9", seq=1))
+        merge.apply_delta("a", _wire_upsert("p9", seq=2))   # same key: count flat
+        merge.apply_delta("a", _wire_delete("p0"))
+        merge.apply_delta("a", _wire_delete("p0"))           # double delete: flat
+        check()
+        merge.apply_batch("b", [_wire_upsert(f"q{i}", seq=i) for i in range(4)]
+                          + [_wire_delete("q1"), _wire_upsert("q1", seq=9)])
+        check()
+        merge.reset_cluster("a", [{"kind": "pod", "key": "p1", "seq": 0}])  # shrink
+        check()
+        assert merge.drop_cluster("b") == 4
+        check()
+        merge.drop_cluster("nonexistent")
+        check()
+        merge.seed_from_view()  # idempotent over what's already registered
+        check()
+
+
+class TestBatchedFanInProperty:
+    @pytest.mark.parametrize("seed", [11, 23, 47])
+    def test_batched_identical_to_per_delta_under_churn_and_resync(self, seed):
+        """Seeded property: the SAME upstream op stream — churn across
+        two clusters with interleaved full-snapshot resyncs — folded
+        per-delta into one merge and batch-wise into another must
+        produce IDENTICAL global views, registries, and exact gauges."""
+        rng = random.Random(seed)
+        reg_a, reg_b = MetricsRegistry(), MetricsRegistry()
+        view_a, view_b = FleetView(compact_horizon=1 << 14), FleetView(compact_horizon=1 << 14)
+        merge_a = GlobalMerge(view_a, metrics=reg_a)
+        merge_b = GlobalMerge(view_b, metrics=reg_b)
+        shadow = {"east": {}, "west": {}}  # upstream truth per cluster
+        pending = {"east": [], "west": []}  # frames buffered for B
+
+        def flush(cluster):
+            while pending[cluster]:
+                size = rng.randint(1, 32)
+                batch, pending[cluster] = pending[cluster][:size], pending[cluster][size:]
+                merge_b.apply_batch(cluster, batch)
+
+        seq = 0
+        for _ in range(600):
+            cluster = rng.choice(("east", "west"))
+            roll = rng.random()
+            if roll < 0.04:
+                # resync: both sides adopt the upstream's current snapshot
+                # (B flushes its buffered frames first — a reconcile never
+                # reorders past in-flight deltas)
+                flush(cluster)
+                objects = list(shadow[cluster].values())
+                merge_a.reset_cluster(cluster, objects)
+                merge_b.reset_cluster(cluster, objects)
+                continue
+            key = f"pod-{rng.randint(0, 15)}"
+            if roll < 0.25 and key in shadow[cluster]:
+                frame = _wire_delete(key)
+                del shadow[cluster][key]
+            else:
+                seq += 1
+                frame = _wire_upsert(key, seq=seq, phase=rng.choice(("Pending", "Running")))
+                shadow[cluster][key] = frame["object"]
+            merge_a.apply_delta(cluster, frame)
+            pending[cluster].append(frame)
+        for cluster in ("east", "west"):
+            flush(cluster)
+        keyed_a = {(o["kind"], o["key"]): o for o in view_a.snapshot()[1]}
+        keyed_b = {(o["kind"], o["key"]): o for o in view_b.snapshot()[1]}
+        assert keyed_a == keyed_b
+        assert merge_a._keys == merge_b._keys
+        assert merge_a.object_count() == merge_b.object_count() == len(keyed_a)
+        assert reg_a.gauge("federation_merged_objects").value == len(keyed_a)
+        assert reg_b.gauge("federation_merged_objects").value == len(keyed_b)
+
+
+class TestSubscriberBatching:
+    def test_on_batch_delivers_every_delta_in_wire_order(self, live_serve):
+        view, _, base = live_serve
+        view.apply("pod", "seed", {"kind": "pod", "key": "seed", "seq": -1})
+        batches = []
+        sub = FleetSubscriber(
+            FleetClient(base),
+            on_batch=batches.append,
+            window_seconds=2.0,
+            backoff_seconds=0.05,
+        )
+        thread = threading.Thread(target=sub.run, daemon=True)
+        thread.start()
+        _wait_for(lambda: sub.snapshots > 0, message="subscriber snapshot")
+        for i in range(30):
+            view.apply("pod", f"p{i % 4}", {"kind": "pod", "key": f"p{i % 4}", "seq": i})
+            if i % 10 == 9:
+                time.sleep(0.05)
+        _wait_for(lambda: sub.rv == view.rv, message="subscriber caught up")
+        sub.stop()
+        thread.join(timeout=5)
+        flat = [f for batch in batches for f in batch]
+        rvs = [f["rv"] for f in flat]
+        assert rvs == sorted(rvs) and len(set(rvs)) == len(rvs)
+        assert sub.checker.clean and sub.checker.delivered == len(flat) > 0
+        assert sub.batches >= 1 and all(batch for batch in batches)
+
+    def test_failed_delivery_is_redelivered_not_skipped(self, live_serve):
+        """Regression: the resume cursor must advance only AFTER a run is
+        delivered — a transient callback failure (retried exception
+        class) reconnects and REDELIVERS the run instead of silently
+        skipping it past an already-advanced cursor."""
+        view, _, base = live_serve
+        view.apply("pod", "seed", {"kind": "pod", "key": "seed", "seq": -1})
+        applied = {}
+        failures = threading.Event()
+
+        def flaky_on_batch(frames):
+            if not failures.is_set():
+                failures.set()
+                raise OSError("transient downstream failure")
+            for f in frames:
+                applied[f["key"]] = f["object"]
+
+        sub = FleetSubscriber(
+            FleetClient(base),
+            on_batch=flaky_on_batch,
+            window_seconds=2.0,
+            backoff_seconds=0.05,
+        )
+        thread = threading.Thread(target=sub.run, daemon=True)
+        thread.start()
+        _wait_for(lambda: sub.snapshots > 0, message="subscriber snapshot")
+        for i in range(5):
+            view.apply("pod", f"p{i}", {"kind": "pod", "key": f"p{i}", "seq": i})
+        _wait_for(
+            lambda: all(f"p{i}" in applied for i in range(5)),
+            message="every delta applied despite the failed delivery",
+        )
+        sub.stop()
+        thread.join(timeout=5)
+        assert failures.is_set() and sub.reconnects >= 1
+        assert applied == {
+            f"p{i}": {"kind": "pod", "key": f"p{i}", "seq": i} for i in range(5)
+        }
+
+    def test_on_delta_fallback_still_works(self, live_serve):
+        view, _, base = live_serve
+        deltas = []
+        sub = FleetSubscriber(
+            FleetClient(base),
+            on_delta=deltas.append,
+            window_seconds=2.0,
+            backoff_seconds=0.05,
+        )
+        thread = threading.Thread(target=sub.run, daemon=True)
+        thread.start()
+        _wait_for(lambda: sub.snapshots > 0, message="subscriber snapshot")
+        view.apply("pod", "x", {"kind": "pod", "key": "x", "seq": 0})
+        _wait_for(lambda: len(deltas) == 1, message="delta delivered")
+        sub.stop()
+        thread.join(timeout=5)
+        assert deltas[0]["key"] == "x"
+
+
+class TestClientCodec:
+    def test_auto_negotiates_msgpack_and_json_pins_json(self, live_serve):
+        view, _, base = live_serve
+        view.apply("pod", "a", {"kind": "pod", "key": "a", "seq": 0})
+        auto = FleetClient(base)
+        pinned = FleetClient(base, codec="json")
+        snap_auto, snap_json = auto.snapshot(), pinned.snapshot()
+        assert auto.active_codec == "msgpack"
+        assert pinned.active_codec == "json"
+        assert snap_auto == snap_json
+
+    def test_watch_batches_equal_across_codecs(self, live_serve):
+        view, _, base = live_serve
+        view.apply("pod", "a", {"kind": "pod", "key": "a", "seq": 0})
+
+        def collect(client, base_seq):
+            got = []
+            stop = threading.Event()
+
+            def churn():
+                for i in range(10):
+                    if stop.is_set():
+                        return
+                    view.apply("pod", f"w{i}", {"kind": "pod", "key": f"w{i}", "seq": base_seq + i})
+                    time.sleep(0.01)
+
+            rv = view.rv
+            t = threading.Thread(target=churn, daemon=True)
+            t.start()
+            try:
+                for batch in client.watch_batches(rv, window_seconds=1.0):
+                    got.extend(f for f in batch if f.get("type") in ("UPSERT", "DELETE"))
+            finally:
+                stop.set()
+                t.join()
+            return got
+
+        got_mp = collect(FleetClient(base), 100)
+        got_json = collect(FleetClient(base, codec="json"), 200)
+        # each codec's decoded stream must replay to the exact state its
+        # window's churn produced — decode equivalence proven against the
+        # same ground truth, one codec per window
+        for got, base_seq in ((got_mp, 100), (got_json, 200)):
+            assert len(got) == 10
+            model = {f["key"]: f["object"] for f in got}
+            assert model == {
+                f"w{i}": {"kind": "pod", "key": f"w{i}", "seq": base_seq + i}
+                for i in range(10)
+            }
+
+    def test_server_side_downgrade_logged_once(self, live_serve, monkeypatch, caplog):
+        """Peer lacks msgpack: the client's JSON fallback is transparent
+        and the downgrade is logged ONCE per client, not per request."""
+        view, _, base = live_serve
+        view.apply("pod", "a", {"kind": "pod", "key": "a", "seq": 0})
+        monkeypatch.setattr(_server_mod, "msgpack_available", lambda: False)
+        client = FleetClient(base, codec="msgpack")
+        with caplog.at_level(_logging.INFO, logger="k8s_watcher_tpu.federate.client"):
+            for _ in range(3):
+                assert client.snapshot().rv == view.rv
+        assert client.active_codec == "json"
+        downgrades = [r for r in caplog.records if "does not speak msgpack" in r.message]
+        assert len(downgrades) == 1
+        assert downgrades[0].levelno == _logging.WARNING  # explicit msgpack pin WARNs
+
+    def test_client_side_import_downgrade_logged_once(self, live_serve, monkeypatch, caplog):
+        """The local import is the limiting side: Accept only offers
+        JSON, requests still work, and the downgrade logs once at
+        construction."""
+        view, _, base = live_serve
+        view.apply("pod", "a", {"kind": "pod", "key": "a", "seq": 0})
+        monkeypatch.setattr(_client_mod, "_msgpack", None)
+        with caplog.at_level(_logging.WARNING, logger="k8s_watcher_tpu.federate.client"):
+            client = FleetClient(base, codec="msgpack")
+            assert client.snapshot().rv == view.rv
+            assert client.snapshot().rv == view.rv
+        assert client.active_codec == "json"
+        assert "Accept" in client._headers() and "msgpack" not in client._headers()["Accept"]
+        downgrades = [r for r in caplog.records if "not importable" in r.message]
+        assert len(downgrades) == 1
+
+    def test_unknown_codec_rejected(self):
+        with pytest.raises(ValueError):
+            FleetClient("http://127.0.0.1:1", codec="bson")
+
+
+class TestFederationCodecSchema:
+    def test_codec_vocabulary(self):
+        cfg = FederationConfig.from_raw({})
+        assert cfg.codec == "auto"
+        for codec in ("auto", "json", "msgpack"):
+            assert FederationConfig.from_raw({"codec": codec}).codec == codec
+        with pytest.raises(SchemaError):
+            FederationConfig.from_raw({"codec": "bson"})
+
+    def test_codec_vocabularies_stay_in_sync(self):
+        """The codec vocabulary is declared in three dependency-ordered
+        modules (schema validates config, client negotiates, view
+        encodes); nothing ties them together at import time, so this
+        does — adding a codec to one without the others is a test
+        failure, not a runtime surprise."""
+        from k8s_watcher_tpu.config.schema import VALID_SERVE_CODECS
+        from k8s_watcher_tpu.federate.client import CODEC_AUTO, CODEC_JSON, CODEC_MSGPACK
+        from k8s_watcher_tpu.serve.view import CODECS
+
+        assert set(VALID_SERVE_CODECS) == {CODEC_AUTO, *CODECS}
+        assert set(CODECS) == {CODEC_JSON, CODEC_MSGPACK}

@@ -1089,3 +1089,194 @@ class TestBroadcastLoopEdgeCases:
         finally:
             epoll_srv.stop()
             legacy_srv.stop()
+
+
+# -- content-negotiated wire codec (msgpack) ---------------------------------
+
+
+import msgpack  # noqa: E402 - baked into the image; the codec tests exercise the real path
+
+from k8s_watcher_tpu.serve import (  # noqa: E402
+    CODEC_MSGPACK,
+    MSGPACK_CONTENT_TYPE,
+    chunk_frame,
+    frame_payload as _frame_payload,
+)
+from k8s_watcher_tpu.serve import server as _server_mod  # noqa: E402
+
+
+class TestCodecFrames:
+    def test_cross_codec_golden_equivalence_for_deltas(self):
+        """The decoded msgpack frame must equal the decoded JSON frame
+        for the SAME delta — the codec changes wire bytes, never
+        content (UPSERT and DELETE both covered)."""
+        view = FleetView()
+        view.apply("pod", "a", {"kind": "pod", "key": "a", "phase": "Running"})
+        view.apply("pod", "a", None)
+        rj = view.read_frames_since(0, max_deltas=16)
+        rm = view.read_frames_since(0, max_deltas=16, codec=CODEC_MSGPACK)
+        assert len(rj.frames) == len(rm.frames) == 2
+        for d, fj, fm in zip(rj.deltas, rj.frames, rm.frames):
+            assert json.loads(_frame_payload(fj)) == d.to_wire()
+            assert msgpack.unpackb(_frame_payload(fm), raw=False) == d.to_wire()
+
+    def test_cross_codec_control_frames(self):
+        """SYNC/COMPACTED/GONE control frames decode identically across
+        codecs too — a consumer's control handling is codec-blind."""
+        for obj in (
+            {"type": "SYNC", "rv": 7, "view": "abc123"},
+            {"type": "COMPACTED", "from_rv": 3, "to_rv": 9},
+            {"type": "GONE", "rv": 2, "oldest_rv": 5},
+        ):
+            decoded_json = json.loads(_frame_payload(chunk_frame(obj)))
+            decoded_mp = msgpack.unpackb(
+                _frame_payload(chunk_frame(obj, CODEC_MSGPACK)), raw=False
+            )
+            assert decoded_json == decoded_mp == obj
+
+    def test_msgpack_frames_lazy_memoized_and_shared(self):
+        reg = MetricsRegistry()
+        view = FleetView(metrics=reg)
+        for i in range(4):
+            view.apply("pod", f"p{i}", {"seq": i})
+        # JSON stays eager (the PR-7 contract); msgpack encodes nothing
+        # until a msgpack subscriber actually reads
+        assert reg.counter("serve_frame_encodes").value == 4
+        assert reg.counter("serve_frame_encodes_msgpack").value == 0
+        r1 = view.read_frames_since(0, max_deltas=16, codec=CODEC_MSGPACK)
+        assert reg.counter("serve_frame_encodes_msgpack").value == 4
+        r2 = view.read_frames_since(0, max_deltas=16, codec=CODEC_MSGPACK)
+        # memoized: the second pull shares the SAME bytes objects and
+        # pays zero further encodes
+        assert all(a is b for a, b in zip(r1.frames, r2.frames))
+        assert reg.counter("serve_frame_encodes_msgpack").value == 4
+        # and the JSON frames were never disturbed
+        rj = view.read_frames_since(0, max_deltas=16)
+        assert reg.counter("serve_frame_encodes").value == 4
+        assert all(f is not None for f in rj.frames)
+
+
+class TestApplyBatch:
+    def test_dense_rvs_dedup_single_wakeup_one_history_publish(self):
+        wakes = []
+        published = []
+
+        class FakeHistory:
+            pass
+
+        history = FakeHistory()
+        history.publish = lambda deltas: published.append(list(deltas))
+        view = FleetView()
+        view.attach_history(history)
+        view.register_wakeup(lambda: wakes.append(1))
+        changed = view.apply_batch([
+            ("pod", "a", {"s": 1}),
+            ("pod", "b", {"s": 2}),
+            ("pod", "a", {"s": 11}),
+            ("pod", "b", {"s": 2}),      # identical upsert: no-op
+            ("pod", "absent", None),      # delete of absent key: no-op
+        ])
+        assert changed == 3 and view.rv == 3
+        assert [d.rv for d in view.read_since(0, max_deltas=16).deltas] == [1, 2, 3]
+        # ONE wakeup and ONE history hand-off for the whole batch — the
+        # per-batch (not per-delta) locking the fan-in pays for
+        assert len(wakes) == 1
+        assert len(published) == 1 and [d.rv for d in published[0]] == [1, 2, 3]
+
+    def test_lazy_json_frames_fill_byte_identical_to_eager(self):
+        view = FleetView()
+        view.apply_batch([
+            ("pod", "a", {"kind": "pod", "key": "a", "phase": "Running"}),
+            ("pod", "a", None),
+        ])
+        r = view.read_frames_since(0, max_deltas=16)
+        for d, f in zip(r.deltas, r.frames):
+            # the lazily-filled frame is byte-identical to the PR-4/PR-7
+            # eager encoder's output (the golden contract)
+            expected = (json.dumps(d.to_wire()) + "\n").encode()
+            assert _frame_payload(f) == expected
+        r2 = view.read_frames_since(0, max_deltas=16)
+        assert all(a is b for a, b in zip(r.frames, r2.frames))
+
+    def test_apply_batch_equivalent_to_apply_sequence(self):
+        items = []
+        for i in range(60):
+            key = f"p{i % 7}"
+            if i % 9 == 8:
+                items.append(("pod", key, None))
+            else:
+                items.append(("pod", key, {"kind": "pod", "key": key, "seq": i}))
+        one = FleetView()
+        for kind, key, obj in items:
+            one.apply(kind, key, obj)
+        batched = FleetView()
+        batched.apply_batch(items)
+        assert one.snapshot()[1] == batched.snapshot()[1]
+        assert one.rv == batched.rv
+
+
+class TestSnapshotCodecCache:
+    def test_per_codec_entries_do_not_evict_each_other(self):
+        reg = MetricsRegistry()
+        view = FleetView(metrics=reg)
+        view.apply("pod", "a", {"kind": "pod", "key": "a", "seq": 0})
+        bj = view.snapshot_bytes()
+        bm = view.snapshot_bytes(codec=CODEC_MSGPACK)
+        # the other codec's read did NOT evict: both still cached objects
+        assert view.snapshot_bytes() is bj
+        assert view.snapshot_bytes(codec=CODEC_MSGPACK) is bm
+        assert msgpack.unpackb(bm, raw=False) == json.loads(bj)
+        # per-codec labels on the hit/miss counters (+ the totals)
+        assert reg.counter("serve_snapshot_cache_misses_json").value == 1
+        assert reg.counter("serve_snapshot_cache_misses_msgpack").value == 1
+        assert reg.counter("serve_snapshot_cache_hits_json").value == 1
+        assert reg.counter("serve_snapshot_cache_hits_msgpack").value == 1
+        assert reg.counter("serve_snapshot_cache_hits").value == 2
+        assert reg.counter("serve_snapshot_cache_misses").value == 2
+        # a publish invalidates BOTH codec entries by bumping rv
+        view.apply("pod", "a", {"kind": "pod", "key": "a", "seq": 1})
+        assert view.snapshot_bytes() is not bj
+        assert view.snapshot_bytes(codec=CODEC_MSGPACK) is not bm
+
+
+class TestCodecHttp:
+    def test_accept_negotiation_on_snapshot_and_long_poll(self, serve_http):
+        view, _, base = serve_http
+        view.apply("pod", "a", {"kind": "pod", "key": "a", "seq": 0})
+        rj = requests.get(f"{base}/serve/fleet", timeout=5)
+        rm = requests.get(
+            f"{base}/serve/fleet", headers={"Accept": MSGPACK_CONTENT_TYPE}, timeout=5
+        )
+        assert rj.headers["Content-Type"] == "application/json"
+        assert rm.headers["Content-Type"] == MSGPACK_CONTENT_TYPE
+        assert msgpack.unpackb(rm.content, raw=False) == rj.json()
+        pj = requests.get(f"{base}/serve/fleet", params={"watch": 1, "once": 1, "rv": 0, "timeout": 0.2}, timeout=5)
+        pm = requests.get(
+            f"{base}/serve/fleet", params={"watch": 1, "once": 1, "rv": 0, "timeout": 0.2},
+            headers={"Accept": MSGPACK_CONTENT_TYPE}, timeout=5,
+        )
+        assert msgpack.unpackb(pm.content, raw=False) == pj.json()
+
+    def test_error_bodies_ride_the_negotiated_codec(self, serve_http):
+        view, _, base = serve_http
+        view.apply("pod", "a", {"kind": "pod", "key": "a", "seq": 0})
+        r = requests.get(
+            f"{base}/serve/fleet", params={"watch": 1, "once": 1, "rv": 999},
+            headers={"Accept": MSGPACK_CONTENT_TYPE}, timeout=5,
+        )
+        assert r.status_code == 410
+        body = msgpack.unpackb(r.content, raw=False)
+        assert "re-snapshot" in body["error"]
+
+    def test_server_without_msgpack_advertises_json(self, serve_http, monkeypatch):
+        # graceful no-msgpack posture: the negotiation seam reports the
+        # codec unavailable -> Accept: msgpack still gets a JSON body
+        view, _, base = serve_http
+        view.apply("pod", "a", {"kind": "pod", "key": "a", "seq": 0})
+        monkeypatch.setattr(_server_mod, "msgpack_available", lambda: False)
+        r = requests.get(
+            f"{base}/serve/fleet", headers={"Accept": MSGPACK_CONTENT_TYPE}, timeout=5
+        )
+        assert r.status_code == 200
+        assert r.headers["Content-Type"] == "application/json"
+        assert r.json()["rv"] == view.rv
