@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: test check check-scale integration integration-kind integration-mock bench bench-smoke trace-smoke serve-smoke history-smoke federation-smoke dryrun dryrun-128 accept
+.PHONY: test check check-scale integration integration-kind integration-mock bench bench-smoke trace-smoke serve-smoke history-smoke federation-smoke obs-smoke dryrun dryrun-128 accept
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -82,6 +82,19 @@ history-smoke:
 # (bench_federation). Artifact: artifacts/federation_smoke.json.
 federation-smoke:
 	$(PY) scripts/federation_smoke.py
+
+# Observability-plane smoke: one mock-backed upstream + one federator
+# with the SLO engine on tight windows. Gates: labeled Prometheus
+# exposition renders ({upstream=...}/{objective=...}), the
+# watch_to_global_view/serve_wire propagation histograms populate
+# through the negotiated ?fresh=1 stamps, /debug/freshness watermarks
+# advance under churn and AGE while the upstream is paused, and the
+# deliberately-tight staleness SLO breaches — degrading the /healthz
+# BODY while liveness stays 200 — then clears on resume. The latency
+# BUDGETS on the same histograms run in bench-smoke (bench_federation).
+# Artifact: artifacts/obs_smoke.json.
+obs-smoke:
+	$(PY) scripts/obs_smoke.py
 
 dryrun:
 	$(PY) __graft_entry__.py 8

@@ -2490,15 +2490,19 @@ def bench_federation(
     ServeServer each) x paced churn -> one FederationPlane merging into a
     global FleetView, gating pod-event->global-view latency p50.
 
-    Every upstream delta carries its publish stamp; a reader on the
-    GLOBAL view measures stamp->global-visibility latency — the number a
-    cross-cluster scheduler reading the federator actually experiences
-    (upstream encode + wire + client decode + merge apply). Correctness
-    legs: the merged terminal state must equal the union of the upstream
-    snapshots under cluster-prefixed keys, and every federation
-    subscriber's SequenceChecker must report zero gaps/dups. A
-    correctness failure stops the retry wrapper COLD (races must not get
-    best-of-N votes); only the latency/starvation legs retry."""
+    The latency numbers are read from the PRODUCTION telemetry — the
+    ``watch_to_global_view_seconds`` histogram the freshness plane
+    populates from the negotiated per-frame origin stamps (upstream
+    apply -> encode + wire + client decode + merge apply). The bench
+    used to keep its own hand-rolled timing map; gating the histogram
+    instead means the number operators scrape IS the number this gate
+    certifies (``freshness_ok`` additionally requires the serve-wire
+    histogram and every upstream's watermark to have populated).
+    Correctness legs: the merged terminal state must equal the union of
+    the upstream snapshots under cluster-prefixed keys, and every
+    federation subscriber's SequenceChecker must report zero gaps/dups.
+    A correctness failure stops the retry wrapper COLD (races must not
+    get best-of-N votes); only the latency/starvation legs retry."""
     import threading as _threading
 
     from k8s_watcher_tpu.config.schema import FederationConfig
@@ -2534,24 +2538,10 @@ def bench_federation(
                     break
                 time.sleep(0.02)
 
-            latencies: list = []
-            stop = _threading.Event()
-
-            def global_reader() -> None:
-                # rides the view's read API directly (the in-process
-                # analogue of a subscriber): every merged delta's object
-                # carries its upstream publish stamp
-                rv = 0
-                while not stop.is_set():
-                    res = gview.read_since(rv, max_deltas=1 << 17, timeout=0.2)
-                    now = time.monotonic()
-                    for d in res.deltas:
-                        obj = d.object
-                        if obj is not None and "t" in obj:
-                            latencies.append(now - obj["t"])
-                    rv = res.to_rv
-
             def publisher(v: "FleetView", cluster: int) -> None:
+                # FleetView.apply stamps ts_wall at apply time — the
+                # origin stamp the freshness plane's histograms measure
+                # from, carried over the negotiated ?fresh=1 wire
                 start = time.monotonic()
                 i = 0
                 while True:
@@ -2567,13 +2557,10 @@ def bench_federation(
                             v.apply("pod", key, {
                                 "kind": "pod", "key": key, "cluster_seq": i,
                                 "phase": ("Pending", "Running")[i % 2],
-                                "t": time.monotonic(),
                             })
                         i += 1
                     time.sleep(0.002)
 
-            reader = _threading.Thread(target=global_reader, daemon=True)
-            reader.start()
             pubs = [
                 _threading.Thread(target=publisher, args=(v, i), daemon=True)
                 for i, (v, _) in enumerate(upstreams)
@@ -2599,27 +2586,41 @@ def bench_federation(
                     merged_matches = True
                     break
                 time.sleep(0.05)
-            stop.set()
-            reader.join(timeout=5)
 
             health = plane.health()
+            freshness = plane.freshness()
             gaps = sum(u["gaps"] for u in health["upstreams"].values())
             dups = sum(u["dups"] for u in health["upstreams"].values())
             resyncs = sum(u["resyncs"] for u in health["upstreams"].values())
             deltas_applied = reg.counter("federation_deltas_applied").value
             plane.stop()
-            lat_sorted = sorted(latencies)
+            # the PRODUCTION telemetry is the gate: pod-event->global-view
+            # latency from the watch_to_global_view_seconds histogram the
+            # plane populated off the negotiated per-frame stamps —
+            # exactly what an operator's scrape (and the SLO engine) sees
+            w2g = reg.histogram("watch_to_global_view_seconds")
+            wire = reg.histogram("serve_wire_seconds")
+            w2g_summary = w2g.summary()
 
-            def pct(q: float):
-                if not lat_sorted:
-                    return None
-                return round(1e3 * lat_sorted[min(len(lat_sorted) - 1, int(q * len(lat_sorted)))], 3)
+            def pct(key: str):
+                value = w2g_summary.get(key)
+                return round(value, 3) if value is not None else None
 
             published = sum(v.rv for v, _ in upstreams)
-            p50 = pct(0.5)
+            p50 = pct("p50_ms")
+            watermarks = {
+                name: u.get("watermark_age_seconds")
+                for name, u in freshness["upstreams"].items()
+            }
+            freshness_ok = (
+                w2g.count > 0
+                and wire.count > 0
+                and all(age is not None for age in watermarks.values())
+            )
             correctness_ok = merged_matches and gaps == 0 and dups == 0
             ok = (
                 correctness_ok
+                and freshness_ok
                 and p50 is not None
                 and p50 <= p50_budget_ms
                 and deltas_applied > 0
@@ -2630,11 +2631,14 @@ def bench_federation(
                 "events_per_sec_offered": events_per_sec * n_upstreams,
                 "events_per_sec": round(published / publish_elapsed, 1) if publish_elapsed else 0.0,
                 "deltas_applied": deltas_applied,
-                "latency_samples": len(lat_sorted),
+                "latency_samples": w2g.count,
                 "p50_ms": p50,
-                "p90_ms": pct(0.9),
-                "p99_ms": pct(0.99),
+                "p90_ms": pct("p90_ms"),
+                "p99_ms": pct("p99_ms"),
                 "p50_budget_ms": p50_budget_ms,
+                "serve_wire_p99_ms": round(wire.summary().get("p99_ms", 0.0), 3) if wire.count else None,
+                "freshness_ok": freshness_ok,
+                "watermark_age_seconds": watermarks,
                 "merged_matches": merged_matches,
                 "merged_objects": health["merged_objects"],
                 "gaps": gaps,
@@ -2833,8 +2837,14 @@ def main(smoke: bool = False) -> int:
         "serve_encode_once_ok": serve_fanout.get("encode_amortized_ok", False),
         "serve_cpu_flat_ok": serve_fanout.get("publisher_cpu_flat_ok", False),
         # federation plane: 3-upstream fan-in pod-event->global-view p50 +
-        # merged-state correctness (zero gaps/dups, union == merged)
+        # merged-state correctness (zero gaps/dups, union == merged).
+        # p50/p99 are read from the watch_to_global_view_seconds
+        # histogram — the freshness plane's production telemetry — and
+        # freshness_ok certifies the stamps/watermarks populated end to
+        # end (the bench gates the numbers operators actually scrape)
         "federation_p50_ms": federation.get("p50_ms"),
+        "propagation_p99_ms": federation.get("p99_ms"),
+        "freshness_ok": federation.get("freshness_ok", False),
         "federation_ok": federation.get("ok", False),
         # batched fan-in: apply_batch >= 3x the per-delta baseline (same
         # run) + the churn-doubling ramp's sustained merged-deltas/s

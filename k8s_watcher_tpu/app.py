@@ -154,6 +154,10 @@ class WatcherApp:
     ):
         self.config = config
         self.metrics = metrics or MetricsRegistry()
+        # labeled-metrics migration continuity: set BEFORE the planes
+        # are built — they read the flag at construction to decide
+        # whether the old suffix-mangled series keep being emitted
+        self.metrics.legacy_suffix_names = config.metrics.legacy_suffix_names
         self.checkpoint = (
             CheckpointStore(
                 config.state.checkpoint_path,
@@ -279,6 +283,16 @@ class WatcherApp:
                 token_dir=token_dir,
                 resume_tokens_valid=tokens_valid,
             )
+        # SLO/burn-rate engine (slo/): samples every registered metric
+        # on a tick into a bounded timeseries ring and evaluates the
+        # config-declared objectives with two-window burn rates. Built
+        # last among the planes so its first sample already sees their
+        # registered series; starts/stops with the app in run()/shutdown.
+        self.slo = None
+        if config.slo.enabled:
+            from k8s_watcher_tpu.slo import SLOPlane
+
+            self.slo = SLOPlane(config.slo, self.metrics)
         c = config.clusterapi
         self.dispatcher = Dispatcher(
             self.notifier.update_pod_status,
@@ -387,6 +401,10 @@ class WatcherApp:
             # after the serve plane (the merged view republishes through
             # it), before the status server (same always-started contract)
             self.federation.start()
+        if self.slo is not None:
+            # after every metric-producing plane exists; the engine's
+            # first tick seeds the ring so burn windows have a base
+            self.slo.start()
         if self.config.watcher.status_port:
             agent_trend = (
                 self._probe_agent.trend.snapshot
@@ -416,6 +434,13 @@ class WatcherApp:
                 # ... and the federation plane: a stale upstream means a
                 # slice of the global view has gone dark
                 federation=self.federation.health if self.federation is not None else None,
+                # freshness watermarks + propagation histograms (the
+                # "how stale is what I'm serving" surface)
+                freshness=self._freshness_snapshot if self.serve is not None else None,
+                # SLO engine: full detail at /debug/slo; the breach
+                # verdict rides the /healthz BODY (degraded only)
+                slo=self.slo.snapshot if self.slo is not None else None,
+                slo_health=self.slo.health if self.slo is not None else None,
                 slices=self.slice_tracker.debug_snapshot,
                 trend=agent_trend,
                 remediation=remediation_state,
@@ -441,6 +466,10 @@ class WatcherApp:
                 ", /debug/history" if self.history is not None else ""
             ) + (
                 ", /debug/federation" if self.federation is not None else ""
+            ) + (
+                ", /debug/freshness" if self.serve is not None else ""
+            ) + (
+                ", /debug/slo" if self.slo is not None else ""
             )
             logger.info("Status endpoint on :%d (%s)", self.status_server.port, routes)
         if self.config.watcher.leader_election.enabled:
@@ -624,6 +653,18 @@ class WatcherApp:
             if known is not None:
                 self.checkpoint.put("known_pods", known, changed_keys=changed)
 
+    def _freshness_snapshot(self) -> dict:
+        """The /debug/freshness body: the local view's watermark, the
+        watch->local-view histogram, and (when federating) per-upstream
+        watermarks + the cross-cluster propagation histograms."""
+        out = {"local": self.serve.view.freshness()}
+        local_hist = self.metrics.histogram("watch_to_local_view_seconds")
+        if local_hist.count:
+            out["local"]["watch_to_local_view_seconds"] = local_hist.summary()
+        if self.federation is not None:
+            out["federation"] = self.federation.freshness()
+        return out
+
     def stop(self) -> None:
         self._stop.set()
         self.ingest.stop()  # stops the shard streams (incl. self.source)
@@ -639,6 +680,8 @@ class WatcherApp:
         if self.status_server is not None:
             self.status_server.stop()
             self.status_server = None
+        if self.slo is not None:
+            self.slo.stop()
         if self.federation is not None:
             # before the serve plane and the WAL close: the upstream
             # subscribers are view producers, and the terminal history

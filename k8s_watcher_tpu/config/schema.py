@@ -887,6 +887,222 @@ class HistoryConfig:
         )
 
 
+@dataclasses.dataclass(frozen=True)
+class MetricsConfig:
+    """The ``metrics:`` section — observability-plane knobs.
+
+    ``legacy_suffix_names``: PR 10 migrated the per-upstream federation
+    gauges (``federation_upstream_lag_{rv,seconds}_<name>``) and the
+    per-codec serve cache counters (``serve_snapshot_cache_*_{json,
+    msgpack}``) from name-suffix mangling onto real Prometheus labels
+    (``...{upstream="a"}`` / ``...{codec="json"}``). This flag keeps the
+    OLD suffixed series emitted alongside for one release so existing
+    dashboards/alerts keep working while they migrate — default on in
+    production.yaml, off elsewhere.
+    """
+
+    legacy_suffix_names: bool = False
+
+    @classmethod
+    def from_raw(cls, raw: Mapping[str, Any]) -> "MetricsConfig":
+        _check_known(raw, ("legacy_suffix_names",), "metrics")
+        return cls(
+            legacy_suffix_names=_opt_bool(raw, "legacy_suffix_names", "metrics", False),
+        )
+
+
+#: accepted SLO objective kinds (slo/engine.py mirrors the semantics)
+VALID_SLO_KINDS = ("quantile", "gauge", "ratio")
+
+#: SLO objective names become Prometheus label values and /debug/slo keys
+_SLO_NAME_RE = re.compile(r"^[a-zA-Z0-9_.\-]{1,64}$")
+
+
+@dataclasses.dataclass(frozen=True)
+class SloObjective:
+    """One declared service-level objective (``slo.objectives[]``).
+
+    Three kinds, keyed by which spec field is present in the raw entry:
+
+    - ``quantile`` (``histogram:`` + ``max_seconds:``): a request-based
+      latency SLO — the error rate over a window is the fraction of
+      observations ABOVE ``max_seconds`` (computed from cumulative
+      bucket deltas, so it is exact at bucket resolution); ``quantile``
+      only picks which windowed percentile /debug/slo reports.
+    - ``gauge`` (``gauge:`` + ``max:``): a state SLO — the error rate is
+      the fraction of ring ticks on which the gauge (max across its
+      label children) exceeded ``max``.
+    - ``ratio`` (``ratio_good:`` + ``ratio_total:`` + ``min_ratio:``):
+      a success-ratio SLO over two counters — the error rate is
+      ``1 - Δgood/Δtotal`` over the window.
+
+    ``target`` is the compliance target; the error budget is
+    ``1 - target`` and a burn rate of 1.0 means the budget is being
+    spent exactly as fast as it accrues (ratio objectives budget off
+    ``min_ratio`` directly).
+    """
+
+    name: str
+    kind: str
+    metric: str = ""  # histogram name (quantile) / gauge name (gauge)
+    quantile: float = 0.99
+    max_seconds: float = 0.0  # quantile threshold
+    max_value: float = 0.0  # gauge threshold
+    good: str = ""  # ratio numerator counter
+    total: str = ""  # ratio denominator counter
+    min_ratio: float = 0.999
+    target: float = 0.99
+
+    @classmethod
+    def from_raw(cls, raw: Mapping[str, Any], path: str) -> "SloObjective":
+        _check_known(
+            raw,
+            ("name", "histogram", "quantile", "max_seconds", "gauge", "max",
+             "ratio_good", "ratio_total", "min_ratio", "target"),
+            path,
+        )
+        name = _opt_str(raw, "name", path, None)
+        if not name or not _SLO_NAME_RE.match(name):
+            raise SchemaError(
+                f"config key '{path}.name': required, 1-64 chars of [a-zA-Z0-9_.-] "
+                f"(it becomes the slo_burn_rate{{objective=...}} label value), got {name!r}"
+            )
+        specs = [k for k in ("histogram", "gauge", "ratio_good") if raw.get(k)]
+        if len(specs) != 1:
+            raise SchemaError(
+                f"config key '{path}': exactly one of histogram:/gauge:/ratio_good: "
+                f"must be set (got {specs or 'none'})"
+            )
+        target = _opt_num(raw, "target", path, 0.99)
+        if not 0.0 < target < 1.0:
+            raise SchemaError(
+                f"config key '{path}.target': must be in (0, 1) — the error budget "
+                f"is 1 - target — got {target}"
+            )
+        if specs[0] == "histogram":
+            quantile = _opt_num(raw, "quantile", path, 0.99)
+            if not 0.0 < quantile <= 1.0:
+                raise SchemaError(f"config key '{path}.quantile': must be in (0, 1], got {quantile}")
+            max_seconds = _opt_num(raw, "max_seconds", path, 0.0)
+            if max_seconds <= 0:
+                raise SchemaError(
+                    f"config key '{path}.max_seconds': required > 0 for a histogram objective"
+                )
+            return cls(name=name, kind="quantile", metric=_opt_str(raw, "histogram", path, ""),
+                       quantile=quantile, max_seconds=max_seconds, target=target)
+        if specs[0] == "gauge":
+            if "max" not in raw or raw["max"] is None:
+                raise SchemaError(f"config key '{path}.max': required for a gauge objective")
+            return cls(name=name, kind="gauge", metric=_opt_str(raw, "gauge", path, ""),
+                       max_value=_opt_num(raw, "max", path, 0.0), target=target)
+        total = _opt_str(raw, "ratio_total", path, None)
+        if not total:
+            raise SchemaError(
+                f"config key '{path}.ratio_total': required alongside ratio_good"
+            )
+        min_ratio = _opt_num(raw, "min_ratio", path, 0.999)
+        if not 0.0 < min_ratio < 1.0:
+            raise SchemaError(
+                f"config key '{path}.min_ratio': must be in (0, 1), got {min_ratio}"
+            )
+        # the budget defaults to the ratio floor itself (budget =
+        # 1 - min_ratio), but an EXPLICIT target: is honored — silently
+        # overriding an accepted key would page at the wrong rate
+        ratio_target = target if raw.get("target") is not None else min_ratio
+        return cls(name=name, kind="ratio", good=_opt_str(raw, "ratio_good", path, ""),
+                   total=total, min_ratio=min_ratio, target=ratio_target)
+
+
+@dataclasses.dataclass(frozen=True)
+class SloConfig:
+    """The ``slo:`` section — net-new SLO/burn-rate engine (slo/): a
+    bounded in-process timeseries ring samples every registered metric
+    on a tick; config-declared objectives are evaluated with the
+    standard two-window burn rate (fast + slow, both over the error
+    budget ``1 - target``; breaching requires BOTH windows hot — the
+    page-worthy "burning fast AND not a blip" rule). Results serve at
+    ``/debug/slo``, export as ``slo_burn_rate{objective=,window=}`` /
+    ``slo_breaching{objective=}``, and fold into the /healthz BODY
+    (degraded, never the liveness verdict — restarting a watcher does
+    not refund an error budget).
+    """
+
+    enabled: bool = False
+    tick_seconds: float = 5.0
+    # ring capacity in ticks; must cover the slow window
+    ring_size: int = 1024
+    fast_window_seconds: float = 300.0
+    slow_window_seconds: float = 3600.0
+    # both windows' burn rates must exceed this to breach (1.0 = budget
+    # being spent exactly at the sustainable rate)
+    burn_threshold: float = 1.0
+    objectives: tuple = ()  # tuple[SloObjective, ...]
+
+    @classmethod
+    def from_raw(cls, raw: Mapping[str, Any]) -> "SloConfig":
+        path = "slo"
+        _check_known(
+            raw,
+            ("enabled", "tick_seconds", "ring_size", "fast_window_seconds",
+             "slow_window_seconds", "burn_threshold", "objectives"),
+            path,
+        )
+        enabled = _opt_bool(raw, "enabled", path, False)
+        tick = _opt_num(raw, "tick_seconds", path, 5.0)
+        if tick <= 0:
+            raise SchemaError(f"config key '{path}.tick_seconds': must be > 0, got {tick}")
+        fast = _opt_num(raw, "fast_window_seconds", path, 300.0)
+        slow = _opt_num(raw, "slow_window_seconds", path, 3600.0)
+        if not tick <= fast < slow:
+            raise SchemaError(
+                f"config key '{path}': need tick_seconds <= fast_window_seconds < "
+                f"slow_window_seconds, got tick={tick} fast={fast} slow={slow}"
+            )
+        ring_size = _opt_int(raw, "ring_size", path, 1024)
+        if ring_size < 2:
+            raise SchemaError(f"config key '{path}.ring_size': must be >= 2, got {ring_size}")
+        if ring_size * tick < slow:
+            raise SchemaError(
+                f"config key '{path}.ring_size': {ring_size} ticks x {tick}s does not "
+                f"cover slow_window_seconds={slow} — the slow burn window would "
+                f"silently evaluate over less history than it claims"
+            )
+        burn_threshold = _opt_num(raw, "burn_threshold", path, 1.0)
+        if burn_threshold <= 0:
+            raise SchemaError(
+                f"config key '{path}.burn_threshold': must be > 0, got {burn_threshold}"
+            )
+        raw_objectives = raw.get("objectives") or ()
+        _expect(raw_objectives, (list, tuple), f"{path}.objectives")
+        objectives = []
+        seen = set()
+        for i, entry in enumerate(raw_objectives):
+            entry_path = f"{path}.objectives[{i}]"
+            _expect(entry, (dict,), entry_path)
+            objective = SloObjective.from_raw(entry, entry_path)
+            if objective.name in seen:
+                raise SchemaError(
+                    f"config key '{entry_path}.name': duplicate objective name "
+                    f"{objective.name!r}"
+                )
+            seen.add(objective.name)
+            objectives.append(objective)
+        if enabled and not objectives:
+            raise SchemaError(
+                "config key 'slo.objectives': at least one objective is required "
+                "when slo.enabled (an SLO engine with nothing to evaluate)"
+            )
+        return cls(
+            enabled=enabled,
+            tick_seconds=tick,
+            ring_size=ring_size,
+            fast_window_seconds=fast,
+            slow_window_seconds=slow,
+            burn_threshold=burn_threshold,
+            objectives=tuple(objectives),
+        )
+
+
 def metric_safe_name(name: str) -> str:
     """Cluster/upstream name -> metric-name- and filename-safe form
     (Prometheus charset). The ONE sanitizer the federation plane uses for
@@ -1069,13 +1285,15 @@ class AppConfig:
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     history: HistoryConfig = dataclasses.field(default_factory=HistoryConfig)
     federation: FederationConfig = dataclasses.field(default_factory=FederationConfig)
+    metrics: MetricsConfig = dataclasses.field(default_factory=MetricsConfig)
+    slo: SloConfig = dataclasses.field(default_factory=SloConfig)
 
-    TOP_LEVEL_KEYS = ("environment", "watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest", "trace", "serve", "history", "federation")
+    TOP_LEVEL_KEYS = ("environment", "watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest", "trace", "serve", "history", "federation", "metrics", "slo")
 
     @classmethod
     def from_raw(cls, raw: Mapping[str, Any], environment: str) -> "AppConfig":
         _check_known(raw, cls.TOP_LEVEL_KEYS, "<root>")
-        for section in ("watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest", "trace", "serve", "history", "federation"):
+        for section in ("watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest", "trace", "serve", "history", "federation", "metrics", "slo"):
             _expect(raw.get(section) or {}, (dict,), section)
         # The reference's development.yaml declared `environment: local` while
         # the CLI only accepted development|staging|production, leaving the
@@ -1111,4 +1329,6 @@ class AppConfig:
             serve=serve,
             history=history,
             federation=federation,
+            metrics=MetricsConfig.from_raw(raw.get("metrics") or {}),
+            slo=SloConfig.from_raw(raw.get("slo") or {}),
         )

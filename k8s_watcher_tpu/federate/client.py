@@ -260,10 +260,17 @@ class FleetClient:
         timeout: float = 10.0,
         verify_tls: bool = True,
         codec: str = CODEC_AUTO,
+        fresh: bool = False,
     ):
         self.token = token
         self.timeout = timeout
         self.verify_tls = verify_tls
+        # freshness negotiation (?fresh=1): delta frames additionally
+        # carry ts=[origin_wall, publish_wall]. Negotiated like the
+        # codec: an upstream that predates the field simply ignores the
+        # param and serves plain frames — the decoded dicts just lack
+        # "ts", so propagation metrics degrade to absent, never wrong.
+        self.fresh = fresh
         if codec not in (CODEC_AUTO, CODEC_JSON, CODEC_MSGPACK):
             raise ValueError(f"unknown serve wire codec {codec!r}")
         self.codec_preference = codec
@@ -434,6 +441,8 @@ class FleetClient:
             params["view"] = view
         if limit:
             params["limit"] = limit
+        if self.fresh:
+            params["fresh"] = "1"
         body = self._get_json(
             f"/serve/fleet?{urlencode(params)}",
             # the HTTP read must outlive the server-side poll window
@@ -489,6 +498,8 @@ class FleetClient:
             params["view"] = view
         if limit:
             params["limit"] = limit
+        if self.fresh:
+            params["fresh"] = "1"
         conn = self._connect(read_timeout if read_timeout is not None else self.timeout)
         if on_conn is not None:
             on_conn(conn)
@@ -729,6 +740,13 @@ class FleetSubscriber:
         self.connected = False
         self.last_error: Optional[str] = None
         self._last_frame_t = 0.0  # 0 = never
+        # freshness watermark: the origin wall stamp of the NEWEST delta
+        # applied downstream (frame ts when the upstream stamps, local
+        # receive wall otherwise; a snapshot reconcile resets it to now —
+        # a full state hand-off is by definition fresh). Advances under
+        # churn, ages while the upstream is paused or dark.
+        self.watermark_wall: Optional[float] = None
+        self._last_delta_mono = 0.0  # local monotonic of the last applied delta
         self._saved_token: Optional[Tuple[int, str]] = None  # last persisted (rv, view)
         self._stop = threading.Event()
         self._invalidate = threading.Event()
@@ -768,6 +786,20 @@ class FleetSubscriber:
         """Seconds since the last frame (None before the first)."""
         t = self._last_frame_t
         return None if t == 0.0 else time.monotonic() - t
+
+    def last_delta_age(self) -> Optional[float]:
+        """Seconds since the last DELTA applied downstream (SYNC
+        heartbeats don't count — an idle-but-alive upstream ages here
+        while staying fresh on ``last_frame_age``)."""
+        t = self._last_delta_mono
+        return None if t == 0.0 else time.monotonic() - t
+
+    def watermark_age(self) -> Optional[float]:
+        """Age of the freshness watermark: wall-now minus the origin
+        stamp of the newest applied delta. Wall clocks (the origin is a
+        REMOTE host) — subject to cross-host skew, clamped at 0."""
+        w = self.watermark_wall
+        return None if w is None else max(0.0, time.time() - w)
 
     # -- the loop ----------------------------------------------------------
 
@@ -855,6 +887,9 @@ class FleetSubscriber:
         self.wire_rv = max(self.wire_rv, snap.rv)
         self.snapshots += 1
         self._last_frame_t = time.monotonic()
+        # a full state hand-off is by definition fresh as of now
+        self.watermark_wall = time.time()
+        self._last_delta_mono = time.monotonic()
         self._save_token(snap.rv, snap.view)
         if self.on_snapshot is not None:
             self.on_snapshot(snap)
@@ -911,13 +946,21 @@ class FleetSubscriber:
             # resumes from the last delivered rv and the run is simply
             # redelivered — never silently skipped.
             run: List[Dict[str, Any]] = []
+            run_watermark: Optional[float] = None
             prev_rv = self.rv or 0
 
             def commit_run() -> None:
-                nonlocal run
+                nonlocal run, run_watermark
                 if run:
                     self._deliver(run)
+                    # watermark semantics: the newest APPLIED delta's
+                    # origin stamp — advanced only AFTER the run reached
+                    # downstream, so a slow apply never reads as fresh
+                    if run_watermark is not None:
+                        self.watermark_wall = run_watermark
+                    self._last_delta_mono = time.monotonic()
                     run = []
+                    run_watermark = None
                 self.rv = max(self.rv, prev_rv)
 
             for frame in batch:
@@ -929,6 +972,11 @@ class FleetSubscriber:
                     run.append(frame)
                     prev_rv = max(prev_rv, rv)
                     deltas_since_save += 1
+                    # watermark candidate: the negotiated origin stamp
+                    # when the upstream sent one, local receive wall
+                    # otherwise (adopted by commit_run AFTER delivery)
+                    ts = frame.get("ts")
+                    run_watermark = ts[0] if ts else time.time()
                     continue
                 commit_run()
                 if ftype == SYNC:
@@ -958,6 +1006,8 @@ class FleetSubscriber:
 
     def status(self) -> Dict[str, Any]:
         age = self.last_frame_age()
+        delta_age = self.last_delta_age()
+        watermark = self.watermark_age()
         return {
             "name": self.name,
             "connected": self.connected,
@@ -965,6 +1015,8 @@ class FleetSubscriber:
             "wire_rv": self.wire_rv,
             "view": self.view,
             "last_frame_age_seconds": round(age, 3) if age is not None else None,
+            "last_delta_age_seconds": round(delta_age, 3) if delta_age is not None else None,
+            "watermark_age_seconds": round(watermark, 3) if watermark is not None else None,
             "frames": self.frames,
             "batches": self.batches,
             "codec": self.client.active_codec,

@@ -164,6 +164,15 @@ class GlobalMerge:
         items.extend((kind, global_key(cluster, key), None) for kind, key in stale)
         return self.view.apply_batch(items)
 
+    @staticmethod
+    def _origin_stamp(item: Dict[str, Any]):
+        """The upstream frame's negotiated freshness stamp (origin wall
+        time), propagated into the merged view's Delta so the global rv
+        line — and any federator federating THIS one — keeps measuring
+        true end-to-end age. None when the upstream didn't stamp."""
+        ts = item.get("ts")
+        return ts[0] if isinstance(ts, (list, tuple)) and ts else None
+
     def apply_delta(self, cluster: str, item: Dict[str, Any]) -> bool:
         """Fold one wire delta (UPSERT/DELETE frame dict) from ``cluster``.
         Returns True when the global view actually changed. The per-delta
@@ -174,8 +183,9 @@ class GlobalMerge:
         kind = item.get("kind") or "pod"
         key = item["key"]
         gkey = global_key(cluster, key)
+        ts_wall = self._origin_stamp(item)
         if item["type"] == DELETE:
-            changed = self.view.apply(kind, gkey, None)
+            changed = self.view.apply(kind, gkey, None, ts_wall=ts_wall)
             with self._lock:
                 keys = self._keys.setdefault(cluster, set())
                 if (kind, key) in keys:
@@ -184,7 +194,8 @@ class GlobalMerge:
                 self._set_gauge_locked()
             return changed
         changed = self.view.apply(
-            kind, gkey, self._decorate(cluster, kind, key, item.get("object") or {})
+            kind, gkey, self._decorate(cluster, kind, key, item.get("object") or {}),
+            ts_wall=ts_wall,
         )
         with self._lock:
             keys = self._keys.setdefault(cluster, set())
@@ -206,16 +217,18 @@ class GlobalMerge:
         for item in items:
             kind = item.get("kind") or "pod"
             key = item["key"]
+            ts_wall = self._origin_stamp(item)
             if item["type"] == DELETE:
-                view_items.append((kind, global_key(cluster, key), None))
+                view_items.append((kind, global_key(cluster, key), None, ts_wall))
             else:
                 view_items.append((kind, global_key(cluster, key),
-                                   self._decorate(cluster, kind, key, item.get("object") or {})))
+                                   self._decorate(cluster, kind, key, item.get("object") or {}),
+                                   ts_wall))
         changed = self.view.apply_batch(view_items)
         with self._lock:
             keys = self._keys.setdefault(cluster, set())
             before = len(keys)
-            for item, (kind, _gkey, obj) in zip(items, view_items):
+            for item, (kind, _gkey, obj, _ts) in zip(items, view_items):
                 entry = (kind, item["key"])
                 if obj is None:
                     keys.discard(entry)

@@ -30,6 +30,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from k8s_watcher_tpu.config.schema import metric_safe_name as _metric_suffix
+from k8s_watcher_tpu.metrics.metrics import MAX_LABEL_SETS
 from k8s_watcher_tpu.federate.client import (
     FleetClient,
     FleetSubscriber,
@@ -59,12 +60,20 @@ class _Upstream:
         # objects missing for up to a watch window
         self.drop_lock = threading.Lock()
         self._synced: Dict[str, int] = {}  # counter diff-sync state
+        # oldest-unpropagated tracking: monotonic stamp of when this
+        # upstream FIRST fell behind (wire_rv ahead of the applied rv);
+        # None while caught up. The monitor tick maintains it.
+        self.lag_since: Optional[float] = None
         # request timeout floored well above the staleness knob: a tight
         # stale_after must not shrink the snapshot-read budget with it
         self.client = FleetClient(
             cfg.url, token=cfg.token,
             timeout=max(5.0, plane.config.stale_after_seconds),
             codec=plane.config.codec,
+            # always negotiate freshness stamps: the propagation
+            # histograms and watermarks are this plane's telemetry; an
+            # upstream that predates the field just serves plain frames
+            fresh=True,
         )
         self.subscriber = FleetSubscriber(
             self.client,
@@ -82,17 +91,49 @@ class _Upstream:
             target=self.subscriber.run, name=f"federate-{self.name}", daemon=True
         )
         self._plane = plane
-        suffix = _metric_suffix(self.name)
         metrics = plane.metrics
-        self.lag_rv_gauge = (
-            metrics.gauge(f"federation_upstream_lag_rv_{suffix}") if metrics else None
-        )
-        self.lag_seconds_gauge = (
-            metrics.gauge(f"federation_upstream_lag_seconds_{suffix}") if metrics else None
-        )
-        self.stale_gauge = (
-            metrics.gauge(f"federation_upstream_stale_{suffix}") if metrics else None
-        )
+        # per-upstream series as REAL labels (`...{upstream="a"}`);
+        # the pre-label suffix-mangled names stay for one release behind
+        # metrics.legacy_suffix_names (dashboard continuity)
+        if metrics is not None:
+            label = {"upstream": self.name}
+            self.lag_rv_gauge = metrics.gauge("federation_upstream_lag_rv").labels(**label)
+            self.lag_seconds_gauge = metrics.gauge("federation_upstream_lag_seconds").labels(**label)
+            self.stale_gauge = metrics.gauge("federation_upstream_stale").labels(**label)
+            # freshness watermarks (the /debug/freshness surface):
+            # watermark age = wall-now minus the newest applied delta's
+            # ORIGIN stamp (ages while the upstream is paused/dark);
+            # last-delta age = local monotonic since the last applied
+            # delta; oldest-unpropagated = how long the subscriber has
+            # been behind the newest rv it has SEEN on the wire
+            self.watermark_age_gauge = metrics.gauge(
+                "federation_upstream_watermark_age_seconds"
+            ).labels(**label)
+            self.last_delta_age_gauge = metrics.gauge(
+                "federation_upstream_last_delta_age_seconds"
+            ).labels(**label)
+            self.oldest_unpropagated_gauge = metrics.gauge(
+                "federation_upstream_oldest_unpropagated_seconds"
+            ).labels(**label)
+        else:
+            self.lag_rv_gauge = None
+            self.lag_seconds_gauge = None
+            self.stale_gauge = None
+            self.watermark_age_gauge = None
+            self.last_delta_age_gauge = None
+            self.oldest_unpropagated_gauge = None
+        legacy = metrics is not None and getattr(metrics, "legacy_suffix_names", False)
+        if legacy:
+            suffix = _metric_suffix(self.name)
+            self.legacy_lag_rv_gauge = metrics.gauge(f"federation_upstream_lag_rv_{suffix}")
+            self.legacy_lag_seconds_gauge = metrics.gauge(
+                f"federation_upstream_lag_seconds_{suffix}"
+            )
+            self.legacy_stale_gauge = metrics.gauge(f"federation_upstream_stale_{suffix}")
+        else:
+            self.legacy_lag_rv_gauge = None
+            self.legacy_lag_seconds_gauge = None
+            self.legacy_stale_gauge = None
 
     def _on_snapshot(self, snap: Snapshot) -> None:
         if self.epoch is not None and snap.view != self.epoch:
@@ -131,6 +172,23 @@ class _Upstream:
             self._plane.deltas_counter.inc(len(frames))
         if self._plane.batches_counter is not None:
             self._plane.batches_counter.inc()
+        # propagation telemetry off the negotiated per-frame stamps
+        # (ts = [origin_wall, upstream_publish_wall]): end-to-end
+        # watch->global-view age and the serve-wire hop. Wall clocks —
+        # origin is a REMOTE host — so readings are clamped at 0 and
+        # carry the documented cross-host skew caveat.
+        w2g = self._plane.watch_to_global
+        wire = self._plane.serve_wire
+        if w2g is not None or wire is not None:
+            now_wall = time.time()
+            for frame in frames:
+                ts = frame.get("ts")
+                if not ts:
+                    continue
+                if w2g is not None:
+                    w2g.record(max(0.0, now_wall - ts[0]))
+                if wire is not None:
+                    wire.record(max(0.0, now_wall - ts[1]))
 
     def sync_counters(self, plane: "FederationPlane") -> None:
         """Diff-sync the subscriber's monotonic counts into the registry
@@ -151,14 +209,57 @@ class _Upstream:
 
     def update_gauges(self) -> None:
         sub = self.subscriber
+        now = time.monotonic()
+        lag_rv = max(0, sub.wire_rv - (sub.rv or 0))
+        # oldest-unpropagated: how long the oldest wire-seen-but-unapplied
+        # event has been pending (0 while caught up). The true per-event
+        # stamp is unknowable without applying it, so this measures from
+        # when the lag BEGAN — a lower bound on the oldest event's age.
+        if lag_rv > 0:
+            if self.lag_since is None:
+                self.lag_since = now
+        else:
+            self.lag_since = None
+        oldest_unpropagated = (now - self.lag_since) if self.lag_since is not None else 0.0
+        age = sub.last_frame_age()
         if self.lag_rv_gauge is not None:
-            self.lag_rv_gauge.set(max(0, sub.wire_rv - (sub.rv or 0)))
-        if self.lag_seconds_gauge is not None:
-            age = sub.last_frame_age()
+            self.lag_rv_gauge.set(lag_rv)
             if age is not None:
                 self.lag_seconds_gauge.set(age)
-        if self.stale_gauge is not None:
             self.stale_gauge.set(1.0 if self.stale else 0.0)
+            watermark = sub.watermark_age()
+            if watermark is not None:
+                self.watermark_age_gauge.set(watermark)
+            delta_age = sub.last_delta_age()
+            if delta_age is not None:
+                self.last_delta_age_gauge.set(delta_age)
+            self.oldest_unpropagated_gauge.set(oldest_unpropagated)
+        if self.legacy_lag_rv_gauge is not None:
+            self.legacy_lag_rv_gauge.set(lag_rv)
+            if age is not None:
+                self.legacy_lag_seconds_gauge.set(age)
+            self.legacy_stale_gauge.set(1.0 if self.stale else 0.0)
+
+    def freshness(self) -> Dict[str, Any]:
+        """This upstream's watermark block for /debug/freshness."""
+        sub = self.subscriber
+        age = sub.last_frame_age()
+        delta_age = sub.last_delta_age()
+        watermark = sub.watermark_age()
+        now = time.monotonic()
+        return {
+            "connected": sub.connected,
+            "stale": self.stale,
+            "rv": sub.rv,
+            "wire_rv": sub.wire_rv,
+            "lag_rv": max(0, sub.wire_rv - (sub.rv or 0)),
+            "last_frame_age_seconds": round(age, 3) if age is not None else None,
+            "last_delta_age_seconds": round(delta_age, 3) if delta_age is not None else None,
+            "watermark_age_seconds": round(watermark, 3) if watermark is not None else None,
+            "oldest_unpropagated_seconds": (
+                round(now - self.lag_since, 3) if self.lag_since is not None else 0.0
+            ),
+        }
 
     def status(self) -> Dict[str, Any]:
         body = self.subscriber.status()
@@ -228,6 +329,33 @@ class FederationPlane:
         self.connected_gauge = (
             metrics.gauge("federation_upstreams_connected") if metrics else None
         )
+        # the freshness plane's cross-cluster histograms, fed by the
+        # negotiated per-frame stamps in _on_batch: end-to-end
+        # watch->global-view propagation and the serve-wire hop alone
+        # (upstream publish -> federator receive). Wall-clock spans
+        # across hosts — see ARCHITECTURE "Freshness & SLO plane".
+        self.watch_to_global = (
+            metrics.histogram("watch_to_global_view_seconds") if metrics else None
+        )
+        self.serve_wire = (
+            metrics.histogram("serve_wire_seconds") if metrics else None
+        )
+        if metrics is not None:
+            # the per-upstream label dimension is bounded by CONFIG, not
+            # by the registry's generic 64-set default: widen each
+            # family's cardinality cap to fit the declared upstream list
+            # (a 100-upstream federation is a legitimate bounded
+            # dimension; a pod-uid label still is not)
+            cap = max(MAX_LABEL_SETS, len(config.upstreams) + 8)
+            for family_name in (
+                "federation_upstream_lag_rv",
+                "federation_upstream_lag_seconds",
+                "federation_upstream_stale",
+                "federation_upstream_watermark_age_seconds",
+                "federation_upstream_last_delta_age_seconds",
+                "federation_upstream_oldest_unpropagated_seconds",
+            ):
+                metrics.gauge(family_name).max_label_sets = cap
         self.upstreams: List[_Upstream] = [
             _Upstream(self, u, i) for i, u in enumerate(config.upstreams)
         ]
@@ -355,6 +483,27 @@ class FederationPlane:
             upstream.update_gauges()
         if self.connected_gauge is not None:
             self.connected_gauge.set(connected)
+
+    # -- freshness ---------------------------------------------------------
+
+    def freshness(self) -> Dict[str, Any]:
+        """Per-upstream freshness watermarks + the propagation histogram
+        summaries — the federation half of ``GET /debug/freshness``.
+
+        What a watermark does and does NOT guarantee: it is the origin
+        stamp of the newest APPLIED delta per upstream — it bounds how
+        stale the merged copy of that cluster can be, but encodes no
+        cross-cluster happens-before (two clusters' concurrent events
+        interleave in arrival order), and cross-host spans compare wall
+        clocks (skew shifts readings; the monotonic-local/wall-remote
+        split is documented in ARCHITECTURE.md)."""
+        out: Dict[str, Any] = {
+            "upstreams": {u.name: u.freshness() for u in self.upstreams},
+        }
+        if self.watch_to_global is not None:
+            out["watch_to_global_view_seconds"] = self.watch_to_global.summary()
+            out["serve_wire_seconds"] = self.serve_wire.summary()
+        return out
 
     # -- health ------------------------------------------------------------
 

@@ -16,9 +16,123 @@ from __future__ import annotations
 import bisect
 import collections
 import math
+import re
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
+
+#: Prometheus label-name grammar (values are free-form strings, escaped
+#: at render time; NAMES are part of the series identity and must parse)
+_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+#: per-family cap on distinct label sets. Labels exist for BOUNDED
+#: dimensions (upstream cluster names, codec names, objective names);
+#: an unbounded value (pod uid, timestamp) would grow one series per
+#: value forever — the classic cardinality explosion that kills both
+#: this process's memory and the downstream Prometheus. Exceeding the
+#: cap raises at ``labels()`` time (registration), never silently drops.
+MAX_LABEL_SETS = 64
+
+
+def _label_key(labels: Dict[str, object]) -> Tuple[Tuple[str, str], ...]:
+    """Validate + canonicalize one label set: sorted ``(name, value)``
+    pairs — the family's child-identity key AND the render order (sorted
+    keys keep the text exposition byte-deterministic)."""
+    if not labels:
+        raise ValueError("labels() requires at least one label")
+    out = []
+    for name in sorted(labels):
+        if not _LABEL_NAME_RE.match(name):
+            raise ValueError(
+                f"invalid metric label name {name!r} (want [a-zA-Z_][a-zA-Z0-9_]*)"
+            )
+        value = labels[name]
+        if not isinstance(value, str):
+            # ints/floats are legitimate bounded dimensions (shard ids);
+            # anything else is almost certainly an object leaking in
+            if not isinstance(value, (int, float, bool)):
+                raise ValueError(
+                    f"metric label {name}={value!r}: values must be str/int/float/bool"
+                )
+            value = str(value)
+        if len(value) > 128:
+            # a >128-char "name" is a payload, not a dimension
+            raise ValueError(
+                f"metric label {name}: value longer than 128 chars (unbounded label value?)"
+            )
+        out.append((name, value))
+    return tuple(out)
+
+
+def _escape_label_value(value: str) -> str:
+    """Prometheus text-format label-value escaping (\\ " and newline)."""
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def render_labels(labelset: Tuple[Tuple[str, str], ...]) -> str:
+    """``(("upstream","a"),)`` -> ``{upstream="a"}`` (empty set -> "")."""
+    if not labelset:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in labelset)
+    return "{" + inner + "}"
+
+
+class _LabelFamily:
+    """Mixin giving a metric first-class Prometheus labels.
+
+    The registry-held metric is the FAMILY (and doubles as the unlabeled
+    series — our convention keeps cross-label totals there, e.g.
+    ``serve_snapshot_cache_hits`` next to its per-codec children).
+    ``labels(upstream="a")`` returns the child series for that label set,
+    creating it on first use — same get-or-create idiom as the registry
+    itself, so hot paths cache the child once and ``inc`` it directly.
+
+    Cardinality is bounded at registration: the ``max_label_sets``-th
+    distinct label set raises instead of growing silently (see
+    ``MAX_LABEL_SETS``). Children are insertion-ordered; exposition
+    renders them sorted by label set for byte determinism.
+    """
+
+    max_label_sets = MAX_LABEL_SETS
+
+    def _init_labels(self) -> None:
+        self.labelset: Tuple[Tuple[str, str], ...] = ()
+        self._children: Dict[Tuple, "_LabelFamily"] = {}
+        self._labels_lock = threading.Lock()
+
+    def _make_child(self):  # overridden per metric type
+        raise NotImplementedError
+
+    def labels(self, **labels):
+        key = _label_key(labels)
+        with self._labels_lock:
+            child = self._children.get(key)
+            if child is None:
+                if self.labelset:
+                    raise ValueError(
+                        f"labels() on an already-labeled series {self.name}{render_labels(self.labelset)}"
+                    )
+                if len(self._children) >= self.max_label_sets:
+                    raise ValueError(
+                        f"metric {self.name}: label-set cardinality bound "
+                        f"({self.max_label_sets}) exceeded registering "
+                        f"{render_labels(key)} — label values must be bounded "
+                        f"dimensions, not identifiers"
+                    )
+                child = self._make_child()
+                child.labelset = key
+                self._children[key] = child
+            return child
+
+    def children(self) -> List["_LabelFamily"]:
+        """Child series sorted by label set (render/export order)."""
+        with self._labels_lock:
+            return [self._children[k] for k in sorted(self._children)]
+
+    @property
+    def has_children(self) -> bool:
+        with self._labels_lock:
+            return bool(self._children)
 
 
 def _log_buckets(lo: float, hi: float, per_decade: int = 40) -> List[float]:
@@ -31,7 +145,7 @@ def _log_buckets(lo: float, hi: float, per_decade: int = 40) -> List[float]:
     return [lo * 10 ** (i / per_decade) for i in range(n)]
 
 
-class Counter:
+class Counter(_LabelFamily):
     """Monotonic counter with a windowed rate.
 
     The rate window is a ring of PER-SECOND buckets, not per-event
@@ -39,6 +153,10 @@ class Counter:
     O(1) with O(window) memory — the old per-timestamp deque cost one
     deque append per counted event and capped the window at 100k entries,
     i.e. the rate silently under-read past ~1.7k events/s sustained.
+
+    ``labels(upstream="a")`` returns the per-label-set child counter
+    (see ``_LabelFamily``); the parent keeps serving as the unlabeled
+    cross-label total by the package convention.
     """
 
     # 60 one-second buckets (+2 for edge churn) bound the window
@@ -50,6 +168,10 @@ class Counter:
         self._count = 0
         # (whole_second, count) per bucket, oldest first
         self._window: collections.deque = collections.deque(maxlen=self._BUCKETS)
+        self._init_labels()
+
+    def _make_child(self) -> "Counter":
+        return Counter(self.name)
 
     def inc(self, n: int = 1) -> None:
         sec = int(time.monotonic())
@@ -74,15 +196,20 @@ class Counter:
             return float(sum(c for sec, c in self._window if sec > cutoff))
 
 
-class Gauge:
+class Gauge(_LabelFamily):
     """A point-in-time reading (probe medians, queue depths): last value
-    wins, unlike a Counter's monotonic accumulation."""
+    wins, unlike a Counter's monotonic accumulation. ``labels(...)``
+    returns per-label-set child gauges (per-upstream lag/staleness)."""
 
     def __init__(self, name: str):
         self.name = name
         self._lock = threading.Lock()
         self._value = 0.0
         self._set = False
+        self._init_labels()
+
+    def _make_child(self) -> "Gauge":
+        return Gauge(self.name)
 
     def set(self, value: float) -> None:
         with self._lock:
@@ -124,17 +251,23 @@ class Gauge:
             return self._value if self._set else None
 
 
-class Histogram:
-    """Log-bucketed latency histogram (seconds)."""
+class Histogram(_LabelFamily):
+    """Log-bucketed latency histogram (seconds). ``labels(...)`` returns
+    per-label-set children sharing the parent's bucket layout."""
 
     def __init__(self, name: str, lo: float = 1e-5, hi: float = 100.0):
         self.name = name
+        self._lo, self._hi = lo, hi
         self._bounds = _log_buckets(lo, hi)
         self._counts = [0] * (len(self._bounds) + 1)
         self._lock = threading.Lock()
         self._n = 0
         self._sum = 0.0
         self._max = 0.0
+        self._init_labels()
+
+    def _make_child(self) -> "Histogram":
+        return Histogram(self.name, self._lo, self._hi)
 
     def record(self, seconds: float) -> None:
         idx = bisect.bisect_left(self._bounds, seconds)
@@ -233,31 +366,84 @@ class Histogram:
 
 
 class MetricsRegistry:
-    """Named counters/histograms for one watcher process."""
+    """Named counters/histograms for one watcher process.
 
-    def __init__(self):
+    The per-type maps are insertion-ordered (plain dicts) and the scrape
+    path renders from a SORTED-NAME CACHE invalidated only on
+    registration: a 1 Hz Prometheus scrape of a few hundred series used
+    to pay a fresh O(n log n) sort per request for a key set that
+    changes only when a new metric first registers (startup, mostly).
+
+    ``legacy_suffix_names`` is the one-release dashboard-continuity
+    flag (config ``metrics.legacy_suffix_names``): planes that migrated
+    their per-upstream/per-codec series from name-suffix mangling
+    (``federation_upstream_lag_rv_<name>``) onto real labels consult it
+    to ALSO keep emitting the old suffixed series.
+    """
+
+    def __init__(self, *, legacy_suffix_names: bool = False):
         self._lock = threading.Lock()
         self._counters: Dict[str, Counter] = {}
         self._histograms: Dict[str, Histogram] = {}
         self._gauges: Dict[str, Gauge] = {}
+        # sorted (name, metric) item lists, rebuilt lazily after a
+        # registration invalidates them (None = stale)
+        self._sorted_counters: Optional[List[Tuple[str, Counter]]] = None
+        self._sorted_histograms: Optional[List[Tuple[str, Histogram]]] = None
+        self._sorted_gauges: Optional[List[Tuple[str, Gauge]]] = None
+        self.legacy_suffix_names = legacy_suffix_names
 
     def counter(self, name: str) -> Counter:
         with self._lock:
             if name not in self._counters:
                 self._counters[name] = Counter(name)
+                self._sorted_counters = None
             return self._counters[name]
 
     def histogram(self, name: str) -> Histogram:
         with self._lock:
             if name not in self._histograms:
                 self._histograms[name] = Histogram(name)
+                self._sorted_histograms = None
             return self._histograms[name]
 
     def gauge(self, name: str) -> Gauge:
         with self._lock:
             if name not in self._gauges:
                 self._gauges[name] = Gauge(name)
+                self._sorted_gauges = None
             return self._gauges[name]
+
+    def _sorted_items(self):
+        """``(counters, gauges, histograms)`` as sorted item lists from
+        the registration-invalidated cache — ONE lock hold, no per-scrape
+        sort once the metric set is stable."""
+        with self._lock:
+            if self._sorted_counters is None:
+                self._sorted_counters = sorted(self._counters.items())
+            if self._sorted_gauges is None:
+                self._sorted_gauges = sorted(self._gauges.items())
+            if self._sorted_histograms is None:
+                self._sorted_histograms = sorted(self._histograms.items())
+            return self._sorted_counters, self._sorted_gauges, self._sorted_histograms
+
+    @staticmethod
+    def _emit_histogram(lines: List[str], metric: str, h: Histogram, labelset) -> None:
+        # real `le` buckets (shared downsampling with Histogram.summary
+        # — scrapers and the JSON snapshot must agree on boundaries),
+        # pairs + totals from one atomic read. `le` renders LAST in the
+        # label set (the Prometheus text-format convention).
+        pairs, total, total_sum = h.downsampled_buckets_with_totals()
+        prefix_labels = ",".join(
+            f'{k}="{_escape_label_value(v)}"' for k, v in labelset
+        )
+        sep = "," if prefix_labels else ""
+        for bound, cum in pairs:
+            le = "+Inf" if bound == float("inf") else f"{bound:.3g}"
+            lines.append(f'{metric}_bucket{{{prefix_labels}{sep}le="{le}"}} {cum}')
+        labels = render_labels(labelset)
+        lines.append(f"{metric}_sum{labels} {total_sum}")
+        lines.append(f"{metric}_count{labels} {total}")
 
     def prometheus_text(self, prefix: str = "k8s_watcher_") -> str:
         """Prometheus text exposition format (v0.0.4) — what real scrapers
@@ -266,51 +452,117 @@ class MetricsRegistry:
         Counters become ``<prefix><name>_total``; histograms emit the
         standard ``_bucket{le=...}``/``_sum``/``_count`` triplet in base
         seconds (Prometheus convention), not the JSON dump's milliseconds.
+
+        Labeled families render one line per child label set (sorted, so
+        the output stays byte-deterministic); the unlabeled parent line
+        renders alongside only when it actually carries data (the
+        cross-label total convention) — a never-touched parent of a
+        labeled family must not scrape as a misleading 0.
         """
-        with self._lock:
-            counters = dict(self._counters)
-            histograms = dict(self._histograms)
-            gauges = dict(self._gauges)
-        lines = []
-        for name, c in sorted(counters.items()):
+        counters, gauges, histograms = self._sorted_items()
+        lines: List[str] = []
+        for name, c in counters:
             metric = f"{prefix}{name}"
             lines.append(f"# TYPE {metric}_total counter")
-            lines.append(f"{metric}_total {c.value}")
-        for name, g in sorted(gauges.items()):
-            reading = g.read()
-            if reading is None:
-                continue  # never-set/cleared gauges would scrape as a misleading 0
+            children = c.children()
+            if not children or c.value > 0:
+                lines.append(f"{metric}_total {c.value}")
+            for child in children:
+                lines.append(f"{metric}_total{render_labels(child.labelset)} {child.value}")
+        for name, g in gauges:
             metric = f"{prefix}{name}"
+            reading = g.read()
+            children = g.children()
+            child_lines = [
+                (child.labelset, child_reading)
+                for child in children
+                if (child_reading := child.read()) is not None
+            ]
+            if reading is None and not child_lines:
+                continue  # never-set/cleared gauges would scrape as a misleading 0
             lines.append(f"# TYPE {metric} gauge")
-            lines.append(f"{metric} {reading:g}")
-        for name, h in sorted(histograms.items()):
+            if reading is not None:
+                lines.append(f"{metric} {reading:g}")
+            for labelset, child_reading in child_lines:
+                lines.append(f"{metric}{render_labels(labelset)} {child_reading:g}")
+        for name, h in histograms:
             # unit suffix by Prometheus convention — but never doubled for
             # registry names that already carry it (watch_to_notify_seconds)
             metric = f"{prefix}{name}" if name.endswith("_seconds") else f"{prefix}{name}_seconds"
-            # real `le` buckets (shared downsampling with Histogram.summary
-            # — scrapers and the JSON snapshot must agree on boundaries),
-            # pairs + totals from one atomic read
-            pairs, total, total_sum = h.downsampled_buckets_with_totals()
+            children = h.children()
             lines.append(f"# TYPE {metric} histogram")
-            for bound, cum in pairs:
-                le = "+Inf" if bound == float("inf") else f"{bound:.3g}"
-                lines.append(f'{metric}_bucket{{le="{le}"}} {cum}')
-            lines.append(f"{metric}_sum {total_sum}")
-            lines.append(f"{metric}_count {total}")
+            if not children or h.count > 0:
+                self._emit_histogram(lines, metric, h, ())
+            for child in children:
+                self._emit_histogram(lines, metric, child, child.labelset)
         return "\n".join(lines) + "\n"
 
+    @staticmethod
+    def _series(children, stats) -> List[Dict]:
+        """Labeled children -> the JSON snapshot's nested ``series`` list:
+        explicit label dicts (not rendered strings), so the snapshot
+        round-trips — a consumer can rebuild every (labels -> stats)
+        mapping from parsed JSON alone."""
+        return [
+            {"labels": dict(child.labelset), **stats(child)}
+            for child in children
+        ]
+
     def dump(self) -> Dict[str, Dict]:
-        with self._lock:
-            counters = dict(self._counters)
-            histograms = dict(self._histograms)
-            gauges = dict(self._gauges)
+        counters, gauges, histograms = self._sorted_items()
         out: Dict[str, Dict] = {}
-        for name, c in counters.items():
-            out[name] = {"count": c.value, "per_minute": c.rate_per_minute()}
-        for name, h in histograms.items():
-            out[name] = h.summary()
-        for name, g in gauges.items():
+        for name, c in counters:
+            entry = {"count": c.value, "per_minute": c.rate_per_minute()}
+            children = c.children()
+            if children:
+                entry["series"] = self._series(
+                    children, lambda ch: {"count": ch.value, "per_minute": ch.rate_per_minute()}
+                )
+            out[name] = entry
+        for name, h in histograms:
+            entry = h.summary()
+            children = h.children()
+            if children:
+                entry["series"] = self._series(children, lambda ch: ch.summary())
+            out[name] = entry
+        for name, g in gauges:
             reading = g.read()
+            children = g.children()
+            if reading is None and not children:
+                continue
+            entry: Dict = {}
             if reading is not None:
-                out[name] = {"value": reading}
+                entry["value"] = reading
+            if children:
+                entry["series"] = [
+                    {"labels": dict(ch.labelset), "value": child_reading}
+                    for ch in children
+                    if (child_reading := ch.read()) is not None
+                ]
+            if entry:
+                out[name] = entry
+        return out
+
+    def sample(self) -> Dict[str, Dict]:
+        """One raw point-in-time sample of every registered metric — the
+        SLO plane's timeseries-ring tick. Deliberately cheaper and rawer
+        than ``dump()``:
+
+        - counters -> the unlabeled total (the package convention keeps
+          cross-label totals on the parent);
+        - gauges -> the MAX over the parent and every set child (per-
+          upstream staleness objectives gate the worst member);
+        - histograms -> ``(cumulative_pairs, total, sum)`` so a window
+          evaluation can difference two samples' buckets.
+        """
+        counters, gauges, histograms = self._sorted_items()
+        out: Dict[str, Dict] = {"counters": {}, "gauges": {}, "histograms": {}}
+        for name, c in counters:
+            out["counters"][name] = c.value
+        for name, g in gauges:
+            readings = [r for r in (g.read(), *(ch.read() for ch in g.children())) if r is not None]
+            if readings:
+                out["gauges"][name] = max(readings)
+        for name, h in histograms:
+            out["histograms"][name] = h.downsampled_buckets_with_totals()
         return out

@@ -159,6 +159,15 @@ class _StatusHandler(BaseHTTPRequestHandler):
     # per-upstream staleness/connectivity); folded into /healthz and
     # served in full at /debug/federation when federation is enabled
     federation = None
+    # Callable[[], dict]: freshness watermarks (local view + per-upstream)
+    # -> /debug/freshness, when the serving plane is enabled
+    freshness = None
+    # Callable[[], dict]: SLO engine detail (SLOPlane.snapshot) -> /debug/slo
+    slo = None
+    # Callable[[], dict]: SLO verdict (SLOPlane.health) folded into the
+    # /healthz BODY — degraded only, never the liveness verdict (a
+    # restart does not refund an error budget)
+    slo_health = None
     slices = None  # Callable[[], dict]: live slice states, optional
     trend = None  # Callable[[], dict]: probe trend anchors/windows, optional
     # Callable[[], Optional[dict]]: remediation policy state; the callable
@@ -253,6 +262,11 @@ class _StatusHandler(BaseHTTPRequestHandler):
                 body["serve"] = serve
             if federation is not None:
                 body["federation"] = federation
+            if self.slo_health is not None:
+                # degraded-body only, same contract as federation: a
+                # breached error budget is an alerting/readiness signal,
+                # and a liveness kill would burn the budget faster
+                body["slo"] = self.slo_health()
             self._json(200 if alive else 503, body)
         elif parsed.path == "/debug/events":
             if self.audit is None:
@@ -334,6 +348,16 @@ class _StatusHandler(BaseHTTPRequestHandler):
                 self._json(404, {"error": "federation plane not enabled (federation.enabled)"})
                 return
             self._json(200, {"federation": self.federation()})
+        elif parsed.path == "/debug/freshness":
+            if self.freshness is None:
+                self._json(404, {"error": "freshness plane not wired (serve.enabled)"})
+                return
+            self._json(200, {"freshness": self.freshness()})
+        elif parsed.path == "/debug/slo":
+            if self.slo is None:
+                self._json(404, {"error": "SLO engine not enabled (slo.enabled)"})
+                return
+            self._json(200, {"slo": self.slo()})
         elif parsed.path == "/debug/remediation":
             if self.remediation is None:
                 self._json(404, {"error": "remediation not wired (tpu.remediation.enabled)"})
@@ -360,6 +384,9 @@ class StatusServer:
         egress=None,  # Callable[[], dict] -> egress liveness folded into /healthz
         serve=None,  # Callable[[], dict] -> serving-plane liveness folded into /healthz
         federation=None,  # Callable[[], dict] -> federation liveness, /healthz + /debug/federation
+        freshness=None,  # Callable[[], dict] -> /debug/freshness (watermarks + propagation)
+        slo=None,  # Callable[[], dict] -> /debug/slo (SLOPlane.snapshot)
+        slo_health=None,  # Callable[[], dict] -> /healthz body fold (SLOPlane.health)
         slices=None,  # Callable[[], dict] -> serves /debug/slices
         trend=None,  # Callable[[], dict] -> serves /debug/trend
         remediation=None,  # Callable[[], Optional[dict]] -> /debug/remediation
@@ -379,6 +406,9 @@ class StatusServer:
                 "egress": staticmethod(egress) if egress else None,
                 "serve": staticmethod(serve) if serve else None,
                 "federation": staticmethod(federation) if federation else None,
+                "freshness": staticmethod(freshness) if freshness else None,
+                "slo": staticmethod(slo) if slo else None,
+                "slo_health": staticmethod(slo_health) if slo_health else None,
                 "slices": staticmethod(slices) if slices else None,
                 "trend": staticmethod(trend) if trend else None,
                 "remediation": staticmethod(remediation) if remediation else None,

@@ -80,7 +80,7 @@ class _StreamClient:
     __slots__ = (
         "sock", "fd", "sub", "limit", "deadline", "hard_deadline",
         "last_frame", "buf", "buf_bytes", "closing", "view_id",
-        "want_write", "codec",
+        "want_write", "codec", "fresh",
     )
 
     def __init__(
@@ -92,6 +92,7 @@ class _StreamClient:
         limit: Optional[int],
         view_id: str,
         codec: str = CODEC_JSON,
+        fresh: bool = False,
     ):
         self.sock = sock
         self.fd = sock.fileno()
@@ -112,6 +113,9 @@ class _StreamClient:
         # synthesized) in this codec; the per-codec frame arrays are
         # shared across every subscriber on the same codec
         self.codec = codec
+        # negotiated freshness stamps (?fresh=1): pulls select the
+        # stamped frame variant; control frames never carry stamps
+        self.fresh = fresh
 
 
 class _LoopWorker(threading.Thread):
@@ -280,7 +284,9 @@ class _LoopWorker(threading.Thread):
                 continue
             if client.sub.rv >= view_rv:
                 continue
-            result = client.sub.pull_frames(limit=client.limit, codec=client.codec)
+            result = client.sub.pull_frames(
+                limit=client.limit, codec=client.codec, fresh=client.fresh
+            )
             if result.status == GONE:
                 self._queue_control(
                     client,
@@ -549,6 +555,7 @@ class BroadcastLoop:
         limit: Optional[int],
         view_id: str,
         codec: str = CODEC_JSON,
+        fresh: bool = False,
     ) -> None:
         """Adopt a handed-off socket (headers already written by the HTTP
         front). The loop owns the socket AND the subscription from here —
@@ -559,6 +566,7 @@ class BroadcastLoop:
             limit=limit,
             view_id=view_id,
             codec=codec,
+            fresh=fresh,
         )
         # round-robin across LIVE workers only: a dead loop's inbox is a
         # black hole (stream never admitted, slot never freed) — the

@@ -335,6 +335,10 @@ class _ServeHandler(BaseHTTPRequestHandler):
         if limit is not None and limit < 0:
             self._send_obj(400, {"error": "limit= must be >= 0 (0 = unpaged)"}, codec)
             return
+        # freshness negotiation (``fresh=1``): delta frames additionally
+        # carry ``ts: [origin_wall, publish_wall]`` — negotiated like the
+        # codec, so peers that don't ask keep the byte-golden frames
+        fresh = params.get("fresh") in ("1", "true")
         client_view = params.get("view")
         if client_view and client_view != self.view.instance:
             # token minted by a previous incarnation of the rv space:
@@ -357,16 +361,16 @@ class _ServeHandler(BaseHTTPRequestHandler):
         handed_off = False
         try:
             if params.get("once") in ("1", "true"):
-                self._long_poll(sub, min(timeout, MAX_LONG_POLL_SECONDS), limit, codec)
+                self._long_poll(sub, min(timeout, MAX_LONG_POLL_SECONDS), limit, codec, fresh)
             elif self.loop is not None:
-                handed_off = self._stream_handoff(sub, timeout, limit, codec)
+                handed_off = self._stream_handoff(sub, timeout, limit, codec, fresh)
             else:
-                self._stream(sub, timeout, limit, codec)
+                self._stream(sub, timeout, limit, codec, fresh)
         finally:
             if not handed_off:
                 self.hub.unsubscribe(sub)
 
-    def _long_poll(self, sub, timeout: float, limit, codec: str = CODEC_JSON) -> None:
+    def _long_poll(self, sub, timeout: float, limit, codec: str = CODEC_JSON, fresh: bool = False) -> None:
         result = sub.pull(timeout=timeout, limit=limit)
         if result.status == GONE:
             self._send_obj(
@@ -396,7 +400,7 @@ class _ServeHandler(BaseHTTPRequestHandler):
                 "to_rv": result.to_rv,
                 "view": self.view.instance,
                 "compacted": result.compacted,
-                "items": [d.to_wire() for d in result.deltas],
+                "items": [d.to_wire(fresh=fresh) for d in result.deltas],
             },
             codec,
         )
@@ -425,7 +429,7 @@ class _ServeHandler(BaseHTTPRequestHandler):
             return True
         return False
 
-    def _stream_handoff(self, sub, timeout: float, limit, codec: str = CODEC_JSON) -> bool:
+    def _stream_handoff(self, sub, timeout: float, limit, codec: str = CODEC_JSON, fresh: bool = False) -> bool:
         """The epoll path: handshake/auth/410 checks ran on THIS thread
         (the HTTP front's job); write the response headers, then release
         the socket to the broadcast loop and return the thread to the
@@ -437,7 +441,7 @@ class _ServeHandler(BaseHTTPRequestHandler):
             # a dead loop's inbox is a black hole; serve this stream on
             # the legacy threaded path instead (degraded but correct —
             # /healthz is already reporting the loop unhealthy)
-            self._stream(sub, timeout, limit, codec)
+            self._stream(sub, timeout, limit, codec, fresh)
             return False
         self.send_response(200)
         self.send_header("Content-Type", CODEC_CONTENT_TYPES[codec])
@@ -454,14 +458,14 @@ class _ServeHandler(BaseHTTPRequestHandler):
             self.loop.submit(
                 self.connection, sub,
                 timeout=timeout, limit=limit, view_id=self.view.instance,
-                codec=codec,
+                codec=codec, fresh=fresh,
             )
         except RuntimeError:
             return False
         self.server.hand_off(self.connection)
         return True
 
-    def _stream(self, sub, timeout: float, limit, codec: str = CODEC_JSON) -> None:
+    def _stream(self, sub, timeout: float, limit, codec: str = CODEC_JSON, fresh: bool = False) -> None:
         # legacy thread-per-connection streamer (serve.io_threads: 0):
         # kept as the PR-4 reference encoder the golden/equivalence tests
         # compare the broadcast core against
@@ -505,7 +509,7 @@ class _ServeHandler(BaseHTTPRequestHandler):
                             "from_rv": result.from_rv,
                             "to_rv": result.to_rv,
                         })
-                    frames.extend(d.to_wire() for d in result.deltas)
+                    frames.extend(d.to_wire(fresh=fresh) for d in result.deltas)
                     write_frames(frames)
                     last_frame = time.monotonic()
                 elif time.monotonic() - last_frame >= SYNC_INTERVAL_SECONDS:

@@ -104,6 +104,24 @@ DELETE = "DELETE"
 CODEC_JSON = "json"
 CODEC_MSGPACK = "msgpack"
 CODECS = (CODEC_JSON, CODEC_MSGPACK)
+
+#: frame-variant suffix for freshness-stamped frames (``?fresh=1``):
+#: negotiated like the codec, and cached like one — each (codec, fresh)
+#: combination is its own parallel frame array, so stamped frames are
+#: still encoded at most once per delta per variant while the plain-JSON
+#: frames stay byte-golden for every peer that did not ask for stamps
+FRESH_SUFFIX = "+ts"
+FRAME_VARIANTS = (
+    CODEC_JSON,
+    CODEC_MSGPACK,
+    CODEC_JSON + FRESH_SUFFIX,
+    CODEC_MSGPACK + FRESH_SUFFIX,
+)
+
+
+def frame_variant(codec: str, fresh: bool) -> str:
+    """The frame-array key for one negotiated (codec, freshness) pair."""
+    return codec + FRESH_SUFFIX if fresh else codec
 JSON_CONTENT_TYPE = "application/json"
 MSGPACK_CONTENT_TYPE = "application/x-msgpack"
 CODEC_CONTENT_TYPES = {
@@ -125,7 +143,18 @@ INVALID = "invalid"  # token ahead of the view (restart or client bug);
 
 
 class Delta(NamedTuple):
-    """One journaled view mutation. ``object`` is None for DELETE."""
+    """One journaled view mutation. ``object`` is None for DELETE.
+
+    ``ts_wall`` is the ORIGIN stamp: the wall-clock time the mutation was
+    first observed entering the system — the watch event's receive stamp
+    for pods, the apply time for sink-tap producers, and for federated
+    deltas the stamp PROPAGATED from the upstream frame (so a second-tier
+    federator still measures true end-to-end age). ``pub_wall`` is when
+    THIS view published the delta; the gap between them is what the
+    freshness plane's histograms attribute per hop. Wall clocks because
+    origin and reader may be different hosts (monotonic stamps don't
+    cross machines); ARCHITECTURE.md documents the skew caveat.
+    """
 
     rv: int
     kind: str  # "pod" | "slice" | "probe"
@@ -133,11 +162,20 @@ class Delta(NamedTuple):
     type: str  # UPSERT | DELETE
     object: Optional[Dict[str, Any]]
     t: float  # monotonic append stamp (feeds the delta-lag histogram)
+    ts_wall: Optional[float] = None  # origin wall stamp (None = unknown)
+    pub_wall: float = 0.0  # publish wall stamp (0 = unstamped/restored)
 
-    def to_wire(self) -> Dict[str, Any]:
+    def to_wire(self, fresh: bool = False) -> Dict[str, Any]:
         out = {"type": self.type, "rv": self.rv, "kind": self.kind, "key": self.key}
         if self.object is not None:
             out["object"] = self.object
+        if fresh and self.ts_wall is not None:
+            # the negotiated freshness field: [origin_wall, publish_wall]
+            # — consumers derive serve-wire latency from the second and
+            # end-to-end propagation age from the first. Only present
+            # when the peer asked (?fresh=1); the default wire dict is
+            # byte-identical to the PR-4 golden.
+            out["ts"] = [self.ts_wall, self.pub_wall]
         return out
 
 
@@ -241,8 +279,7 @@ class FleetView:
         self._delta_rvs: List[int] = []
         self._deltas: List[Delta] = []
         self._frames: Dict[str, List[Optional[bytes]]] = {
-            CODEC_JSON: [],
-            CODEC_MSGPACK: [],
+            variant: [] for variant in FRAME_VARIANTS
         }
         # (rv, codec)-keyed snapshot byte cache: rebuilt at most once per
         # rv PER CODEC, served only while rv is still current (a publish
@@ -277,24 +314,48 @@ class FleetView:
         self._frame_encodes_mp = (
             metrics.counter("serve_frame_encodes_msgpack") if metrics is not None else None
         )
+        # freshness-stamped frame fills pay their own counter: the PR-7
+        # encodes==publishes amortization gate is defined over the plain
+        # JSON publish path and must not be perturbed by a stamped peer
+        self._frame_encodes_fresh = (
+            metrics.counter("serve_frame_encodes_fresh") if metrics is not None else None
+        )
         self._snap_hits = (
             metrics.counter("serve_snapshot_cache_hits") if metrics is not None else None
         )
         self._snap_misses = (
             metrics.counter("serve_snapshot_cache_misses") if metrics is not None else None
         )
-        # per-codec labels on the snapshot cache counters (the registry
-        # is label-free, so labels are name suffixes — the federation
-        # plane's per-upstream gauge idiom)
+        # per-codec breakdown as REAL labels (`...{codec="json"}`); the
+        # parents above keep the cross-codec totals. The pre-label
+        # suffix-mangled names are kept for one release behind
+        # metrics.legacy_suffix_names (dashboard continuity).
+        legacy = metrics is not None and getattr(metrics, "legacy_suffix_names", False)
         self._snap_hits_by_codec = (
-            {c: metrics.counter(f"serve_snapshot_cache_hits_{c}") for c in CODECS}
+            {c: self._snap_hits.labels(codec=c) for c in CODECS}
             if metrics is not None
             else None
         )
         self._snap_misses_by_codec = (
-            {c: metrics.counter(f"serve_snapshot_cache_misses_{c}") for c in CODECS}
+            {c: self._snap_misses.labels(codec=c) for c in CODECS}
             if metrics is not None
             else None
+        )
+        self._snap_hits_legacy = (
+            {c: metrics.counter(f"serve_snapshot_cache_hits_{c}") for c in CODECS}
+            if legacy
+            else None
+        )
+        self._snap_misses_legacy = (
+            {c: metrics.counter(f"serve_snapshot_cache_misses_{c}") for c in CODECS}
+            if legacy
+            else None
+        )
+        # freshness plane: how long a mutation took from its origin stamp
+        # (watch receive for pods; apply for sink taps) to local view
+        # visibility — monotonic clock, same host, no skew
+        self._watch_to_local = (
+            metrics.histogram("watch_to_local_view_seconds") if metrics is not None else None
         )
 
     # -- durable history (restart-surviving rv line) -----------------------
@@ -321,7 +382,7 @@ class FleetView:
             # holes, not eager re-encodes: a restart must not pay
             # O(journal) json.dumps before serving — the first resumed
             # subscriber's read fills (and memoizes) exactly what it pulls
-            self._frames = {codec: [None] * len(journal) for codec in CODECS}
+            self._frames = {variant: [None] * len(journal) for variant in FRAME_VARIANTS}
             self._snapshot_cache = {}
             # tokens older than the preloaded tail 410 — the compaction-
             # horizon contract, now spanning incarnations
@@ -383,12 +444,15 @@ class FleetView:
         obj: Optional[Dict[str, Any]],
         now: float,
         encode: bool = True,
+        ts_wall: Optional[float] = None,
+        pub_wall: float = 0.0,
     ) -> bool:
         """One delta under the lock. Returns False for no-ops (identical
         upsert, delete of an absent key) — no rv burn, no journal entry.
         ``encode=False`` (the merge-facing batch path) journals a hole in
         every codec's frame array instead of paying json.dumps here; the
-        first read in a codec fills it."""
+        first read in a codec fills it. ``ts_wall``/``pub_wall`` are the
+        freshness plane's origin/publish stamps (see ``Delta``)."""
         map_key = (kind, key)
         if obj is None:
             if self._objects.pop(map_key, None) is None:
@@ -400,13 +464,16 @@ class FleetView:
             self._objects[map_key] = obj
             delta_type = UPSERT
         self._rv += 1
-        delta = Delta(self._rv, kind, key, delta_type, obj, now)
+        delta = Delta(self._rv, kind, key, delta_type, obj, now, ts_wall, pub_wall)
         self._delta_rvs.append(self._rv)
         self._deltas.append(delta)
         self._frames[CODEC_JSON].append(self._encode_locked(delta) if encode else None)
-        # msgpack frames are ALWAYS lazy: most deployments never attach a
-        # msgpack subscriber, and the ones that do pay once, at read time
-        self._frames[CODEC_MSGPACK].append(None)
+        # every other variant (msgpack, and both freshness-stamped
+        # shapes) is ALWAYS lazy: most deployments never attach such a
+        # subscriber, and the ones that do pay once, at read time
+        for variant in FRAME_VARIANTS:
+            if variant != CODEC_JSON:
+                self._frames[variant].append(None)
         return True
 
     def _trim_locked(self) -> None:
@@ -421,12 +488,25 @@ class FleetView:
         for frames in self._frames.values():
             del frames[:overflow]
 
-    def apply(self, kind: str, key: str, obj: Optional[Dict[str, Any]]) -> bool:
+    def apply(
+        self,
+        kind: str,
+        key: str,
+        obj: Optional[Dict[str, Any]],
+        *,
+        ts_wall: Optional[float] = None,
+    ) -> bool:
         """Upsert (``obj``) or delete (``obj is None``) one object and wake
-        subscribers. Public single-delta shape (benches, sink taps)."""
+        subscribers. Public single-delta shape (benches, sink taps).
+        ``ts_wall`` overrides the origin stamp (default: now — for a sink
+        tap, the apply IS the origin)."""
         now = time.monotonic()
+        wall = time.time()
         with self._cond:
-            changed = self._apply_locked(kind, key, obj, now)
+            changed = self._apply_locked(
+                kind, key, obj, now,
+                ts_wall=ts_wall if ts_wall is not None else wall, pub_wall=wall,
+            )
             if changed:
                 if self._history is not None:
                     # BEFORE the trim: a horizon shorter than the burst
@@ -455,12 +535,23 @@ class FleetView:
         bytes no subscriber may ever pull in that codec; the first read
         in each codec fills and memoizes them (still at most one encode
         per delta per codec). Returns the number of deltas minted
-        (identical upserts and absent-key deletes are free)."""
+        (identical upserts and absent-key deletes are free).
+
+        Items are ``(kind, key, obj_or_None)`` or — the federation
+        fan-in's stamped shape — ``(kind, key, obj_or_None, ts_wall)``,
+        carrying the upstream frame's ORIGIN stamp so the merged delta
+        keeps measuring true end-to-end age (and a second-tier federator
+        propagates it again)."""
         now = time.monotonic()
+        wall = time.time()
         changed = 0
         with self._cond:
-            for kind, key, obj in items:
-                if self._apply_locked(kind, key, obj, now, encode=False):
+            for item in items:
+                kind, key, obj = item[0], item[1], item[2]
+                ts = item[3] if len(item) > 3 and item[3] is not None else wall
+                if self._apply_locked(
+                    kind, key, obj, now, encode=False, ts_wall=ts, pub_wall=wall
+                ):
                     changed += 1
             if changed:
                 if self._history is not None:
@@ -502,20 +593,34 @@ class FleetView:
         are left alone.
         """
         t_start = time.monotonic()
+        wall = time.time()
         changed = 0
         stamp = []
+        applied_watch_stamps: List[float] = []
         with self._cond:
             for event, result in zip(events, results):
                 if result.reason in _NEVER_IN_VIEW:
                     continue
+                # origin stamp = the watch receive stamp (wall for the
+                # wire's cross-host field, monotonic for the same-host
+                # watch_to_local_view histogram below)
+                ts_wall = getattr(event, "received_at", None) or wall
                 if event.type == EventType.DELETED:
                     meta = (event.pod or {}).get("metadata") or {}
-                    applied = self._apply_locked("pod", pod_key(meta), None, t_start)
+                    applied = self._apply_locked(
+                        "pod", pod_key(meta), None, t_start,
+                        ts_wall=ts_wall, pub_wall=wall,
+                    )
                 else:
                     uid, obj = _pod_object(event)
-                    applied = self._apply_locked("pod", uid, obj, t_start)
+                    applied = self._apply_locked(
+                        "pod", uid, obj, t_start, ts_wall=ts_wall, pub_wall=wall
+                    )
                 if applied:
                     changed += 1
+                    received = getattr(event, "received_monotonic", None)
+                    if received is not None:
+                        applied_watch_stamps.append(received)
                 trace = getattr(event, "trace", None)
                 if trace is not None and not trace.handed_off:
                     stamp.append(trace)
@@ -542,6 +647,11 @@ class FleetView:
                 self._deltas_published.inc(changed)
             if self._publish_seconds is not None:
                 self._publish_seconds.record(t_end - t_start)
+            if self._watch_to_local is not None:
+                # per applied delta: watch receive -> view visibility,
+                # both stamps monotonic on THIS host (no wall skew)
+                for received in applied_watch_stamps:
+                    self._watch_to_local.record(max(0.0, t_end - received))
             for fn in self._wakeups:
                 fn()
         return changed
@@ -613,6 +723,8 @@ class FleetView:
                 if self._snap_hits is not None:
                     self._snap_hits.inc()
                     self._snap_hits_by_codec[codec].inc()
+                    if self._snap_hits_legacy is not None:
+                        self._snap_hits_legacy[codec].inc()
                 return cached[1]
             rv, objects = self._rv, list(self._objects.values())
             instance = self.instance
@@ -633,11 +745,39 @@ class FleetView:
         if self._snap_misses is not None:
             self._snap_misses.inc()
             self._snap_misses_by_codec[codec].inc()
+            if self._snap_misses_legacy is not None:
+                self._snap_misses_legacy[codec].inc()
         return data
 
     def object_count(self) -> int:
         with self._cond:
             return len(self._objects)
+
+    def freshness(self) -> Dict[str, Any]:
+        """The local view's freshness watermark (the /debug/freshness
+        ``local`` section): how old the newest published delta is, by the
+        local monotonic publish stamp AND by its origin wall stamp. An
+        idle fleet legitimately ages here — the watermark says "nothing
+        newer has been seen", never "something is wrong" by itself; the
+        SLO plane is what turns age into a verdict."""
+        with self._cond:
+            rv = self._rv
+            objects = len(self._objects)
+            last = self._deltas[-1] if self._deltas else None
+        out: Dict[str, Any] = {
+            "rv": rv,
+            "objects": objects,
+            "last_delta_age_seconds": (
+                round(time.monotonic() - last.t, 3) if last is not None else None
+            ),
+        }
+        if last is not None and last.ts_wall is not None:
+            # origin-stamped age (wall clock: comparable across hosts,
+            # subject to the documented skew caveat)
+            out["last_delta_origin_age_seconds"] = round(
+                max(0.0, time.time() - last.ts_wall), 3
+            )
+        return out
 
     def read_since(
         self,
@@ -683,6 +823,7 @@ class FleetView:
         limit: Optional[int] = None,
         timeout: float = 0.0,
         codec: str = CODEC_JSON,
+        fresh: bool = False,
     ) -> FrameReadResult:
         """``read_since`` plus the wire frames in ``codec`` — the
         broadcast path. ``frames[i]`` is ``deltas[i]`` chunk-framed in
@@ -690,12 +831,18 @@ class FleetView:
         by reference across every subscriber pulling this range
         (compacted and paged batches included — they subset the same
         bytes objects). Holes left by lazy paths (msgpack, the merge's
-        ``apply_batch``) are filled off the publish lock and memoized."""
+        ``apply_batch``) are filled off the publish lock and memoized.
+        ``fresh`` selects the freshness-stamped frame variant (its own
+        parallel array — stamped peers share stamped bytes, unstamped
+        peers keep the byte-golden plain frames)."""
         return FrameReadResult(
-            *self._read(rv, max_deltas, limit, timeout, want_frames=True, codec=codec)
+            *self._read(
+                rv, max_deltas, limit, timeout, want_frames=True,
+                variant=frame_variant(codec, fresh),
+            )
         )
 
-    def _fill_frames(self, deltas: List[Delta], frames: List[Optional[bytes]], codec: str) -> None:
+    def _fill_frames(self, deltas: List[Delta], frames: List[Optional[bytes]], variant: str) -> None:
         """Encode the ``None`` holes in one pulled frame slice (OFF the
         publish lock — a large catch-up read must not stall publishers
         behind O(pending) serialization), then memoize the results back
@@ -713,22 +860,30 @@ class FleetView:
         onto the puller). The fill is bounded by what the pull DELIVERS
         — ``max_deltas``/``queue_depth`` raw, unique-keys-in-range
         compacted — and is paid once per delta per codec ever."""
+        fresh = variant.endswith(FRESH_SUFFIX)
+        codec = variant[: -len(FRESH_SUFFIX)] if fresh else variant
         t0 = time.perf_counter() if self._encode_seconds is not None else 0.0
         encoded: List[Tuple[int, bytes]] = []
         for i, frame in enumerate(frames):
             if frame is None:
-                frame = chunk_frame(deltas[i].to_wire(), codec)
+                frame = chunk_frame(deltas[i].to_wire(fresh=fresh), codec)
                 frames[i] = frame
                 encoded.append((deltas[i].rv, frame))
         if not encoded:
             return
         if self._encode_seconds is not None:
             self._encode_seconds.record(time.perf_counter() - t0)
-        counter = self._frame_encodes if codec == CODEC_JSON else self._frame_encodes_mp
+        if fresh:
+            # stamped variants bill their own counter: the PR-7
+            # encodes==publishes invariant is stated over the plain
+            # JSON path and must stay exact with stamped peers attached
+            counter = self._frame_encodes_fresh
+        else:
+            counter = self._frame_encodes if codec == CODEC_JSON else self._frame_encodes_mp
         if counter is not None:
             counter.inc(len(encoded))
         with self._cond:
-            master = self._frames[codec]
+            master = self._frames[variant]
             if not self._delta_rvs:
                 return
             base = self._delta_rvs[0]
@@ -744,7 +899,7 @@ class FleetView:
         limit: Optional[int],
         timeout: float,
         want_frames: bool,
-        codec: str = CODEC_JSON,
+        variant: str = CODEC_JSON,
     ) -> Tuple[str, int, int, bool, List[Delta], List[bytes]]:
         deadline = time.monotonic() + timeout if timeout > 0 else None
         frames: List[bytes] = []
@@ -778,7 +933,7 @@ class FleetView:
             # subscribers' compactions serialize every publish behind them
             deltas = self._deltas[idx:]
             if want_frames:
-                frames = self._frames[codec][idx:]
+                frames = self._frames[variant][idx:]
         oldest_pending_t = deltas[0].t
         if pending <= max_deltas:
             compacted = False
@@ -803,7 +958,7 @@ class FleetView:
             # fill lazy holes for exactly what this pull delivers (after
             # compaction/paging subset the range — never for deltas the
             # subscriber won't receive)
-            self._fill_frames(deltas, frames, codec)
+            self._fill_frames(deltas, frames, variant)
         if self._delta_lag is not None:
             # lag = how stale the oldest pending delta had become by the
             # time this pull delivered it
@@ -876,14 +1031,16 @@ class Subscription:
         timeout: float = 0.0,
         limit: Optional[int] = None,
         codec: str = CODEC_JSON,
+        fresh: bool = False,
     ) -> FrameReadResult:
         """``pull`` returning the wire frames in ``codec`` alongside the
         deltas — the broadcast core's (and fan-out bench's) shape; the
-        frames are shared bytes, a delivery is a buffer append."""
+        frames are shared bytes, a delivery is a buffer append. ``fresh``
+        selects the freshness-stamped frame variant."""
         return self._advance(
             self.view.read_frames_since(
                 self.rv, max_deltas=self.queue_depth, limit=limit, timeout=timeout,
-                codec=codec,
+                codec=codec, fresh=fresh,
             )
         )
 

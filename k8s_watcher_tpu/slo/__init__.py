@@ -1,0 +1,6 @@
+"""SLO/burn-rate engine: config-declared objectives over the metrics
+registry, evaluated with two-window burn rates (see slo/engine.py)."""
+
+from k8s_watcher_tpu.slo.engine import SLOPlane
+
+__all__ = ["SLOPlane"]

@@ -77,6 +77,12 @@ def test_bench_smoke_headline_within_budget():
     # budget, merged state == union of upstreams, zero gaps/dups
     assert headline["federation_ok"] is True, headline
     assert headline["federation_p50_ms"] is not None, headline
+    # freshness plane: the bench's latency numbers are READ FROM the
+    # watch_to_global_view_seconds histogram (the telemetry operators
+    # scrape), and the per-upstream watermarks + serve-wire histogram
+    # all populated through the negotiated ?fresh=1 stamps
+    assert headline["freshness_ok"] is True, headline
+    assert headline["propagation_p99_ms"] is not None, headline
     # batched fan-in: GlobalMerge.apply_batch sustained >= 3x the
     # per-delta-apply baseline on merged-deltas/s (measured in the same
     # run), and the live churn-doubling ramp kept the merged view caught
@@ -116,6 +122,9 @@ def test_bench_smoke_headline_within_budget():
     assert fed["merged_matches"], fed
     assert fed["gaps"] == 0 and fed["dups"] == 0, fed
     assert fed["deltas_applied"] > 0 and fed["latency_samples"] > 0, fed
+    # every upstream's freshness watermark populated during the run
+    assert fed["freshness_ok"], fed
+    assert all(age is not None for age in fed["watermark_age_seconds"].values()), fed
     assert all(a["correctness_ok"] for a in fed["attempts"]), fed["attempts"]
     # the fan-in A/B's own correctness legs: the batched terminal view is
     # IDENTICAL to the per-delta one and the merged-object gauge stayed

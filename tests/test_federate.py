@@ -734,6 +734,130 @@ class TestFederationPlaneLive:
 # -- config schema ------------------------------------------------------------
 
 
+class TestFreshnessPlane:
+    """PR-10 propagation stamping through the federation wire: origin
+    stamps ride the negotiated ?fresh=1 frames, populate the
+    watch_to_global_view/serve_wire histograms and the per-upstream
+    watermarks, and propagate into the merged view's own deltas."""
+
+    def test_stamps_histograms_and_watermarks_over_live_wire(self):
+        (v1, s1) = _upstream_stack()
+        reg = MetricsRegistry()
+        gview = FleetView(metrics=reg)
+        plane = FederationPlane(
+            _fed_config([f"http://127.0.0.1:{s1.port}"], stale_after_seconds=5.0),
+            gview, metrics=reg,
+        ).start()
+        try:
+            _wait_for(
+                lambda: all(u.subscriber.snapshots > 0 for u in plane.upstreams),
+                message="initial snapshots",
+            )
+            origin_floor = time.time()
+            for j in range(4):
+                v1.apply("pod", f"p{j}", {"kind": "pod", "key": f"p{j}", "seq": j})
+            _wait_for(lambda: gview.object_count() == 4, message="merge convergence")
+            w2g = reg.histogram("watch_to_global_view_seconds")
+            wire = reg.histogram("serve_wire_seconds")
+            _wait_for(lambda: w2g.count >= 4, message="propagation histograms")
+            assert wire.count >= 4
+            # same-host wall clocks: the measured span is tiny, never huge
+            assert (w2g.summary()["p99_ms"] or 0) < 60_000
+            # the merged view's OWN deltas carry the upstream origin
+            # stamp (a second-tier federator would keep measuring e2e)
+            merged = [
+                d for d in gview.read_since(0, max_deltas=64).deltas
+                if d.object is not None and d.object.get("cluster")
+            ]
+            assert merged and all(
+                d.ts_wall is not None and origin_floor - 60 < d.ts_wall <= d.pub_wall + 0.001
+                for d in merged
+            )
+            # per-upstream watermark: young while churn just flowed
+            upstream = plane.upstreams[0]
+            _wait_for(lambda: upstream.subscriber.watermark_age() is not None,
+                      message="watermark")
+            plane._tick()
+            assert upstream.watermark_age_gauge.value < 30.0
+            fresh = plane.freshness()
+            block = fresh["upstreams"]["c0"]
+            assert block["watermark_age_seconds"] is not None
+            assert block["oldest_unpropagated_seconds"] == 0.0
+            assert fresh["watch_to_global_view_seconds"]["count"] >= 4
+        finally:
+            plane.stop()
+            s1.stop()
+
+    def test_labeled_gauges_default_legacy_off(self):
+        (v1, s1) = _upstream_stack()
+        reg = MetricsRegistry()
+        gview = FleetView(metrics=reg)
+        plane = FederationPlane(
+            _fed_config([f"http://127.0.0.1:{s1.port}"]), gview, metrics=reg,
+        )
+        try:
+            plane.upstreams[0].update_gauges()
+            text = reg.prometheus_text()
+            assert 'k8s_watcher_federation_upstream_lag_rv{upstream="c0"} 0' in text
+            # suffix-mangled legacy series NOT emitted without the flag
+            assert "federation_upstream_lag_rv_c0" not in text
+        finally:
+            s1.stop()
+
+    def test_legacy_suffix_names_flag_mirrors_gauges(self):
+        (v1, s1) = _upstream_stack()
+        reg = MetricsRegistry(legacy_suffix_names=True)
+        gview = FleetView(metrics=reg)
+        plane = FederationPlane(
+            _fed_config([f"http://127.0.0.1:{s1.port}"]), gview, metrics=reg,
+        )
+        try:
+            plane.upstreams[0].update_gauges()
+            text = reg.prometheus_text()
+            # both shapes tick for one release of dashboard continuity
+            assert 'k8s_watcher_federation_upstream_lag_rv{upstream="c0"} 0' in text
+            assert "k8s_watcher_federation_upstream_lag_rv_c0 0" in text
+        finally:
+            s1.stop()
+
+    def test_cardinality_cap_fits_configured_upstream_count(self):
+        # >64 upstreams is a legitimate BOUNDED dimension (bounded by
+        # config): the plane widens the gauge families' cardinality cap
+        # to fit the declared list instead of crashing at startup
+        reg = MetricsRegistry()
+        gview = FleetView(metrics=reg)
+        cfg = FederationConfig.from_raw({
+            "enabled": True,
+            "upstreams": [
+                {"name": f"c{i}", "url": f"http://127.0.0.1:{10000 + i}"}
+                for i in range(70)
+            ],
+        })
+        plane = FederationPlane(cfg, gview, metrics=reg)  # must not raise
+        assert len(plane.upstreams) == 70
+        # ...while an unrelated family keeps the default bound
+        assert reg.gauge("some_other_gauge").max_label_sets == 64
+
+    def test_unstamped_upstream_degrades_gracefully(self, live_serve):
+        # a peer that never sends ts (e.g. fresh=False client asking the
+        # questions): watermark falls back to local receive time and the
+        # propagation histograms simply stay empty — absent, never wrong
+        view, _, base = live_serve
+        client = FleetClient(base)  # fresh NOT negotiated
+        sub = FleetSubscriber(client, stale_after_seconds=3.0)
+        thread = threading.Thread(target=sub.run, daemon=True)
+        thread.start()
+        try:
+            _wait_for(lambda: sub.snapshots > 0, message="snapshot")
+            view.apply("pod", "a", {"kind": "pod", "key": "a", "seq": 0})
+            _wait_for(lambda: sub.frames > 0 and sub.last_delta_age() is not None,
+                      message="delta")
+            assert sub.watermark_age() is not None
+        finally:
+            sub.stop()
+            thread.join(timeout=5)
+
+
 class TestFederationConfigSchema:
     def test_defaults_off(self):
         cfg = FederationConfig.from_raw({})

@@ -581,6 +581,191 @@ class TestPrometheusExposition:
             server.stop()
 
 
+LABELED_GOLDEN_EXPOSITION = """\
+# TYPE k8s_watcher_deltas_applied_total counter
+k8s_watcher_deltas_applied_total 10
+k8s_watcher_deltas_applied_total{upstream="a"} 7
+k8s_watcher_deltas_applied_total{upstream="b"} 3
+# TYPE k8s_watcher_upstream_lag gauge
+k8s_watcher_upstream_lag{upstream="a"} 1.5
+k8s_watcher_upstream_lag{upstream="b"} 4
+# TYPE k8s_watcher_hop_seconds histogram
+k8s_watcher_hop_seconds_bucket{upstream="a",le="1e-05"} 0
+k8s_watcher_hop_seconds_bucket{upstream="a",le="3.16e-05"} 0
+k8s_watcher_hop_seconds_bucket{upstream="a",le="0.0001"} 0
+k8s_watcher_hop_seconds_bucket{upstream="a",le="0.000316"} 0
+k8s_watcher_hop_seconds_bucket{upstream="a",le="0.001"} 0
+k8s_watcher_hop_seconds_bucket{upstream="a",le="0.00316"} 1
+k8s_watcher_hop_seconds_bucket{upstream="a",le="0.01"} 1
+k8s_watcher_hop_seconds_bucket{upstream="a",le="0.0316"} 1
+k8s_watcher_hop_seconds_bucket{upstream="a",le="0.1"} 1
+k8s_watcher_hop_seconds_bucket{upstream="a",le="0.316"} 1
+k8s_watcher_hop_seconds_bucket{upstream="a",le="1"} 1
+k8s_watcher_hop_seconds_bucket{upstream="a",le="3.16"} 1
+k8s_watcher_hop_seconds_bucket{upstream="a",le="10"} 1
+k8s_watcher_hop_seconds_bucket{upstream="a",le="31.6"} 1
+k8s_watcher_hop_seconds_bucket{upstream="a",le="100"} 1
+k8s_watcher_hop_seconds_bucket{upstream="a",le="+Inf"} 1
+k8s_watcher_hop_seconds_sum{upstream="a"} 0.002
+k8s_watcher_hop_seconds_count{upstream="a"} 1
+"""
+
+
+class TestLabeledMetrics:
+    """First-class Prometheus labels (PR 10): Counter/Gauge/Histogram
+    ``.labels()``, labeled text exposition, JSON-snapshot nesting, the
+    cardinality bound, and the insertion-ordered registry's sorted-name
+    scrape cache."""
+
+    def test_labeled_exposition_is_byte_stable(self):
+        # the labeled golden, next to the unlabeled PR-3 golden in
+        # test_trace.py: label render order (sorted keys, children
+        # sorted by label set, `le` last on buckets) and the
+        # parent-only-when-touched rule are load-bearing for scrapers
+        reg = MetricsRegistry()
+        c = reg.counter("deltas_applied")
+        c.inc(10)  # the cross-label total (package convention)
+        c.labels(upstream="a").inc(7)
+        c.labels(upstream="b").inc(3)
+        g = reg.gauge("upstream_lag")  # parent never set -> no bare line
+        g.labels(upstream="b").set(4)  # registration order b, a...
+        g.labels(upstream="a").set(1.5)
+        h = reg.histogram("hop_seconds")  # parent empty -> no bare series
+        h.labels(upstream="a").record(0.002)
+        assert reg.prometheus_text() == LABELED_GOLDEN_EXPOSITION
+        # ...and byte-stable across scrapes (the sorted-name cache)
+        assert reg.prometheus_text() == LABELED_GOLDEN_EXPOSITION
+
+    def test_same_label_set_returns_same_child(self):
+        reg = MetricsRegistry()
+        c = reg.counter("x")
+        assert c.labels(upstream="a", codec="json") is c.labels(codec="json", upstream="a")
+        assert c.labels(upstream="a") is not c.labels(upstream="b")
+
+    def test_cardinality_bound_rejects_unbounded_values(self):
+        import pytest
+
+        reg = MetricsRegistry()
+        c = reg.counter("per_pod")  # a pod-uid label would explode here
+        for i in range(c.max_label_sets):
+            c.labels(uid=f"pod-{i}").inc()
+        with pytest.raises(ValueError, match="cardinality"):
+            c.labels(uid="pod-too-many")
+
+    def test_label_validation(self):
+        import pytest
+
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError):
+            reg.counter("x").labels()  # empty label set
+        with pytest.raises(ValueError, match="label name"):
+            reg.counter("x").labels(**{"bad-name": "v"})
+        with pytest.raises(ValueError, match="str/int/float"):
+            reg.counter("x").labels(obj=object())
+        with pytest.raises(ValueError, match="128"):
+            reg.counter("x").labels(v="x" * 200)
+        with pytest.raises(ValueError, match="already-labeled"):
+            reg.counter("x").labels(a="1").labels(b="2")
+
+    def test_label_value_escaping(self):
+        reg = MetricsRegistry()
+        reg.counter("esc").labels(v='say "hi"\\\n').inc()
+        text = reg.prometheus_text()
+        assert 'k8s_watcher_esc_total{v="say \\"hi\\"\\\\\\n"} 1' in text
+
+    def test_json_snapshot_round_trips_labels(self):
+        import json as _json
+
+        reg = MetricsRegistry()
+        c = reg.counter("deltas")
+        c.inc(10)
+        c.labels(upstream="a").inc(7)
+        reg.gauge("lag").labels(upstream="a").set(2.5)
+        reg.histogram("hop_seconds").labels(upstream="a").record(0.01)
+        # the dump must survive a JSON wire round trip with the label
+        # sets recoverable as data (not baked into rendered strings)
+        dump = _json.loads(_json.dumps(reg.dump()))
+        assert dump["deltas"]["count"] == 10
+        series = {tuple(sorted(s["labels"].items())): s for s in dump["deltas"]["series"]}
+        assert series[(("upstream", "a"),)]["count"] == 7
+        gauge_series = dump["lag"]["series"]
+        assert gauge_series == [{"labels": {"upstream": "a"}, "value": 2.5}]
+        hop = dump["hop_seconds"]["series"][0]
+        assert hop["labels"] == {"upstream": "a"} and hop["count"] == 1
+
+    def test_scrape_does_not_resort_unchanged_registry(self):
+        # the sorted-name cache: after one scrape, further scrapes reuse
+        # the cached item lists; a NEW registration invalidates them
+        reg = MetricsRegistry()
+        reg.counter("b").inc()
+        reg.counter("a").inc()
+        first = reg.prometheus_text()
+        assert reg._sorted_counters is not None
+        cached = reg._sorted_counters
+        reg.counter("a")  # get-or-create of an EXISTING name: cache kept
+        assert reg._sorted_counters is cached
+        assert reg.prometheus_text() == first
+        reg.counter("c").inc()  # new registration invalidates
+        assert "k8s_watcher_c_total" in reg.prometheus_text()
+        a_idx = first.index("k8s_watcher_a_total")
+        b_idx = first.index("k8s_watcher_b_total")
+        assert a_idx < b_idx  # sorted despite insertion order b, a
+
+    def test_registry_sample_shapes(self):
+        reg = MetricsRegistry()
+        reg.counter("sent").inc(5)
+        g = reg.gauge("age")
+        g.labels(upstream="a").set(3.0)
+        g.labels(upstream="b").set(9.0)
+        reg.histogram("hop_seconds").record(0.01)
+        sample = reg.sample()
+        assert sample["counters"]["sent"] == 5
+        # gauges sample as the MAX over parent + children (the
+        # worst-member reading staleness objectives gate)
+        assert sample["gauges"]["age"] == 9.0
+        pairs, total, total_sum = sample["histograms"]["hop_seconds"]
+        assert total == 1 and pairs[-1] == (float("inf"), 1)
+
+
+class TestFreshnessAndSloRoutes:
+    def test_debug_freshness_404_when_not_wired(self):
+        server = StatusServer(MetricsRegistry(), Liveness()).start()
+        try:
+            assert requests.get(
+                f"http://127.0.0.1:{server.port}/debug/freshness", timeout=5
+            ).status_code == 404
+            assert requests.get(
+                f"http://127.0.0.1:{server.port}/debug/slo", timeout=5
+            ).status_code == 404
+        finally:
+            server.stop()
+
+    def test_slo_fold_degrades_body_never_liveness(self):
+        liveness = Liveness()
+        liveness.beat()
+        server = StatusServer(
+            MetricsRegistry(), liveness,
+            freshness=lambda: {"local": {"rv": 7}},
+            slo=lambda: {"objectives": {}},
+            slo_health=lambda: {"healthy": False, "breaching": ["propagation-p99"]},
+        ).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            r = requests.get(f"{url}/healthz", timeout=5)
+            # a breached error budget NEVER flips liveness (restart
+            # refunds nothing) — degraded body only
+            assert r.status_code == 200
+            body = r.json()
+            assert body["alive"] is True
+            assert body["slo"] == {"healthy": False, "breaching": ["propagation-p99"]}
+            fresh = requests.get(f"{url}/debug/freshness", timeout=5).json()
+            assert fresh["freshness"]["local"]["rv"] == 7
+            slo = requests.get(f"{url}/debug/slo", timeout=5).json()
+            assert slo["slo"] == {"objectives": {}}
+        finally:
+            server.stop()
+
+
 class TestDebugSlicesEndpoint:
     def test_live_slice_states_served(self):
         from k8s_watcher_tpu.pipeline.phase import PhaseTracker

@@ -1226,17 +1226,139 @@ class TestSnapshotCodecCache:
         assert view.snapshot_bytes() is bj
         assert view.snapshot_bytes(codec=CODEC_MSGPACK) is bm
         assert msgpack.unpackb(bm, raw=False) == json.loads(bj)
-        # per-codec labels on the hit/miss counters (+ the totals)
-        assert reg.counter("serve_snapshot_cache_misses_json").value == 1
-        assert reg.counter("serve_snapshot_cache_misses_msgpack").value == 1
-        assert reg.counter("serve_snapshot_cache_hits_json").value == 1
-        assert reg.counter("serve_snapshot_cache_hits_msgpack").value == 1
+        # per-codec breakdown as REAL labels (+ the cross-codec totals
+        # on the parents) — the PR-10 migration off suffix-mangled names
+        assert reg.counter("serve_snapshot_cache_misses").labels(codec="json").value == 1
+        assert reg.counter("serve_snapshot_cache_misses").labels(codec="msgpack").value == 1
+        assert reg.counter("serve_snapshot_cache_hits").labels(codec="json").value == 1
+        assert reg.counter("serve_snapshot_cache_hits").labels(codec="msgpack").value == 1
         assert reg.counter("serve_snapshot_cache_hits").value == 2
         assert reg.counter("serve_snapshot_cache_misses").value == 2
+        # the legacy suffixed names are NOT emitted by default...
+        assert reg.counter("serve_snapshot_cache_hits_json").value == 0
         # a publish invalidates BOTH codec entries by bumping rv
         view.apply("pod", "a", {"kind": "pod", "key": "a", "seq": 1})
         assert view.snapshot_bytes() is not bj
         assert view.snapshot_bytes(codec=CODEC_MSGPACK) is not bm
+
+    def test_legacy_suffix_names_flag_mirrors_old_series(self):
+        # metrics.legacy_suffix_names: one release of dashboard
+        # continuity — the old suffix-mangled series keep ticking
+        # ALONGSIDE the labeled ones
+        reg = MetricsRegistry(legacy_suffix_names=True)
+        view = FleetView(metrics=reg)
+        view.apply("pod", "a", {"kind": "pod", "key": "a", "seq": 0})
+        view.snapshot_bytes()
+        view.snapshot_bytes()
+        assert reg.counter("serve_snapshot_cache_misses_json").value == 1
+        assert reg.counter("serve_snapshot_cache_hits_json").value == 1
+        assert reg.counter("serve_snapshot_cache_misses").labels(codec="json").value == 1
+        assert reg.counter("serve_snapshot_cache_hits").labels(codec="json").value == 1
+
+
+class TestFreshnessStamps:
+    """The negotiated per-frame freshness field (?fresh=1): stamped
+    frames carry ts=[origin_wall, publish_wall]; everything a peer that
+    did NOT negotiate sees stays byte-golden."""
+
+    def test_plain_wire_dict_has_no_ts_key(self):
+        view = FleetView()
+        view.apply("pod", "a", {"kind": "pod", "key": "a", "seq": 0})
+        r = view.read_frames_since(0, max_deltas=4)
+        d = r.deltas[0]
+        assert "ts" not in d.to_wire()
+        assert d.ts_wall is not None and d.pub_wall > 0
+        fresh = d.to_wire(fresh=True)
+        assert fresh["ts"] == [d.ts_wall, d.pub_wall]
+        # the plain frame bytes are the PR-4 golden, untouched
+        assert _frame_payload(r.frames[0]) == (json.dumps(d.to_wire()) + "\n").encode()
+
+    def test_fresh_variant_is_its_own_encode_once_array(self):
+        reg = MetricsRegistry()
+        view = FleetView(metrics=reg)
+        view.apply("pod", "a", {"kind": "pod", "key": "a", "seq": 0})
+        plain = view.read_frames_since(0, max_deltas=4)
+        fresh1 = view.read_frames_since(0, max_deltas=4, fresh=True)
+        fresh2 = view.read_frames_since(0, max_deltas=4, fresh=True)
+        assert _frame_payload(plain.frames[0]) != _frame_payload(fresh1.frames[0])
+        assert json.loads(_frame_payload(fresh1.frames[0]))["ts"] is not None
+        # memoized: the second fresh pull shares the SAME bytes object
+        assert fresh1.frames[0] is fresh2.frames[0]
+        # ...and billed to its own counter: the PR-7 encodes==publishes
+        # invariant over the plain JSON path stays exact
+        assert reg.counter("serve_frame_encodes").value == 1
+        assert reg.counter("serve_frame_encodes_fresh").value == 1
+        # msgpack fresh variant decodes to the same dict
+        fm = view.read_frames_since(0, max_deltas=4, codec=CODEC_MSGPACK, fresh=True)
+        assert msgpack.unpackb(_frame_payload(fm.frames[0]), raw=False) == json.loads(
+            _frame_payload(fresh1.frames[0])
+        )
+
+    def test_apply_batch_propagates_origin_stamps(self):
+        view = FleetView()
+        origin = time.time() - 42.0
+        view.apply_batch([
+            ("pod", "a", {"kind": "pod", "key": "a", "seq": 0}, origin),
+            ("pod", "b", {"kind": "pod", "key": "b", "seq": 0}),  # unstamped: now
+        ])
+        deltas = view.read_since(0, max_deltas=4).deltas
+        assert deltas[0].ts_wall == origin
+        assert deltas[1].ts_wall == pytest.approx(time.time(), abs=5.0)
+        assert all(d.pub_wall >= d.ts_wall - 0.001 for d in deltas[1:])
+
+    def test_long_poll_fresh_negotiation(self, serve_http):
+        view, _, base = serve_http
+        view.apply("pod", "a", {"kind": "pod", "key": "a", "seq": 0})
+        plain = requests.get(
+            f"{base}/serve/fleet", timeout=5,
+            params={"watch": 1, "once": 1, "rv": 0, "timeout": 0.2},
+        ).json()
+        fresh = requests.get(
+            f"{base}/serve/fleet", timeout=5,
+            params={"watch": 1, "once": 1, "rv": 0, "timeout": 0.2, "fresh": 1},
+        ).json()
+        assert "ts" not in plain["items"][0]
+        ts = fresh["items"][0]["ts"]
+        assert len(ts) == 2 and abs(time.time() - ts[0]) < 60
+        stripped = [{k: v for k, v in i.items() if k != "ts"} for i in fresh["items"]]
+        assert stripped == plain["items"]
+
+    def test_stream_fresh_negotiation(self, serve_http):
+        view, _, base = serve_http
+        view.apply("pod", "a", {"kind": "pod", "key": "a", "seq": 0})
+        r = requests.get(
+            f"{base}/serve/fleet", timeout=5, stream=True,
+            params={"watch": 1, "rv": 0, "timeout": 0.5, "fresh": 1},
+        )
+        frames = [json.loads(line) for line in r.iter_lines() if line.strip()]
+        deltas = [f for f in frames if f["type"] == "UPSERT"]
+        assert deltas and all("ts" in f for f in deltas)
+        # control frames (SYNC) never carry stamps
+        assert all("ts" not in f for f in frames if f["type"] == "SYNC")
+
+    def test_view_freshness_watermark(self):
+        view = FleetView()
+        assert view.freshness()["last_delta_age_seconds"] is None
+        view.apply("pod", "a", {"kind": "pod", "key": "a", "seq": 0})
+        fresh = view.freshness()
+        assert fresh["rv"] == 1 and fresh["objects"] == 1
+        assert fresh["last_delta_age_seconds"] < 5.0
+        assert fresh["last_delta_origin_age_seconds"] < 5.0
+
+    def test_publish_batch_records_watch_to_local_view(self):
+        reg = MetricsRegistry()
+        view = FleetView(metrics=reg)
+        pod = build_pod("p", "default", uid="u1", phase="Running", tpu_chips=4)
+        event = WatchEvent(EventType.ADDED, pod)
+
+        class _R:
+            reason = "notified"
+
+        view.publish_batch([event], [_R()])
+        h = reg.histogram("watch_to_local_view_seconds")
+        assert h.count == 1
+        deltas = view.read_since(0, max_deltas=4).deltas
+        assert deltas[0].ts_wall == event.received_at
 
 
 class TestCodecHttp:

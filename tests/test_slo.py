@@ -1,0 +1,257 @@
+"""SLO engine tests: the timeseries ring, the three objective kinds,
+the two-window burn-rate breach rule, and the config schema."""
+
+import time
+
+import pytest
+
+from k8s_watcher_tpu.config.schema import SchemaError, SloConfig, SloObjective
+from k8s_watcher_tpu.metrics import MetricsRegistry
+from k8s_watcher_tpu.slo import SLOPlane
+from k8s_watcher_tpu.slo.engine import _Ring, _window_error_quantile
+
+
+def _config(**overrides):
+    raw = {
+        "enabled": True,
+        "tick_seconds": 0.05,
+        "ring_size": 512,
+        "fast_window_seconds": 0.2,
+        "slow_window_seconds": 0.6,
+        "objectives": [
+            {"name": "latency-p99", "histogram": "hop_seconds",
+             "quantile": 0.99, "max_seconds": 1.0, "target": 0.99},
+            {"name": "staleness", "gauge": "age_seconds", "max": 30.0},
+            {"name": "success", "ratio_good": "sent", "ratio_total": "enqueued",
+             "min_ratio": 0.9},
+        ],
+    }
+    raw.update(overrides)
+    return SloConfig.from_raw(raw)
+
+
+def _drive(plane, rounds, step, sleep=0.01):
+    for _ in range(rounds):
+        step()
+        plane.tick()
+        time.sleep(sleep)
+
+
+class TestRing:
+    def test_window_start_picks_newest_at_or_before_boundary(self):
+        ring = _Ring(16)
+        for t in (0.0, 1.0, 2.0, 3.0):
+            ring.append(t, {"t": t})
+        entry = ring.at_window_start(now=3.0, window=1.5)
+        assert entry[0] == 1.0  # newest sample <= 3.0 - 1.5
+        # window longer than the ring's history: the oldest entry serves
+        # as the base (and the eval's `covered` flag says it was short)
+        assert ring.at_window_start(now=3.0, window=10.0)[0] == 0.0
+
+    def test_bounded(self):
+        ring = _Ring(4)
+        for t in range(10):
+            ring.append(float(t), {})
+        assert len(ring) == 4
+        assert ring.at_window_start(now=9.0, window=100.0)[0] == 6.0
+
+
+class TestWindowErrorQuantile:
+    def _hist(self, *observations):
+        from k8s_watcher_tpu.metrics.metrics import Histogram
+
+        h = Histogram("hop_seconds")
+        for s in observations:
+            h.record(s)
+        return h.downsampled_buckets_with_totals()
+
+    def test_error_rate_is_fraction_over_threshold(self):
+        base = self._hist()
+        cur = self._hist(0.01, 0.02, 5.0, 7.0)
+        error, q, n = _window_error_quantile(base, cur, max_seconds=1.0, quantile=0.5)
+        assert n == 4
+        assert error == pytest.approx(0.5)
+        # windowed p50: its bucket's upper edge (~31.6 ms for a 20 ms
+        # observation under the downsampled ~2-bounds-per-decade layout)
+        assert q is not None and q == pytest.approx(0.0316, rel=0.01)
+
+    def test_differences_against_the_window_base(self):
+        # the base's observations must not count against the window
+        base = self._hist(5.0, 5.0, 5.0)
+        cur = self._hist(5.0, 5.0, 5.0, 0.01)  # only the 10 ms is new
+        error, _q, n = _window_error_quantile(base, cur, max_seconds=1.0, quantile=0.99)
+        assert n == 1 and error == 0.0
+
+    def test_no_observations_no_burn(self):
+        sample = self._hist(0.5)
+        error, q, n = _window_error_quantile(sample, sample, 1.0, 0.99)
+        assert (error, q, n) == (0.0, None, 0)
+
+
+class TestObjectiveKinds:
+    def test_quantile_objective_breaches_on_slow_traffic(self):
+        reg = MetricsRegistry()
+        plane = SLOPlane(_config(), reg)
+        h = reg.histogram("hop_seconds")
+        _drive(plane, 20, lambda: h.record(0.01))
+        assert plane.results()["latency-p99"]["breaching"] is False
+        _drive(plane, 40, lambda: h.record(5.0))
+        result = plane.results()["latency-p99"]
+        assert result["breaching"] is True
+        assert result["windows"]["fast"]["burn_rate"] > 1.0
+        assert result["windows"]["slow"]["burn_rate"] > 1.0
+        # exported through the labeled gauges
+        assert reg.gauge("slo_breaching").labels(objective="latency-p99").value == 1.0
+
+    def test_gauge_objective_uses_worst_label_child(self):
+        reg = MetricsRegistry()
+        plane = SLOPlane(_config(), reg)
+        g = reg.gauge("age_seconds")
+        g.labels(upstream="a").set(1.0)
+        g.labels(upstream="b").set(1.0)
+        _drive(plane, 20, lambda: None)
+        assert plane.results()["staleness"]["breaching"] is False
+        # ONE upstream going stale must breach (max over children)
+        g.labels(upstream="b").set(120.0)
+        _drive(plane, 40, lambda: None)
+        result = plane.results()["staleness"]
+        assert result["breaching"] is True
+        assert result["current"] == 120.0
+
+    def test_ratio_objective(self):
+        reg = MetricsRegistry()
+        plane = SLOPlane(_config(), reg)
+        sent, enq = reg.counter("sent"), reg.counter("enqueued")
+
+        def ok():
+            sent.inc()
+            enq.inc()
+
+        _drive(plane, 20, ok)
+        assert plane.results()["success"]["breaching"] is False
+
+        _drive(plane, 40, lambda: enq.inc())  # everything fails now
+        result = plane.results()["success"]
+        assert result["breaching"] is True
+        assert result["windows"]["fast"]["ratio"] < 0.9
+
+    def test_no_traffic_is_not_a_breach(self):
+        # zero observations/ticks in a window must read as zero burn —
+        # "nothing flowed" is the staleness gauges' job, not the
+        # latency/ratio objectives'
+        reg = MetricsRegistry()
+        plane = SLOPlane(_config(), reg)
+        _drive(plane, 15, lambda: None)
+        results = plane.results()
+        assert all(not r["breaching"] for r in results.values())
+        assert all(
+            r["windows"]["fast"]["burn_rate"] == 0.0 for r in results.values()
+        )
+
+
+class TestTwoWindowRule:
+    def test_fast_only_blip_does_not_breach(self):
+        # a short burst violates the fast window but not the slow one —
+        # the two-window rule keeps blips out of the breach verdict
+        reg = MetricsRegistry()
+        cfg = _config(fast_window_seconds=0.1, slow_window_seconds=2.0,
+                      ring_size=4096)
+        plane = SLOPlane(cfg, reg)
+        h = reg.histogram("hop_seconds")
+        _drive(plane, 30, lambda: h.record(0.01))  # healthy history
+        _drive(plane, 4, lambda: h.record(5.0))  # short burst
+        result = plane.results()["latency-p99"]
+        assert result["windows"]["fast"]["burn_rate"] > 1.0
+        assert result["breaching"] is (result["windows"]["slow"]["burn_rate"] > 1.0)
+
+    def test_coverage_flag_reports_short_history(self):
+        reg = MetricsRegistry()
+        cfg = _config(slow_window_seconds=60.0, fast_window_seconds=0.2,
+                      ring_size=4096)
+        plane = SLOPlane(cfg, reg)
+        plane.tick()
+        plane.tick()
+        slow = plane.results()["latency-p99"]["windows"]["slow"]
+        assert slow["covered"] is False  # the ring reaches back ~0 s, not 60
+
+
+class TestSurfaces:
+    def test_snapshot_and_health(self):
+        reg = MetricsRegistry()
+        plane = SLOPlane(_config(), reg)
+        g = reg.gauge("age_seconds")
+        _drive(plane, 40, lambda: g.set(500.0))
+        snap = plane.snapshot()
+        assert snap["objectives"]["staleness"]["breaching"] is True
+        assert snap["ring_entries"] > 0
+        health = plane.health()
+        assert health["healthy"] is False
+        assert health["breaching"] == ["staleness"]
+
+    def test_start_stop_thread(self):
+        reg = MetricsRegistry()
+        plane = SLOPlane(_config(), reg).start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while plane._ticks < 3 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert plane._ticks >= 3
+            assert plane.health()["thread_alive"] is True
+        finally:
+            plane.stop()
+        assert plane.health()["thread_alive"] is False
+
+
+class TestSchema:
+    def test_objective_kinds_parse(self):
+        cfg = _config()
+        kinds = {o.name: o.kind for o in cfg.objectives}
+        assert kinds == {"latency-p99": "quantile", "staleness": "gauge", "success": "ratio"}
+        ratio = next(o for o in cfg.objectives if o.kind == "ratio")
+        assert ratio.target == ratio.min_ratio == 0.9
+
+    def test_rejects_ambiguous_or_missing_spec(self):
+        with pytest.raises(SchemaError, match="exactly one of"):
+            SloObjective.from_raw({"name": "x"}, "slo.objectives[0]")
+        with pytest.raises(SchemaError, match="exactly one of"):
+            SloObjective.from_raw(
+                {"name": "x", "histogram": "h", "gauge": "g", "max_seconds": 1, "max": 1},
+                "slo.objectives[0]",
+            )
+        with pytest.raises(SchemaError, match="max_seconds"):
+            SloObjective.from_raw({"name": "x", "histogram": "h"}, "slo.objectives[0]")
+        with pytest.raises(SchemaError, match="ratio_total"):
+            SloObjective.from_raw({"name": "x", "ratio_good": "g"}, "slo.objectives[0]")
+        with pytest.raises(SchemaError, match="name"):
+            SloObjective.from_raw({"name": "bad name!", "gauge": "g", "max": 1}, "slo.objectives[0]")
+
+    def test_rejects_bad_windows_and_ring(self):
+        with pytest.raises(SchemaError, match="fast_window_seconds"):
+            _config(fast_window_seconds=10.0, slow_window_seconds=5.0)
+        with pytest.raises(SchemaError, match="cover slow_window_seconds"):
+            _config(ring_size=4, slow_window_seconds=100.0, tick_seconds=1.0,
+                    fast_window_seconds=10.0)
+        with pytest.raises(SchemaError, match="at least one objective"):
+            _config(objectives=[])
+
+    def test_ratio_honors_explicit_target(self):
+        # an explicit target: must set the budget; without one the
+        # budget defaults to the ratio floor (budget = 1 - min_ratio)
+        explicit = SloObjective.from_raw(
+            {"name": "x", "ratio_good": "g", "ratio_total": "t",
+             "min_ratio": 0.999, "target": 0.9},
+            "slo.objectives[0]",
+        )
+        assert explicit.target == 0.9 and explicit.min_ratio == 0.999
+        defaulted = SloObjective.from_raw(
+            {"name": "x", "ratio_good": "g", "ratio_total": "t", "min_ratio": 0.95},
+            "slo.objectives[0]",
+        )
+        assert defaulted.target == 0.95
+
+    def test_duplicate_objective_names_rejected(self):
+        with pytest.raises(SchemaError, match="duplicate"):
+            _config(objectives=[
+                {"name": "x", "gauge": "g", "max": 1},
+                {"name": "x", "ratio_good": "a", "ratio_total": "b"},
+            ])
