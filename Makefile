@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: test check check-scale integration integration-kind integration-mock bench bench-smoke trace-smoke serve-smoke history-smoke federation-smoke obs-smoke dryrun dryrun-128 accept
+.PHONY: test check check-scale integration integration-kind integration-mock bench bench-smoke trace-smoke serve-smoke history-smoke federation-smoke obs-smoke health-smoke dryrun dryrun-128 accept
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -95,6 +95,21 @@ federation-smoke:
 # Artifact: artifacts/obs_smoke.json.
 obs-smoke:
 	$(PY) scripts/obs_smoke.py
+
+# Health-plane chaos drill: three mock-backed upstream watchers + one
+# federator with the straggler detector on a fast tick. Injects the
+# three ROADMAP scenarios — a degraded ICI link (scripted probe
+# reports), one slow-but-alive host in a slice (delayed Pending->
+# Running), and a lagging apiserver (watch delivery held while state
+# mutates) — and gates that EXACTLY the guilty node/node/upstream
+# escalates to confirmed, the dry-run actuator logs each quarantine
+# intent, no innocent subject is ever confirmed, /healthz degrades its
+# BODY without flipping liveness, and every verdict decays back to
+# healthy when its fault is removed. The detector's tick-cost budget is
+# gated by bench-smoke (bench_health). Artifact:
+# artifacts/health_smoke.json.
+health-smoke:
+	$(PY) scripts/health_smoke.py
 
 dryrun:
 	$(PY) __graft_entry__.py 8

@@ -2684,6 +2684,98 @@ def bench_federation(
     return best
 
 
+def bench_health(
+    n_slices: int = 64,
+    nodes_per_slice: int = 4,
+    n_upstreams: int = 8,
+    ticks: int = 40,
+    tick_budget_ms: float = 50.0,
+) -> dict:
+    """Health-plane detector gate: tick cost AND verdict exactness at
+    fleet scale, in one deterministic run.
+
+    Feeds the detector ``n_slices x nodes_per_slice`` per-node phase
+    observations + ``n_upstreams`` watermark observations per tick (the
+    full fusion path: peer grouping, robust z, trend fold, state
+    machine). One scripted straggler turns slow mid-run and recovers:
+    the gate is (a) tick p99 under ``tick_budget_ms`` — a detector that
+    stalls the process is itself a straggler source — and (b) EXACTLY
+    the guilty node escalates (zero collateral verdicts) and decays back
+    to healthy within the configured decay cycles. Correctness failures
+    are never retried away.
+    """
+    import random as _random
+
+    from k8s_watcher_tpu.health import HEALTHY, HealthDetector, Observation
+    from k8s_watcher_tpu.metrics import MetricsRegistry
+
+    rng = _random.Random(7)
+    detector = HealthDetector(
+        suspect_z=4.0, confirm_cycles=3, decay_cycles=2, metrics=MetricsRegistry()
+    )
+    nodes = [
+        (f"node-{s}-{w}", f"slice:{s}")
+        for s in range(n_slices) for w in range(nodes_per_slice)
+    ]
+    straggler = f"node-{n_slices // 2}-1"
+    fault_from, fault_to = ticks // 4, ticks // 2
+
+    def observations(tick: int):
+        obs = []
+        for name, group in nodes:
+            value = 0.08 + rng.random() * 0.06
+            if name == straggler and fault_from <= tick < fault_to:
+                value = 6.0
+            obs.append(Observation(
+                kind="node", name=name, metric="phase_latency_seconds",
+                value=value, group=group, floor=0.25,
+            ))
+        for u in range(n_upstreams):
+            obs.append(Observation(
+                kind="upstream", name=f"cluster-{u}",
+                metric="watermark_age_seconds",
+                value=0.2 + rng.random() * 0.2, group="upstreams", floor=0.5,
+            ))
+        return obs
+
+    tick_ms: list = []
+    confirmed_during_fault = set()
+    collateral = set()
+    for tick in range(ticks):
+        obs = observations(tick)
+        t0 = time.perf_counter()
+        detector.tick(obs)
+        tick_ms.append(1e3 * (time.perf_counter() - t0))
+        verdict = detector.health()
+        hot = set(verdict["confirmed"]) | set(verdict["remediating"])
+        confirmed_during_fault |= hot
+        collateral |= hot - {f"node/{straggler}"}
+    final = detector.health()
+    tick_ms.sort()
+    p99 = tick_ms[min(len(tick_ms) - 1, int(0.99 * len(tick_ms)))]
+    within_budget = p99 <= tick_budget_ms
+    exact = (
+        confirmed_during_fault == {f"node/{straggler}"}
+        and not collateral
+        and final["healthy"]  # decayed back after the fault cleared
+        and detector.snapshot()["subjects"][f"node/{straggler}"]["state"] == HEALTHY
+    )
+    return {
+        "ok": within_budget and exact,
+        "within_budget": within_budget,
+        "verdicts_exact": exact,
+        "tick_p50_ms": round(tick_ms[len(tick_ms) // 2], 3),
+        "tick_p99_ms": round(p99, 3),
+        "tick_budget_ms": tick_budget_ms,
+        "nodes": len(nodes),
+        "upstreams": n_upstreams,
+        "ticks": ticks,
+        "straggler": straggler,
+        "confirmed": sorted(confirmed_during_fault),
+        "collateral": sorted(collateral),
+    }
+
+
 def main(smoke: bool = False) -> int:
     if smoke:
         # bounded-budget smoke tier (make bench-smoke / the slow-marked
@@ -2742,6 +2834,9 @@ def main(smoke: bool = False) -> int:
             seconds=2.0, fanin_ab_deltas=20_000,
             ramp_start_eps=2000.0, codec_frames=1000,
         )
+        # health-plane detector: tick overhead + exact-verdict gate at
+        # fleet scale (256 nodes + 8 upstreams), pure in-process — ~fast
+        health_stats = bench_health()
         skipped = {"skipped": "smoke"}
         pipeline_stats = pipeline_500 = scan_stats = skipped
         relist_50k = checkpoint_50k = virtual_stats = probe_stats = skipped
@@ -2760,6 +2855,7 @@ def main(smoke: bool = False) -> int:
         wal_overhead = bench_wal_overhead()
         serve_fanout = bench_serve_fanout(seconds=6.0)
         federation = bench_federation(seconds=4.0)
+        health_stats = bench_health(ticks=80)
         scan_stats = bench_frame_scan()
         relist_stats = bench_relist_scale()
         relist_50k = bench_relist_scale(n_pods=50_000)
@@ -2782,6 +2878,7 @@ def main(smoke: bool = False) -> int:
         "wal_overhead": wal_overhead,
         "serve_fanout": serve_fanout,
         "federation": federation,
+        "health": health_stats,
         "frame_scan": scan_stats,
         "relist_10k": relist_stats,
         "relist_50k": relist_50k,
@@ -2855,6 +2952,10 @@ def main(smoke: bool = False) -> int:
         # codec negotiation: msgpack == JSON decoded on every read shape
         # over the real wire, msgpack actually negotiated when available
         "serve_codec_ok": (federation.get("codec_ab") or {}).get("ok", False),
+        # health plane: detector tick p99 inside its budget AND exactly
+        # the scripted straggler escalated (zero collateral verdicts)
+        "health_ok": health_stats.get("ok", False),
+        "health_tick_p99_ms": health_stats.get("tick_p99_ms"),
         "relist_10k_ms": relist_stats.get("relist_ms"),
         "relist_shard_speedup": relist_stats.get("shard_speedup"),
         "checkpoint_10k_flush_ms": checkpoint_stats.get("flush_ms_median"),
@@ -2875,11 +2976,13 @@ def main(smoke: bool = False) -> int:
         headline["smoke"] = True
         # the smoke tier skips the probe/50k tiers; their fields are all
         # null there and the headline must stay inside the ~1 KB
-        # tail-capture budget (the federation fields pushed it past)
+        # tail-capture budget (the federation fields pushed it past, and
+        # the health fields pushed the always-null smoke saturating_stage
+        # out too — the full tier still reports it)
         for key in (
             "checkpoint_50k_flush_ms", "checkpoint_50k_compact_ms",
             "checkpoint_50k_max_slice_ms", "mxu_tflops", "hbm_read_gbps",
-            "hbm_write_gbps", "links", "dcn_pairs",
+            "hbm_write_gbps", "links", "dcn_pairs", "saturating_stage",
         ):
             if headline.get(key) is None:
                 headline.pop(key, None)

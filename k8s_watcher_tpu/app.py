@@ -283,6 +283,24 @@ class WatcherApp:
                 token_dir=token_dir,
                 resume_tokens_valid=tokens_valid,
             )
+        # straggler & node-health detection plane (health/): fuses probe
+        # findings, fleet-view phase latencies, federation freshness and
+        # trace stage outliers into peer-relative per-node/slice/upstream
+        # verdicts with config-declared escalation. The budgeted actuator
+        # arms in run() (post-campaign — standbys must not multiply the
+        # remediation fences). Built after serve/federation (it reads
+        # both) and before the SLO engine (its gauges join the ring).
+        self.health = None
+        if config.health.enabled:
+            from k8s_watcher_tpu.health import HealthPlane
+
+            self.health = HealthPlane(
+                config.health,
+                metrics=self.metrics,
+                view=self.serve.view if self.serve is not None else None,
+                federation=self.federation,
+                environment=config.environment,
+            )
         # SLO/burn-rate engine (slo/): samples every registered metric
         # on a tick into a bounded timeseries ring and evaluates the
         # config-declared objectives with two-window burn rates. Built
@@ -401,6 +419,11 @@ class WatcherApp:
             # after the serve plane (the merged view republishes through
             # it), before the status server (same always-started contract)
             self.federation.start()
+        if self.health is not None:
+            # ticking starts now so peer baselines and trend anchors warm
+            # up immediately; the ACTUATOR arms post-campaign in
+            # _start_health (a standby must not multiply the fences)
+            self.health.start()
         if self.slo is not None:
             # after every metric-producing plane exists; the engine's
             # first tick seeds the ring so burn windows have a base
@@ -441,6 +464,11 @@ class WatcherApp:
                 # verdict rides the /healthz BODY (degraded only)
                 slo=self.slo.snapshot if self.slo is not None else None,
                 slo_health=self.slo.health if self.slo is not None else None,
+                # straggler/health verdicts: full detail at /debug/health,
+                # the healthy/suspect/confirmed fold in the /healthz BODY
+                # (degraded only — never the liveness verdict)
+                node_health=self.health.snapshot if self.health is not None else None,
+                node_health_fold=self.health.health if self.health is not None else None,
                 slices=self.slice_tracker.debug_snapshot,
                 trend=agent_trend,
                 remediation=remediation_state,
@@ -470,6 +498,8 @@ class WatcherApp:
                 ", /debug/freshness" if self.serve is not None else ""
             ) + (
                 ", /debug/slo" if self.slo is not None else ""
+            ) + (
+                ", /debug/health" if self.health is not None else ""
             )
             logger.info("Status endpoint on :%d (%s)", self.status_server.port, routes)
         if self.config.watcher.leader_election.enabled:
@@ -487,6 +517,7 @@ class WatcherApp:
             "Monitoring %s", f"namespaces: {list(namespaces)}" if namespaces else "all namespaces"
         )
         self._start_remediation()
+        self._start_health()
         if self._probe_agent is not None:
             self._probe_agent.start()
         self._start_node_watch()
@@ -586,6 +617,66 @@ class WatcherApp:
             t.remediation_taint_key, t.remediation_taint_value, t.remediation_taint_effect,
         )
 
+    def _start_health(self) -> None:
+        """Arm the health plane's write side (post-campaign, like
+        remediation): the budgeted actuator its confirmed node verdicts
+        feed, the probe-report feed, and the notification sink.
+
+        Actuator selection: the remediation plane's actuator when that
+        plane armed (one budget/cooldown/rate accounting for BOTH
+        confirmation paths — two actuators would double every fence);
+        else a dedicated one built from the same tpu.remediation config
+        when it is enabled and a k8s client exists. With remediation
+        disabled the verdicts stop at confirmed (log/metrics/notify only).
+        """
+        if self.health is None:
+            return
+        # probe reports feed the detector alongside the remediation policy
+        # (observer chain: both see every report)
+        if self._probe_agent is not None and self.config.health.source_probe:
+            prev = self._probe_agent.report_observer
+            observe = self.health.observe_report
+
+            def chained(report, _prev=prev, _observe=observe):
+                if _prev is not None:
+                    _prev(report)
+                _observe(report)
+
+            self._probe_agent.report_observer = chained
+        # TPU_HEALTH escalation notifications ride the async dispatcher
+        # like remediation's do
+        import time as _time
+
+        from k8s_watcher_tpu.pipeline.pipeline import Notification
+
+        def health_sink(payload, _submit=self.dispatcher.submit):
+            _submit(Notification(payload, _time.monotonic(), kind="health"))
+
+        self.health.detector.sink = health_sink
+        actuator = None
+        if self.remediation is not None:
+            actuator = self.remediation.actuator
+        elif self.config.tpu.remediation_enabled:
+            client = getattr(self.source, "client", None)
+            if client is not None:
+                from k8s_watcher_tpu.k8s.client import K8sClient
+                from k8s_watcher_tpu.remediate import build_actuator
+
+                actuator = build_actuator(
+                    K8sClient(
+                        client.connection,
+                        request_timeout=self.config.kubernetes.request_timeout,
+                    ),
+                    self.config.tpu,
+                    metrics=self.metrics,
+                )
+        if actuator is not None:
+            self.health.arm_actuator(actuator)
+            logger.info(
+                "Health plane actuator armed (dry_run=%s, shared_with_remediation=%s)",
+                actuator.dry_run, self.remediation is not None,
+            )
+
     def _start_node_watch(self) -> None:
         """Start the node-plane watch (tpu.node_watch.enabled): a second
         resilient list+watch over /api/v1/nodes on its own thread + client.
@@ -682,6 +773,9 @@ class WatcherApp:
             self.status_server = None
         if self.slo is not None:
             self.slo.stop()
+        if self.health is not None:
+            # before federation/serve stop: the tick reads both planes
+            self.health.stop()
         if self.federation is not None:
             # before the serve plane and the WAL close: the upstream
             # subscribers are view producers, and the terminal history

@@ -1103,6 +1103,94 @@ class SloConfig:
         )
 
 
+#: signal sources the health detector may fuse (health.sources.*)
+VALID_HEALTH_SOURCES = ("probe", "phase", "freshness", "trace")
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthConfig:
+    """The ``health:`` section — net-new straggler & node-health detection
+    plane (health/): fuses signals the platform already produces — probe
+    RTTs + suspect-link findings, per-upstream freshness watermarks, pod
+    phase-transition latencies from the fleet view, trace stage outliers —
+    into per-node / per-slice / per-upstream verdicts using PEER-RELATIVE
+    outlier scoring (a node is a straggler relative to its slice peers,
+    never against an absolute threshold). Verdicts walk a config-declared
+    escalation state machine (healthy -> suspect -> confirmed ->
+    remediating) with confirm-cycle hysteresis and clean-cycle decay;
+    confirmed NODE verdicts feed the existing budgeted (dry-run by
+    default) remediation actuator. Full detail at ``GET /debug/health``;
+    ``node_health_score{node=}`` / ``health_state{node=,state=}`` labeled
+    gauges; the verdict folds into the /healthz BODY (degraded, never
+    liveness). See ARCHITECTURE.md "Health & remediation plane".
+    """
+
+    enabled: bool = False
+    tick_seconds: float = 5.0
+    # peer-relative robust z-score (deviation from the peer median in
+    # MAD units) at which a subject turns suspicious
+    suspect_z: float = 4.0
+    # consecutive suspicious ticks before suspect escalates to confirmed
+    # (one clean tick resets — mirrors remediate/policy.py)
+    confirm_cycles: int = 3
+    # consecutive CLEAN ticks before a confirmed/remediating subject
+    # de-escalates back to healthy (absence of signal is NOT clean)
+    decay_cycles: int = 2
+    # which signal planes the detector reads (each requires its plane)
+    source_probe: bool = True
+    source_phase: bool = True
+    source_freshness: bool = False
+    source_trace: bool = True
+
+    @classmethod
+    def from_raw(cls, raw: Mapping[str, Any]) -> "HealthConfig":
+        path = "health"
+        _check_known(
+            raw,
+            ("enabled", "tick_seconds", "suspect_z", "confirm_cycles",
+             "decay_cycles", "sources"),
+            path,
+        )
+        enabled = _opt_bool(raw, "enabled", path, False)
+        tick = _opt_num(raw, "tick_seconds", path, 5.0)
+        if tick <= 0:
+            raise SchemaError(f"config key '{path}.tick_seconds': must be > 0, got {tick}")
+        suspect_z = _opt_num(raw, "suspect_z", path, 4.0)
+        if suspect_z <= 0:
+            raise SchemaError(
+                f"config key '{path}.suspect_z': must be > 0, got {suspect_z} "
+                f"(a non-positive threshold would call every subject a straggler)"
+            )
+        confirm = _opt_int(raw, "confirm_cycles", path, 3)
+        if confirm < 1:
+            raise SchemaError(f"config key '{path}.confirm_cycles': must be >= 1, got {confirm}")
+        decay = _opt_int(raw, "decay_cycles", path, 2)
+        if decay < 1:
+            raise SchemaError(f"config key '{path}.decay_cycles': must be >= 1, got {decay}")
+        sources = raw.get("sources") or {}
+        _expect(sources, (dict,), f"{path}.sources")
+        _check_known(sources, VALID_HEALTH_SOURCES, f"{path}.sources")
+        cfg = cls(
+            enabled=enabled,
+            tick_seconds=tick,
+            suspect_z=suspect_z,
+            confirm_cycles=confirm,
+            decay_cycles=decay,
+            source_probe=_opt_bool(sources, "probe", f"{path}.sources", True),
+            source_phase=_opt_bool(sources, "phase", f"{path}.sources", True),
+            source_freshness=_opt_bool(sources, "freshness", f"{path}.sources", False),
+            source_trace=_opt_bool(sources, "trace", f"{path}.sources", True),
+        )
+        if enabled and not (
+            cfg.source_probe or cfg.source_phase or cfg.source_freshness or cfg.source_trace
+        ):
+            raise SchemaError(
+                "config key 'health.sources': at least one source must be enabled "
+                "when health.enabled (a detector with nothing to fuse)"
+            )
+        return cfg
+
+
 def metric_safe_name(name: str) -> str:
     """Cluster/upstream name -> metric-name- and filename-safe form
     (Prometheus charset). The ONE sanitizer the federation plane uses for
@@ -1287,13 +1375,14 @@ class AppConfig:
     federation: FederationConfig = dataclasses.field(default_factory=FederationConfig)
     metrics: MetricsConfig = dataclasses.field(default_factory=MetricsConfig)
     slo: SloConfig = dataclasses.field(default_factory=SloConfig)
+    health: HealthConfig = dataclasses.field(default_factory=HealthConfig)
 
-    TOP_LEVEL_KEYS = ("environment", "watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest", "trace", "serve", "history", "federation", "metrics", "slo")
+    TOP_LEVEL_KEYS = ("environment", "watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest", "trace", "serve", "history", "federation", "metrics", "slo", "health")
 
     @classmethod
     def from_raw(cls, raw: Mapping[str, Any], environment: str) -> "AppConfig":
         _check_known(raw, cls.TOP_LEVEL_KEYS, "<root>")
-        for section in ("watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest", "trace", "serve", "history", "federation", "metrics", "slo"):
+        for section in ("watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest", "trace", "serve", "history", "federation", "metrics", "slo", "health"):
             _expect(raw.get(section) or {}, (dict,), section)
         # The reference's development.yaml declared `environment: local` while
         # the CLI only accepted development|staging|production, leaving the
@@ -1317,6 +1406,27 @@ class AppConfig:
                 "merged global view republishes through the serving plane's "
                 "FleetView; without it the fan-in has nowhere to land)"
             )
+        trace = TraceConfig.from_raw(raw.get("trace") or {})
+        health = HealthConfig.from_raw(raw.get("health") or {})
+        if health.enabled:
+            # each enabled source must have the plane it reads — a silently
+            # signal-less source would look like "everything healthy"
+            if health.source_phase and not serve.enabled:
+                raise SchemaError(
+                    "config key 'health.sources.phase': requires serve.enabled "
+                    "(phase-transition latencies are read from the FleetView)"
+                )
+            if health.source_freshness and not federation.enabled:
+                raise SchemaError(
+                    "config key 'health.sources.freshness': requires "
+                    "federation.enabled (per-upstream watermarks are the "
+                    "federation plane's telemetry)"
+                )
+            if health.source_trace and not trace.enabled:
+                raise SchemaError(
+                    "config key 'health.sources.trace': requires trace.enabled "
+                    "(stage outliers are read from the tracing plane's histograms)"
+                )
         return cls(
             environment=environment,
             watcher=WatcherConfig.from_raw(raw.get("watcher") or {}),
@@ -1325,10 +1435,11 @@ class AppConfig:
             tpu=TpuConfig.from_raw(raw.get("tpu") or {}),
             state=StateConfig.from_raw(raw.get("state") or {}),
             ingest=IngestConfig.from_raw(raw.get("ingest") or {}),
-            trace=TraceConfig.from_raw(raw.get("trace") or {}),
+            trace=trace,
             serve=serve,
             history=history,
             federation=federation,
             metrics=MetricsConfig.from_raw(raw.get("metrics") or {}),
             slo=SloConfig.from_raw(raw.get("slo") or {}),
+            health=health,
         )

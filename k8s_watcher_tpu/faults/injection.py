@@ -34,6 +34,7 @@ class ChurnGenerator:
         seed: int = 0,
         preempt_prob: float = 0.05,
         fail_prob: float = 0.02,
+        node_namer: Optional[Callable[[int, int], str]] = None,
     ):
         self.n_slices = n_slices
         self.workers_per_slice = workers_per_slice
@@ -42,6 +43,12 @@ class ChurnGenerator:
         self.rng = random.Random(seed)
         self.preempt_prob = preempt_prob
         self.fail_prob = fail_prob
+        # (slice_idx, worker_idx) -> spec.nodeName: gives churned pods a
+        # stable host identity so node-attributed consumers (the health
+        # plane's phase-latency scoring, slice node-down folding) see
+        # realistic placement. None keeps pods unscheduled, the
+        # pre-round-13 shape.
+        self.node_namer = node_namer
         self._rv = 0
         # worker state: (slice_idx, worker_idx) -> phase or None (deleted)
         self._phase: Dict[tuple, Optional[str]] = {}
@@ -65,6 +72,7 @@ class ChurnGenerator:
             f"slice{s}-worker-{w}",
             self.namespace,
             uid=f"uid-s{s}-w{w}",
+            node_name=self.node_namer(s, w) if self.node_namer is not None else None,
             phase=phase,
             tpu_chips=self.chips_per_worker,
             tpu_topology=f"1x1x{topology_chips}",

@@ -1,0 +1,34 @@
+"""Straggler & node-health detection plane (net-new; ROADMAP item 3).
+
+Peer-relative signal fusion over planes the platform already runs (probe
+RTTs/suspect links, fleet-view phase latencies, federation freshness
+watermarks, trace stage outliers) into per-node / per-slice / per-upstream
+verdicts, escalated ``healthy → suspect → confirmed → remediating``
+through config-declared hysteresis, with confirmed node verdicts feeding
+the existing budgeted dry-run remediation actuator. Grounding: Guard +
+ARGUS (PAPERS.md). See ARCHITECTURE.md "Health & remediation plane".
+"""
+
+from k8s_watcher_tpu.health.detector import (  # noqa: F401
+    CONFIRMED,
+    HEALTH_STATES,
+    HEALTHY,
+    REMEDIATING,
+    SUSPECT,
+    HealthDetector,
+    Observation,
+    robust_peer_z,
+)
+from k8s_watcher_tpu.health.plane import HealthPlane  # noqa: F401
+
+__all__ = [
+    "CONFIRMED",
+    "HEALTHY",
+    "HEALTH_STATES",
+    "HealthDetector",
+    "HealthPlane",
+    "Observation",
+    "REMEDIATING",
+    "SUSPECT",
+    "robust_peer_z",
+]

@@ -177,6 +177,9 @@ class MockCluster:
         self._oldest_rv = 0  # journal entries <= this are compacted away
         self._fail_next = 0
         self._fail_status = 500
+        # hold_watch: events with rv above this stay invisible to
+        # events_since until released (None = delivering normally)
+        self._watch_hold_rv: Optional[int] = None
         self.namespaces = ["default", "kube-system"]
         self._leases: Dict[Tuple[str, str], Dict[str, Any]] = {}
         # sorted-key cache per collection, keyed on the rv it was built
@@ -527,6 +530,19 @@ class MockCluster:
             self._fail_next = n
             self._fail_status = status
 
+    def hold_watch(self, hold: bool = True) -> None:
+        """Freeze watch delivery at the CURRENT rv: state keeps mutating
+        (rv advances, LISTs serve fresh pages) but ``events_since`` stops
+        returning anything newer until released — the "lagging apiserver"
+        fault (a wedged/backed-up watch cache) the health-plane chaos
+        drill scripts. Releasing notifies every parked watcher, so the
+        held window floods out at once, exactly like a real cache
+        catching up."""
+        with self._lock:
+            self._watch_hold_rv = self._rv if hold else None
+            if not hold:
+                self._lock.notify_all()
+
     def consume_failure(self) -> int:
         """The injected failure status for this request, or 0 for none."""
         with self._lock:
@@ -622,8 +638,13 @@ class MockCluster:
                     # (appends under the cluster-global rv), so the resume
                     # point is a bisect and the batch is one tail slice
                     idx = bisect.bisect_right(rvs, rv)
-                    if idx < len(rvs):
-                        return self._journal_events[collection][idx:]
+                    end = len(rvs)
+                    if self._watch_hold_rv is not None:
+                        # lagging-apiserver fault: deliver nothing past
+                        # the hold point (see hold_watch)
+                        end = bisect.bisect_right(rvs, self._watch_hold_rv)
+                    if idx < end:
+                        return self._journal_events[collection][idx:end]
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return []

@@ -414,6 +414,13 @@ class MetricsRegistry:
                 self._sorted_gauges = None
             return self._gauges[name]
 
+    def peek_histogram(self, name: str) -> Optional[Histogram]:
+        """The histogram if it is already registered, else None — for
+        read-only consumers (the health plane's trace-stage sweep) that
+        must not mint empty series into the exposition."""
+        with self._lock:
+            return self._histograms.get(name)
+
     def _sorted_items(self):
         """``(counters, gauges, histograms)`` as sorted item lists from
         the registration-invalidated cache — ONE lock hold, no per-scrape

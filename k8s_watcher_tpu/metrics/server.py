@@ -168,6 +168,13 @@ class _StatusHandler(BaseHTTPRequestHandler):
     # /healthz BODY — degraded only, never the liveness verdict (a
     # restart does not refund an error budget)
     slo_health = None
+    # Callable[[], dict]: health-plane detail (HealthPlane.snapshot) ->
+    # /debug/health, when the detection plane is enabled
+    node_health = None
+    # Callable[[], dict]: health-plane verdict (HealthPlane.health) folded
+    # into the /healthz BODY — degraded only, never liveness (restarting
+    # the watcher cannot fix a straggling machine)
+    node_health_fold = None
     slices = None  # Callable[[], dict]: live slice states, optional
     trend = None  # Callable[[], dict]: probe trend anchors/windows, optional
     # Callable[[], Optional[dict]]: remediation policy state; the callable
@@ -267,6 +274,10 @@ class _StatusHandler(BaseHTTPRequestHandler):
                 # breached error budget is an alerting/readiness signal,
                 # and a liveness kill would burn the budget faster
                 body["slo"] = self.slo_health()
+            if self.node_health_fold is not None:
+                # degraded-body only too: a confirmed straggler is a fleet
+                # fact, not a local fault a kubelet restart can fix
+                body["health"] = self.node_health_fold()
             self._json(200 if alive else 503, body)
         elif parsed.path == "/debug/events":
             if self.audit is None:
@@ -358,6 +369,11 @@ class _StatusHandler(BaseHTTPRequestHandler):
                 self._json(404, {"error": "SLO engine not enabled (slo.enabled)"})
                 return
             self._json(200, {"slo": self.slo()})
+        elif parsed.path == "/debug/health":
+            if self.node_health is None:
+                self._json(404, {"error": "health plane not enabled (health.enabled)"})
+                return
+            self._json(200, {"health": self.node_health()})
         elif parsed.path == "/debug/remediation":
             if self.remediation is None:
                 self._json(404, {"error": "remediation not wired (tpu.remediation.enabled)"})
@@ -387,6 +403,8 @@ class StatusServer:
         freshness=None,  # Callable[[], dict] -> /debug/freshness (watermarks + propagation)
         slo=None,  # Callable[[], dict] -> /debug/slo (SLOPlane.snapshot)
         slo_health=None,  # Callable[[], dict] -> /healthz body fold (SLOPlane.health)
+        node_health=None,  # Callable[[], dict] -> /debug/health (HealthPlane.snapshot)
+        node_health_fold=None,  # Callable[[], dict] -> /healthz body fold (HealthPlane.health)
         slices=None,  # Callable[[], dict] -> serves /debug/slices
         trend=None,  # Callable[[], dict] -> serves /debug/trend
         remediation=None,  # Callable[[], Optional[dict]] -> /debug/remediation
@@ -409,6 +427,8 @@ class StatusServer:
                 "freshness": staticmethod(freshness) if freshness else None,
                 "slo": staticmethod(slo) if slo else None,
                 "slo_health": staticmethod(slo_health) if slo_health else None,
+                "node_health": staticmethod(node_health) if node_health else None,
+                "node_health_fold": staticmethod(node_health_fold) if node_health_fold else None,
                 "slices": staticmethod(slices) if slices else None,
                 "trend": staticmethod(trend) if trend else None,
                 "remediation": staticmethod(remediation) if remediation else None,

@@ -12,3 +12,18 @@ from k8s_watcher_tpu.probe.device import enumerate_devices  # noqa: F401
 from k8s_watcher_tpu.probe.ici import IciProbeResult, run_ici_probe, run_mxu_probe  # noqa: F401
 from k8s_watcher_tpu.probe.report import ProbeReport  # noqa: F401
 from k8s_watcher_tpu.probe.agent import ProbeAgent  # noqa: F401
+# the plane's shared rolling-baseline primitive: the probe agent trends
+# its own readings with it and the health detector (health/) reuses it
+# for upstream/stage baselines — ONE drift implementation, not two
+from k8s_watcher_tpu.probe.trend import TrendAlert, TrendTracker  # noqa: F401
+
+__all__ = [
+    "IciProbeResult",
+    "ProbeAgent",
+    "ProbeReport",
+    "TrendAlert",
+    "TrendTracker",
+    "enumerate_devices",
+    "run_ici_probe",
+    "run_mxu_probe",
+]

@@ -5,6 +5,8 @@ Usage:
     python scripts/remediate_ctl.py [environment] status
     python scripts/remediate_ctl.py [environment] quarantine NODE [--reason=TEXT] [--no-dry-run]
     python scripts/remediate_ctl.py [environment] release NODE [--no-dry-run]
+    python scripts/remediate_ctl.py [environment] health [--url=http://host:port] [--token=TOKEN]
+    python scripts/remediate_ctl.py [environment] health release NODE [--no-dry-run]
 
 ``status`` lists nodes carrying the configured remediation taint and/or a
 cordon. ``quarantine``/``release`` drive the same NodeActuator the watcher
@@ -12,6 +14,14 @@ uses, with the same config-derived taint — dry-run unless ``--no-dry-run``
 is given explicitly (CLI actions are subject to the same review discipline
 as automated ones). Manual actions bypass confirm_cycles by design: the
 operator IS the confirmation.
+
+``health`` reads the detection plane's live scores/states from the
+watcher's ``GET /debug/health`` (the status port from config, or
+``--url``). ``health release NODE`` is the operator path out of a
+health-plane quarantine: it drives the SAME actuator release
+(uncordon + remove our taint) the RUNBOOK documents — dry-run unless
+``--no-dry-run`` — after which the detector's clean-cycle decay returns
+the node to ``healthy`` on its own once signals look normal.
 """
 
 from __future__ import annotations
@@ -35,7 +45,7 @@ def main() -> int:
     known_envs = ("development", "staging", "production")
     env_args = args[:1] if args and args[0] in known_envs else []
     rest = args[len(env_args):]
-    if not rest or rest[0] not in ("status", "quarantine", "release"):
+    if not rest or rest[0] not in ("status", "quarantine", "release", "health"):
         print(__doc__)
         return 2
     command, *rest = rest
@@ -43,6 +53,44 @@ def main() -> int:
     environment = resolve_environment(env_args)
     config = load_config(environment)
     setup_logging(environment, config.watcher.log_level)
+
+    if command == "health" and (not rest or rest[0] != "release"):
+        # read-only: scores/states over HTTP from the running watcher
+        import urllib.request
+
+        url = None
+        token = config.watcher.status_auth_token
+        for flag in flags:
+            if flag.startswith("--url="):
+                url = flag[len("--url="):].rstrip("/")
+            elif flag.startswith("--token="):
+                token = flag[len("--token="):]
+        if url is None:
+            if not config.watcher.status_port:
+                print(
+                    "health: no watcher.status_port in this environment's config; "
+                    "pass --url=http://host:port", file=sys.stderr,
+                )
+                return 2
+            url = f"http://127.0.0.1:{config.watcher.status_port}"
+        request = urllib.request.Request(f"{url}/debug/health")
+        if token:
+            request.add_header("Authorization", f"Bearer {token}")
+        try:
+            with urllib.request.urlopen(request, timeout=10) as resp:
+                body = json.loads(resp.read())
+        except Exception as exc:  # noqa: BLE001 — operator CLI: report, don't trace
+            print(f"health: GET {url}/debug/health failed: {exc}", file=sys.stderr)
+            return 1
+        print(json.dumps(body, indent=2))
+        return 0
+
+    if command == "health":  # health release NODE -> the actuator path
+        command, rest = "release", rest[1:]
+        if not rest:
+            print("health release: NODE argument required", file=sys.stderr)
+            return 2
+
     connection = load_connection(
         use_incluster=config.kubernetes.use_incluster_config,
         config_file=config.kubernetes.config_file,

@@ -93,6 +93,11 @@ def test_bench_smoke_headline_within_budget():
     # on snapshot/long-poll/stream over the real wire, with msgpack
     # actually negotiated by an Accept: application/x-msgpack client
     assert headline["serve_codec_ok"] is True, headline
+    # health plane: detector tick p99 inside its budget at fleet scale
+    # (256 nodes + 8 upstreams) AND exactly the scripted straggler
+    # escalated — zero collateral verdicts, decayed back to healthy
+    assert headline["health_ok"] is True, headline
+    assert headline["health_tick_p99_ms"] is not None, headline
     detail = json.loads((REPO_ROOT / "artifacts" / "bench_smoke.json").read_text())
     assert detail["details"]["relist_10k"]["events"] == detail["details"]["relist_10k"]["n_pods"]
     egress = detail["details"]["egress_saturation"]
@@ -142,3 +147,8 @@ def test_bench_smoke_headline_within_budget():
     codec = fed["codec_ab"]
     assert codec["snapshot_equal"] and codec["long_poll_equal"] and codec["stream_equal"], codec
     assert codec["msgpack_negotiated"], codec
+    health = detail["details"]["health"]
+    assert health["within_budget"], health
+    assert health["verdicts_exact"], health
+    assert health["confirmed"] == [f"node/{health['straggler']}"], health
+    assert health["collateral"] == [], health

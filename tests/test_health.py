@@ -1,0 +1,705 @@
+"""Health-plane tests: peer-relative scoring, escalation hysteresis,
+no-signal semantics, surfaces (HTTP + healthz fold), schema validation,
+and the probe/phase/freshness collectors (round 13)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+import requests
+
+from k8s_watcher_tpu.config.schema import AppConfig, HealthConfig, SchemaError
+from k8s_watcher_tpu.health import (
+    CONFIRMED,
+    HEALTHY,
+    REMEDIATING,
+    SUSPECT,
+    HealthDetector,
+    HealthPlane,
+    Observation,
+    robust_peer_z,
+)
+from k8s_watcher_tpu.health.synthetic import synthetic_link_report
+from k8s_watcher_tpu.metrics import MetricsRegistry
+from k8s_watcher_tpu.metrics.server import Liveness, StatusServer
+
+
+def node_obs(values, *, group="slice:a", metric="phase_latency_seconds", floor=0.25):
+    return [
+        Observation(kind="node", name=name, metric=metric, value=value,
+                    group=group, floor=floor)
+        for name, value in values.items()
+    ]
+
+
+class TestPeerScoring:
+    def test_outlier_scores_high_and_peers_near_zero(self):
+        z = robust_peer_z({"a": 0.1, "b": 0.12, "c": 0.11, "d": 8.0}, floor=0.25)
+        assert z["d"] > 10.0
+        assert abs(z["a"]) < 1.0 and abs(z["b"]) < 1.0 and abs(z["c"]) < 1.0
+
+    def test_single_member_group_has_no_peers(self):
+        # a single-node slice has no peers -> never a straggler
+        assert robust_peer_z({"only": 99.0}, floor=0.25) == {}
+
+    def test_two_member_group_cannot_tell_which_side_is_slow(self):
+        assert robust_peer_z({"a": 0.1, "b": 99.0}, floor=0.25) == {}
+
+    def test_identical_peers_floor_prevents_divide_blowup(self):
+        z = robust_peer_z({"a": 0.1, "b": 0.1, "c": 0.1}, floor=0.25)
+        assert all(v == 0.0 for v in z.values())
+
+    def test_floor_suppresses_trivial_absolute_spread(self):
+        # 40 ms vs 10 ms peers: huge relatively, trivial absolutely —
+        # the floor keeps it below any sane suspect_z
+        z = robust_peer_z({"a": 0.010, "b": 0.011, "c": 0.012, "d": 0.040}, floor=0.25)
+        assert z["d"] < 1.0
+
+    def test_fleet_wide_slowdown_implicates_nobody(self):
+        # everything 50x slower together: the median moves with the
+        # fleet, so no one deviates from peers
+        z = robust_peer_z({"a": 5.0, "b": 5.2, "c": 4.9, "d": 5.1}, floor=0.25)
+        assert all(abs(v) < 2.0 for v in z.values())
+
+    def test_single_node_slice_never_straggles_through_detector(self):
+        detector = HealthDetector(suspect_z=2.0, confirm_cycles=1, decay_cycles=1)
+        for _ in range(20):
+            detector.tick(node_obs({"lonely": 50.0}, group="slice:solo"))
+        assert detector.health()["healthy"]
+        snap = detector.snapshot()["subjects"]["node/lonely"]
+        assert snap["state"] == HEALTHY
+
+
+class TestEscalationHysteresis:
+    def fleet(self, slow=8.0):
+        return node_obs({"n0": 0.1, "n1": 0.1, "n2": 0.1, "slow": slow})
+
+    def detector(self, **kw):
+        kw.setdefault("suspect_z", 4.0)
+        kw.setdefault("confirm_cycles", 3)
+        kw.setdefault("decay_cycles", 2)
+        return HealthDetector(**kw)
+
+    def test_n_confirm_cycles_escalate(self):
+        detector = self.detector()
+        states = []
+        for _ in range(3):
+            detector.tick(self.fleet())
+            states.append(detector.snapshot()["subjects"]["node/slow"]["state"])
+        assert states == [SUSPECT, SUSPECT, CONFIRMED]
+        # innocents never left healthy
+        for name in ("n0", "n1", "n2"):
+            assert detector.snapshot()["subjects"][f"node/{name}"]["state"] == HEALTHY
+
+    def test_one_clean_cycle_resets_suspect(self):
+        detector = self.detector()
+        detector.tick(self.fleet())
+        detector.tick(self.fleet())
+        assert detector.snapshot()["subjects"]["node/slow"]["state"] == SUSPECT
+        detector.tick(self.fleet(slow=0.1))  # one clean cycle
+        assert detector.snapshot()["subjects"]["node/slow"]["state"] == HEALTHY
+        # and the streak restarted: two more suspicious ticks don't confirm
+        detector.tick(self.fleet())
+        detector.tick(self.fleet())
+        assert detector.snapshot()["subjects"]["node/slow"]["state"] == SUSPECT
+
+    def test_confirmed_decays_after_decay_cycles_clean(self):
+        detector = self.detector()
+        for _ in range(3):
+            detector.tick(self.fleet())
+        assert detector.snapshot()["subjects"]["node/slow"]["state"] == CONFIRMED
+        detector.tick(self.fleet(slow=0.1))
+        assert detector.snapshot()["subjects"]["node/slow"]["state"] == CONFIRMED
+        detector.tick(self.fleet(slow=0.1))
+        assert detector.snapshot()["subjects"]["node/slow"]["state"] == HEALTHY
+        assert detector.health()["healthy"]
+
+    def test_no_signal_is_not_healthy(self):
+        # a confirmed subject whose signal plane goes quiet must NOT
+        # decay: absence of signal is not cleanliness
+        detector = self.detector()
+        for _ in range(3):
+            detector.tick(self.fleet())
+        assert detector.snapshot()["subjects"]["node/slow"]["state"] == CONFIRMED
+        for _ in range(10):
+            detector.tick([])  # nobody measured anything
+        assert detector.snapshot()["subjects"]["node/slow"]["state"] == CONFIRMED
+        assert not detector.health()["healthy"]
+
+    def test_suspicious_tick_resets_clean_counter(self):
+        detector = self.detector()
+        for _ in range(3):
+            detector.tick(self.fleet())
+        detector.tick(self.fleet(slow=0.1))  # clean 1 of 2
+        detector.tick(self.fleet())  # relapse
+        detector.tick(self.fleet(slow=0.1))  # clean 1 of 2 again
+        assert detector.snapshot()["subjects"]["node/slow"]["state"] == CONFIRMED
+
+    def test_probe_suspicion_not_washed_out_by_clean_phase_ticks(self):
+        # sources tick at different cadences: a probe implication must
+        # survive clean phase readings between reports (latched), but
+        # only the probe RE-observing the fault advances the streak
+        detector = self.detector(confirm_cycles=2)
+        phase_clean = [
+            Observation(kind="node", name=n, metric="phase_latency_seconds",
+                        value=0.1, group="slice:a", floor=0.25, source="phase")
+            for n in ("n0", "n1", "n2", "slow")
+        ]
+        bad = {("node", "slow"): ["link probe: device 3 suspect"]}
+        detector.tick(phase_clean, bad)  # report 1 -> suspect
+        assert detector.snapshot()["subjects"]["node/slow"]["state"] == SUSPECT
+        for _ in range(5):  # clean phase ticks, probe silent: state holds
+            detector.tick(phase_clean)
+            snap = detector.snapshot()["subjects"]["node/slow"]
+            assert snap["state"] == SUSPECT
+            assert snap["streak"] == 1  # latched holds, does not confirm
+        detector.tick(phase_clean, bad)  # report 2 -> confirmed
+        assert detector.snapshot()["subjects"]["node/slow"]["state"] == CONFIRMED
+        # a clean probe observation for the node clears the latch...
+        clean_probe = [Observation(
+            kind="node", name="slow", metric="link_rtt_ms", value=0.2,
+            group=None, floor=0.05, source="probe",
+        )]
+        detector.tick(phase_clean + clean_probe)
+        detector.tick(phase_clean + clean_probe)
+        # ...and decay_cycles clean ticks de-escalate
+        assert detector.snapshot()["subjects"]["node/slow"]["state"] == HEALTHY
+
+    def test_direct_evidence_is_suspicious_without_observations(self):
+        detector = self.detector(confirm_cycles=2)
+        for _ in range(2):
+            detector.tick([], {("node", "bad"): ["link probe: device 3 suspect"]})
+        snap = detector.snapshot()["subjects"]["node/bad"]
+        assert snap["state"] == CONFIRMED
+        assert "link probe" in snap["reasons"][0]
+
+
+class FakeActuator:
+    def __init__(self, ok=True, dry_run=True):
+        self.ok = ok
+        self.dry_run = dry_run
+        self.quarantines = []
+        self.releases = []
+
+    def quarantine(self, node, reason):
+        from k8s_watcher_tpu.remediate import ActionRecord
+
+        self.quarantines.append((node, reason))
+        return ActionRecord(node=node, action="quarantine", ok=self.ok,
+                            dry_run=self.dry_run, reason=reason)
+
+    def release(self, node, reason):
+        from k8s_watcher_tpu.remediate import ActionRecord
+
+        self.releases.append((node, reason))
+        return ActionRecord(node=node, action="release", ok=True,
+                            dry_run=self.dry_run, reason=reason)
+
+    def quarantined_nodes(self):
+        return [n for n, _ in self.quarantines]
+
+
+class TestActuatorWiring:
+    def test_confirmed_node_feeds_actuator_and_remediates(self):
+        actuator = FakeActuator()
+        detector = HealthDetector(
+            suspect_z=4.0, confirm_cycles=2, decay_cycles=2, actuator=actuator
+        )
+        fleet = node_obs({"n0": 0.1, "n1": 0.1, "n2": 0.1, "slow": 9.0})
+        detector.tick(fleet)
+        detector.tick(fleet)
+        assert [n for n, _ in actuator.quarantines] == ["slow"]
+        assert detector.snapshot()["subjects"]["node/slow"]["state"] == REMEDIATING
+        assert detector.snapshot()["actions"][-1]["action"] == "quarantine"
+
+    def test_refused_quarantine_stays_confirmed(self):
+        actuator = FakeActuator(ok=False)
+        detector = HealthDetector(
+            suspect_z=4.0, confirm_cycles=1, decay_cycles=2, actuator=actuator
+        )
+        detector.tick(node_obs({"n0": 0.1, "n1": 0.1, "n2": 0.1, "slow": 9.0}))
+        assert detector.snapshot()["subjects"]["node/slow"]["state"] == CONFIRMED
+
+    def test_confirmed_upstream_never_reaches_actuator(self):
+        actuator = FakeActuator()
+        detector = HealthDetector(
+            suspect_z=4.0, confirm_cycles=1, decay_cycles=2, actuator=actuator
+        )
+        obs = [
+            Observation(kind="upstream", name=n, metric="watermark_age_seconds",
+                        value=v, group="upstreams", floor=0.5)
+            for n, v in {"a": 0.2, "b": 0.3, "c": 30.0}.items()
+        ]
+        detector.tick(obs)
+        assert detector.snapshot()["subjects"]["upstream/c"]["state"] == CONFIRMED
+        assert actuator.quarantines == []
+
+    def test_release_resets_state_and_drives_actuator(self):
+        actuator = FakeActuator()
+        detector = HealthDetector(
+            suspect_z=4.0, confirm_cycles=1, decay_cycles=5, actuator=actuator
+        )
+        detector.tick(node_obs({"n0": 0.1, "n1": 0.1, "n2": 0.1, "slow": 9.0}))
+        assert detector.snapshot()["subjects"]["node/slow"]["state"] == REMEDIATING
+        out = detector.release("slow", "operator cleared the host")
+        assert out["released"] is True
+        assert actuator.releases[0][0] == "slow"
+        assert detector.snapshot()["subjects"]["node/slow"]["state"] == HEALTHY
+
+    def test_release_clears_latched_probe_suspicion(self):
+        # an operator release must clear the per-source latches too: a
+        # probe implication the probe never re-answers would otherwise
+        # keep the released node severity-degraded and state-frozen
+        detector = HealthDetector(suspect_z=4.0, confirm_cycles=1, decay_cycles=1)
+        detector.tick([], {("node", "bad"): ["link probe: device 3 suspect"]})
+        assert detector.snapshot()["subjects"]["node/bad"]["state"] == CONFIRMED
+        detector.release("bad")
+        snap = detector.snapshot()["subjects"]["node/bad"]
+        assert snap["state"] == HEALTHY
+        assert snap["severity"] == 0.0 and snap["score"] == 1.0
+        # clean phase ticks now actually count as clean (no latched hold)
+        phase = [Observation(kind="node", name="bad", metric="phase_latency_seconds",
+                             value=0.1, group=None, floor=0.25, source="phase")]
+        detector.tick(phase)
+        assert detector.snapshot()["subjects"]["node/bad"]["state"] == HEALTHY
+        assert detector.snapshot()["subjects"]["node/bad"]["clean"] == 1
+
+    def test_refused_quarantine_retried_at_confirm_cadence(self):
+        # a node that STAYS suspicious after a fence refusal keeps asking
+        # every confirm_cycles ticks; a later success moves it to
+        # remediating and stops the retries
+        actuator = FakeActuator(ok=False)
+        detector = HealthDetector(
+            suspect_z=4.0, confirm_cycles=2, decay_cycles=2, actuator=actuator
+        )
+        fleet = node_obs({"n0": 0.1, "n1": 0.1, "n2": 0.1, "slow": 9.0})
+        for _ in range(6):  # confirm at streak 2, retries at 4 and 6
+            detector.tick(fleet)
+        assert len(actuator.quarantines) == 3
+        assert detector.snapshot()["subjects"]["node/slow"]["state"] == CONFIRMED
+        actuator.ok = True  # the fence freed up
+        detector.tick(fleet)
+        detector.tick(fleet)  # streak 8 -> retry succeeds
+        assert len(actuator.quarantines) == 4
+        assert detector.snapshot()["subjects"]["node/slow"]["state"] == REMEDIATING
+        detector.tick(fleet)
+        detector.tick(fleet)
+        assert len(actuator.quarantines) == 4  # remediating: no more asks
+
+    def test_healthy_ghost_subjects_expire(self):
+        detector = HealthDetector(suspect_z=4.0, confirm_cycles=3, decay_cycles=2)
+        detector.SUBJECT_TTL_TICKS = 10
+        fleet = node_obs({"n0": 0.1, "n1": 0.1, "n2": 0.1, "gone": 0.1})
+        detector.tick(fleet)
+        live = node_obs({"n0": 0.1, "n1": 0.1, "n2": 0.1})
+        for _ in range(80):
+            detector.tick(live)
+        assert "node/gone" not in detector.snapshot()["subjects"]
+        assert "node/n0" in detector.snapshot()["subjects"]
+
+    def test_confirmed_ghost_subjects_are_immortal(self):
+        # a confirmed straggler must never be garbage-collected healthy
+        detector = HealthDetector(suspect_z=4.0, confirm_cycles=1, decay_cycles=2)
+        detector.SUBJECT_TTL_TICKS = 10
+        detector.tick(node_obs({"n0": 0.1, "n1": 0.1, "n2": 0.1, "slow": 9.0}))
+        live = node_obs({"n0": 0.1, "n1": 0.1, "n2": 0.1})
+        for _ in range(80):
+            detector.tick(live)
+        assert detector.snapshot()["subjects"]["node/slow"]["state"] == CONFIRMED
+
+
+class TestMetricsEmission:
+    def test_labeled_score_and_state_gauges(self):
+        metrics = MetricsRegistry()
+        detector = HealthDetector(
+            suspect_z=4.0, confirm_cycles=1, decay_cycles=2, metrics=metrics
+        )
+        detector.tick(node_obs({"n0": 0.1, "n1": 0.1, "n2": 0.1, "slow": 9.0}))
+        text = metrics.prometheus_text()
+        assert 'node_health_score{node="slow"}' in text
+        assert 'health_state{node="slow",state="confirmed"} 1' in text
+        assert 'health_state{node="n0",state="healthy"} 1' in text
+        score = metrics.gauge("node_health_score").labels(node="slow").value
+        assert score < 0.5
+        assert metrics.gauge("node_health_score").labels(node="n0").value > 0.9
+        assert metrics.gauge("health_confirmed_subjects").value == 1
+
+    def test_label_cardinality_bounded(self):
+        metrics = MetricsRegistry()
+        detector = HealthDetector(
+            suspect_z=4.0, confirm_cycles=1, decay_cycles=2, metrics=metrics,
+            max_labeled_nodes=4,
+        )
+        values = {f"n{i}": 0.1 for i in range(10)}
+        detector.tick(node_obs(values))
+        families = metrics.gauge("node_health_score").children()
+        assert len(families) == 4  # capped; no ValueError, tick survived
+        # verdicts still exist for every node
+        assert len(detector.snapshot()["subjects"]) == 10
+
+
+class TestProbeCollector:
+    def plane(self, config=None):
+        return HealthPlane(
+            config or HealthConfig(
+                enabled=True, tick_seconds=60.0, suspect_z=4.0,
+                confirm_cycles=2, decay_cycles=2,
+                source_probe=True, source_phase=False,
+                source_freshness=False, source_trace=False,
+            ),
+            metrics=MetricsRegistry(),
+        )
+
+    def test_degraded_link_report_implicates_only_the_guilty_node(self):
+        plane = self.plane()
+        nodes = ["node-0", "node-1", "node-2", "node-3"]
+        for _ in range(2):
+            plane.observe_report(
+                synthetic_link_report(nodes, degraded_node="node-2")
+            )
+            plane.tick()
+        subjects = plane.snapshot()["subjects"]
+        assert subjects["node/node-2"]["state"] == CONFIRMED
+        for name in ("node-0", "node-1", "node-3"):
+            assert subjects[f"node/{name}"]["state"] == HEALTHY
+
+    def test_clean_reports_decay_the_verdict(self):
+        plane = self.plane()
+        nodes = ["node-0", "node-1", "node-2", "node-3"]
+        for _ in range(2):
+            plane.observe_report(synthetic_link_report(nodes, degraded_node="node-2"))
+            plane.tick()
+        assert not plane.health()["healthy"]
+        for _ in range(2):
+            plane.observe_report(synthetic_link_report(nodes))
+            plane.tick()
+        assert plane.health()["healthy"]
+
+    def test_two_reports_in_one_tick_stay_separate_peer_groups(self):
+        # two slices' probe reports draining in the same tick must NOT
+        # z-score against each other: a slice with a uniformly higher but
+        # healthy fabric RTT is not a straggler relative to a FOREIGN
+        # fabric's floor
+        plane = self.plane()
+        slow_fabric = ["node-s0", "node-s1", "node-s2", "node-s3"]
+        fast_fabric = ["node-f0", "node-f1", "node-f2", "node-f3"]
+        for _ in range(3):
+            # healthy-but-slower fabric: all links 2.0 ms, no suspects
+            plane.observe_report(synthetic_link_report(
+                slow_fabric, healthy_rtt_ms=2.0,
+            ))
+            plane.observe_report(synthetic_link_report(
+                fast_fabric, healthy_rtt_ms=0.1,
+            ))
+            plane.tick()
+        subjects = plane.snapshot()["subjects"]
+        for node in slow_fabric + fast_fabric:
+            assert subjects[f"node/{node}"]["state"] == HEALTHY, node
+
+    def test_departed_node_stops_emitting_phase_observations(self):
+        view = TestPhaseCollector.FakeView()
+        cfg = HealthConfig(
+            enabled=True, tick_seconds=60.0, source_probe=False,
+            source_phase=True, source_freshness=False, source_trace=False,
+        )
+        plane = HealthPlane(cfg, metrics=MetricsRegistry(), view=view)
+        view.objects = [
+            {"kind": "pod", "key": "uid-1", "phase": "Pending", "node": "n1"},
+        ]
+        plane.tick()
+        view.objects[0]["phase"] = "Running"
+        plane.tick()
+        assert "n1" in plane._node_latency
+        view.objects = []  # node drained away with its pods
+        plane.tick()
+        assert "n1" not in plane._node_latency
+
+    def test_reports_ignored_when_probe_source_off(self):
+        plane = self.plane(HealthConfig(
+            enabled=True, tick_seconds=60.0, source_probe=False,
+            source_phase=False, source_freshness=False, source_trace=False,
+        ))
+        plane.observe_report(synthetic_link_report(["a", "b", "c"], degraded_node="b"))
+        plane.tick()
+        assert plane.snapshot()["subjects"] == {}
+
+
+class TestPhaseCollector:
+    class FakeView:
+        def __init__(self):
+            self.objects = []
+
+        def snapshot(self):
+            return 1, list(self.objects)
+
+    def test_stuck_pending_pod_scores_its_node_against_slice_peers(self):
+        view = self.FakeView()
+        cfg = HealthConfig(
+            enabled=True, tick_seconds=60.0, suspect_z=4.0,
+            confirm_cycles=2, decay_cycles=2,
+            source_probe=False, source_phase=True,
+            source_freshness=False, source_trace=False,
+        )
+        plane = HealthPlane(cfg, metrics=MetricsRegistry(), view=view)
+        nodes = [f"node-{i}" for i in range(4)]
+        view.objects = [{
+            "kind": "slice", "key": "train-0",
+            "workers": [{"node": n} for n in nodes],
+        }] + [
+            {"kind": "pod", "key": f"uid-{i}", "phase": "Pending", "node": n}
+            for i, n in enumerate(nodes)
+        ]
+        plane.tick()  # everyone starts Pending together
+        # three nodes' pods come up; node-3's pod stays Pending
+        for i in range(3):
+            view.objects[1 + i]["phase"] = "Running"
+        time.sleep(0.05)
+        plane.tick()
+        # make node-3's pending age a clear outlier vs peers' latencies
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            time.sleep(0.3)
+            plane.tick()
+            state = plane.snapshot()["subjects"].get("node/node-3", {}).get("state")
+            if state == CONFIRMED:
+                break
+        subjects = plane.snapshot()["subjects"]
+        assert subjects["node/node-3"]["state"] == CONFIRMED
+        for i in range(3):
+            assert subjects[f"node/node-{i}"]["state"] == HEALTHY
+
+    def test_deleted_pods_are_forgotten(self):
+        view = self.FakeView()
+        cfg = HealthConfig(
+            enabled=True, tick_seconds=60.0, source_probe=False,
+            source_phase=True, source_freshness=False, source_trace=False,
+        )
+        plane = HealthPlane(cfg, metrics=MetricsRegistry(), view=view)
+        view.objects = [
+            {"kind": "pod", "key": "uid-1", "phase": "Pending", "node": "n1"},
+        ]
+        plane.tick()
+        assert "uid-1" in plane._pods
+        view.objects = []
+        plane.tick()
+        assert "uid-1" not in plane._pods
+
+
+class TestFreshnessCollector:
+    class FakeFederation:
+        def __init__(self, ages):
+            self.ages = ages
+
+        def freshness(self):
+            return {"upstreams": {
+                name: {"watermark_age_seconds": age, "oldest_unpropagated_seconds": 0.0}
+                for name, age in self.ages.items()
+            }}
+
+    def test_lagging_upstream_escalates_against_peers(self):
+        fed = self.FakeFederation({"a": 0.2, "b": 0.3, "c": 0.25})
+        cfg = HealthConfig(
+            enabled=True, tick_seconds=60.0, suspect_z=4.0,
+            confirm_cycles=2, decay_cycles=2,
+            source_probe=False, source_phase=False,
+            source_freshness=True, source_trace=False,
+        )
+        plane = HealthPlane(cfg, metrics=MetricsRegistry(), federation=fed)
+        plane.tick()
+        fed.ages["c"] = 25.0
+        plane.tick()
+        plane.tick()
+        subjects = plane.snapshot()["subjects"]
+        assert subjects["upstream/c"]["state"] == CONFIRMED
+        assert subjects["upstream/a"]["state"] == HEALTHY
+        assert subjects["upstream/b"]["state"] == HEALTHY
+        # recovery decays it back
+        fed.ages["c"] = 0.2
+        plane.tick()
+        plane.tick()
+        assert plane.snapshot()["subjects"]["upstream/c"]["state"] == HEALTHY
+
+
+class TestHttpSurfaces:
+    def setup_method(self):
+        self.metrics = MetricsRegistry()
+        self.liveness = Liveness(stale_after_seconds=60.0)
+
+    def test_debug_health_serves_snapshot(self):
+        detector = HealthDetector(suspect_z=4.0, confirm_cycles=1, decay_cycles=1)
+        detector.tick(node_obs({"n0": 0.1, "n1": 0.1, "n2": 0.1, "slow": 9.0}))
+        server = StatusServer(
+            self.metrics, self.liveness, host="127.0.0.1",
+            node_health=detector.snapshot, node_health_fold=detector.health,
+        ).start()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            body = requests.get(f"{url}/debug/health", timeout=5).json()["health"]
+            assert body["subjects"]["node/slow"]["state"] == CONFIRMED
+            assert body["suspect_z"] == 4.0
+        finally:
+            server.stop()
+
+    def test_debug_health_404_when_off(self):
+        server = StatusServer(self.metrics, self.liveness, host="127.0.0.1").start()
+        try:
+            r = requests.get(
+                f"http://127.0.0.1:{server.port}/debug/health", timeout=5
+            )
+            assert r.status_code == 404
+            assert "health.enabled" in r.json()["error"]
+        finally:
+            server.stop()
+
+    def test_healthz_fold_degrades_body_never_liveness(self):
+        detector = HealthDetector(suspect_z=4.0, confirm_cycles=1, decay_cycles=1)
+        detector.tick(node_obs({"n0": 0.1, "n1": 0.1, "n2": 0.1, "slow": 9.0}))
+        self.liveness.beat()
+        server = StatusServer(
+            self.metrics, self.liveness, host="127.0.0.1",
+            node_health=detector.snapshot, node_health_fold=detector.health,
+        ).start()
+        try:
+            r = requests.get(f"http://127.0.0.1:{server.port}/healthz", timeout=5)
+            assert r.status_code == 200  # liveness NEVER flips on a verdict
+            body = r.json()
+            assert body["alive"] is True
+            assert body["health"]["healthy"] is False
+            assert body["health"]["confirmed"] == ["node/slow"]
+        finally:
+            server.stop()
+
+
+class TestPlaneLifecycle:
+    def test_tick_thread_runs_and_stops(self):
+        cfg = HealthConfig(
+            enabled=True, tick_seconds=0.05, source_probe=True,
+            source_phase=False, source_freshness=False, source_trace=False,
+        )
+        plane = HealthPlane(cfg, metrics=MetricsRegistry())
+        plane.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if plane.snapshot()["ticks"] >= 3:
+                    break
+                time.sleep(0.05)
+            assert plane.snapshot()["ticks"] >= 3
+            assert plane.health()["thread_alive"] is True
+        finally:
+            plane.stop()
+        assert plane.health()["thread_alive"] is False
+
+    def test_snapshot_races_tick(self):
+        cfg = HealthConfig(
+            enabled=True, tick_seconds=60.0, source_probe=True,
+            source_phase=False, source_freshness=False, source_trace=False,
+        )
+        plane = HealthPlane(cfg, metrics=MetricsRegistry())
+        stop = threading.Event()
+        errors = []
+
+        def reader():
+            while not stop.is_set():
+                try:
+                    plane.snapshot()
+                    plane.health()
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(exc)
+
+        thread = threading.Thread(target=reader, daemon=True)
+        thread.start()
+        for _ in range(50):
+            plane.observe_report(
+                synthetic_link_report(["a", "b", "c", "d"], degraded_node="b")
+            )
+            plane.tick()
+        stop.set()
+        thread.join(timeout=5)
+        assert errors == []
+
+
+class TestSchema:
+    BASE = {
+        "serve": {"enabled": True},
+        "trace": {"enabled": True},
+    }
+
+    def build(self, health, extra=None):
+        raw = dict(self.BASE)
+        raw["health"] = health
+        raw.update(extra or {})
+        return AppConfig.from_raw(raw, "development")
+
+    def test_defaults_disabled(self):
+        cfg = AppConfig.from_raw({}, "development")
+        assert cfg.health.enabled is False
+        assert cfg.health.suspect_z == 4.0
+
+    def test_valid_enabled(self):
+        cfg = self.build({"enabled": True, "tick_seconds": 1, "suspect_z": 3.5,
+                          "confirm_cycles": 2, "decay_cycles": 1})
+        assert cfg.health.enabled and cfg.health.suspect_z == 3.5
+
+    def test_confirm_cycles_floor(self):
+        with pytest.raises(SchemaError, match="confirm_cycles"):
+            self.build({"enabled": True, "confirm_cycles": 0})
+
+    def test_decay_cycles_floor(self):
+        with pytest.raises(SchemaError, match="decay_cycles"):
+            self.build({"enabled": True, "decay_cycles": 0})
+
+    def test_suspect_z_positive(self):
+        with pytest.raises(SchemaError, match="suspect_z"):
+            self.build({"enabled": True, "suspect_z": 0})
+
+    def test_tick_positive(self):
+        with pytest.raises(SchemaError, match="tick_seconds"):
+            self.build({"enabled": True, "tick_seconds": 0})
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(SchemaError, match="unknown"):
+            self.build({"enabled": True, "zeal": 11})
+
+    def test_unknown_source_rejected(self):
+        with pytest.raises(SchemaError, match="sources"):
+            self.build({"enabled": True, "sources": {"vibes": True}})
+
+    def test_enabled_needs_a_source(self):
+        with pytest.raises(SchemaError, match="at least one source"):
+            self.build({"enabled": True, "sources": {
+                "probe": False, "phase": False, "freshness": False, "trace": False,
+            }})
+
+    def test_phase_source_requires_serve(self):
+        with pytest.raises(SchemaError, match="serve.enabled"):
+            AppConfig.from_raw(
+                {"health": {"enabled": True, "sources": {"phase": True}}},
+                "development",
+            )
+
+    def test_freshness_source_requires_federation(self):
+        with pytest.raises(SchemaError, match="federation.enabled"):
+            self.build({"enabled": True, "sources": {"freshness": True}})
+
+    def test_trace_source_requires_trace(self):
+        with pytest.raises(SchemaError, match="trace.enabled"):
+            AppConfig.from_raw(
+                {
+                    "serve": {"enabled": True},
+                    "trace": {"enabled": False},
+                    "health": {"enabled": True,
+                               "sources": {"phase": True, "trace": True}},
+                },
+                "development",
+            )
+
+    def test_trend_tracker_exported_from_probe(self):
+        # satellite: the ONE rolling-baseline implementation is a public
+        # probe-plane export, reused by the health detector
+        from k8s_watcher_tpu.probe import TrendTracker
+
+        detector = HealthDetector(suspect_z=4.0, confirm_cycles=1, decay_cycles=1)
+        assert isinstance(detector.trend, TrendTracker)
