@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: test check check-scale integration integration-kind integration-mock bench bench-smoke trace-smoke serve-smoke history-smoke federation-smoke obs-smoke health-smoke dryrun dryrun-128 accept
+.PHONY: test check check-scale integration integration-kind integration-mock bench bench-smoke trace-smoke serve-smoke history-smoke federation-smoke obs-smoke health-smoke analytics-smoke dryrun dryrun-128 accept
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -110,6 +110,20 @@ obs-smoke:
 # artifacts/health_smoke.json.
 health-smoke:
 	$(PY) scripts/health_smoke.py
+
+# Analytics-plane smoke: the what-if contract end to end through the
+# real app — two real TPU slices formed by the live pipeline plus a
+# synthetic second cluster merged via the real federation keying. Gates:
+# vectorized slice aggregates == the tracker's incremental counters
+# EXACTLY, the drain-cluster-A what-if names exactly the quorum-losing
+# slices (never an already-degraded one), cordoning one node names
+# exactly its slice, /serve/analytics is bearer-gated + msgpack-
+# negotiated, and the batched N-scenario WAL replay equals N sequential
+# Python folds verdict-for-verdict. The >=5x batched-replay SPEEDUP at
+# 10k pods is gated by bench-smoke (bench_analytics). Artifact:
+# artifacts/analytics_smoke.json.
+analytics-smoke:
+	$(PY) scripts/analytics_smoke.py
 
 dryrun:
 	$(PY) __graft_entry__.py 8

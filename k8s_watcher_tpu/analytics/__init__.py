@@ -1,0 +1,72 @@
+"""JAX-vectorized fleet analytics & what-if engine (ARCHITECTURE.md
+"Analytics plane"): columnar encoding of the FleetView, jitted kernels
+over a jnp/numpy backend seam, batched placement what-ifs, and bulk
+WAL-replay analytics."""
+
+from k8s_watcher_tpu.analytics.backend import (
+    BACKENDS,
+    ArrayBackend,
+    jax_available,
+    resolve_backend,
+)
+from k8s_watcher_tpu.analytics.encode import (
+    LOCAL_CLUSTER,
+    POD_PHASES,
+    SLICE_PHASES,
+    FleetColumns,
+    FleetEncoder,
+    Interner,
+    tables_from_objects,
+)
+from k8s_watcher_tpu.analytics.kernels import (
+    FleetKernels,
+    SliceRollup,
+    WhatIfResult,
+    crosscheck,
+)
+from k8s_watcher_tpu.analytics.plane import AnalyticsPlane
+from k8s_watcher_tpu.analytics.replay import (
+    batched_replay_verdicts,
+    comparable,
+    sequential_replay_verdicts,
+    verdicts_from_objects,
+)
+from k8s_watcher_tpu.analytics.whatif import (
+    SCENARIO_KINDS,
+    Scenario,
+    ScenarioError,
+    build_masks,
+    evaluate_scenarios,
+    parse_scenarios,
+    python_reference_verdicts,
+)
+
+__all__ = [
+    "BACKENDS",
+    "LOCAL_CLUSTER",
+    "POD_PHASES",
+    "SCENARIO_KINDS",
+    "SLICE_PHASES",
+    "AnalyticsPlane",
+    "ArrayBackend",
+    "FleetColumns",
+    "FleetEncoder",
+    "FleetKernels",
+    "Interner",
+    "Scenario",
+    "ScenarioError",
+    "SliceRollup",
+    "WhatIfResult",
+    "batched_replay_verdicts",
+    "build_masks",
+    "comparable",
+    "crosscheck",
+    "evaluate_scenarios",
+    "jax_available",
+    "parse_scenarios",
+    "python_reference_verdicts",
+    "resolve_backend",
+    "sequential_replay_verdicts",
+    "tables_from_objects",
+    "verdicts_from_objects",
+]

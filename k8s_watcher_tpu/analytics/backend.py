@@ -1,0 +1,189 @@
+"""The array-backend seam: one `xp` namespace, two implementations.
+
+Every analytics kernel is written against this thin seam instead of
+importing ``jax.numpy`` directly, for the same reason the serve plane
+negotiates codecs instead of hardcoding one: the COMPUTATION is the
+contract, the substrate is a deployment detail.
+
+- ``jax``: ``jax.numpy`` + ``jax.jit`` + ``jax.ops.segment_sum`` — the
+  device path (CPU under ``JAX_PLATFORMS=cpu``, TPU where the graft
+  toolchain provides one). Kernels are jitted once per input shape and
+  the scenario axis batches through one traced program (the
+  batch-everything-into-arrays method of Ising-on-TPU, PAPERS.md
+  arXiv:1903.11714).
+- ``numpy``: the degraded twin — ``numpy`` + an identity ``jit`` + a
+  ``bincount`` segment sum. Slower, never wrong: the golden parity
+  suite (tests/test_analytics.py) pins every kernel's numpy results
+  EXACTLY equal to the jax results, which is why all kernels return
+  integer counts (float ratios are derived on the host from the same
+  ints) — cross-backend float drift can never leak into a verdict.
+
+Resolution (``analytics.backend``):
+
+- ``auto`` (default): jax when it imports AND can run a trivial op;
+  numpy otherwise. A stripped or broken jax install degrades silently
+  to numpy (INFO log) — tier-1 already carries pre-existing jax
+  failures and this subsystem must add zero new ones.
+- ``jax``: the same probe with a WARNING posture on fallback (the
+  operator pinned a backend the process cannot provide — mirrors the
+  federation codec pin).
+- ``numpy``: never touches jax (debugging / byte-stable baselines).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+#: accepted analytics.backend values (config/schema.py validates against
+#: this — the schema is the dependency-light layer, so it re-declares it)
+BACKENDS = ("auto", "jax", "numpy")
+
+BACKEND_JAX = "jax"
+BACKEND_NUMPY = "numpy"
+
+#: cached jax probe verdict: (available, modules-or-None). The probe
+#: runs a real op, not just an import — a jax that imports but cannot
+#: execute (missing backend plugin, broken XLA) must also degrade.
+_JAX_PROBE: Optional[Tuple[bool, Any]] = None
+
+
+def _import_jax():
+    """Import hook the jax-absent tests monkeypatch (raising ImportError
+    here IS the stripped-environment simulation)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax, jnp
+
+
+def _probe_jax() -> Tuple[bool, Any]:
+    global _JAX_PROBE
+    if _JAX_PROBE is not None:
+        return _JAX_PROBE
+    try:
+        jax, jnp = _import_jax()
+        # prove the backend can EXECUTE, not just import: a broken
+        # platform init surfaces at the first op, and it must surface
+        # here (once, at resolution) — never inside a serve request
+        int(jnp.zeros((1,), dtype=jnp.int32).sum())
+        _JAX_PROBE = (True, (jax, jnp))
+    except Exception as exc:  # noqa: BLE001 — any jax breakage = degrade
+        logger.debug("jax backend probe failed: %s", exc)
+        _JAX_PROBE = (False, None)
+    return _JAX_PROBE
+
+
+def reset_probe_cache() -> None:
+    """Forget the cached jax probe (tests flip availability mid-process)."""
+    global _JAX_PROBE
+    _JAX_PROBE = None
+
+
+def jax_available() -> bool:
+    return _probe_jax()[0]
+
+
+class ArrayBackend:
+    """One resolved backend: the ``xp`` namespace plus the two ops whose
+    spelling differs across substrates (``jit``, ``segment_sum``).
+
+    ``segment_sum(data, segment_ids, num_segments)`` sums ``data`` over
+    its LAST axis into ``num_segments`` bins — ``data`` is ``(n,)`` or
+    ``(batch, n)`` (the scenario axis), ``segment_ids`` is ``(n,)``.
+    Always returns int64 (counts are the kernel contract; float
+    accumulation paths cast back, exactly, because every addend is a
+    small integer).
+    """
+
+    def __init__(self, name: str, xp, jit: Callable, segment_sum: Callable):
+        self.name = name
+        self.xp = xp
+        self.jit = jit
+        self._segment_sum = segment_sum
+
+    def segment_sum(self, data, segment_ids, num_segments: int):
+        return self._segment_sum(data, segment_ids, num_segments)
+
+    def asarray(self, a, dtype=None):
+        return self.xp.asarray(a, dtype=dtype)
+
+    @staticmethod
+    def to_numpy(a) -> np.ndarray:
+        """Device (or numpy) array -> host numpy — the boundary every
+        kernel result crosses before entering a verdict dict."""
+        return np.asarray(a)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging nicety
+        return f"ArrayBackend({self.name})"
+
+
+def _numpy_backend() -> ArrayBackend:
+    def jit(fn, **_kwargs):  # static_argnames etc. are jax-only hints
+        return fn
+
+    def segment_sum(data, segment_ids, num_segments: int):
+        data = np.asarray(data)
+        segment_ids = np.asarray(segment_ids)
+        if data.ndim == 1:
+            # bincount weights accumulate in float64 — exact for the
+            # integer counts these kernels sum (all << 2^53)
+            return np.bincount(
+                segment_ids, weights=data, minlength=num_segments
+            ).astype(np.int64)
+        return np.stack([
+            np.bincount(segment_ids, weights=row, minlength=num_segments).astype(np.int64)
+            for row in data
+        ]) if data.shape[0] else np.zeros((0, num_segments), dtype=np.int64)
+
+    return ArrayBackend(BACKEND_NUMPY, np, jit, segment_sum)
+
+
+def _jax_backend(jax, jnp) -> ArrayBackend:
+    def jit(fn, **kwargs):
+        return jax.jit(fn, **kwargs)
+
+    def segment_sum(data, segment_ids, num_segments: int):
+        data = jnp.asarray(data, dtype=jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)
+        # jax.ops.segment_sum segments axis 0; the batched (scenario)
+        # shape rides a transpose pair — one fused program under jit
+        if data.ndim == 1:
+            out = jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+        else:
+            out = jax.ops.segment_sum(
+                data.T, segment_ids, num_segments=num_segments
+            ).T
+        return out.astype(jnp.int64) if jax.config.jax_enable_x64 else out
+
+    return ArrayBackend(BACKEND_JAX, jnp, jit, segment_sum)
+
+
+def resolve_backend(preference: str = "auto") -> ArrayBackend:
+    """Resolve ``analytics.backend`` to a live :class:`ArrayBackend`.
+
+    Never raises on a missing/broken jax: the analytics plane degrading
+    to numpy is strictly better than a watcher that cannot boot (the
+    pinned-``jax`` case logs a WARNING so the operator knows the pin
+    did not hold)."""
+    if preference not in BACKENDS:
+        raise ValueError(
+            f"analytics backend must be one of {', '.join(BACKENDS)}, got {preference!r}"
+        )
+    if preference == BACKEND_NUMPY:
+        return _numpy_backend()
+    ok, modules = _probe_jax()
+    if ok:
+        jax, jnp = modules
+        return _jax_backend(jax, jnp)
+    if preference == BACKEND_JAX:
+        logger.warning(
+            "analytics.backend=jax but jax is absent/broken; degrading to numpy "
+            "(kernel results are identical — the golden parity suite pins it)"
+        )
+    else:
+        logger.info("analytics backend: jax unavailable, using numpy")
+    return _numpy_backend()

@@ -1,0 +1,115 @@
+"""Bulk replay analytics: N what-if scenarios over ONE WAL replay.
+
+The history plane's deterministic replay (``history/replay.py``) turns
+any production capture into a state you can interrogate — but before
+this module, asking N placement questions of a capture meant N full
+sequential Python folds (replay the WAL, walk the dicts, repeat per
+question). Here the capture is replayed ONCE, encoded into columns
+once, and all N scenarios ride the batched what-if kernel's scenario
+axis in one launch — the bench gates this at >=5x the sequential fold
+for >=8 scenarios at 10k pods.
+
+``sequential_replay_verdicts`` IS the pre-subsystem baseline, kept as a
+first-class function for two reasons: it is the oracle the batched path
+must equal EXACTLY (``make analytics-smoke`` and ``bench_analytics``
+both gate ``batched == sequential`` before any speedup is believed),
+and it is the measurement baseline the speedup is honest against.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Dict, Optional, Sequence
+
+from k8s_watcher_tpu.analytics.backend import ArrayBackend, resolve_backend
+from k8s_watcher_tpu.analytics.encode import FleetEncoder, tables_from_objects
+from k8s_watcher_tpu.analytics.kernels import FleetKernels, crosscheck
+from k8s_watcher_tpu.analytics.whatif import (
+    Scenario,
+    evaluate_scenarios,
+    python_reference_verdicts,
+)
+from k8s_watcher_tpu.history.replay import replay_wal
+
+
+def verdicts_from_objects(
+    objects,
+    scenarios: Sequence[Scenario],
+    *,
+    backend: Optional[ArrayBackend] = None,
+    kernels: Optional[FleetKernels] = None,
+) -> Dict[str, Any]:
+    """Evaluate scenarios over a replayed terminal state (the
+    ``{(kind, key): obj}`` shape ``replay_wal`` returns), through the
+    full columnar path: encode once, one batched kernel launch.
+
+    Pass ``kernels`` to reuse one jitted kernel set across calls (a
+    long-lived caller compiles once per input shape, like the live
+    plane); otherwise one is built from ``backend``/auto."""
+    if kernels is None:
+        kernels = FleetKernels(backend or resolve_backend("auto"))
+    encoder = FleetEncoder()
+    encoder.reset(tables_from_objects(objects))
+    cols = encoder.columns()
+    out = evaluate_scenarios(cols, scenarios, kernels)
+    out["crosscheck"] = crosscheck(cols, kernels.slice_rollup(cols))
+    return out
+
+
+def batched_replay_verdicts(
+    wal_dir: Path | str,
+    scenarios: Sequence[Scenario],
+    *,
+    at: Optional[int] = None,
+    backend: Optional[ArrayBackend] = None,
+    kernels: Optional[FleetKernels] = None,
+) -> Dict[str, Any]:
+    """ONE deterministic replay, one encode, one batched kernel pass for
+    every scenario. ``at`` stops the replay at a historical rv — the
+    offline twin of asking ``/serve/analytics`` in the past."""
+    result = replay_wal(wal_dir, at=at)
+    out = verdicts_from_objects(result.objects, scenarios, backend=backend, kernels=kernels)
+    out["rv"] = result.rv
+    out["deltas_applied"] = result.deltas_applied
+    out["rv_mismatches"] = result.rv_mismatches
+    return out
+
+
+def sequential_replay_verdicts(
+    wal_dir: Path | str,
+    scenarios: Sequence[Scenario],
+    *,
+    at: Optional[int] = None,
+) -> Dict[str, Any]:
+    """The baseline: N sequential Python folds — each scenario pays a
+    full WAL replay plus a dict-walk fold (no arrays anywhere). Same
+    verdict document as the batched path, assembled the slow way."""
+    baseline: Optional[Dict[str, Any]] = None
+    out_scenarios = []
+    rv = 0
+    deltas_applied = 0
+    mismatches = 0
+    for scenario in scenarios:
+        result = replay_wal(wal_dir, at=at)
+        rv = result.rv
+        deltas_applied = result.deltas_applied
+        mismatches = result.rv_mismatches
+        tables = tables_from_objects(result.objects)
+        verdict = python_reference_verdicts(tables, [scenario])
+        if baseline is None:
+            baseline = verdict["baseline"]
+        out_scenarios.append(verdict["scenarios"][0])
+    return {
+        "baseline": baseline or {},
+        "scenarios": out_scenarios,
+        "rv": rv,
+        "deltas_applied": deltas_applied,
+        "rv_mismatches": mismatches,
+    }
+
+
+def comparable(verdicts: Dict[str, Any]) -> Dict[str, Any]:
+    """Strip the run metadata (backend name, crosscheck detail, replay
+    counters) so batched-vs-sequential equality compares exactly the
+    VERDICTS — the facts both implementations claim about the fleet."""
+    return {"baseline": verdicts.get("baseline"), "scenarios": verdicts.get("scenarios")}

@@ -283,6 +283,20 @@ class WatcherApp:
                 token_dir=token_dir,
                 resume_tokens_valid=tokens_valid,
             )
+        # fleet analytics & what-if plane (analytics/): the FleetView's
+        # columnar twin + jitted kernels + /serve/analytics. Built after
+        # federation so the encoder covers the merged global fleet from
+        # the first request; attached to the serve plane BEFORE start()
+        # so the HTTP handler binds the route. Passive — refreshed per
+        # request off the delta stream, nothing to start/stop.
+        self.analytics = None
+        if config.analytics.enabled:
+            from k8s_watcher_tpu.analytics import AnalyticsPlane
+
+            self.analytics = AnalyticsPlane(
+                config.analytics, self.serve.view, metrics=self.metrics
+            )
+            self.serve.attach_analytics(self.analytics)
         # straggler & node-health detection plane (health/): fuses probe
         # findings, fleet-view phase latencies, federation freshness and
         # trace stage outliers into peer-relative per-node/slice/upstream

@@ -1191,6 +1191,63 @@ class HealthConfig:
         return cfg
 
 
+#: accepted analytics.backend values (analytics/backend.py mirrors this —
+#: the schema is the dependency-light layer, so it re-declares the
+#: vocabulary instead of importing numpy/jax at config-load time)
+VALID_ANALYTICS_BACKENDS = ("auto", "jax", "numpy")
+
+
+@dataclasses.dataclass(frozen=True)
+class AnalyticsConfig:
+    """The ``analytics:`` section — net-new JAX-vectorized fleet
+    analytics & what-if engine (analytics/): the FleetView encoded into
+    dense integer columns (stable interning dictionaries, incrementally
+    maintained from the delta stream), jitted kernels over a jnp/numpy
+    backend seam (vectorized slice aggregates cross-checked exactly
+    against the incremental counters, quorum math, topology scoring),
+    and batched placement what-ifs ("drain cluster A — which slices
+    lose quorum?") at ``GET /serve/analytics``. Requires
+    ``serve.enabled`` (the columns are the serving plane's view).
+    See ARCHITECTURE.md "Analytics plane".
+    """
+
+    enabled: bool = False
+    # array substrate: auto (jax when importable AND executable, else
+    # numpy), jax (same probe, WARNs on fallback), numpy (never touches
+    # jax — debugging / byte-stable baselines). Kernel RESULTS are
+    # identical across backends (integer contract, parity-suite pinned).
+    backend: str = "auto"
+    # per-request scenario cap for /serve/analytics?scenarios= (400 past
+    # it) — one request's mask matrix is [scenarios x workers]
+    max_scenarios: int = 16
+    # run the vectorized-vs-incremental slice-aggregate cross-check on
+    # every request (cheap: one extra segment-sum) and surface failures
+    # via analytics_crosscheck_failures + the response body
+    crosscheck: bool = True
+
+    @classmethod
+    def from_raw(cls, raw: Mapping[str, Any]) -> "AnalyticsConfig":
+        path = "analytics"
+        _check_known(raw, ("enabled", "backend", "max_scenarios", "crosscheck"), path)
+        backend = _opt_str(raw, "backend", path, "auto")
+        if backend not in VALID_ANALYTICS_BACKENDS:
+            raise SchemaError(
+                f"config key '{path}.backend': must be one of "
+                f"{', '.join(VALID_ANALYTICS_BACKENDS)}, got {backend!r}"
+            )
+        max_scenarios = _opt_int(raw, "max_scenarios", path, 16)
+        if max_scenarios < 1:
+            raise SchemaError(
+                f"config key '{path}.max_scenarios': must be >= 1, got {max_scenarios}"
+            )
+        return cls(
+            enabled=_opt_bool(raw, "enabled", path, False),
+            backend=backend,
+            max_scenarios=max_scenarios,
+            crosscheck=_opt_bool(raw, "crosscheck", path, True),
+        )
+
+
 def metric_safe_name(name: str) -> str:
     """Cluster/upstream name -> metric-name- and filename-safe form
     (Prometheus charset). The ONE sanitizer the federation plane uses for
@@ -1376,13 +1433,14 @@ class AppConfig:
     metrics: MetricsConfig = dataclasses.field(default_factory=MetricsConfig)
     slo: SloConfig = dataclasses.field(default_factory=SloConfig)
     health: HealthConfig = dataclasses.field(default_factory=HealthConfig)
+    analytics: AnalyticsConfig = dataclasses.field(default_factory=AnalyticsConfig)
 
-    TOP_LEVEL_KEYS = ("environment", "watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest", "trace", "serve", "history", "federation", "metrics", "slo", "health")
+    TOP_LEVEL_KEYS = ("environment", "watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest", "trace", "serve", "history", "federation", "metrics", "slo", "health", "analytics")
 
     @classmethod
     def from_raw(cls, raw: Mapping[str, Any], environment: str) -> "AppConfig":
         _check_known(raw, cls.TOP_LEVEL_KEYS, "<root>")
-        for section in ("watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest", "trace", "serve", "history", "federation", "metrics", "slo", "health"):
+        for section in ("watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest", "trace", "serve", "history", "federation", "metrics", "slo", "health", "analytics"):
             _expect(raw.get(section) or {}, (dict,), section)
         # The reference's development.yaml declared `environment: local` while
         # the CLI only accepted development|staging|production, leaving the
@@ -1407,6 +1465,13 @@ class AppConfig:
                 "FleetView; without it the fan-in has nowhere to land)"
             )
         trace = TraceConfig.from_raw(raw.get("trace") or {})
+        analytics = AnalyticsConfig.from_raw(raw.get("analytics") or {})
+        if analytics.enabled and not serve.enabled:
+            raise SchemaError(
+                "config key 'analytics.enabled': requires serve.enabled (the "
+                "columnar encoder's source of truth is the serving plane's "
+                "FleetView, and /serve/analytics rides its HTTP surface)"
+            )
         health = HealthConfig.from_raw(raw.get("health") or {})
         if health.enabled:
             # each enabled source must have the plane it reads — a silently
@@ -1442,4 +1507,5 @@ class AppConfig:
             metrics=MetricsConfig.from_raw(raw.get("metrics") or {}),
             slo=SloConfig.from_raw(raw.get("slo") or {}),
             health=health,
+            analytics=analytics,
         )

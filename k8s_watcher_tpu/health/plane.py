@@ -186,23 +186,20 @@ class HealthPlane:
         return self.detector._ticks_counter
 
     def _collect_phase(self, observations: List[Observation]) -> None:
-        """Per-node phase-transition latencies off the FleetView (see
-        module docstring). One O(objects) snapshot walk per tick."""
+        """Per-node phase-transition latencies off the FleetView's bulk
+        per-kind tables (``snapshot_tables`` — ONE object walk per rv,
+        cached on the view and shared with the analytics encoder, so two
+        per-tick consumers cost one classification pass between them)."""
         now = time.monotonic()
-        _rv, objects = self.view.snapshot()
+        _rv, tables = self.view.snapshot_tables()
         node_slice: Dict[str, str] = {}
-        pods: List[Dict[str, Any]] = []
-        live_keys = set()
-        for obj in objects:
-            kind = obj.get("kind")
-            if kind == "slice":
-                for worker in obj.get("workers") or ():
-                    node = worker.get("node")
-                    if node:
-                        node_slice[node] = str(obj.get("key") or obj.get("slice") or "")
-            elif kind == "pod":
-                pods.append(obj)
-                live_keys.add(obj.get("key"))
+        for obj in tables.get("slice", ()):
+            for worker in obj.get("workers") or ():
+                node = worker.get("node")
+                if node:
+                    node_slice[node] = str(obj.get("key") or obj.get("slice") or "")
+        pods = tables.get("pod", ())
+        live_keys = {obj.get("key") for obj in pods}
         pending_age: Dict[str, float] = {}
         live_nodes = set()
         for obj in pods:

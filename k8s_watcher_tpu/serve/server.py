@@ -169,6 +169,7 @@ class _ServeHandler(BaseHTTPRequestHandler):
     hub: SubscriptionHub
     plane = None  # the owning ServePlane (health payload)
     history = None  # history.HistoryStore -> ?at= time-travel reads
+    analytics = None  # analytics.AnalyticsPlane -> /serve/analytics
     loop: Optional[BroadcastLoop] = None  # epoll core; None = threaded streams
     at_cache: Optional[_AtCache] = None  # ?at= reconstruction LRU
     at_hits = None  # metrics counters (bound by ServeServer when wired)
@@ -225,6 +226,18 @@ class _ServeHandler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", "0")
             self.end_headers()
             return
+        if path == "/serve/analytics":
+            # keep_blank_values: "" is MEANINGFUL here (?drain_cluster=
+            # names the local cluster) — the default drop would silently
+            # answer the summary instead of the rehearsal the operator
+            # asked for
+            self._serve_analytics(
+                {k: v[0] for k, v in parse_qs(
+                    parsed.query, keep_blank_values=True
+                ).items()},
+                self._codec(),
+            )
+            return
         if path != "/serve/fleet":
             self._json(404, {"error": f"no route {path}"})
             return
@@ -243,6 +256,55 @@ class _ServeHandler(BaseHTTPRequestHandler):
         self._body_bytes(
             200, self.view.snapshot_bytes(codec=codec), CODEC_CONTENT_TYPES[codec]
         )
+
+    def _serve_analytics(self, params: dict, codec: str = CODEC_JSON) -> None:
+        """``GET /serve/analytics``: the fleet's columnar rollup, or a
+        batched what-if evaluation (ARCHITECTURE.md "Analytics plane").
+
+        Shapes (all bearer-gated and codec-negotiated like every other
+        serve route):
+
+        - no params -> the summary (rollup + quorum/capacity stance +
+          the declared scenario vocabulary);
+        - ``?scenarios=<json array>`` -> batched evaluation (at most
+          ``analytics.max_scenarios`` per request, 400 past it);
+        - ``?drain_cluster=<name>`` / ``?cordon_nodes=a,b`` -> the two
+          common questions as curl-friendly single-scenario sugar.
+        """
+        if self.analytics is None:
+            self._send_obj(
+                404,
+                {"error": "analytics plane disabled (analytics.enabled)"},
+                codec,
+            )
+            return
+        from k8s_watcher_tpu.analytics import ScenarioError
+
+        raw_scenarios = None
+        if "scenarios" in params:
+            try:
+                raw_scenarios = json.loads(params["scenarios"])
+            except ValueError:
+                self._send_obj(
+                    400, {"error": "scenarios= must be a JSON array"}, codec
+                )
+                return
+        elif "drain_cluster" in params:
+            raw_scenarios = [
+                {"kind": "drain_cluster", "cluster": params["drain_cluster"]}
+            ]
+        elif "cordon_nodes" in params:
+            nodes = [n for n in params["cordon_nodes"].split(",") if n]
+            raw_scenarios = [{"kind": "cordon_nodes", "nodes": nodes}]
+        try:
+            if raw_scenarios is None:
+                body = self.analytics.summary()
+            else:
+                body = self.analytics.evaluate(raw_scenarios)
+        except ScenarioError as exc:
+            self._send_obj(400, {"error": str(exc)}, codec)
+            return
+        self._send_obj(200, body, codec)
 
     def _serve_at(self, params: dict, codec: str = CODEC_JSON) -> None:
         """Time travel: ``GET /serve/fleet?at=N`` reconstructs the fleet
@@ -539,6 +601,7 @@ class ServeServer:
         auth_token: Optional[str] = None,
         plane=None,
         history=None,
+        analytics=None,
         io_threads: int = 1,
         sub_buffer_bytes: int = 1 << 20,
         metrics=None,
@@ -560,7 +623,7 @@ class ServeServer:
             "BoundServeHandler",
             (_ServeHandler,),
             {"view": view, "hub": hub, "auth_token": auth_token, "plane": plane,
-             "history": history, "loop": self.loop,
+             "history": history, "analytics": analytics, "loop": self.loop,
              "at_cache": _AtCache() if history is not None else None,
              "at_hits": metrics.counter("serve_at_cache_hits")
              if metrics is not None and history is not None else None,
@@ -657,6 +720,15 @@ class ServePlane:
         )
         self.server: Optional[ServeServer] = None
         self._auth_token = auth_token
+        # analytics.AnalyticsPlane, attached by the app AFTER the view
+        # exists (and after federation, so the columnar twin covers the
+        # merged global fleet) — routes /serve/analytics when set
+        self.analytics = None
+
+    def attach_analytics(self, analytics) -> None:
+        """Wire the analytics plane; call before ``start()`` so the HTTP
+        handler binds the route."""
+        self.analytics = analytics
 
     def wrap_sink(self, sink):
         """Tap a notification sink: every Notification folds into the view
@@ -678,14 +750,17 @@ class ServePlane:
             auth_token=self._auth_token,
             plane=self,
             history=self.history,
+            analytics=self.analytics,
             io_threads=getattr(self.config, "io_threads", 1),
             sub_buffer_bytes=getattr(self.config, "sub_buffer_bytes", 1 << 20),
             metrics=self.metrics,
         ).start()
         logger.info(
-            "Serving plane on :%d (/serve/fleet snapshot+watch, max_subscribers=%d, "
+            "Serving plane on :%d (/serve/fleet snapshot+watch%s, max_subscribers=%d, "
             "queue_depth=%d, compact_horizon=%d, io_threads=%d)",
-            self.server.port, self.config.max_subscribers,
+            self.server.port,
+            ", /serve/analytics" if self.analytics is not None else "",
+            self.config.max_subscribers,
             self.config.queue_depth, self.config.compact_horizon,
             getattr(self.config, "io_threads", 1),
         )

@@ -286,6 +286,11 @@ class FleetView:
         # invalidates by bumping rv) — a msgpack snapshot read must not
         # evict the JSON body, or an A/B-consuming tier would thrash both
         self._snapshot_cache: Dict[str, Tuple[int, bytes]] = {}
+        # rv-keyed per-kind object tables (snapshot_tables): ONE object
+        # walk per rv shared by every per-kind consumer — the health
+        # plane's phase collector and the analytics encoder both read
+        # this instead of each re-classifying the full snapshot per tick
+        self._tables_cache: Optional[Tuple[int, Dict[str, List[Dict[str, Any]]]]] = None
         # post-publish wakeups OUTSIDE the lock (the broadcast event
         # loop's one-wakeup-per-publish signal; never the per-waiter
         # notify_all herd)
@@ -384,6 +389,10 @@ class FleetView:
             # subscriber's read fills (and memoizes) exactly what it pulls
             self._frames = {variant: [None] * len(journal) for variant in FRAME_VARIANTS}
             self._snapshot_cache = {}
+            # restore() can re-seed the SAME rv with different objects
+            # (replay re-seeding across a rebase hole) — rv keying alone
+            # would serve the old incarnation's tables
+            self._tables_cache = None
             # tokens older than the preloaded tail 410 — the compaction-
             # horizon contract, now spanning incarnations
             self._oldest_rv = journal[0].rv - 1 if journal else rv
@@ -752,6 +761,32 @@ class FleetView:
     def object_count(self) -> int:
         with self._cond:
             return len(self._objects)
+
+    def snapshot_tables(self) -> Tuple[int, Dict[str, List[Dict[str, Any]]]]:
+        """``(rv, {kind: [objects]})`` — the bulk per-kind snapshot
+        accessor: one object walk, grouped by kind, built at most once
+        per rv and shared by reference across consumers (the health
+        plane's phase collector and the analytics encoder both read the
+        SAME walk instead of re-classifying the snapshot each). Objects
+        are the live references (replaced on write, never mutated) and
+        the lists/dict are shared — treat the whole result as
+        immutable. The grouping happens OUTSIDE the lock (O(fleet) work
+        must not stall publishes); a publish landing mid-build just
+        means the next read rebuilds at the new rv."""
+        with self._cond:
+            cached = self._tables_cache
+            if cached is not None and cached[0] == self._rv:
+                return cached
+            rv = self._rv
+            items = list(self._objects.items())
+        tables: Dict[str, List[Dict[str, Any]]] = {}
+        for (kind, _key), obj in items:
+            tables.setdefault(kind, []).append(obj)
+        result = (rv, tables)
+        with self._cond:
+            if self._rv == rv:
+                self._tables_cache = result
+        return result
 
     def freshness(self) -> Dict[str, Any]:
         """The local view's freshness watermark (the /debug/freshness

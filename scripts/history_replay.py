@@ -10,9 +10,17 @@ replay at a historical rv (the offline twin of ``GET /serve/fleet?at=``)
 and ``--out FILE`` writes the canonical snapshot for diffing two
 captures or pinning a regression fixture.
 
+``--analytics`` appends a terminal slice/quorum/capacity report computed
+by the analytics kernels (the same columnar path behind
+``/serve/analytics``) from the replayed state; ``--scenarios`` adds
+what-if rows to it (JSON array, the /serve/analytics vocabulary —
+``baseline`` / ``drain_cluster`` / ``cordon_nodes``).
+
     python scripts/history_replay.py --wal /var/lib/k8s-watcher-tpu/history
     python scripts/history_replay.py --wal ./capture --at 48211 --out snap.json
     python scripts/history_replay.py --wal ./capture --verify
+    python scripts/history_replay.py --wal ./capture --analytics \\
+        --scenarios '[{"kind": "drain_cluster", "cluster": "us-east1-v5p"}]'
 """
 
 from __future__ import annotations
@@ -41,7 +49,19 @@ def main() -> int:
         "--verify", action="store_true",
         help="replay twice and fail unless the terminal snapshots are byte-identical",
     )
+    parser.add_argument(
+        "--analytics", action="store_true",
+        help="append a terminal slice/quorum/capacity report (analytics kernels)",
+    )
+    parser.add_argument(
+        "--scenarios", default=None,
+        help="JSON array of what-if scenarios for --analytics "
+             "(the /serve/analytics vocabulary)",
+    )
     args = parser.parse_args()
+    if args.scenarios is not None and not args.analytics:
+        print("ERROR: --scenarios requires --analytics", file=sys.stderr)
+        return 2
     wal_dir = Path(args.wal)
     if not wal_dir.is_dir():
         print(f"ERROR: {wal_dir} is not a directory", file=sys.stderr)
@@ -63,10 +83,44 @@ def main() -> int:
             print(json.dumps({"first": digest, "second": second}, indent=2))
             return 1
         digest["verified_deterministic"] = True
+    # --out and --analytics both need the terminal objects: ONE shared
+    # replay (a multi-GB capture's replay is the dominant cost here)
+    terminal = None
+    if args.out or args.analytics:
+        terminal = replay_wal(wal_dir, at=args.at)
     if args.out:
-        result = replay_wal(wal_dir, at=args.at)
-        Path(args.out).write_bytes(canonical_snapshot(result.rv, result.objects) + b"\n")
+        Path(args.out).write_bytes(
+            canonical_snapshot(terminal.rv, terminal.objects) + b"\n"
+        )
         digest["out"] = args.out
+    if args.analytics:
+        from k8s_watcher_tpu.analytics import (  # noqa: E402
+            Scenario,
+            ScenarioError,
+            parse_scenarios,
+            verdicts_from_objects,
+        )
+
+        scenarios = [Scenario("baseline")]
+        if args.scenarios:
+            try:
+                scenarios = parse_scenarios(
+                    json.loads(args.scenarios), max_scenarios=64
+                )
+            except (ValueError, ScenarioError) as exc:
+                print(f"ERROR: bad --scenarios: {exc}", file=sys.stderr)
+                return 2
+        report = verdicts_from_objects(terminal.objects, scenarios)
+        digest["analytics"] = report
+        if not report["crosscheck"]["ok"]:
+            print(
+                "ERROR: analytics cross-check failed — the vectorized slice "
+                "aggregates diverge from the capture's incremental counters "
+                f"on {report['crosscheck']['mismatched'][:8]}",
+                file=sys.stderr,
+            )
+            print(json.dumps(digest, indent=2))
+            return 1
     print(json.dumps(digest, indent=2))
     return 0
 

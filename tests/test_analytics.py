@@ -1,0 +1,837 @@
+"""Analytics plane: backend seam, columnar encoder, kernels, what-if
+engine, HTTP surface, and bulk replay analytics.
+
+The structural invariants under test:
+
+- the jnp/numpy backend seam resolves per config and DEGRADES (never
+  raises) when jax is absent/broken — and the two backends' kernels are
+  bit-identical (the golden parity suite);
+- the encoder's incremental path (delta folds) always equals a fresh
+  full-snapshot encode, with STABLE interning across both;
+- the vectorized slice rollup equals the tracker-carried incremental
+  counters exactly, and a planted divergence is DETECTED;
+- the batched scenario-axis what-if equals the pure-Python dict-walk
+  reference verdict-for-verdict (two independent implementations);
+- /serve/analytics rides the serve plane's bearer + codec contracts;
+- batched WAL-replay analytics equal N sequential folds.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+import requests
+
+from k8s_watcher_tpu.analytics import (
+    FleetEncoder,
+    FleetKernels,
+    Scenario,
+    ScenarioError,
+    batched_replay_verdicts,
+    comparable,
+    crosscheck,
+    evaluate_scenarios,
+    parse_scenarios,
+    python_reference_verdicts,
+    resolve_backend,
+    sequential_replay_verdicts,
+    tables_from_objects,
+    verdicts_from_objects,
+)
+from k8s_watcher_tpu.analytics import backend as backend_mod
+from k8s_watcher_tpu.analytics.encode import Interner
+from k8s_watcher_tpu.analytics.plane import AnalyticsPlane
+from k8s_watcher_tpu.config.schema import AnalyticsConfig, AppConfig, SchemaError
+from k8s_watcher_tpu.metrics import MetricsRegistry
+from k8s_watcher_tpu.serve.server import ServeServer
+from k8s_watcher_tpu.serve.view import FleetView, SubscriptionHub
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+# -- fixtures ----------------------------------------------------------------
+
+
+def worker(slice_idx, i, *, up=True, node=None, node_ready=True):
+    return {
+        "name": f"s{slice_idx}-w{i}", "worker_index": i,
+        "phase": "Running" if up else "Pending",
+        "ready": up, "restarts": 0,
+        "node": node or f"node-{slice_idx}-{i}", "node_ready": node_ready,
+    }
+
+
+def slice_obj(idx, *, ready, expected=4, observed=None, cluster=None, chips=4,
+              workers=None):
+    observed = observed if observed is not None else (len(workers) if workers is not None else expected)
+    if workers is None:
+        workers = [worker(idx, i, up=i < ready) for i in range(observed)]
+    prefix = f"{cluster}/" if cluster else ""
+    key = f"{prefix}default/slice-{idx}"
+    obj = {
+        "kind": "slice", "key": key, "slice": key,
+        "expected_workers": expected, "observed_workers": observed,
+        "ready_workers": ready, "chips_per_worker": chips,
+        "phase": "Ready" if ready == expected else "Degraded",
+        "workers": workers,
+    }
+    if cluster:
+        obj["cluster"] = cluster
+    return obj
+
+
+def pod_obj(key, *, phase="Running", ready=True, node=None, cluster=None):
+    obj = {"kind": "pod", "key": key, "phase": phase, "ready": ready, "node": node}
+    if cluster:
+        obj["cluster"] = cluster
+    return obj
+
+
+def small_fleet_tables():
+    """Two local slices (one with quorum, one degraded below it) + one
+    merged cluster with a healthy and a hopeless slice."""
+    return {
+        "pod": [
+            pod_obj(f"p-{i}", node=f"node-0-{i}") for i in range(4)
+        ] + [
+            pod_obj("p-b0", phase="Pending", ready=False, node="node-1-0"),
+            pod_obj("ca/p-0", node="ca-n0", cluster="ca"),
+        ],
+        "slice": [
+            slice_obj(0, ready=4),                      # local, quorum
+            slice_obj(1, ready=2),                      # local, degraded (no quorum)
+            slice_obj(2, ready=4, cluster="ca"),        # merged, quorum
+            slice_obj(3, ready=1, cluster="ca"),        # merged, hopeless
+        ],
+        "probe": [{"kind": "probe", "key": "local", "ok": True}],
+    }
+
+
+SCENARIOS = [
+    Scenario("baseline"),
+    Scenario("drain_cluster", cluster="ca"),
+    Scenario("drain_cluster", cluster=""),
+    Scenario("cordon_nodes", nodes=("node-0-0", "missing-node")),
+]
+
+
+# -- backend seam ------------------------------------------------------------
+
+
+class TestBackend:
+    def test_numpy_pin_never_touches_jax(self):
+        be = resolve_backend("numpy")
+        assert be.name == "numpy" and be.xp is np
+
+    def test_auto_prefers_jax_when_available(self):
+        be = resolve_backend("auto")
+        assert be.name == ("jax" if backend_mod.jax_available() else "numpy")
+
+    def test_unknown_preference_rejected(self):
+        with pytest.raises(ValueError):
+            resolve_backend("tpu")
+
+    def test_broken_jax_degrades_to_numpy(self, monkeypatch):
+        # the stripped-environment simulation: the import hook raises,
+        # so BOTH auto and the explicit jax pin must degrade, not raise
+        monkeypatch.setattr(
+            backend_mod, "_import_jax",
+            lambda: (_ for _ in ()).throw(ImportError("no jax in this build")),
+        )
+        backend_mod.reset_probe_cache()
+        try:
+            assert resolve_backend("auto").name == "numpy"
+            assert resolve_backend("jax").name == "numpy"
+            assert backend_mod.jax_available() is False
+        finally:
+            backend_mod.reset_probe_cache()
+
+    def test_segment_sum_shapes_and_dtype(self):
+        for pref in ("numpy", "auto"):
+            be = resolve_backend(pref)
+            ids = np.array([0, 2, 0, 1], dtype=np.int32)
+            flat = be.to_numpy(be.segment_sum(np.array([1, 1, 1, 1]), ids, 4))
+            assert flat.tolist() == [2, 1, 1, 0]
+            batched = be.to_numpy(
+                be.segment_sum(np.array([[1, 1, 1, 1], [2, 0, 0, 0]]), ids, 3)
+            )
+            assert batched.tolist() == [[2, 1, 1], [2, 0, 0]]
+
+
+# -- interner / encoder ------------------------------------------------------
+
+
+class TestEncoder:
+    def test_interner_stable_and_lookup_never_mints(self):
+        interner = Interner()
+        a = interner.code("a")
+        assert interner.code("a") == a
+        assert interner.lookup("never-seen") is None
+        assert len(interner) == 1
+        assert interner.name(a) == "a"
+
+    def test_incremental_equals_full_reset(self):
+        tables = small_fleet_tables()
+        full = FleetEncoder()
+        full.reset(tables)
+        incremental = FleetEncoder()
+        for kind in ("pod", "slice"):
+            for obj in tables[kind]:
+                incremental.apply(kind, obj["key"], obj)
+        kernels = FleetKernels(resolve_backend("numpy"))
+        assert (
+            evaluate_scenarios(full.columns(), SCENARIOS, kernels)
+            == evaluate_scenarios(incremental.columns(), SCENARIOS, kernels)
+        )
+
+    def test_swap_remove_delete_keeps_rows_consistent(self):
+        enc = FleetEncoder()
+        for i in range(5):
+            enc.apply("pod", f"p{i}", pod_obj(f"p{i}", node=f"n{i}"))
+        enc.apply("pod", "p1", None)  # middle delete: p4 swaps into row 1
+        enc.apply("pod", "p4", pod_obj("p4", phase="Pending", ready=False, node="n4"))
+        cols = enc.columns()
+        assert cols.n_pods == 4
+        row_nodes = sorted(cols.nodes.name(c) for c in cols.pod_node)
+        assert row_nodes == ["n0", "n2", "n3", "n4"]
+        # the re-upserted moved row took the update (not a stale row)
+        from k8s_watcher_tpu.analytics.encode import POD_PHASE_CODE
+
+        p4_row = list(cols.pod_node).index(cols.nodes.lookup("n4"))
+        assert cols.pod_phase[p4_row] == POD_PHASE_CODE["Pending"]
+
+    def test_delete_absent_key_is_noop(self):
+        enc = FleetEncoder()
+        enc.apply("pod", "ghost", None)
+        enc.apply("slice", "ghost", None)
+        assert enc.columns().n_pods == 0
+
+    def test_interners_survive_reset(self):
+        enc = FleetEncoder()
+        enc.apply("pod", "p0", pod_obj("p0", node="stable-node"))
+        code = enc.columns().nodes.lookup("stable-node")
+        enc.reset({"pod": [pod_obj("p1", node="other"), pod_obj("p2", node="stable-node")]})
+        cols = enc.columns()
+        assert cols.nodes.lookup("stable-node") == code
+        assert cols.n_pods == 2
+
+    def test_columns_cached_until_dirty(self):
+        enc = FleetEncoder()
+        enc.apply("pod", "p0", pod_obj("p0"))
+        first = enc.columns()
+        assert enc.columns() is first
+        enc.apply("pod", "p1", pod_obj("p1"))
+        assert enc.columns() is not first
+
+    def test_ignored_kinds_change_nothing(self):
+        enc = FleetEncoder()
+        enc.apply("probe", "local", {"kind": "probe", "key": "local"})
+        assert enc.columns().n_pods == 0 and enc.columns().n_slices == 0
+
+
+# -- kernels -----------------------------------------------------------------
+
+
+class TestKernels:
+    def test_rollup_matches_hand_counts(self):
+        enc = FleetEncoder()
+        enc.reset(small_fleet_tables())
+        cols = enc.columns()
+        rollup = FleetKernels(resolve_backend("numpy")).slice_rollup(cols)
+        by_name = dict(zip(cols.slice_names, rollup.ready.tolist()))
+        assert by_name["default/slice-0"] == 4
+        assert by_name["default/slice-1"] == 2
+        assert by_name["ca/default/slice-3"] == 1
+        assert rollup.observed.sum() == 16
+        assert rollup.chips_ready.tolist() == [4 * r for r in rollup.ready.tolist()]
+
+    def test_crosscheck_detects_planted_divergence(self):
+        tables = small_fleet_tables()
+        tables["slice"][0] = dict(tables["slice"][0], ready_workers=3)  # lie
+        enc = FleetEncoder()
+        enc.reset(tables)
+        cols = enc.columns()
+        kernels = FleetKernels(resolve_backend("numpy"))
+        check = crosscheck(cols, kernels.slice_rollup(cols))
+        assert check["ok"] is False
+        assert check["mismatched"] == ["default/slice-0"]
+
+    def test_empty_fleet_kernels(self):
+        enc = FleetEncoder()
+        cols = enc.columns()
+        kernels = FleetKernels(resolve_backend("numpy"))
+        out = evaluate_scenarios(cols, SCENARIOS, kernels)
+        assert out["baseline"]["slices"] == 0
+        assert all(s["slices_losing_quorum"] == [] for s in out["scenarios"])
+        assert crosscheck(cols, kernels.slice_rollup(cols))["ok"] is True
+
+    def test_pod_phase_counts_per_cluster(self):
+        enc = FleetEncoder()
+        enc.reset(small_fleet_tables())
+        cols = enc.columns()
+        counts = FleetKernels(resolve_backend("numpy")).pod_phase_counts(cols)
+        from k8s_watcher_tpu.analytics.encode import POD_PHASE_CODE
+
+        local = cols.clusters.lookup("")
+        ca = cols.clusters.lookup("ca")
+        assert counts[local, POD_PHASE_CODE["Running"]] == 4
+        assert counts[local, POD_PHASE_CODE["Pending"]] == 1
+        assert counts[ca, POD_PHASE_CODE["Running"]] == 1
+        assert counts.sum() == 6
+
+
+# -- golden parity (jax == numpy, exactly) -----------------------------------
+
+
+class TestBackendParity:
+    def _big_tables(self):
+        rng = np.random.default_rng(11)
+        pods, slices = [], []
+        for s in range(60):
+            cluster = (None, "east", "west")[s % 3]
+            n_workers = int(rng.integers(1, 6))
+            ready = int(rng.integers(0, n_workers + 1))
+            expected = None if s % 5 == 0 else n_workers
+            workers = [
+                worker(s, i, up=i < ready, node=f"n-{s % 17}-{i % 3}")
+                for i in range(n_workers)
+            ]
+            slices.append(slice_obj(
+                s, ready=ready, expected=expected, observed=n_workers,
+                cluster=cluster, chips=int(rng.integers(1, 9)), workers=workers,
+            ))
+            for i in range(n_workers):
+                pods.append(pod_obj(
+                    f"p-{s}-{i}", phase="Running" if i < ready else "Failed",
+                    ready=i < ready, node=f"n-{s % 17}-{i % 3}", cluster=cluster,
+                ))
+        return {"pod": pods, "slice": slices}
+
+    def test_all_kernels_bit_identical_across_backends(self):
+        if not backend_mod.jax_available():
+            pytest.skip("jax not importable in this environment")
+        tables = self._big_tables()
+        scenarios = [
+            Scenario("baseline"),
+            Scenario("drain_cluster", cluster="east"),
+            Scenario("drain_cluster", cluster=""),
+            Scenario("cordon_nodes", nodes=tuple(f"n-{i}-0" for i in range(17))),
+            Scenario("cordon_nodes", nodes=("n-3-1", "ghost")),
+        ]
+        results = {}
+        for name in ("jax", "numpy"):
+            enc = FleetEncoder()
+            enc.reset(tables)
+            cols = enc.columns()
+            kernels = FleetKernels(resolve_backend(name))
+            rollup = kernels.slice_rollup(cols)
+            results[name] = {
+                "rollup": [rollup.observed.tolist(), rollup.ready.tolist(),
+                           rollup.chips_ready.tolist()],
+                "phase": kernels.pod_phase_counts(cols).tolist(),
+                "verdicts": evaluate_scenarios(cols, scenarios, kernels),
+            }
+        assert results["jax"] == results["numpy"]
+
+    def test_numpy_path_equals_jax_results_when_jax_is_absent(self, monkeypatch):
+        """The jax-absent satellite: capture the jax kernels' results,
+        then simulate a stripped environment via a monkeypatched import
+        failure and assert the forced-numpy resolution reproduces them
+        exactly."""
+        if not backend_mod.jax_available():
+            pytest.skip("jax not importable in this environment")
+        tables = self._big_tables()
+        enc = FleetEncoder()
+        enc.reset(tables)
+        cols = enc.columns()
+        golden = evaluate_scenarios(
+            cols, SCENARIOS, FleetKernels(resolve_backend("jax"))
+        )
+        monkeypatch.setattr(
+            backend_mod, "_import_jax",
+            lambda: (_ for _ in ()).throw(ImportError("stripped environment")),
+        )
+        backend_mod.reset_probe_cache()
+        try:
+            degraded = resolve_backend("auto")
+            assert degraded.name == "numpy"
+            assert evaluate_scenarios(cols, SCENARIOS, FleetKernels(degraded)) == golden
+        finally:
+            backend_mod.reset_probe_cache()
+
+    def test_reference_fold_equals_array_path(self):
+        tables = self._big_tables()
+        enc = FleetEncoder()
+        enc.reset(tables)
+        scenarios = SCENARIOS + [
+            Scenario("drain_cluster", cluster="west"),
+        ]
+        out = evaluate_scenarios(
+            enc.columns(), scenarios, FleetKernels(resolve_backend("auto"))
+        )
+        assert out == python_reference_verdicts(tables, scenarios)
+
+
+# -- scenario vocabulary -----------------------------------------------------
+
+
+class TestScenarios:
+    def test_parse_round_trip(self):
+        parsed = parse_scenarios(
+            [{"kind": "baseline"},
+             {"kind": "drain_cluster", "cluster": "a"},
+             {"kind": "cordon_nodes", "nodes": ["n1", "n2"]}],
+            max_scenarios=4,
+        )
+        assert [s.to_wire() for s in parsed] == [
+            {"kind": "baseline"},
+            {"kind": "drain_cluster", "cluster": "a"},
+            {"kind": "cordon_nodes", "nodes": ["n1", "n2"]},
+        ]
+
+    @pytest.mark.parametrize("raw", [
+        "not-a-list",
+        [],
+        [{"kind": "reboot_everything"}],
+        [{"kind": "drain_cluster"}],
+        [{"kind": "cordon_nodes", "nodes": []}],
+        [{"kind": "cordon_nodes", "nodes": ["ok", 7]}],
+        [{"kind": "baseline", "extra": 1}],
+        # cross-kind fields are errors, never silently dropped — the
+        # operator expected combined semantics this vocabulary lacks
+        [{"kind": "drain_cluster", "cluster": "a", "nodes": ["n1"]}],
+        [{"kind": "cordon_nodes", "nodes": ["n1"], "cluster": "a"}],
+        [{"kind": "baseline", "cluster": "a"}],
+        [{"kind": "baseline"}] * 3,
+    ])
+    def test_parse_rejections(self, raw):
+        with pytest.raises(ScenarioError):
+            parse_scenarios(raw, max_scenarios=2)
+
+    def test_quorum_semantics(self):
+        tables = small_fleet_tables()
+        enc = FleetEncoder()
+        enc.reset(tables)
+        kernels = FleetKernels(resolve_backend("numpy"))
+        out = evaluate_scenarios(
+            enc.columns(),
+            [Scenario("drain_cluster", cluster="ca"),
+             Scenario("cordon_nodes", nodes=("node-0-0", "missing-node"))],
+            kernels,
+        )
+        drain, cordon = out["scenarios"]
+        # only the HEALTHY merged slice loses quorum — slice-3 (1/4
+        # ready) had none to lose
+        assert drain["slices_losing_quorum"] == ["ca/default/slice-2"]
+        assert cordon["slices_losing_quorum"] == ["default/slice-0"]
+        assert cordon["unknown_nodes"] == ["missing-node"]
+        assert out["baseline"]["slices_with_quorum"] == 2
+
+    def test_need_source_is_workers_not_the_drifted_counter(self):
+        """A capture whose observed_workers counter drifted from its
+        workers[] list (the state the cross-check exists to catch) must
+        not make the array path and the dict-walk oracle disagree: both
+        derive quorum need from the membership the masks act on."""
+        workers = [worker(5, i, up=True) for i in range(4)]
+        tables = {"slice": [slice_obj(
+            5, ready=4, expected=None, observed=3,  # counter lies: 3 != 4
+            workers=workers,
+        )], "pod": []}
+        enc = FleetEncoder()
+        enc.reset(tables)
+        scenarios = [Scenario("cordon_nodes", nodes=("node-5-0",))]
+        out = evaluate_scenarios(
+            enc.columns(), scenarios, FleetKernels(resolve_backend("numpy"))
+        )
+        assert out == python_reference_verdicts(tables, scenarios)
+        # and with need == 4 (the real membership), losing one IS a loss
+        assert out["scenarios"][0]["slices_losing_quorum"] == ["default/slice-5"]
+
+    def test_expected_unknown_falls_back_to_observed(self):
+        workers = [worker(9, i, up=True) for i in range(3)]
+        tables = {"slice": [slice_obj(9, ready=3, expected=None, observed=3,
+                                      workers=workers)], "pod": []}
+        enc = FleetEncoder()
+        enc.reset(tables)
+        out = evaluate_scenarios(
+            enc.columns(), [Scenario("cordon_nodes", nodes=("node-9-0",))],
+            FleetKernels(resolve_backend("numpy")),
+        )
+        assert out["baseline"]["slices_with_quorum"] == 1
+        assert out["scenarios"][0]["slices_losing_quorum"] == ["default/slice-9"]
+
+
+# -- the live plane ----------------------------------------------------------
+
+
+def _seed_view(view):
+    tables = small_fleet_tables()
+    items = [("pod", o["key"], o) for o in tables["pod"]]
+    items += [("slice", o["key"], o) for o in tables["slice"]]
+    view.apply_batch(items)
+
+
+class TestAnalyticsPlane:
+    def _plane(self, view=None, metrics=None, **overrides):
+        view = view or FleetView()
+        config = AnalyticsConfig(enabled=True, backend="numpy", **overrides)
+        return AnalyticsPlane(config, view, metrics=metrics), view
+
+    def test_summary_and_evaluate_over_live_view(self):
+        metrics = MetricsRegistry()
+        plane, view = self._plane(metrics=metrics)
+        _seed_view(view)
+        summary = plane.summary()
+        assert summary["fleet"]["slices"] == 4
+        assert summary["fleet"]["slices_with_quorum"] == 2
+        assert summary["crosscheck"]["ok"] is True
+        assert summary["rv"] == view.rv
+        body = plane.evaluate([{"kind": "drain_cluster", "cluster": "ca"}])
+        assert body["scenarios"][0]["slices_losing_quorum"] == ["ca/default/slice-2"]
+        assert metrics.counter("analytics_requests").value == 2
+        assert metrics.counter("analytics_scenarios_evaluated").value == 1
+
+    def test_refresh_is_incremental_between_requests(self):
+        metrics = MetricsRegistry()
+        plane, view = self._plane(metrics=metrics)
+        _seed_view(view)
+        plane.summary()
+        assert metrics.counter("analytics_encoder_resets").value == 1
+        view.apply("pod", "late-pod", pod_obj("late-pod", node="n-late"))
+        summary = plane.summary()
+        # the second request folded the delta — no full re-encode
+        assert metrics.counter("analytics_encoder_resets").value == 1
+        assert metrics.counter("analytics_encoder_deltas").value == 1
+        assert summary["fleet"]["pods"] == 7
+
+    def test_horizon_fall_behind_triggers_full_reencode(self):
+        metrics = MetricsRegistry()
+        view = FleetView(compact_horizon=8)
+        plane, _ = self._plane(view=view, metrics=metrics)
+        _seed_view(view)
+        plane.summary()
+        for i in range(40):  # churn far past the tiny horizon
+            view.apply("pod", f"churn-{i % 4}", pod_obj(f"churn-{i % 4}", node=f"n{i}"))
+        summary = plane.summary()
+        assert metrics.counter("analytics_encoder_resets").value == 2
+        assert summary["fleet"]["pods"] == 6 + 4
+
+    def test_view_restart_triggers_full_reencode(self):
+        metrics = MetricsRegistry()
+        plane, view = self._plane(metrics=metrics)
+        _seed_view(view)
+        assert plane.summary()["fleet"]["pods"] == 6
+        replacement = {("pod", "only"): pod_obj("only")}
+        view.restore(instance="0" * 12, rv=100, objects=replacement, journal=[])
+        summary = plane.summary()
+        assert summary["fleet"]["pods"] == 1 and summary["rv"] == 100
+        assert metrics.counter("analytics_encoder_resets").value == 2
+
+    def test_crosscheck_failure_is_surfaced_and_counted(self):
+        metrics = MetricsRegistry()
+        plane, view = self._plane(metrics=metrics)
+        view.apply("slice", "default/liar", dict(
+            slice_obj(0, ready=4), key="default/liar", ready_workers=2,
+        ))
+        summary = plane.summary()
+        assert summary["crosscheck"]["ok"] is False
+        assert summary["crosscheck"]["mismatched"] == ["default/liar"]
+        assert metrics.counter("analytics_crosscheck_failures").value == 1
+
+    def test_crosscheck_can_be_disabled(self):
+        plane, view = self._plane(crosscheck=False)
+        _seed_view(view)
+        assert "crosscheck" not in plane.summary()
+
+    def test_max_scenarios_enforced(self):
+        plane, view = self._plane(max_scenarios=2)
+        _seed_view(view)
+        with pytest.raises(ScenarioError):
+            plane.evaluate([{"kind": "baseline"}] * 3)
+
+
+# -- snapshot_tables (the shared bulk accessor) ------------------------------
+
+
+class TestSnapshotTables:
+    def test_grouped_and_cached_per_rv(self):
+        view = FleetView()
+        _seed_view(view)
+        rv, tables = view.snapshot_tables()
+        assert rv == view.rv
+        assert {k: len(v) for k, v in tables.items()} == {"pod": 6, "slice": 4}
+        # same rv -> the SAME walk (shared by reference)
+        assert view.snapshot_tables()[1] is tables
+        view.apply("pod", "new", pod_obj("new"))
+        rv2, tables2 = view.snapshot_tables()
+        assert rv2 == rv + 1 and tables2 is not tables
+        assert len(tables2["pod"]) == 7
+
+    def test_restore_invalidates_cache(self):
+        view = FleetView()
+        view.apply("pod", "a", pod_obj("a"))
+        rv, tables = view.snapshot_tables()
+        # re-seed the SAME rv with different objects (replay re-seeding)
+        view.restore(instance=view.instance, rv=rv,
+                     objects={("pod", "b"): pod_obj("b")}, journal=[])
+        _rv2, tables2 = view.snapshot_tables()
+        assert tables2 is not tables
+        assert tables2["pod"][0]["key"] == "b"
+
+
+# -- HTTP surface ------------------------------------------------------------
+
+
+class TestAnalyticsHTTP:
+    def _server(self, analytics=None, token=None):
+        view = FleetView()
+        hub = SubscriptionHub(view, max_subscribers=4, queue_depth=16)
+        plane = None
+        if analytics:
+            _seed_view(view)
+            plane = AnalyticsPlane(
+                AnalyticsConfig(enabled=True, backend="numpy", max_scenarios=4),
+                view,
+            )
+        server = ServeServer(
+            view, hub, host="127.0.0.1", port=0, analytics=plane, auth_token=token,
+        ).start()
+        return server, view
+
+    def test_route_404_when_disabled(self):
+        server, _ = self._server(analytics=False)
+        try:
+            r = requests.get(
+                f"http://127.0.0.1:{server.port}/serve/analytics", timeout=5
+            )
+            assert r.status_code == 404
+            assert "analytics" in r.json()["error"]
+        finally:
+            server.stop()
+
+    def test_summary_scenarios_and_sugar_params(self):
+        server, _ = self._server(analytics=True)
+        base = f"http://127.0.0.1:{server.port}/serve/analytics"
+        try:
+            summary = requests.get(base, timeout=5).json()
+            assert summary["fleet"]["slices"] == 4
+            assert summary["scenario_kinds"] == [
+                "baseline", "drain_cluster", "cordon_nodes",
+            ]
+            body = requests.get(
+                base,
+                params={"scenarios": json.dumps(
+                    [{"kind": "drain_cluster", "cluster": "ca"}]
+                )},
+                timeout=5,
+            ).json()
+            assert body["scenarios"][0]["slices_losing_quorum"] == ["ca/default/slice-2"]
+            sugar = requests.get(
+                base, params={"drain_cluster": "ca"}, timeout=5
+            ).json()
+            assert sugar["scenarios"] == body["scenarios"]
+            cordon = requests.get(
+                base, params={"cordon_nodes": "node-0-0,node-0-1"}, timeout=5
+            ).json()
+            assert cordon["scenarios"][0]["slices_losing_quorum"] == ["default/slice-0"]
+        finally:
+            server.stop()
+
+    def test_blank_drain_cluster_means_local(self):
+        # "" names the LOCAL cluster: the blank query value must reach
+        # the scenario parser (keep_blank_values), never silently fall
+        # through to the summary body
+        server, _ = self._server(analytics=True)
+        try:
+            body = requests.get(
+                f"http://127.0.0.1:{server.port}/serve/analytics?drain_cluster=",
+                timeout=5,
+            ).json()
+            verdict = body["scenarios"][0]
+            assert verdict["scenario"] == {"kind": "drain_cluster", "cluster": ""}
+            assert verdict["slices_losing_quorum"] == ["default/slice-0"]
+        finally:
+            server.stop()
+
+    def test_bad_requests_400(self):
+        server, _ = self._server(analytics=True)
+        base = f"http://127.0.0.1:{server.port}/serve/analytics"
+        try:
+            assert requests.get(
+                base, params={"scenarios": "not json"}, timeout=5
+            ).status_code == 400
+            assert requests.get(
+                base, params={"scenarios": json.dumps([{"kind": "nope"}])}, timeout=5
+            ).status_code == 400
+            over = requests.get(
+                base,
+                params={"scenarios": json.dumps([{"kind": "baseline"}] * 5)},
+                timeout=5,
+            )
+            assert over.status_code == 400
+            assert "max_scenarios" in over.json()["error"]
+        finally:
+            server.stop()
+
+    def test_bearer_gate(self):
+        server, _ = self._server(analytics=True, token="secret")
+        base = f"http://127.0.0.1:{server.port}/serve/analytics"
+        try:
+            assert requests.get(base, timeout=5).status_code == 401
+            ok = requests.get(
+                base, headers={"Authorization": "Bearer secret"}, timeout=5
+            )
+            assert ok.status_code == 200
+        finally:
+            server.stop()
+
+    def test_msgpack_negotiation_decodes_equal(self):
+        msgpack = pytest.importorskip("msgpack")
+        server, _ = self._server(analytics=True)
+        base = f"http://127.0.0.1:{server.port}/serve/analytics"
+        try:
+            plain = requests.get(base, timeout=5).json()
+            mp = requests.get(
+                base, headers={"Accept": "application/x-msgpack"}, timeout=5
+            )
+            assert mp.headers["Content-Type"] == "application/x-msgpack"
+            assert msgpack.unpackb(mp.content, raw=False) == plain
+        finally:
+            server.stop()
+
+
+# -- bulk replay analytics ---------------------------------------------------
+
+
+def _write_wal(tmp_path):
+    from k8s_watcher_tpu.history import HistoryStore
+
+    wal_dir = tmp_path / "wal"
+    view = FleetView()
+    store = HistoryStore(str(wal_dir), fsync="never")
+    store.recover()
+    store.open(view.instance)
+    view.attach_history(store)
+    _seed_view(view)
+    # churn a little so the capture holds more than one batch
+    for i in range(10):
+        view.apply("pod", "churny", pod_obj("churny", node=f"n-{i}"))
+    view.apply("pod", "churny", None)
+    store.close()
+    return wal_dir
+
+
+class TestReplayAnalytics:
+    def test_batched_equals_sequential(self, tmp_path):
+        wal_dir = _write_wal(tmp_path)
+        batched = batched_replay_verdicts(wal_dir, SCENARIOS)
+        sequential = sequential_replay_verdicts(wal_dir, SCENARIOS)
+        assert comparable(batched) == comparable(sequential)
+        assert batched["rv_mismatches"] == 0
+        assert batched["crosscheck"]["ok"] is True
+        assert batched["baseline"]["slices"] == 4
+
+    def test_at_rv_time_travel(self, tmp_path):
+        wal_dir = _write_wal(tmp_path)
+        full = batched_replay_verdicts(wal_dir, [Scenario("baseline")])
+        early = batched_replay_verdicts(
+            wal_dir, [Scenario("baseline")], at=full["rv"] - 1
+        )
+        assert early["rv"] == full["rv"] - 1
+        # the churny pod still existed one delta before the end
+        assert early["baseline"]["pods"] == full["baseline"]["pods"] + 1
+
+    def test_verdicts_from_objects_shape(self):
+        tables = small_fleet_tables()
+        objects = {
+            (o["kind"], o["key"]): o
+            for kind in ("pod", "slice") for o in tables[kind]
+        }
+        out = verdicts_from_objects(objects, SCENARIOS)
+        assert out["crosscheck"]["ok"] is True
+        assert comparable(out) == comparable(
+            python_reference_verdicts(tables_from_objects(objects), SCENARIOS)
+        )
+
+    def test_history_replay_script_round_trip(self, tmp_path):
+        """The --analytics satellite: the CLI replays a capture and its
+        report equals the library's batched verdicts for the same
+        scenarios (round trip through argv/JSON)."""
+        wal_dir = _write_wal(tmp_path)
+        scenarios_json = json.dumps(
+            [{"kind": "baseline"}, {"kind": "drain_cluster", "cluster": "ca"}]
+        )
+        proc = subprocess.run(
+            [sys.executable, str(REPO_ROOT / "scripts" / "history_replay.py"),
+             "--wal", str(wal_dir), "--verify", "--analytics",
+             "--scenarios", scenarios_json],
+            capture_output=True, text=True, timeout=120, cwd=str(REPO_ROOT),
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        digest = json.loads(proc.stdout)
+        assert digest["verified_deterministic"] is True
+        report = digest["analytics"]
+        assert report["crosscheck"]["ok"] is True
+        expected = batched_replay_verdicts(
+            wal_dir,
+            [Scenario("baseline"), Scenario("drain_cluster", cluster="ca")],
+        )
+        assert comparable(report) == comparable(expected)
+
+
+# -- config schema -----------------------------------------------------------
+
+
+class TestAnalyticsSchema:
+    BASE = {
+        "watcher": {}, "clusterapi": {}, "kubernetes": {}, "tpu": {}, "state": {},
+        "serve": {"enabled": True},
+    }
+
+    def test_defaults(self):
+        config = AppConfig.from_raw(self.BASE, "test")
+        assert config.analytics.enabled is False
+        assert config.analytics.backend == "auto"
+        assert config.analytics.max_scenarios == 16
+        assert config.analytics.crosscheck is True
+
+    def test_enabled_round_trip(self):
+        config = AppConfig.from_raw(
+            {**self.BASE, "analytics": {
+                "enabled": True, "backend": "numpy",
+                "max_scenarios": 8, "crosscheck": False,
+            }},
+            "test",
+        )
+        assert config.analytics.enabled is True
+        assert config.analytics.backend == "numpy"
+        assert config.analytics.max_scenarios == 8
+        assert config.analytics.crosscheck is False
+
+    def test_requires_serve(self):
+        with pytest.raises(SchemaError, match="serve.enabled"):
+            AppConfig.from_raw(
+                {**self.BASE, "serve": {}, "analytics": {"enabled": True}}, "test"
+            )
+
+    def test_backend_vocabulary(self):
+        with pytest.raises(SchemaError, match="backend"):
+            AppConfig.from_raw(
+                {**self.BASE, "analytics": {"enabled": True, "backend": "tpu"}},
+                "test",
+            )
+
+    def test_max_scenarios_floor(self):
+        with pytest.raises(SchemaError, match="max_scenarios"):
+            AppConfig.from_raw(
+                {**self.BASE, "analytics": {"max_scenarios": 0}}, "test"
+            )
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(SchemaError, match="unknown"):
+            AppConfig.from_raw(
+                {**self.BASE, "analytics": {"vectorize": True}}, "test"
+            )

@@ -98,6 +98,12 @@ def test_bench_smoke_headline_within_budget():
     # escalated — zero collateral verdicts, decayed back to healthy
     assert headline["health_ok"] is True, headline
     assert headline["health_tick_p99_ms"] is not None, headline
+    # analytics plane: batched N-scenario what-if replay >= 5x the
+    # sequential Python fold at 10k pods, with the batched verdicts AND
+    # the vectorized slice aggregates exactly equal to their references
+    assert headline["analytics_ok"] is True, headline
+    assert headline["analytics_speedup"] is not None, headline
+    assert headline["analytics_speedup"] >= 5.0, headline
     detail = json.loads((REPO_ROOT / "artifacts" / "bench_smoke.json").read_text())
     assert detail["details"]["relist_10k"]["events"] == detail["details"]["relist_10k"]["n_pods"]
     egress = detail["details"]["egress_saturation"]
@@ -152,3 +158,11 @@ def test_bench_smoke_headline_within_budget():
     assert health["verdicts_exact"], health
     assert health["confirmed"] == [f"node/{health['straggler']}"], health
     assert health["collateral"] == [], health
+    # the analytics correctness legs behind the speedup: two independent
+    # implementations (batched array path vs sequential dict fold) agree
+    # exactly, and the vectorized aggregates match the view's counters
+    ana = detail["details"]["analytics"]
+    assert ana["verdicts_equal"], ana
+    assert ana["aggregates_exact"], ana
+    assert ana["scenarios"] >= 8 and ana["pods"] >= 10_000, ana
+    assert ana["speedup"] >= 5.0, ana

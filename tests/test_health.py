@@ -434,6 +434,14 @@ class TestPhaseCollector:
         def snapshot(self):
             return 1, list(self.objects)
 
+        def snapshot_tables(self):
+            # the bulk per-kind accessor the phase collector reads
+            # (serve/view.py snapshot_tables): {kind: [objects]}
+            tables = {}
+            for obj in self.objects:
+                tables.setdefault(obj.get("kind"), []).append(obj)
+            return 1, tables
+
     def test_stuck_pending_pod_scores_its_node_against_slice_peers(self):
         view = self.FakeView()
         cfg = HealthConfig(
