@@ -2474,6 +2474,165 @@ def bench_codec_ab(n_objects: int = 200, n_frames: int = 2000) -> dict:
     return result
 
 
+def bench_trace_fanin_ab(
+    n_deltas: int = 30_000,
+    sample_rate: int = 256,
+    batch: int = 128,
+    rounds: int = 6,
+    budget_pct: float = 3.0,
+) -> dict:
+    """Trace-propagation overhead gate on the federation fan-in path:
+    the SAME wire batches decoded + folded through
+    ``GlobalMerge.apply_batch`` twice — (A) plain stamped frames vs (B)
+    frames where 1-in-``sample_rate`` carries the in-band ``trace`` dict
+    AND the ``FleetTraceCollector`` joins each (serve_wire span rewrite
+    before the fold, federate_merge/global_serve + ring + labeled
+    histograms after). The timed path is the CONSUMER's real fan-in
+    path — wire decode, the one membership walk federate/plane.py pays,
+    the fold — so the A/B also bills the traced frames' extra wire
+    bytes, not just the collector CPU. Min-of-interleaved-rounds on
+    ``perf_counter`` with alternating A/B order and a pre-round
+    ``gc.collect`` (the same anti-noise discipline as
+    ``bench_trace_overhead``); gate: traced within ``budget_pct`` of
+    plain. Correctness legs run BEFORE the budget verdict and are never
+    retried away: every traced frame must join (ring count exact),
+    every joined journey must carry the three cross-cluster stages plus
+    the forwarded upstream spans, and both sides' terminal views must
+    hold every delta."""
+    import gc as _gc
+
+    from k8s_watcher_tpu.federate.merge import GlobalMerge
+    from k8s_watcher_tpu.metrics import MetricsRegistry
+    from k8s_watcher_tpu.serve import FleetView
+    from k8s_watcher_tpu.trace import FEDERATION_STAGES, Tracer
+    from k8s_watcher_tpu.trace.federation import FleetTraceCollector
+
+    n_traced = len(range(0, n_deltas, sample_rate))
+
+    def build_wire(traced: bool) -> list:
+        """The upstream's side of the A/B: per-batch JSON-line blobs,
+        exactly what one chunked read hands the subscriber."""
+        now = time.time()
+        frames = []
+        for i in range(n_deltas):
+            frame = {
+                "type": "UPSERT", "rv": i + 1, "kind": "pod", "key": f"pod-{i}",
+                "object": {"kind": "pod", "key": f"pod-{i}", "seq": i,
+                           "phase": ("Pending", "Running")[i % 2]},
+                "ts": [now - 0.005, now - 0.002],
+            }
+            if traced and i % sample_rate == 0:
+                # the compact in-band form a ?trace=1 upstream serves
+                frame["trace"] = {
+                    "id": f"tr-{i:08x}", "uid": f"pod-{i}",
+                    "spans": [["shard_receive", 0.0, 0.0002],
+                              ["queue_wait", 0.0002, 0.0006],
+                              ["pipeline", 0.0006, 0.0015]],
+                }
+            frames.append(frame)
+        return [
+            "".join(
+                json.dumps(f) + "\n" for f in frames[start:start + batch]
+            ).encode()
+            for start in range(0, n_deltas, batch)
+        ]
+
+    def run_fold(blobs: list, traced: bool):
+        """One full decode+fold; returns (seconds, view, collector)."""
+        view = FleetView(compact_horizon=n_deltas + 16)
+        merge = GlobalMerge(view)
+        collector = None
+        if traced:
+            collector = FleetTraceCollector(
+                tracer=Tracer(sample_rate=1, ring_size=n_traced + 16),
+                metrics=MetricsRegistry(),
+                max_joined=n_traced + 16,
+                max_label_sets=64,
+            )
+        _gc.collect()
+        t0 = time.perf_counter()
+        for blob in blobs:
+            chunk = [json.loads(line) for line in blob.splitlines()]
+            if collector is not None:
+                # the production _on_batch shape (federate/plane.py):
+                # one membership walk, collector work per TRACED frame
+                traced_chunk = [f for f in chunk if "trace" in f]
+                if traced_chunk:
+                    t_recv = time.time()
+                    collector.note_receive("c0", traced_chunk, t_recv)
+                    t_pub = time.time()
+                    merge.apply_batch("c0", chunk)
+                    collector.adopt("c0", traced_chunk, t_recv, t_pub, time.time())
+                else:
+                    merge.apply_batch("c0", chunk)
+            else:
+                merge.apply_batch("c0", chunk)
+        elapsed = time.perf_counter() - t0
+        return elapsed, view, collector
+
+    wire = {False: build_wire(False), True: build_wire(True)}
+    # CORRECTNESS pass first — one fold per side, checked before any
+    # timing verdict and never retried away: every traced frame joined,
+    # every journey complete, both terminal views hold every delta
+    _, plain_view, _ = run_fold(wire[False], False)
+    _, traced_view, collector = run_fold(wire[True], True)
+    joined = collector.tracer.ring.snapshot(n_traced + 16)
+    journeys_complete = bool(joined) and all(
+        {s["stage"] for s in t["spans"]}
+        >= set(FEDERATION_STAGES) | {"shard_receive", "queue_wait", "pipeline"}
+        for t in joined
+    )
+    correctness_ok = (
+        len(joined) == n_traced
+        and journeys_complete
+        and plain_view.rv == n_deltas
+        and traced_view.rv == n_deltas
+    )
+    n_joined = len(joined)
+    # release everything before timing: two retained 30k-delta views
+    # skew the allocator enough to fake several percent of "overhead"
+    del plain_view, traced_view, collector, joined
+    # min-of-interleaved-rounds with ADAPTIVE extension (the correctness
+    # pass doubles as the untimed warmup): rounds keep running until the
+    # mins land inside the budget or the round budget is spent.
+    # Extension cannot fake a pass — min is a consistent estimator of
+    # each side's quiet floor, so a real >3% regression stays >3%
+    # however many rounds run (the exact argument bench_trace_overhead
+    # documents). A/B order alternates so co-tenant drift never
+    # consistently bills one side, and each fold retains NOTHING.
+    min_rounds, max_rounds = max(1, rounds), 4 * max(1, rounds)
+    best = {False: float("inf"), True: float("inf")}
+    rounds_run = 0
+    overhead_pct = float("inf")
+    while rounds_run < max_rounds:
+        order = (False, True) if rounds_run % 2 == 0 else (True, False)
+        for traced in order:
+            elapsed, _view, _collector = run_fold(wire[traced], traced)
+            best[traced] = min(best[traced], elapsed)
+            del _view, _collector
+        rounds_run += 1
+        overhead_pct = 100.0 * (best[True] - best[False]) / best[False]
+        if rounds_run >= min_rounds and overhead_pct < budget_pct:
+            break
+    within_budget = overhead_pct < budget_pct
+    return {
+        "deltas": n_deltas,
+        "sample_rate": sample_rate,
+        "traced_frames": n_traced,
+        "joined": n_joined,
+        "plain_deltas_per_sec": round(n_deltas / best[False], 1),
+        "traced_deltas_per_sec": round(n_deltas / best[True], 1),
+        "overhead_pct": round(overhead_pct, 2),
+        "budget_pct": budget_pct,
+        "rounds": rounds_run,
+        "max_rounds": max_rounds,
+        "within_budget": within_budget,
+        "journeys_complete": journeys_complete,
+        "correctness_ok": correctness_ok,
+        "ok": correctness_ok and within_budget,
+    }
+
+
 def bench_federation(
     n_upstreams: int = 3,
     events_per_sec: float = 400.0,
@@ -2681,6 +2840,11 @@ def bench_federation(
     )
     best["codec_ab"] = bench_codec_ab(n_frames=codec_frames)
     best["fanin_ok"] = bool(best["fanin_ab"]["ok"] and best["fanin_ramp"]["ok"])
+    # trace-propagation overhead on the same fan-in path: stamped-plain
+    # vs 1/256-traced frame batches (joined-trace correctness gated
+    # before the <3% budget — deterministic, no best-of-N)
+    best["trace_fleet"] = bench_trace_fanin_ab(n_deltas=fanin_ab_deltas)
+    best["trace_fleet_ok"] = bool(best["trace_fleet"]["ok"])
     return best
 
 
@@ -3128,6 +3292,10 @@ def main(smoke: bool = False) -> int:
         # codec negotiation: msgpack == JSON decoded on every read shape
         # over the real wire, msgpack actually negotiated when available
         "serve_codec_ok": (federation.get("codec_ab") or {}).get("ok", False),
+        # fleet tracing: in-band trace propagation on the fan-in path —
+        # every 1/256-traced frame joined (watch->global journey complete)
+        # within the <3% overhead budget vs plain stamped frames
+        "trace_fleet_ok": federation.get("trace_fleet_ok", False),
         # health plane: detector tick p99 inside its budget AND exactly
         # the scripted straggler escalated (zero collateral verdicts)
         "health_ok": health_stats.get("ok", False),
@@ -3158,13 +3326,17 @@ def main(smoke: bool = False) -> int:
         headline["smoke"] = True
         # the smoke tier skips the probe/50k tiers; their fields are all
         # null there and the headline must stay inside the ~1 KB
-        # tail-capture budget (the federation fields pushed it past, and
-        # the health fields pushed the always-null smoke saturating_stage
-        # out too — the full tier still reports it)
+        # tail-capture budget (the federation fields pushed it past, the
+        # health fields pushed the always-null smoke saturating_stage
+        # out too, and the trace_fleet gate pushed the usually-null
+        # egress_saturating_stage onto the same null-trim list — the
+        # full tier still reports them, and the detail artifact always
+        # carries first_saturating_stage)
         for key in (
             "checkpoint_50k_flush_ms", "checkpoint_50k_compact_ms",
             "checkpoint_50k_max_slice_ms", "mxu_tflops", "hbm_read_gbps",
             "hbm_write_gbps", "links", "dcn_pairs", "saturating_stage",
+            "egress_saturating_stage",
         ):
             if headline.get(key) is None:
                 headline.pop(key, None)

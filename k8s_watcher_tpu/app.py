@@ -237,6 +237,11 @@ class WatcherApp:
                 auth_token=config.watcher.status_auth_token,
                 history=self.history,
             )
+            if self.tracer is not None:
+                # /debug/trace on the SERVE port: the lazy-stitch surface
+                # a downstream federator reads this process's local spans
+                # from (its federation config only knows the serve URL)
+                self.serve.attach_trace(self.tracer.ring)
         # multi-cluster federation plane (federate/): N upstream serving
         # planes subscribed (resume-protocol consumers with durable
         # tokens) and merged into THIS process's FleetView under
@@ -245,8 +250,30 @@ class WatcherApp:
         # in run() (after the serve plane binds) and stop before the WAL
         # closes (they are view producers).
         self.federation = None
+        # fleet trace joining (trace.federation.enabled): the upstream
+        # subscribers negotiate ?trace=1 and the collector joins each
+        # sampled journey's upstream spans with the serve_wire/
+        # federate_merge/global_serve hops, into the SHARED tracer ring —
+        # /debug/trace?uid= answers the fleet-wide journey, /debug/trace/
+        # diagnosis attributes propagation time per upstream per stage
+        self.trace_collector = None
         if config.federation.enabled:
             from k8s_watcher_tpu.federate import FederationPlane
+
+            if self.tracer is not None and config.trace.federation.enabled:
+                from k8s_watcher_tpu.trace import ALL_STAGES, FleetTraceCollector
+
+                self.trace_collector = FleetTraceCollector(
+                    tracer=self.tracer,
+                    metrics=self.metrics,
+                    forward_spans=config.trace.federation.forward_spans,
+                    max_joined=config.trace.federation.max_joined,
+                    # the (stage x upstream) label dimension is bounded
+                    # by config, like the federation gauges' upstream cap
+                    max_label_sets=(
+                        len(config.federation.upstreams) * len(ALL_STAGES) + 8
+                    ),
+                )
 
             # durable resume tokens ONLY when the merged view itself is
             # durable (history WAL): a persisted token would otherwise
@@ -282,6 +309,7 @@ class WatcherApp:
                 metrics=self.metrics,
                 token_dir=token_dir,
                 resume_tokens_valid=tokens_valid,
+                trace_collector=self.trace_collector,
             )
         # fleet analytics & what-if plane (analytics/): the FleetView's
         # columnar twin + jitted kernels + /serve/analytics. Built after
@@ -462,6 +490,17 @@ class WatcherApp:
                 port=self.config.watcher.status_port,
                 audit=self.audit,
                 trace=self.tracer.ring if self.tracer is not None else None,
+                # fleet-wide stitched ?uid= answers + /debug/trace/
+                # diagnosis (slowest-stage attribution per upstream) on
+                # a federator with trace joining enabled
+                trace_stitch=(
+                    self.trace_collector.stitch
+                    if self.trace_collector is not None else None
+                ),
+                trace_diagnosis=(
+                    self.trace_collector.diagnosis
+                    if self.trace_collector is not None else None
+                ),
                 # /healthz covers the egress side too: all-workers-dead or
                 # a wedged lane past the stall threshold turns it 503
                 egress=lambda: self.dispatcher.egress_health(stall_after),
@@ -498,6 +537,8 @@ class WatcherApp:
                 ", /debug/events" if self.audit is not None else ""
             ) + (
                 ", /debug/trace" if self.tracer is not None else ""
+            ) + (
+                ", /debug/trace/diagnosis" if self.trace_collector is not None else ""
             ) + (", /debug/trend" if agent_trend is not None else "") + (
                 ", /debug/probes" if self._probe_agent is not None else ""
             ) + (

@@ -677,6 +677,47 @@ class IngestConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class TraceFederationConfig:
+    """The ``trace.federation:`` sub-section — cross-cluster trace
+    joining at a federator (trace/federation.py): upstream subscribers
+    negotiate ``?trace=1`` so sampled deltas carry their journey's
+    compact trace in-band; the federator joins them with the
+    ``serve_wire``/``federate_merge``/``global_serve`` stages, serves the
+    fleet-wide journey at ``/debug/trace?uid=`` and slowest-stage
+    attribution at ``/debug/trace/diagnosis``, and emits the labeled
+    ``trace_stage_seconds{stage=,upstream=}`` histograms the SLO and
+    health planes consume. Requires ``trace.enabled`` AND
+    ``federation.enabled`` (schema-enforced pairing).
+    """
+
+    enabled: bool = False
+    # keep the upstream's forwarded local spans in the joined traces;
+    # false bounds federator memory to the cross-cluster stages and the
+    # stitched query fetches upstream spans lazily from the upstream's
+    # serve-port /debug/trace (partial answer when it is unreachable)
+    forward_spans: bool = True
+    # joined journeys retained for stitched queries / diagnosis examples
+    # (newest wins — the production memory bound)
+    max_joined: int = 256
+
+    @classmethod
+    def from_raw(cls, raw: Mapping[str, Any]) -> "TraceFederationConfig":
+        path = "trace.federation"
+        _check_known(raw, ("enabled", "forward_spans", "max_joined"), path)
+        max_joined = _opt_int(raw, "max_joined", path, 256)
+        if max_joined < 1:
+            raise SchemaError(
+                f"config key '{path}.max_joined': must be >= 1 (use "
+                f"{path}.enabled: false to turn trace joining off), got {max_joined}"
+            )
+        return cls(
+            enabled=_opt_bool(raw, "enabled", path, False),
+            forward_spans=_opt_bool(raw, "forward_spans", path, True),
+            max_joined=max_joined,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class TraceConfig:
     """The ``trace:`` section — net-new end-to-end event tracing plane
     (trace/trace.py): head-sampled span trees across every hand-off an
@@ -688,15 +729,21 @@ class TraceConfig:
     anomaly capture keeps recording. Unsampled events pay only the
     sampling branch — no allocation, no lock (the <3% overhead budget the
     bench smoke gates).
+
+    ``federation:`` extends sampled journeys across the serve/federation
+    wire (see ``TraceFederationConfig``).
     """
 
     enabled: bool = True
     sample_rate: int = 256
     ring_size: int = 512
+    federation: TraceFederationConfig = dataclasses.field(
+        default_factory=TraceFederationConfig
+    )
 
     @classmethod
     def from_raw(cls, raw: Mapping[str, Any]) -> "TraceConfig":
-        _check_known(raw, ("enabled", "sample_rate", "ring_size"), "trace")
+        _check_known(raw, ("enabled", "sample_rate", "ring_size", "federation"), "trace")
         sample_rate = _opt_int(raw, "sample_rate", "trace", 256)
         if sample_rate < 0:
             raise SchemaError(
@@ -707,10 +754,13 @@ class TraceConfig:
             raise SchemaError(
                 f"config key 'trace.ring_size': must be >= 1 (use trace.enabled: false to turn tracing off), got {ring_size}"
             )
+        federation = raw.get("federation") or {}
+        _expect(federation, (dict,), "trace.federation")
         return cls(
             enabled=_opt_bool(raw, "enabled", "trace", True),
             sample_rate=sample_rate,
             ring_size=ring_size,
+            federation=TraceFederationConfig.from_raw(federation),
         )
 
 
@@ -1465,6 +1515,22 @@ class AppConfig:
                 "FleetView; without it the fan-in has nowhere to land)"
             )
         trace = TraceConfig.from_raw(raw.get("trace") or {})
+        if trace.federation.enabled:
+            # schema-enforced pairing (same posture as health.sources.*):
+            # a silently plane-less joined-trace config would look like
+            # "no cross-cluster traces" instead of a wiring mistake
+            if not trace.enabled:
+                raise SchemaError(
+                    "config key 'trace.federation.enabled': requires trace.enabled "
+                    "(joined journeys land in the tracing plane's ring and ride "
+                    "its sampled deltas)"
+                )
+            if not federation.enabled:
+                raise SchemaError(
+                    "config key 'trace.federation.enabled': requires "
+                    "federation.enabled (trace joining happens on the federation "
+                    "fan-in path; without upstreams there is nothing to join)"
+                )
         analytics = AnalyticsConfig.from_raw(raw.get("analytics") or {})
         if analytics.enabled and not serve.enabled:
             raise SchemaError(

@@ -261,6 +261,7 @@ class FleetClient:
         verify_tls: bool = True,
         codec: str = CODEC_AUTO,
         fresh: bool = False,
+        trace: bool = False,
     ):
         self.token = token
         self.timeout = timeout
@@ -271,6 +272,12 @@ class FleetClient:
         # param and serves plain frames — the decoded dicts just lack
         # "ts", so propagation metrics degrade to absent, never wrong.
         self.fresh = fresh
+        # trace negotiation (?trace=1): sampled deltas additionally
+        # carry their journey's compact "trace" field (implies fresh on
+        # the server side). Same degradation contract: an upstream that
+        # predates the field serves plain frames and the joined-trace
+        # plane simply sees nothing to join.
+        self.trace = trace
         if codec not in (CODEC_AUTO, CODEC_JSON, CODEC_MSGPACK):
             raise ValueError(f"unknown serve wire codec {codec!r}")
         self.codec_preference = codec
@@ -416,6 +423,17 @@ class FleetClient:
         body = self._get_json(f"/serve/fleet?at={int(rv)}", self.timeout)
         return Snapshot(body["rv"], body.get("view", ""), body.get("objects", []))
 
+    def debug_trace(self, uid: str, *, n: int = 50) -> List[Dict[str, Any]]:
+        """One upstream's local traces for a pod — ``GET /debug/trace``
+        on the SERVE port (serve/server.py routes it when tracing is on).
+        The federation plane's lazy-stitch path: called only on a
+        stitched query that needs spans not forwarded in-band. Raises the
+        client's usual error family; the collector degrades any failure
+        to a partial answer."""
+        query = urlencode({"uid": uid, "n": int(n)})
+        body = self._get_json(f"/debug/trace?{query}", self.timeout)
+        return body.get("traces", [])
+
     def healthz(self) -> dict:
         """``/serve/healthz`` (open route; also tolerates non-200 — the
         body is the point)."""
@@ -443,6 +461,8 @@ class FleetClient:
             params["limit"] = limit
         if self.fresh:
             params["fresh"] = "1"
+        if self.trace:
+            params["trace"] = "1"
         body = self._get_json(
             f"/serve/fleet?{urlencode(params)}",
             # the HTTP read must outlive the server-side poll window
@@ -500,6 +520,8 @@ class FleetClient:
             params["limit"] = limit
         if self.fresh:
             params["fresh"] = "1"
+        if self.trace:
+            params["trace"] = "1"
         conn = self._connect(read_timeout if read_timeout is not None else self.timeout)
         if on_conn is not None:
             on_conn(conn)

@@ -173,6 +173,17 @@ class GlobalMerge:
         ts = item.get("ts")
         return ts[0] if isinstance(ts, (list, tuple)) and ts else None
 
+    @staticmethod
+    def _wire_trace(item: Dict[str, Any]):
+        """The upstream frame's negotiated ``trace`` field (the compact
+        journey dict, already augmented by the trace collector with this
+        hop's serve_wire span), propagated into the merged Delta so the
+        GLOBAL view's republished frames keep the trace identity — a
+        second-tier federator joins the next hop from it. None when the
+        upstream didn't trace (the unsampled 255/256)."""
+        trace = item.get("trace")
+        return trace if isinstance(trace, dict) else None
+
     def apply_delta(self, cluster: str, item: Dict[str, Any]) -> bool:
         """Fold one wire delta (UPSERT/DELETE frame dict) from ``cluster``.
         Returns True when the global view actually changed. The per-delta
@@ -184,8 +195,9 @@ class GlobalMerge:
         key = item["key"]
         gkey = global_key(cluster, key)
         ts_wall = self._origin_stamp(item)
+        trace = self._wire_trace(item)
         if item["type"] == DELETE:
-            changed = self.view.apply(kind, gkey, None, ts_wall=ts_wall)
+            changed = self.view.apply(kind, gkey, None, ts_wall=ts_wall, trace=trace)
             with self._lock:
                 keys = self._keys.setdefault(cluster, set())
                 if (kind, key) in keys:
@@ -195,7 +207,7 @@ class GlobalMerge:
             return changed
         changed = self.view.apply(
             kind, gkey, self._decorate(cluster, kind, key, item.get("object") or {}),
-            ts_wall=ts_wall,
+            ts_wall=ts_wall, trace=trace,
         )
         with self._lock:
             keys = self._keys.setdefault(cluster, set())
@@ -218,17 +230,18 @@ class GlobalMerge:
             kind = item.get("kind") or "pod"
             key = item["key"]
             ts_wall = self._origin_stamp(item)
+            trace = self._wire_trace(item)
             if item["type"] == DELETE:
-                view_items.append((kind, global_key(cluster, key), None, ts_wall))
+                view_items.append((kind, global_key(cluster, key), None, ts_wall, trace))
             else:
                 view_items.append((kind, global_key(cluster, key),
                                    self._decorate(cluster, kind, key, item.get("object") or {}),
-                                   ts_wall))
+                                   ts_wall, trace))
         changed = self.view.apply_batch(view_items)
         with self._lock:
             keys = self._keys.setdefault(cluster, set())
             before = len(keys)
-            for item, (kind, _gkey, obj, _ts) in zip(items, view_items):
+            for item, (kind, _gkey, obj, _ts, _tr) in zip(items, view_items):
                 entry = (kind, item["key"])
                 if obj is None:
                     keys.discard(entry)

@@ -74,7 +74,17 @@ class _Upstream:
             # histograms and watermarks are this plane's telemetry; an
             # upstream that predates the field just serves plain frames
             fresh=True,
+            # negotiate in-band trace forwarding only when the joined-
+            # trace plane is on — unjoined trace fields would be dead
+            # wire bytes on every sampled delta
+            trace=plane.trace_collector is not None,
         )
+        if plane.trace_collector is not None:
+            # lazy-stitch fetcher: the collector queries THIS upstream's
+            # serve-port /debug/trace for spans not forwarded in-band
+            # (each call opens its own connection — safe alongside the
+            # subscriber thread's watch stream)
+            plane.trace_collector.register_fetcher(self.name, self.client.debug_trace)
         self.subscriber = FleetSubscriber(
             self.client,
             on_snapshot=self._on_snapshot,
@@ -160,6 +170,20 @@ class _Upstream:
         the per-delta ``apply_delta`` baseline."""
         if not frames:
             return
+        collector = self._plane.trace_collector
+        # ONE cheap membership walk finds the sampled 1/N; the collector
+        # then pays per TRACED frame only — the unsampled fan-in hot
+        # path's whole trace bill is this `in` check (bench-gated <3%)
+        traced = (
+            [f for f in frames if "trace" in f] if collector is not None else ()
+        )
+        t_recv = time.time() if traced else 0.0
+        if traced:
+            # rewrite traced frames' in-band trace field with this hop's
+            # serve_wire span BEFORE the fold — the merged deltas journal
+            # the rewritten dict, so the global view's republished frames
+            # carry the joined identity to any second-tier federator
+            collector.note_receive(self.name, traced, t_recv)
         with self.drop_lock:
             if self.dropped:
                 # drop_stale removed our objects while this stream was
@@ -167,7 +191,14 @@ class _Upstream:
                 # every untouched object missing — force the full
                 # reconcile instead
                 raise ResyncRequired("objects dropped while stale; re-snapshot to reconcile")
+            t_pub = time.time() if traced else 0.0
             self._plane.merge.apply_batch(self.name, frames)
+        if traced:
+            # close the journeys: federate_merge (receive -> merged
+            # publish) + global_serve (merged publish -> fan-out
+            # hand-off, i.e. apply_batch's wakeup returned) and record
+            # the JOINED traces + attribution histograms
+            collector.adopt(self.name, traced, t_recv, t_pub, time.time())
         if self._plane.deltas_counter is not None:
             self._plane.deltas_counter.inc(len(frames))
         if self._plane.batches_counter is not None:
@@ -292,10 +323,15 @@ class FederationPlane:
         metrics=None,
         token_dir: Optional[str] = None,
         resume_tokens_valid: bool = True,
+        trace_collector=None,  # trace.federation.FleetTraceCollector
     ):
         self.config = config
         self.metrics = metrics
         self.token_dir = token_dir
+        # joined-trace plane (trace.federation.enabled): upstream
+        # subscribers negotiate ?trace=1 and feed it per batch — set
+        # BEFORE the upstreams are built (they read it at construction)
+        self.trace_collector = trace_collector
         # False when the merged view did NOT restart as a clean
         # continuation of the rv line the tokens were minted against
         # (unclean WAL end, cold/wiped WAL dir): a persisted token would

@@ -54,6 +54,39 @@ def send_json(handler: BaseHTTPRequestHandler, status: int, body: dict) -> None:
     handler.wfile.write(data)
 
 
+def trace_ring_response(ring, params: dict) -> tuple:
+    """The ONE ``/debug/trace`` ring-query implementation, shared by the
+    status server and the serve plane's lazy-stitch route (serve/server.py)
+    so the two surfaces can never drift on validation or shape.
+
+    Validation is strict — junk answers 400, never an empty 200 a caller
+    would misread as "no traces": ``n`` must parse as a non-negative int,
+    and ``slowest`` must name a stage from the ``ALL_STAGES`` vocabulary
+    (an unknown stage used to silently match nothing). Returns
+    ``(status, body)``.
+    """
+    from k8s_watcher_tpu.trace import ALL_STAGES
+
+    if ring is None:
+        return 404, {"error": "tracing disabled (trace.enabled: false)"}
+    try:
+        n = int(params.get("n", "50"))
+    except ValueError:
+        return 400, {"error": f"bad n={params.get('n')!r} (must be an integer)"}
+    if n < 0:
+        return 400, {"error": f"bad n={n} (must be >= 0)"}
+    slowest = params.get("slowest")
+    if slowest is not None and slowest not in ALL_STAGES:
+        return 400, {
+            "error": f"bad slowest={slowest!r} (stages: {', '.join(ALL_STAGES)})"
+        }
+    return 200, {
+        "traces": ring.snapshot(n, uid=params.get("uid"), slowest=slowest),
+        "ring_size": len(ring),
+        "stages": list(ALL_STAGES),
+    }
+
+
 def bearer_authorized(header: Optional[str], token: Optional[str]) -> bool:
     """The status plane's bearer check, shared with the serving plane
     (serve/server.py) so /serve routes get the SAME constant-time token
@@ -149,6 +182,13 @@ class _StatusHandler(BaseHTTPRequestHandler):
     liveness: Liveness
     audit = None  # metrics.audit.AuditRing, optional
     trace = None  # trace.TraceRing, optional -> serves /debug/trace
+    # Callable[[str], dict]: fleet-wide stitched journeys for one uid
+    # (trace.federation.FleetTraceCollector.stitch) — augments
+    # /debug/trace?uid= answers on a federator
+    trace_stitch = None
+    # Callable[[], dict]: per-upstream slowest-stage attribution
+    # (FleetTraceCollector.diagnosis) -> /debug/trace/diagnosis
+    trace_diagnosis = None
     # Callable[[], dict]: egress-plane liveness verdict
     # (Dispatcher.egress_health); folded into /healthz when wired
     egress = None
@@ -297,32 +337,27 @@ class _StatusHandler(BaseHTTPRequestHandler):
                 },
             )
         elif parsed.path == "/debug/trace":
-            if self.trace is None:
-                self._json(404, {"error": "tracing disabled (trace.enabled: false)"})
-                return
-            from k8s_watcher_tpu.trace import ALL_STAGES
-
             params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
-            try:
-                n = int(params.get("n", "50"))
-            except ValueError:
-                self._json(400, {"error": f"bad n={params.get('n')!r}"})
-                return
-            slowest = params.get("slowest")
-            if slowest is not None and slowest not in ALL_STAGES:
-                self._json(
-                    400,
-                    {"error": f"bad slowest={slowest!r} (stages: {', '.join(ALL_STAGES)})"},
+            status, body = trace_ring_response(self.trace, params)
+            if status == 200 and params.get("uid") and self.trace_stitch is not None:
+                # the fleet-wide stitched journeys for this pod: joined
+                # cross-cluster traces, with upstream spans fetched
+                # lazily when not forwarded in-band (partial — never a
+                # 500 — when an upstream is unreachable). ?n= bounds the
+                # stitched section like the ring section (already
+                # validated by trace_ring_response — status is 200)
+                body["stitched"] = self.trace_stitch(
+                    params["uid"], n=int(params.get("n", "50"))
                 )
+            self._json(status, body)
+        elif parsed.path == "/debug/trace/diagnosis":
+            if self.trace_diagnosis is None:
+                self._json(404, {
+                    "error": "fleet trace diagnosis not wired "
+                             "(trace.federation.enabled + federation.enabled)",
+                })
                 return
-            self._json(
-                200,
-                {
-                    "traces": self.trace.snapshot(n, uid=params.get("uid"), slowest=slowest),
-                    "ring_size": len(self.trace),
-                    "stages": list(ALL_STAGES),
-                },
-            )
+            self._json(200, {"diagnosis": self.trace_diagnosis()})
         elif parsed.path == "/debug/slices":
             if self.slices is None:
                 self._json(404, {"error": "slice tracking not wired"})
@@ -397,6 +432,8 @@ class StatusServer:
         port: int = 0,
         audit=None,  # metrics.audit.AuditRing -> serves /debug/events
         trace=None,  # trace.TraceRing -> serves /debug/trace
+        trace_stitch=None,  # Callable[[str], dict] -> stitched ?uid= answers
+        trace_diagnosis=None,  # Callable[[], dict] -> /debug/trace/diagnosis
         egress=None,  # Callable[[], dict] -> egress liveness folded into /healthz
         serve=None,  # Callable[[], dict] -> serving-plane liveness folded into /healthz
         federation=None,  # Callable[[], dict] -> federation liveness, /healthz + /debug/federation
@@ -421,6 +458,8 @@ class StatusServer:
                 "liveness": liveness,
                 "audit": audit,
                 "trace": trace,
+                "trace_stitch": staticmethod(trace_stitch) if trace_stitch else None,
+                "trace_diagnosis": staticmethod(trace_diagnosis) if trace_diagnosis else None,
                 "egress": staticmethod(egress) if egress else None,
                 "serve": staticmethod(serve) if serve else None,
                 "federation": staticmethod(federation) if federation else None,

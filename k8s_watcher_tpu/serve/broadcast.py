@@ -80,7 +80,7 @@ class _StreamClient:
     __slots__ = (
         "sock", "fd", "sub", "limit", "deadline", "hard_deadline",
         "last_frame", "buf", "buf_bytes", "closing", "view_id",
-        "want_write", "codec", "fresh",
+        "want_write", "codec", "fresh", "traced",
     )
 
     def __init__(
@@ -93,6 +93,7 @@ class _StreamClient:
         view_id: str,
         codec: str = CODEC_JSON,
         fresh: bool = False,
+        traced: bool = False,
     ):
         self.sock = sock
         self.fd = sock.fileno()
@@ -116,6 +117,9 @@ class _StreamClient:
         # negotiated freshness stamps (?fresh=1): pulls select the
         # stamped frame variant; control frames never carry stamps
         self.fresh = fresh
+        # negotiated trace forwarding (?trace=1): pulls select the
+        # trace-forwarding frame variant (always stamped)
+        self.traced = traced
 
 
 class _LoopWorker(threading.Thread):
@@ -285,7 +289,8 @@ class _LoopWorker(threading.Thread):
             if client.sub.rv >= view_rv:
                 continue
             result = client.sub.pull_frames(
-                limit=client.limit, codec=client.codec, fresh=client.fresh
+                limit=client.limit, codec=client.codec, fresh=client.fresh,
+                traced=client.traced,
             )
             if result.status == GONE:
                 self._queue_control(
@@ -556,6 +561,7 @@ class BroadcastLoop:
         view_id: str,
         codec: str = CODEC_JSON,
         fresh: bool = False,
+        traced: bool = False,
     ) -> None:
         """Adopt a handed-off socket (headers already written by the HTTP
         front). The loop owns the socket AND the subscription from here —
@@ -567,6 +573,7 @@ class BroadcastLoop:
             view_id=view_id,
             codec=codec,
             fresh=fresh,
+            traced=traced,
         )
         # round-robin across LIVE workers only: a dead loop's inbox is a
         # black hole (stream never admitted, slot never freed) — the

@@ -170,6 +170,12 @@ class _ServeHandler(BaseHTTPRequestHandler):
     plane = None  # the owning ServePlane (health payload)
     history = None  # history.HistoryStore -> ?at= time-travel reads
     analytics = None  # analytics.AnalyticsPlane -> /serve/analytics
+    # trace.TraceRing -> GET /debug/trace on the SERVE port: the lazy
+    # stitch path a downstream federator queries for this process's local
+    # spans (its federation config only knows the serve URL; the status
+    # port is a separate, possibly unreachable, surface). Bearer-gated
+    # like every serve route; 404 when tracing is off.
+    trace = None
     loop: Optional[BroadcastLoop] = None  # epoll core; None = threaded streams
     at_cache: Optional[_AtCache] = None  # ?at= reconstruction LRU
     at_hits = None  # metrics counters (bound by ServeServer when wired)
@@ -237,6 +243,14 @@ class _ServeHandler(BaseHTTPRequestHandler):
                 ).items()},
                 self._codec(),
             )
+            return
+        if path == "/debug/trace":
+            from k8s_watcher_tpu.metrics.server import trace_ring_response
+
+            status, body = trace_ring_response(
+                self.trace, {k: v[0] for k, v in parse_qs(parsed.query).items()}
+            )
+            self._json(status, body)
             return
         if path != "/serve/fleet":
             self._json(404, {"error": f"no route {path}"})
@@ -399,8 +413,12 @@ class _ServeHandler(BaseHTTPRequestHandler):
             return
         # freshness negotiation (``fresh=1``): delta frames additionally
         # carry ``ts: [origin_wall, publish_wall]`` — negotiated like the
-        # codec, so peers that don't ask keep the byte-golden frames
-        fresh = params.get("fresh") in ("1", "true")
+        # codec, so peers that don't ask keep the byte-golden frames.
+        # trace negotiation (``trace=1``): sampled deltas additionally
+        # carry their journey's compact ``trace`` field; trace implies
+        # fresh (the federator's serve_wire span reads the ts stamps).
+        traced = params.get("trace") in ("1", "true")
+        fresh = traced or params.get("fresh") in ("1", "true")
         client_view = params.get("view")
         if client_view and client_view != self.view.instance:
             # token minted by a previous incarnation of the rv space:
@@ -423,16 +441,16 @@ class _ServeHandler(BaseHTTPRequestHandler):
         handed_off = False
         try:
             if params.get("once") in ("1", "true"):
-                self._long_poll(sub, min(timeout, MAX_LONG_POLL_SECONDS), limit, codec, fresh)
+                self._long_poll(sub, min(timeout, MAX_LONG_POLL_SECONDS), limit, codec, fresh, traced)
             elif self.loop is not None:
-                handed_off = self._stream_handoff(sub, timeout, limit, codec, fresh)
+                handed_off = self._stream_handoff(sub, timeout, limit, codec, fresh, traced)
             else:
-                self._stream(sub, timeout, limit, codec, fresh)
+                self._stream(sub, timeout, limit, codec, fresh, traced)
         finally:
             if not handed_off:
                 self.hub.unsubscribe(sub)
 
-    def _long_poll(self, sub, timeout: float, limit, codec: str = CODEC_JSON, fresh: bool = False) -> None:
+    def _long_poll(self, sub, timeout: float, limit, codec: str = CODEC_JSON, fresh: bool = False, traced: bool = False) -> None:
         result = sub.pull(timeout=timeout, limit=limit)
         if result.status == GONE:
             self._send_obj(
@@ -462,7 +480,7 @@ class _ServeHandler(BaseHTTPRequestHandler):
                 "to_rv": result.to_rv,
                 "view": self.view.instance,
                 "compacted": result.compacted,
-                "items": [d.to_wire(fresh=fresh) for d in result.deltas],
+                "items": [d.to_wire(fresh=fresh, trace=traced) for d in result.deltas],
             },
             codec,
         )
@@ -491,7 +509,7 @@ class _ServeHandler(BaseHTTPRequestHandler):
             return True
         return False
 
-    def _stream_handoff(self, sub, timeout: float, limit, codec: str = CODEC_JSON, fresh: bool = False) -> bool:
+    def _stream_handoff(self, sub, timeout: float, limit, codec: str = CODEC_JSON, fresh: bool = False, traced: bool = False) -> bool:
         """The epoll path: handshake/auth/410 checks ran on THIS thread
         (the HTTP front's job); write the response headers, then release
         the socket to the broadcast loop and return the thread to the
@@ -503,7 +521,7 @@ class _ServeHandler(BaseHTTPRequestHandler):
             # a dead loop's inbox is a black hole; serve this stream on
             # the legacy threaded path instead (degraded but correct —
             # /healthz is already reporting the loop unhealthy)
-            self._stream(sub, timeout, limit, codec, fresh)
+            self._stream(sub, timeout, limit, codec, fresh, traced)
             return False
         self.send_response(200)
         self.send_header("Content-Type", CODEC_CONTENT_TYPES[codec])
@@ -520,14 +538,14 @@ class _ServeHandler(BaseHTTPRequestHandler):
             self.loop.submit(
                 self.connection, sub,
                 timeout=timeout, limit=limit, view_id=self.view.instance,
-                codec=codec, fresh=fresh,
+                codec=codec, fresh=fresh, traced=traced,
             )
         except RuntimeError:
             return False
         self.server.hand_off(self.connection)
         return True
 
-    def _stream(self, sub, timeout: float, limit, codec: str = CODEC_JSON, fresh: bool = False) -> None:
+    def _stream(self, sub, timeout: float, limit, codec: str = CODEC_JSON, fresh: bool = False, traced: bool = False) -> None:
         # legacy thread-per-connection streamer (serve.io_threads: 0):
         # kept as the PR-4 reference encoder the golden/equivalence tests
         # compare the broadcast core against
@@ -571,7 +589,7 @@ class _ServeHandler(BaseHTTPRequestHandler):
                             "from_rv": result.from_rv,
                             "to_rv": result.to_rv,
                         })
-                    frames.extend(d.to_wire(fresh=fresh) for d in result.deltas)
+                    frames.extend(d.to_wire(fresh=fresh, trace=traced) for d in result.deltas)
                     write_frames(frames)
                     last_frame = time.monotonic()
                 elif time.monotonic() - last_frame >= SYNC_INTERVAL_SECONDS:
@@ -602,6 +620,7 @@ class ServeServer:
         plane=None,
         history=None,
         analytics=None,
+        trace=None,  # trace.TraceRing -> GET /debug/trace (lazy stitch)
         io_threads: int = 1,
         sub_buffer_bytes: int = 1 << 20,
         metrics=None,
@@ -623,7 +642,8 @@ class ServeServer:
             "BoundServeHandler",
             (_ServeHandler,),
             {"view": view, "hub": hub, "auth_token": auth_token, "plane": plane,
-             "history": history, "analytics": analytics, "loop": self.loop,
+             "history": history, "analytics": analytics, "trace": trace,
+             "loop": self.loop,
              "at_cache": _AtCache() if history is not None else None,
              "at_hits": metrics.counter("serve_at_cache_hits")
              if metrics is not None and history is not None else None,
@@ -724,11 +744,20 @@ class ServePlane:
         # exists (and after federation, so the columnar twin covers the
         # merged global fleet) — routes /serve/analytics when set
         self.analytics = None
+        # trace.TraceRing, attached by the app when tracing is on —
+        # routes GET /debug/trace on the serve port (the lazy-stitch
+        # surface a downstream federator reads this process's spans from)
+        self.trace_ring = None
 
     def attach_analytics(self, analytics) -> None:
         """Wire the analytics plane; call before ``start()`` so the HTTP
         handler binds the route."""
         self.analytics = analytics
+
+    def attach_trace(self, ring) -> None:
+        """Wire the tracing ring; call before ``start()`` so the HTTP
+        handler binds /debug/trace on the serve port."""
+        self.trace_ring = ring
 
     def wrap_sink(self, sink):
         """Tap a notification sink: every Notification folds into the view
@@ -751,6 +780,7 @@ class ServePlane:
             plane=self,
             history=self.history,
             analytics=self.analytics,
+            trace=self.trace_ring,
             io_threads=getattr(self.config, "io_threads", 1),
             sub_buffer_bytes=getattr(self.config, "sub_buffer_bytes", 1 << 20),
             metrics=self.metrics,

@@ -111,16 +111,27 @@ CODECS = (CODEC_JSON, CODEC_MSGPACK)
 #: still encoded at most once per delta per variant while the plain-JSON
 #: frames stay byte-golden for every peer that did not ask for stamps
 FRESH_SUFFIX = "+ts"
+#: frame-variant suffix for trace-forwarding frames (``?trace=1``):
+#: sampled deltas additionally carry their journey's compact ``trace``
+#: field. Trace implies freshness (the federator derives ``serve_wire``
+#: from the ``ts`` stamps), so the traced variants always stack on
+#: ``+ts`` — six parallel arrays total, each still encode-once
+TRACE_SUFFIX = "+tr"
 FRAME_VARIANTS = (
     CODEC_JSON,
     CODEC_MSGPACK,
     CODEC_JSON + FRESH_SUFFIX,
     CODEC_MSGPACK + FRESH_SUFFIX,
+    CODEC_JSON + FRESH_SUFFIX + TRACE_SUFFIX,
+    CODEC_MSGPACK + FRESH_SUFFIX + TRACE_SUFFIX,
 )
 
 
-def frame_variant(codec: str, fresh: bool) -> str:
-    """The frame-array key for one negotiated (codec, freshness) pair."""
+def frame_variant(codec: str, fresh: bool, traced: bool = False) -> str:
+    """The frame-array key for one negotiated (codec, freshness, trace)
+    triple. ``traced`` implies the stamped variant."""
+    if traced:
+        return codec + FRESH_SUFFIX + TRACE_SUFFIX
     return codec + FRESH_SUFFIX if fresh else codec
 JSON_CONTENT_TYPE = "application/json"
 MSGPACK_CONTENT_TYPE = "application/x-msgpack"
@@ -164,8 +175,15 @@ class Delta(NamedTuple):
     t: float  # monotonic append stamp (feeds the delta-lag histogram)
     ts_wall: Optional[float] = None  # origin wall stamp (None = unknown)
     pub_wall: float = 0.0  # publish wall stamp (0 = unstamped/restored)
+    # the sampled journey riding this delta, for the negotiated ?trace=1
+    # wire field: a live trace.Trace on the local publish path (its spans
+    # snapshot at encode time), or the upstream's already-compact dict on
+    # the federation fan-in path (merge.apply_batch 5-tuples). None for
+    # the unsampled 255/256 — the plain wire dict never changes shape.
+    # Never persisted: the WAL's delta records carry explicit fields.
+    trace: Optional[Any] = None
 
-    def to_wire(self, fresh: bool = False) -> Dict[str, Any]:
+    def to_wire(self, fresh: bool = False, trace: bool = False) -> Dict[str, Any]:
         out = {"type": self.type, "rv": self.rv, "kind": self.kind, "key": self.key}
         if self.object is not None:
             out["object"] = self.object
@@ -176,6 +194,16 @@ class Delta(NamedTuple):
             # when the peer asked (?fresh=1); the default wire dict is
             # byte-identical to the PR-4 golden.
             out["ts"] = [self.ts_wall, self.pub_wall]
+        if trace and self.trace is not None:
+            # the negotiated trace field (?trace=1): the journey's
+            # identity + local spans so far, compacted at encode time —
+            # a federation dict passes through verbatim (second hop)
+            if isinstance(self.trace, dict):
+                out["trace"] = self.trace
+            else:
+                from k8s_watcher_tpu.trace.trace import wire_trace
+
+                out["trace"] = wire_trace(self.trace)
         return out
 
 
@@ -325,6 +353,11 @@ class FleetView:
         self._frame_encodes_fresh = (
             metrics.counter("serve_frame_encodes_fresh") if metrics is not None else None
         )
+        # trace-forwarding fills likewise bill their own counter — the
+        # amortization gate stays stated over the plain JSON publish path
+        self._frame_encodes_trace = (
+            metrics.counter("serve_frame_encodes_trace") if metrics is not None else None
+        )
         self._snap_hits = (
             metrics.counter("serve_snapshot_cache_hits") if metrics is not None else None
         )
@@ -455,13 +488,15 @@ class FleetView:
         encode: bool = True,
         ts_wall: Optional[float] = None,
         pub_wall: float = 0.0,
+        trace: Optional[Any] = None,
     ) -> bool:
         """One delta under the lock. Returns False for no-ops (identical
         upsert, delete of an absent key) — no rv burn, no journal entry.
         ``encode=False`` (the merge-facing batch path) journals a hole in
         every codec's frame array instead of paying json.dumps here; the
         first read in a codec fills it. ``ts_wall``/``pub_wall`` are the
-        freshness plane's origin/publish stamps (see ``Delta``)."""
+        freshness plane's origin/publish stamps; ``trace`` is the sampled
+        journey the ?trace=1 wire forwards (see ``Delta``)."""
         map_key = (kind, key)
         if obj is None:
             if self._objects.pop(map_key, None) is None:
@@ -473,7 +508,7 @@ class FleetView:
             self._objects[map_key] = obj
             delta_type = UPSERT
         self._rv += 1
-        delta = Delta(self._rv, kind, key, delta_type, obj, now, ts_wall, pub_wall)
+        delta = Delta(self._rv, kind, key, delta_type, obj, now, ts_wall, pub_wall, trace)
         self._delta_rvs.append(self._rv)
         self._deltas.append(delta)
         self._frames[CODEC_JSON].append(self._encode_locked(delta) if encode else None)
@@ -504,17 +539,20 @@ class FleetView:
         obj: Optional[Dict[str, Any]],
         *,
         ts_wall: Optional[float] = None,
+        trace: Optional[Any] = None,
     ) -> bool:
         """Upsert (``obj``) or delete (``obj is None``) one object and wake
         subscribers. Public single-delta shape (benches, sink taps).
         ``ts_wall`` overrides the origin stamp (default: now — for a sink
-        tap, the apply IS the origin)."""
+        tap, the apply IS the origin); ``trace`` rides the ?trace=1 wire
+        (the merge's per-delta baseline path propagates it here)."""
         now = time.monotonic()
         wall = time.time()
         with self._cond:
             changed = self._apply_locked(
                 kind, key, obj, now,
                 ts_wall=ts_wall if ts_wall is not None else wall, pub_wall=wall,
+                trace=trace,
             )
             if changed:
                 if self._history is not None:
@@ -550,7 +588,9 @@ class FleetView:
         fan-in's stamped shape — ``(kind, key, obj_or_None, ts_wall)``,
         carrying the upstream frame's ORIGIN stamp so the merged delta
         keeps measuring true end-to-end age (and a second-tier federator
-        propagates it again)."""
+        propagates it again). A fifth element carries the upstream's
+        compact ``trace`` dict (the ?trace=1 field) so the merged view's
+        republished frames keep the journey's identity across hops."""
         now = time.monotonic()
         wall = time.time()
         changed = 0
@@ -558,8 +598,10 @@ class FleetView:
             for item in items:
                 kind, key, obj = item[0], item[1], item[2]
                 ts = item[3] if len(item) > 3 and item[3] is not None else wall
+                tr = item[4] if len(item) > 4 else None
                 if self._apply_locked(
-                    kind, key, obj, now, encode=False, ts_wall=ts, pub_wall=wall
+                    kind, key, obj, now, encode=False, ts_wall=ts, pub_wall=wall,
+                    trace=tr,
                 ):
                     changed += 1
             if changed:
@@ -614,16 +656,22 @@ class FleetView:
                 # wire's cross-host field, monotonic for the same-host
                 # watch_to_local_view histogram below)
                 ts_wall = getattr(event, "received_at", None) or wall
+                # the sampled journey (1/N) rides its delta onto the
+                # ?trace=1 wire — the LIVE Trace object, so spans stamped
+                # after this publish (the traced variants encode lazily,
+                # on first traced read) still make the wire
+                event_trace = getattr(event, "trace", None)
                 if event.type == EventType.DELETED:
                     meta = (event.pod or {}).get("metadata") or {}
                     applied = self._apply_locked(
                         "pod", pod_key(meta), None, t_start,
-                        ts_wall=ts_wall, pub_wall=wall,
+                        ts_wall=ts_wall, pub_wall=wall, trace=event_trace,
                     )
                 else:
                     uid, obj = _pod_object(event)
                     applied = self._apply_locked(
-                        "pod", uid, obj, t_start, ts_wall=ts_wall, pub_wall=wall
+                        "pod", uid, obj, t_start, ts_wall=ts_wall, pub_wall=wall,
+                        trace=event_trace,
                     )
                 if applied:
                     changed += 1
@@ -859,6 +907,7 @@ class FleetView:
         timeout: float = 0.0,
         codec: str = CODEC_JSON,
         fresh: bool = False,
+        traced: bool = False,
     ) -> FrameReadResult:
         """``read_since`` plus the wire frames in ``codec`` — the
         broadcast path. ``frames[i]`` is ``deltas[i]`` chunk-framed in
@@ -869,11 +918,12 @@ class FleetView:
         ``apply_batch``) are filled off the publish lock and memoized.
         ``fresh`` selects the freshness-stamped frame variant (its own
         parallel array — stamped peers share stamped bytes, unstamped
-        peers keep the byte-golden plain frames)."""
+        peers keep the byte-golden plain frames); ``traced`` selects the
+        trace-forwarding variant (always stamped — trace implies fresh)."""
         return FrameReadResult(
             *self._read(
                 rv, max_deltas, limit, timeout, want_frames=True,
-                variant=frame_variant(codec, fresh),
+                variant=frame_variant(codec, fresh, traced),
             )
         )
 
@@ -895,21 +945,25 @@ class FleetView:
         onto the puller). The fill is bounded by what the pull DELIVERS
         — ``max_deltas``/``queue_depth`` raw, unique-keys-in-range
         compacted — and is paid once per delta per codec ever."""
-        fresh = variant.endswith(FRESH_SUFFIX)
-        codec = variant[: -len(FRESH_SUFFIX)] if fresh else variant
+        traced = variant.endswith(TRACE_SUFFIX)
+        base = variant[: -len(TRACE_SUFFIX)] if traced else variant
+        fresh = base.endswith(FRESH_SUFFIX)
+        codec = base[: -len(FRESH_SUFFIX)] if fresh else base
         t0 = time.perf_counter() if self._encode_seconds is not None else 0.0
         encoded: List[Tuple[int, bytes]] = []
         for i, frame in enumerate(frames):
             if frame is None:
-                frame = chunk_frame(deltas[i].to_wire(fresh=fresh), codec)
+                frame = chunk_frame(deltas[i].to_wire(fresh=fresh, trace=traced), codec)
                 frames[i] = frame
                 encoded.append((deltas[i].rv, frame))
         if not encoded:
             return
         if self._encode_seconds is not None:
             self._encode_seconds.record(time.perf_counter() - t0)
-        if fresh:
-            # stamped variants bill their own counter: the PR-7
+        if traced:
+            counter = self._frame_encodes_trace
+        elif fresh:
+            # stamped/traced variants bill their own counters: the PR-7
             # encodes==publishes invariant is stated over the plain
             # JSON path and must stay exact with stamped peers attached
             counter = self._frame_encodes_fresh
@@ -1067,15 +1121,17 @@ class Subscription:
         limit: Optional[int] = None,
         codec: str = CODEC_JSON,
         fresh: bool = False,
+        traced: bool = False,
     ) -> FrameReadResult:
         """``pull`` returning the wire frames in ``codec`` alongside the
         deltas — the broadcast core's (and fan-out bench's) shape; the
         frames are shared bytes, a delivery is a buffer append. ``fresh``
-        selects the freshness-stamped frame variant."""
+        selects the freshness-stamped frame variant; ``traced`` the
+        trace-forwarding one."""
         return self._advance(
             self.view.read_frames_since(
                 self.rv, max_deltas=self.queue_depth, limit=limit, timeout=timeout,
-                codec=codec, fresh=fresh,
+                codec=codec, fresh=fresh, traced=traced,
             )
         )
 

@@ -79,7 +79,39 @@ SERVE_STAGE = "serve_fanout"
 #: journeys (it happens on the dedicated writer thread, batched) — it
 #: is attributed by the ``history_wal_write_seconds`` histogram instead.
 WAL_STAGE = "wal_append"
-ALL_STAGES = STAGES + (SERVE_STAGE, WAL_STAGE)
+
+#: Cross-cluster stages (federation tier): a sampled delta's journey no
+#: longer ends at the process boundary — the serve wire forwards the
+#: trace in-band (``?trace=1``, negotiated like ``?fresh=1``) and the
+#: federator JOINS the upstream's local spans with the hops it can
+#: measure itself:
+#:
+#:     serve_wire      upstream publish (frame ts[1]) -> federator receive
+#:     federate_merge  federator receive -> the merged view's PUBLISH
+#:                     STAMP (pub_wall is minted at apply_batch entry —
+#:                     the same instant the merged Delta itself carries,
+#:                     and the instant a second-tier serve_wire measures
+#:                     from); covers the pre-fold merge-plane work:
+#:                     trace rewrite + the fan-in drop-lock wait
+#:     global_serve    merged publish stamp -> global fan-out hand-off
+#:                     complete (the fold + journal + encode-once
+#:                     wakeup — one apply_batch; subscriber delivery is
+#:                     the consumer's own clock)
+#:
+#: Cross-host spans compare WALL clocks (monotonic stamps don't cross
+#: machines) — the same skew caveat as the freshness plane, documented
+#: in ARCHITECTURE.md "Fleet tracing". A two-tier federation repeats
+#: ``serve_wire`` per hop (``stage_durations`` sums repeats, so
+#: attribution stays total-time-per-stage); each tier's
+#: ``federate_merge``/``global_serve`` are measured and attributed AT
+#: that tier — the forwarded dict carries the upstream spans plus the
+#: wire hops, never a mid-tier's own merge spans, so a slow mid-tier
+#: merge shows in the MID tier's /debug/trace/diagnosis, not the top's.
+SERVE_WIRE_STAGE = "serve_wire"
+FEDERATE_MERGE_STAGE = "federate_merge"
+GLOBAL_SERVE_STAGE = "global_serve"
+FEDERATION_STAGES = (SERVE_WIRE_STAGE, FEDERATE_MERGE_STAGE, GLOBAL_SERVE_STAGE)
+ALL_STAGES = STAGES + (SERVE_STAGE, WAL_STAGE) + FEDERATION_STAGES
 
 #: Egress terminal outcomes that mark a trace anomalous (always recorded,
 #: never head-sampled away): the notification's journey ended somewhere
@@ -107,6 +139,7 @@ class Trace:
         "namespace",
         "event_type",
         "kind",
+        "cluster",
         "shard",
         "lane",
         "sampled_by",
@@ -139,6 +172,7 @@ class Trace:
         self.namespace = namespace
         self.event_type = event_type
         self.kind = "pod"
+        self.cluster: Optional[str] = None  # origin cluster (joined traces)
         self.shard = shard
         self.lane: Optional[int] = None
         self.sampled_by = sampled_by  # "head" | "anomaly"
@@ -188,7 +222,7 @@ class Trace:
             for stage, start, end in list(self.spans)
         ]
         total = self.duration_seconds()
-        return {
+        out = {
             "trace_id": self.trace_id,
             "uid": self.uid,
             "name": self.name,
@@ -205,6 +239,33 @@ class Trace:
             "slowest_stage": self.slowest_stage(),
             "spans": spans,
         }
+        if self.cluster is not None:
+            # only joined (federation) traces carry a cluster; local
+            # entries keep their pre-federation dict shape byte-for-byte
+            out["cluster"] = self.cluster
+        return out
+
+
+def wire_trace(trace: "Trace") -> Dict[str, Any]:
+    """The compact wire form of a sampled journey — the serve wire's
+    negotiated per-frame ``trace`` field (``?trace=1``): trace identity
+    plus the spans stamped SO FAR, as ``[stage, start_s, end_s]`` offsets
+    relative to the journey's origin (the watch receive stamp, ``t0``).
+    Offsets are same-host monotonic differences, so no wall skew lives
+    inside them; cross-host joining happens at the federator against the
+    frame's ``ts`` wall stamps. Built at encode time (lazily, per frame
+    variant), so a late-stamped span still rides the wire — each encoded
+    variant is self-consistent, two variants encoded at different times
+    may carry different prefixes of the same journey (documented)."""
+    t0 = trace.t0
+    return {
+        "id": trace.trace_id,
+        "uid": trace.uid,
+        "spans": [
+            [stage, round(start - t0, 6), round(end - t0, 6)]
+            for stage, start, end in list(trace.spans)
+        ],
+    }
 
 
 class TraceSampler:

@@ -14,6 +14,19 @@ churns pod phases, and asserts the tracing plane's three contracts:
    stages at ``/debug/trace`` — shard_receive, queue_wait, pipeline,
    lane_wait, conn_borrow, post — i.e. no hand-off drops the span context.
 
+Then the FEDERATION leg: a second WatcherApp (an upstream with the serve
+plane on) watches the same mock apiserver while a federator WatcherApp
+subscribes to it with ``trace.federation`` enabled, and the leg asserts
+the cross-cluster contracts:
+
+4. one ``/debug/trace?uid=`` query at the FEDERATOR returns a single
+   JOINED journey for a pod that originated in the upstream cluster —
+   watch (shard_receive) -> pipeline -> serve_wire -> federate_merge ->
+   global_serve — spanning both processes, with monotone stage ordering;
+5. ``/debug/trace/diagnosis`` attributes propagation time per upstream
+   per stage (slowest-stage attribution present), and the labeled
+   ``trace_stage_seconds{stage=,upstream=}`` series render in /metrics.
+
 Artifact: ``artifacts/trace_smoke.json``. Exit 0 on PASS.
 
 The overhead side of the tracing budget (<3% at 1/256 sampling) is gated
@@ -40,6 +53,7 @@ import requests
 
 from k8s_watcher_tpu.app import WatcherApp
 from k8s_watcher_tpu.config.loader import load_config
+from k8s_watcher_tpu.config.schema import FederationUpstream
 from k8s_watcher_tpu.k8s.mock_server import MockApiServer
 from k8s_watcher_tpu.trace import STAGES
 from k8s_watcher_tpu.watch.fake import build_pod
@@ -164,8 +178,193 @@ def run_smoke() -> dict:
     return result
 
 
+def _federation_configs(tmp: Path, server_url: str, serve_port: int, fed_status_port: int):
+    """(upstream config, federator config): the upstream watches the mock
+    apiserver and serves its view on ``serve_port``; the federator
+    subscribes with trace joining on. Both trace at 1/1 so every churned
+    transition is a joinable journey."""
+    kc_path = tmp / "fed-kubeconfig.json"
+    kc_path.write_text(json.dumps({
+        "apiVersion": "v1", "kind": "Config",
+        "clusters": [{"name": "m", "cluster": {"server": server_url}}],
+        "contexts": [{"name": "m", "context": {"cluster": "m", "user": "m"}}],
+        "current-context": "m",
+        "users": [{"name": "m", "user": {"token": "t"}}],
+    }))
+    base = load_config("development", str(REPO / "config"), env={})
+    upstream = dataclasses.replace(
+        base,
+        kubernetes=dataclasses.replace(
+            base.kubernetes, use_mock=False, config_file=str(kc_path),
+            watch_timeout_seconds=5,
+        ),
+        clusterapi=dataclasses.replace(
+            base.clusterapi, base_url=server_url, coalesce=False, batch_max=1,
+        ),
+        serve=dataclasses.replace(base.serve, enabled=True, port=serve_port),
+        trace=dataclasses.replace(base.trace, enabled=True, sample_rate=1, ring_size=512),
+    )
+    federator = dataclasses.replace(
+        base,
+        # the federator's own watch source is the in-process fake — its
+        # local pods are irrelevant; the journeys under test originate
+        # in the UPSTREAM cluster and arrive over the federation wire
+        kubernetes=dataclasses.replace(base.kubernetes, use_mock=True),
+        clusterapi=dataclasses.replace(
+            base.clusterapi, base_url=server_url, coalesce=False, batch_max=1,
+        ),
+        watcher=dataclasses.replace(base.watcher, status_port=fed_status_port),
+        serve=dataclasses.replace(base.serve, enabled=True, port=0),
+        federation=dataclasses.replace(
+            base.federation, enabled=True,
+            upstreams=(FederationUpstream(
+                url=f"http://127.0.0.1:{serve_port}", name="cluster-a",
+            ),),
+            stale_after_seconds=5.0,
+        ),
+        trace=dataclasses.replace(
+            base.trace, enabled=True, sample_rate=1, ring_size=512,
+            federation=dataclasses.replace(
+                base.trace.federation, enabled=True, forward_spans=True,
+                max_joined=128,
+            ),
+        ),
+    )
+    return upstream, federator
+
+
+#: the joined journey's required path (watch -> ... -> global view); the
+#: smoke additionally requires monotone ordering along it
+JOURNEY_STAGES = ("shard_receive", "pipeline", "serve_wire", "federate_merge", "global_serve")
+#: cross-clock slack for the ordering check: upstream-local offsets are
+#: monotonic-measured, cross-cluster offsets wall-measured — both anchor
+#: at the watch receive instant, but the clocks are different
+ORDER_SLACK_MS = 50.0
+
+
+def _journey_ordered(trace: dict) -> bool:
+    """Monotone stage ordering along the joined journey path."""
+    starts = {}
+    for span in trace["spans"]:
+        stage = span["stage"]
+        if stage not in starts:
+            starts[stage] = span["start_ms"]
+    prev = None
+    for stage in JOURNEY_STAGES:
+        if stage not in starts:
+            return False
+        if prev is not None and starts[stage] < prev - ORDER_SLACK_MS:
+            return False
+        prev = starts[stage]
+    return True
+
+
+def run_federation_leg() -> dict:
+    import tempfile
+
+    serve_port = _free_port()
+    fed_status_port = _free_port()
+    fed_base = f"http://127.0.0.1:{fed_status_port}"
+    result: dict = {"checks": {}}
+    with tempfile.TemporaryDirectory(prefix="trace-fed-smoke-") as tmp, MockApiServer() as server:
+        for i in range(N_PODS):
+            server.cluster.add_pod(build_pod(
+                f"fed-pod-{i}", "default", uid=f"fed-uid-{i}",
+                phase="Pending", tpu_chips=4,
+            ))
+        up_cfg, fed_cfg = _federation_configs(
+            Path(tmp), server.url, serve_port, fed_status_port
+        )
+        upstream = WatcherApp(up_cfg)
+        up_thread = threading.Thread(target=upstream.run, daemon=True)
+        up_thread.start()
+        federator = WatcherApp(fed_cfg)
+        fed_thread = threading.Thread(target=federator.run, daemon=True)
+        fed_thread.start()
+        try:
+            deadline = time.monotonic() + DEADLINE_S
+            phase_flip, churned = ("Running", "Pending"), 0
+            joined = None
+            diagnosis: dict = {}
+            stitched: dict = {}
+            while time.monotonic() < deadline:
+                for i in range(N_PODS):
+                    server.cluster.set_phase(
+                        "default", f"fed-pod-{i}", phase_flip[churned % 2]
+                    )
+                churned += 1
+                time.sleep(0.25)
+                try:
+                    body = requests.get(
+                        f"{fed_base}/debug/trace?uid=fed-uid-3&n=50", timeout=5
+                    ).json()
+                    diagnosis = requests.get(
+                        f"{fed_base}/debug/trace/diagnosis", timeout=5
+                    ).json().get("diagnosis", {})
+                except requests.RequestException:
+                    continue  # federator status server still coming up
+                stitched = body.get("stitched") or {}
+                joined = next(
+                    (
+                        t for t in body.get("traces", [])
+                        if t.get("outcome") == "merged"
+                        and t.get("cluster") == "cluster-a"
+                        and {s["stage"] for s in t["spans"]} >= set(JOURNEY_STAGES)
+                    ),
+                    None,
+                )
+                cluster_diag = (diagnosis.get("upstreams") or {}).get("cluster-a") or {}
+                if joined is not None and cluster_diag.get("slowest_stage"):
+                    break
+            try:
+                prom_text = requests.get(
+                    f"{fed_base}/metrics", params={"format": "prometheus"}, timeout=5
+                ).text
+            except requests.RequestException:
+                # a federator that never came up must FAIL the checks
+                # below, not crash the smoke before the artifact writes
+                prom_text = ""
+            cluster_diag = (diagnosis.get("upstreams") or {}).get("cluster-a") or {}
+            result["churn_rounds"] = churned
+            result["joined_trace"] = joined
+            result["diagnosis_cluster_a"] = cluster_diag
+            result["checks"] = {
+                # one query at the FEDERATOR answers the whole journey
+                "joined_trace_spans_both_processes": joined is not None,
+                "joined_stage_order_monotone": (
+                    joined is not None and _journey_ordered(joined)
+                ),
+                # the stitched section rides the same ?uid= answer
+                "stitched_journeys_present": bool(stitched.get("journeys")),
+                # slowest-stage attribution per upstream per stage
+                "diagnosis_slowest_stage": bool(cluster_diag.get("slowest_stage")),
+                "diagnosis_serve_wire_counted": (
+                    (cluster_diag.get("stages") or {}).get("serve_wire", {})
+                    .get("count", 0) > 0
+                ),
+                # the labeled family the SLO/health planes consume
+                "labeled_stage_series_render": (
+                    'k8s_watcher_trace_stage_seconds_bucket{' in prom_text
+                    and 'upstream="cluster-a"' in prom_text
+                ),
+            }
+        finally:
+            federator.stop()
+            fed_thread.join(timeout=10)
+            upstream.stop()
+            up_thread.join(timeout=10)
+    result["ok"] = all(result["checks"].values())
+    return result
+
+
 def main() -> int:
     result = run_smoke()
+    federation = run_federation_leg()
+    result["federation"] = federation
+    result["checks"].update(
+        {f"federation_{k}": v for k, v in federation["checks"].items()}
+    )
+    result["ok"] = result["ok"] and federation["ok"]
     ARTIFACTS.mkdir(exist_ok=True)
     out = ARTIFACTS / "trace_smoke.json"
     out.write_text(json.dumps(result, indent=2) + "\n")

@@ -44,7 +44,12 @@ def test_bench_smoke_headline_within_budget():
     # the rebuilt plane measures 15-20k/s, so 2600 only trips on a real
     # regression, not host noise
     assert headline["max_sustained_notify_per_sec"] > 2600, headline
-    assert "egress_saturating_stage" in headline, headline
+    # the egress verdict field rides the headline only when non-null in
+    # smoke (1 KB tail budget null-trim); the detail artifact always
+    # carries first_saturating_stage — asserted below
+    assert headline.get("egress_saturating_stage", None) is None or isinstance(
+        headline["egress_saturating_stage"], str
+    ), headline
     # burst drain is recorded and didn't collapse back to the r06 plane
     # (~520/s; the rebuilt plane drains 3x+ that with ingest in the
     # denominator — 1000 guards the 10x drain-phase win against noise)
@@ -93,6 +98,10 @@ def test_bench_smoke_headline_within_budget():
     # on snapshot/long-poll/stream over the real wire, with msgpack
     # actually negotiated by an Accept: application/x-msgpack client
     assert headline["serve_codec_ok"] is True, headline
+    # fleet tracing: in-band trace propagation on the federation fan-in
+    # path — every traced frame joined into a complete watch->global
+    # journey, inside the <3% overhead budget vs plain stamped frames
+    assert headline["trace_fleet_ok"] is True, headline
     # health plane: detector tick p99 inside its budget at fleet scale
     # (256 nodes + 8 upstreams) AND exactly the scripted straggler
     # escalated — zero collateral verdicts, decayed back to healthy
@@ -153,6 +162,13 @@ def test_bench_smoke_headline_within_budget():
     codec = fed["codec_ab"]
     assert codec["snapshot_equal"] and codec["long_poll_equal"] and codec["stream_equal"], codec
     assert codec["msgpack_negotiated"], codec
+    # fleet-trace A/B: every 1/256-traced frame joined into a journey
+    # carrying serve_wire/federate_merge/global_serve + the forwarded
+    # upstream spans, and the traced fold stayed inside the <3% budget
+    tf = fed["trace_fleet"]
+    assert tf["joined"] == tf["traced_frames"] > 0, tf
+    assert tf["journeys_complete"] and tf["correctness_ok"], tf
+    assert tf["within_budget"], tf
     health = detail["details"]["health"]
     assert health["within_budget"], health
     assert health["verdicts_exact"], health

@@ -1226,3 +1226,191 @@ class TestFederationCodecSchema:
 
         assert set(VALID_SERVE_CODECS) == {CODEC_AUTO, *CODECS}
         assert set(CODECS) == {CODEC_JSON, CODEC_MSGPACK}
+
+
+# -- fleet tracing over the federation wire -----------------------------------
+
+
+def _upstream_traced_delta(view, uid, spans=True):
+    """Publish one delta carrying a live sampled journey, the shape the
+    pipeline's publish_batch attaches."""
+    from k8s_watcher_tpu.trace import Tracer
+    from k8s_watcher_tpu.watch.fake import build_pod
+    from k8s_watcher_tpu.watch.source import EventType, WatchEvent
+
+    tracer = Tracer(sample_rate=1, ring_size=8)
+    trace = tracer.start(WatchEvent(
+        type=EventType.ADDED, pod=build_pod(uid, uid=uid, tpu_chips=4),
+    ))
+    if spans:
+        trace.add_span("shard_receive", trace.t0, trace.t0 + 0.001)
+        trace.add_span("queue_wait", trace.t0 + 0.001, trace.t0 + 0.002)
+        trace.add_span("pipeline", trace.t0 + 0.002, trace.t0 + 0.004)
+    view.apply("pod", uid, {"kind": "pod", "key": uid, "seq": 1}, trace=trace)
+    return trace
+
+
+class TestTraceOverTheWire:
+    def test_traced_client_sees_trace_field_untraced_stays_golden(self, live_serve):
+        view, _, base = live_serve
+        trace = _upstream_traced_delta(view, "tp-1")
+        view.apply("pod", "tp-2", {"kind": "pod", "key": "tp-2", "seq": 1})
+        plain = FleetClient(base).long_poll(0, timeout=0.2)
+        traced = FleetClient(base, trace=True).long_poll(0, timeout=0.2)
+        assert all("trace" not in i and "ts" not in i for i in plain.items)
+        by_key = {i["key"]: i for i in traced.items}
+        assert by_key["tp-1"]["trace"]["id"] == trace.trace_id
+        assert by_key["tp-1"]["trace"]["spans"][0][0] == "shard_receive"
+        assert "ts" in by_key["tp-1"]  # trace implies fresh
+        assert "trace" not in by_key["tp-2"]  # unsampled delta
+
+    def test_traced_watch_stream_carries_trace(self, live_serve):
+        view, _, base = live_serve
+        trace = _upstream_traced_delta(view, "tw-1")
+        client = FleetClient(base, trace=True)
+        frames = []
+        for batch in client.watch_batches(0, window_seconds=0.5):
+            frames.extend(batch)
+        deltas = [f for f in frames if f.get("type") == "UPSERT"]
+        assert deltas and deltas[0]["trace"]["id"] == trace.trace_id
+        # control frames never carry a trace
+        assert all("trace" not in f for f in frames if f.get("type") == "SYNC")
+
+    def test_serve_port_debug_trace_route(self):
+        from k8s_watcher_tpu.trace import Tracer
+
+        view = FleetView(compact_horizon=64)
+        hub = SubscriptionHub(view, max_subscribers=8, queue_depth=8)
+        tracer = Tracer(sample_rate=1, ring_size=8)
+        trace = _upstream_traced_delta(view, "dr-1")
+        tracer.finish(trace, "sent")
+        server = ServeServer(
+            view, hub, host="127.0.0.1", port=0, trace=tracer.ring
+        ).start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            client = FleetClient(base)
+            traces = client.debug_trace("dr-1")
+            assert traces and traces[0]["trace_id"] == trace.trace_id
+            # hardening is shared with the status route (one helper)
+            import requests as _requests
+
+            assert _requests.get(f"{base}/debug/trace?n=-3", timeout=5).status_code == 400
+            assert _requests.get(
+                f"{base}/debug/trace?slowest=bogus", timeout=5
+            ).status_code == 400
+        finally:
+            server.stop()
+
+    def test_serve_port_debug_trace_404_when_tracing_off(self):
+        view = FleetView(compact_horizon=64)
+        hub = SubscriptionHub(view, max_subscribers=8, queue_depth=8)
+        server = ServeServer(view, hub, host="127.0.0.1", port=0).start()
+        try:
+            import requests as _requests
+
+            r = _requests.get(
+                f"http://127.0.0.1:{server.port}/debug/trace", timeout=5
+            )
+            assert r.status_code == 404
+        finally:
+            server.stop()
+
+
+class TestMergeTracePropagation:
+    def test_apply_batch_five_tuples_reach_merged_frames(self):
+        gview = FleetView(compact_horizon=64)
+        merge = GlobalMerge(gview)
+        wire_trace = {"id": "up-7", "uid": "p7", "cluster": "east",
+                      "spans": [["pipeline", 0.001, 0.002],
+                                ["serve_wire", 0.002, 0.003]]}
+        merge.apply_batch("east", [
+            {"type": "UPSERT", "kind": "pod", "key": "p7",
+             "object": {"kind": "pod", "key": "p7"},
+             "ts": [100.0, 100.1], "trace": wire_trace},
+            {"type": "UPSERT", "kind": "pod", "key": "p8",
+             "object": {"kind": "pod", "key": "p8"}, "ts": [100.0, 100.1]},
+        ])
+        deltas = gview.read_since(0, max_deltas=8).deltas
+        by_key = {d.key: d for d in deltas}
+        # the merged delta journals the dict; the GLOBAL view's traced
+        # frames republish it (a second-tier federator joins from it)
+        assert by_key["east/p7"].trace is wire_trace
+        assert by_key["east/p8"].trace is None
+        traced = gview.read_frames_since(0, max_deltas=8, traced=True)
+        from k8s_watcher_tpu.serve.view import frame_payload
+
+        bodies = {
+            json.loads(frame_payload(f))["key"]: json.loads(frame_payload(f))
+            for f in traced.frames
+        }
+        assert bodies["east/p7"]["trace"] == wire_trace
+        assert "trace" not in bodies["east/p8"]
+
+    def test_apply_delta_baseline_propagates_too(self):
+        gview = FleetView(compact_horizon=64)
+        merge = GlobalMerge(gview)
+        wire_trace = {"id": "up-9", "uid": "p9", "spans": []}
+        merge.apply_delta("west", {
+            "type": "UPSERT", "kind": "pod", "key": "p9",
+            "object": {"kind": "pod", "key": "p9"},
+            "ts": [100.0, 100.1], "trace": wire_trace,
+        })
+        [delta] = gview.read_since(0, max_deltas=4).deltas
+        assert delta.trace is wire_trace
+
+
+class TestFleetTracePlaneLive:
+    """The full joined path over real HTTP: an upstream serving plane
+    with traced deltas -> a federator plane with the collector -> the
+    joined journey in the federator's ring."""
+
+    def test_joined_journey_through_live_plane(self):
+        from k8s_watcher_tpu.trace import FEDERATION_STAGES, Tracer
+        from k8s_watcher_tpu.trace.federation import FleetTraceCollector
+
+        (v1, s1) = _upstream_stack()
+        reg = MetricsRegistry()
+        gview = FleetView(metrics=reg)
+        tracer = Tracer(sample_rate=1, ring_size=64, metrics=reg)
+        collector = FleetTraceCollector(
+            tracer=tracer, metrics=reg, max_joined=64, max_label_sets=64
+        )
+        plane = FederationPlane(
+            _fed_config([f"http://127.0.0.1:{s1.port}"], stale_after_seconds=5.0),
+            gview, metrics=reg, trace_collector=collector,
+        ).start()
+        try:
+            _wait_for(
+                lambda: all(u.subscriber.snapshots > 0 for u in plane.upstreams),
+                message="initial snapshots",
+            )
+            _upstream_traced_delta(v1, "fleet-1")
+            _wait_for(lambda: gview.object_count() == 1, message="merge convergence")
+            _wait_for(
+                lambda: tracer.ring.snapshot(4, uid="fleet-1"), message="joined trace"
+            )
+            [joined] = tracer.ring.snapshot(4, uid="fleet-1")
+            stages = {s["stage"] for s in joined["spans"]}
+            assert stages >= set(FEDERATION_STAGES) | {"shard_receive", "pipeline"}
+            assert joined["cluster"] == "c0"
+            # attribution landed in the labeled family + the diagnosis
+            assert reg.histogram("trace_stage_seconds").labels(
+                stage="serve_wire", upstream="c0"
+            ).count >= 1
+            diag = collector.diagnosis()
+            assert diag["upstreams"]["c0"]["slowest_stage"]
+            # the merged view's OWN traced frames carry the augmented
+            # dict (second-tier joinability), cluster preserved
+            traced = gview.read_frames_since(0, max_deltas=8, traced=True)
+            from k8s_watcher_tpu.serve.view import frame_payload
+
+            traced_bodies = [
+                json.loads(frame_payload(f)) for f in traced.frames
+            ]
+            carried = [b for b in traced_bodies if "trace" in b]
+            assert carried and carried[0]["trace"]["cluster"] == "c0"
+            assert carried[0]["trace"]["spans"][-1][0] == "serve_wire"
+        finally:
+            plane.stop()
+            s1.stop()

@@ -554,3 +554,429 @@ class TestTraceIdInLogs:
             tracer.finish(trace, "failed")  # anomaly -> INFO
         matching = [r for r in caplog.records if getattr(r, "trace_id", None)]
         assert matching and matching[0].trace_id == trace.trace_id
+
+
+# -- fleet tracing (cross-cluster joining, trace/federation.py) ---------------
+
+
+class TestFleetStageVocabulary:
+    def test_cross_cluster_stages_extend_all_stages_not_the_six(self):
+        from k8s_watcher_tpu.trace import (
+            ALL_STAGES,
+            FEDERATE_MERGE_STAGE,
+            FEDERATION_STAGES,
+            GLOBAL_SERVE_STAGE,
+            SERVE_WIRE_STAGE,
+        )
+
+        assert FEDERATION_STAGES == ("serve_wire", "federate_merge", "global_serve")
+        assert SERVE_WIRE_STAGE in ALL_STAGES
+        assert FEDERATE_MERGE_STAGE in ALL_STAGES
+        assert GLOBAL_SERVE_STAGE in ALL_STAGES
+        # the six REQUIRED local hand-off stages are untouched
+        assert len(STAGES) == 6
+        assert not any(s in STAGES for s in FEDERATION_STAGES)
+
+    def test_wire_trace_offsets_relative_to_origin(self):
+        from k8s_watcher_tpu.trace import wire_trace
+
+        tracer = Tracer(sample_rate=1)
+        trace = tracer.start(tpu_event(1))
+        t0 = trace.t0
+        trace.add_span("shard_receive", t0, t0 + 0.002)
+        trace.add_span("pipeline", t0 + 0.002, t0 + 0.005)
+        wt = wire_trace(trace)
+        assert wt["id"] == trace.trace_id and wt["uid"] == "uid-1"
+        assert wt["spans"] == [
+            ["shard_receive", 0.0, 0.002],
+            ["pipeline", 0.002, 0.005],
+        ]
+
+
+class TestTracedWireFrames:
+    """The negotiated ?trace=1 frame variant (serve/view.py): sampled
+    deltas carry their journey in-band; everything an untraced peer sees
+    stays byte-golden."""
+
+    def _traced_view(self, reg=None):
+        from k8s_watcher_tpu.serve import FleetView
+
+        view = FleetView(metrics=reg)
+        tracer = Tracer(sample_rate=1)
+        trace = tracer.start(tpu_event(7))
+        trace.add_span("shard_receive", trace.t0, trace.t0 + 0.001)
+        view.apply("pod", "uid-7", {"kind": "pod", "key": "uid-7", "seq": 0},
+                   trace=trace)
+        view.apply("pod", "uid-8", {"kind": "pod", "key": "uid-8", "seq": 0})
+        return view, trace
+
+    def test_untraced_frames_stay_byte_golden(self):
+        from k8s_watcher_tpu.serve.view import frame_payload
+
+        view, _ = self._traced_view()
+        r = view.read_frames_since(0, max_deltas=4)
+        for delta, frame in zip(r.deltas, r.frames):
+            assert "trace" not in delta.to_wire()
+            assert "ts" not in delta.to_wire()
+            assert frame_payload(frame) == (json.dumps(delta.to_wire()) + "\n").encode()
+
+    def test_traced_variant_carries_trace_and_implies_ts(self):
+        from k8s_watcher_tpu.metrics import MetricsRegistry as _Reg
+        from k8s_watcher_tpu.serve.view import frame_payload
+
+        reg = _Reg()
+        view, trace = self._traced_view(reg)
+        traced1 = view.read_frames_since(0, max_deltas=4, traced=True)
+        traced2 = view.read_frames_since(0, max_deltas=4, traced=True)
+        body = json.loads(frame_payload(traced1.frames[0]))
+        assert body["trace"]["id"] == trace.trace_id
+        assert body["trace"]["uid"] == "uid-7"
+        assert body["trace"]["spans"][0][0] == "shard_receive"
+        assert "ts" in body  # trace implies the freshness stamps
+        # the UNsampled delta's traced frame has no trace field
+        assert "trace" not in json.loads(frame_payload(traced1.frames[1]))
+        # memoized per variant + billed to its own counter: the PR-7
+        # encodes==publishes invariant over the plain path stays exact
+        assert traced1.frames[0] is traced2.frames[0]
+        assert reg.counter("serve_frame_encodes_trace").value == 2
+        assert reg.counter("serve_frame_encodes_fresh").value == 0
+
+    def test_second_hop_dict_passes_through_verbatim(self):
+        from k8s_watcher_tpu.serve import FleetView
+
+        view = FleetView()
+        wire_dict = {"id": "up-1", "uid": "p", "cluster": "east",
+                     "spans": [["serve_wire", 0.001, 0.002]]}
+        view.apply_batch([
+            ("pod", "east/p", {"kind": "pod", "key": "east/p"}, 123.0, wire_dict),
+        ])
+        delta = view.read_since(0, max_deltas=4).deltas[0]
+        assert delta.to_wire(trace=True)["trace"] is wire_dict
+
+    def test_http_trace_negotiation_long_poll(self):
+        from k8s_watcher_tpu.serve import FleetView, ServeServer, SubscriptionHub
+
+        view, _ = self._traced_view()
+        hub = SubscriptionHub(view, max_subscribers=4, queue_depth=16)
+        server = ServeServer(view, hub, host="127.0.0.1", port=0).start()
+        try:
+            base = f"http://127.0.0.1:{server.port}/serve/fleet"
+            plain = requests.get(base, timeout=5, params={
+                "watch": 1, "once": 1, "rv": 0, "timeout": 0.2}).json()
+            traced = requests.get(base, timeout=5, params={
+                "watch": 1, "once": 1, "rv": 0, "timeout": 0.2, "trace": 1}).json()
+            assert all("trace" not in i and "ts" not in i for i in plain["items"])
+            assert "trace" in traced["items"][0] and "ts" in traced["items"][0]
+            assert "trace" not in traced["items"][1]  # unsampled delta
+            stripped = [
+                {k: v for k, v in i.items() if k not in ("ts", "trace")}
+                for i in traced["items"]
+            ]
+            assert stripped == plain["items"]
+        finally:
+            server.stop()
+
+
+def _traced_frame(i, origin, pub, uid=None):
+    """One decoded ?trace=1 wire frame as a federator's subscriber
+    delivers it."""
+    return {
+        "type": "UPSERT", "rv": i + 1, "kind": "pod",
+        "key": uid or f"uid-{i}",
+        "object": {"kind": "pod", "key": uid or f"uid-{i}", "seq": i},
+        "ts": [origin, pub],
+        "trace": {
+            "id": f"tr-{i:04x}", "uid": uid or f"uid-{i}",
+            "spans": [["shard_receive", 0.0, 0.001],
+                      ["queue_wait", 0.001, 0.002],
+                      ["pipeline", 0.002, 0.004]],
+        },
+    }
+
+
+def _collector(metrics=None, **kw):
+    from k8s_watcher_tpu.trace.federation import FleetTraceCollector
+
+    tracer = Tracer(sample_rate=1, ring_size=64, metrics=metrics)
+    return FleetTraceCollector(tracer=tracer, metrics=metrics, **kw), tracer
+
+
+class TestFleetTraceCollector:
+    def test_joins_complete_journey_into_shared_ring(self):
+        coll, tracer = _collector(MetricsRegistry())
+        origin = time.time() - 0.010
+        frame = _traced_frame(0, origin, origin + 0.005)
+        t_recv, t_pub, t_done = origin + 0.008, origin + 0.009, origin + 0.0095
+        coll.note_receive("cluster-a", [frame], t_recv)
+        # the frame's trace dict was REWRITTEN for the merged republish:
+        # upstream spans + this hop's serve_wire + the origin cluster
+        assert frame["trace"]["cluster"] == "cluster-a"
+        assert frame["trace"]["spans"][-1][0] == "serve_wire"
+        assert coll.adopt("cluster-a", [frame], t_recv, t_pub, t_done) == 1
+        [joined] = tracer.ring.snapshot(4, uid="uid-0")
+        stages = [s["stage"] for s in joined["spans"]]
+        assert stages == ["shard_receive", "queue_wait", "pipeline",
+                          "serve_wire", "federate_merge", "global_serve"]
+        assert joined["cluster"] == "cluster-a"
+        assert joined["trace_id"] == "tr-0000"  # identity propagated
+        assert joined["outcome"] == "merged"
+        # monotone along the journey: serve_wire starts at the upstream
+        # publish offset, merge/serve follow receive/publish
+        starts = {s["stage"]: s["start_ms"] for s in joined["spans"]}
+        assert starts["serve_wire"] == pytest.approx(5.0, abs=0.5)
+        assert starts["federate_merge"] == pytest.approx(8.0, abs=0.5)
+        assert starts["global_serve"] == pytest.approx(9.0, abs=0.5)
+
+    def test_labeled_histograms_and_unlabeled_federation_stages(self):
+        reg = MetricsRegistry()
+        coll, _ = _collector(reg)
+        origin = time.time() - 0.010
+        frame = _traced_frame(3, origin, origin + 0.002)
+        coll.note_receive("cluster-b", [frame], origin + 0.004)
+        coll.adopt("cluster-b", [frame], origin + 0.004, origin + 0.005, origin + 0.006)
+        family = reg.histogram("trace_stage_seconds")
+        child = family.labels(stage="serve_wire", upstream="cluster-b")
+        assert child.count == 1
+        # upstream-local stages land labeled too (the attribution axis)
+        assert family.labels(stage="pipeline", upstream="cluster-b").count == 1
+        # cross-cluster stages feed the UNLABELED trace_stage_* series the
+        # health plane's collector reads; upstream-local ones do NOT (they
+        # were measured on another host)
+        assert reg.histogram("trace_stage_serve_wire").count == 1
+        assert reg.histogram("trace_stage_federate_merge").count == 1
+        assert reg.histogram("trace_stage_global_serve").count == 1
+        assert reg.histogram("trace_stage_pipeline").count == 0
+        assert reg.counter("trace_joined").value == 1
+
+    def test_diagnosis_attributes_slowest_stage_per_upstream(self):
+        reg = MetricsRegistry()
+        coll, _ = _collector(reg)
+        origin = time.time() - 1.0
+        # a slow serve_wire hop: publish long before receive
+        frame = _traced_frame(1, origin, origin + 0.001)
+        coll.note_receive("cluster-a", [frame], origin + 0.900)
+        coll.adopt("cluster-a", [frame], origin + 0.900, origin + 0.901, origin + 0.902)
+        diag = coll.diagnosis()
+        entry = diag["upstreams"]["cluster-a"]
+        assert entry["slowest_stage"] == "serve_wire"
+        assert entry["slowest_share"] > 0.9
+        assert entry["stages"]["serve_wire"]["count"] == 1
+        assert entry["stages"]["serve_wire"]["window"]["count"] == 1
+        # the second read's window is empty (cum-delta differencing)
+        again = coll.diagnosis()
+        assert again["upstreams"]["cluster-a"]["stages"]["serve_wire"]["window"]["count"] == 0
+
+    def test_forward_spans_off_bounds_memory_and_stitches_lazily(self):
+        coll, tracer = _collector(MetricsRegistry(), forward_spans=False, max_joined=8)
+        origin = time.time() - 0.010
+        frame = _traced_frame(5, origin, origin + 0.002)
+        coll.note_receive("cluster-a", [frame], origin + 0.004)
+        coll.adopt("cluster-a", [frame], origin + 0.004, origin + 0.005, origin + 0.006)
+        [joined] = tracer.ring.snapshot(4, uid="uid-5")
+        # only the cross-cluster stages were kept in memory
+        assert {s["stage"] for s in joined["spans"]} == {
+            "serve_wire", "federate_merge", "global_serve"}
+        # lazy stitch: the registered fetcher supplies the upstream spans
+        coll.register_fetcher("cluster-a", lambda uid: [{
+            "trace_id": "tr-0005", "uid": uid,
+            "spans": [{"stage": "pipeline", "start_ms": 2.0, "duration_ms": 2.0}],
+        }])
+        stitched = coll.stitch("uid-5")
+        assert not stitched["partial"]
+        [journey] = stitched["journeys"]
+        assert journey["stitched_from"] == "cluster-a"
+        assert journey["spans"][0]["stage"] == "pipeline"
+
+    def test_stitch_partial_when_upstream_unreachable_never_raises(self):
+        coll, _ = _collector(MetricsRegistry(), forward_spans=False)
+        origin = time.time() - 0.010
+        frame = _traced_frame(6, origin, origin + 0.002)
+        coll.note_receive("cluster-a", [frame], origin + 0.004)
+        coll.adopt("cluster-a", [frame], origin + 0.004, origin + 0.005, origin + 0.006)
+
+        def dark_upstream(uid):
+            raise ConnectionError("connection refused")
+
+        coll.register_fetcher("cluster-a", dark_upstream)
+        stitched = coll.stitch("uid-6")
+        assert stitched["partial"] is True
+        assert "cluster-a" in stitched["upstream_errors"]
+        # the cross-cluster spans still answer (partial trace, never 500)
+        assert stitched["journeys"] and stitched["journeys"][0]["spans"]
+
+    def test_max_joined_bounds_recent_newest_wins(self):
+        coll, _ = _collector(MetricsRegistry(), max_joined=2)
+        origin = time.time() - 0.010
+        for i in range(4):
+            frame = _traced_frame(i, origin, origin + 0.002)
+            coll.note_receive("c", [frame], origin + 0.004)
+            coll.adopt("c", [frame], origin + 0.004, origin + 0.005, origin + 0.006)
+        assert [t.uid for t in coll._recent] == ["uid-2", "uid-3"]
+
+    def test_adopt_emits_log_line_with_trace_id(self, caplog):
+        import logging
+
+        coll, _ = _collector(MetricsRegistry())
+        origin = time.time() - 0.010
+        frame = _traced_frame(9, origin, origin + 0.002)
+        with caplog.at_level(logging.DEBUG, logger="k8s_watcher_tpu.trace.federation"):
+            coll.note_receive("cluster-a", [frame], origin + 0.004)
+            coll.adopt("cluster-a", [frame], origin + 0.004, origin + 0.005, origin + 0.006)
+        matching = [r for r in caplog.records if getattr(r, "trace_id", None)]
+        assert matching and matching[0].trace_id == "tr-0009"
+
+
+class TestDebugTraceHardening:
+    def _server(self, ring, **kw):
+        from k8s_watcher_tpu.metrics.server import Liveness, StatusServer
+
+        return StatusServer(MetricsRegistry(), Liveness(), trace=ring, **kw).start()
+
+    def test_negative_and_junk_n_answer_400(self):
+        tracer = Tracer(sample_rate=1, ring_size=4)
+        server = self._server(tracer.ring)
+        try:
+            base = f"http://127.0.0.1:{server.port}/debug/trace"
+            assert requests.get(f"{base}?n=-1", timeout=5).status_code == 400
+            assert requests.get(f"{base}?n=1.5", timeout=5).status_code == 400
+            assert requests.get(f"{base}?n=0", timeout=5).status_code == 200
+        finally:
+            server.stop()
+
+    def test_new_stages_are_valid_slowest_filters(self):
+        tracer = Tracer(sample_rate=1, ring_size=4)
+        server = self._server(tracer.ring)
+        try:
+            base = f"http://127.0.0.1:{server.port}/debug/trace"
+            for stage in ("serve_wire", "federate_merge", "global_serve"):
+                assert requests.get(f"{base}?slowest={stage}", timeout=5).status_code == 200
+            assert requests.get(f"{base}?slowest=warp_drive", timeout=5).status_code == 400
+        finally:
+            server.stop()
+
+    def test_diagnosis_route_404_when_not_wired_200_when_wired(self):
+        coll, tracer = _collector(MetricsRegistry())
+        bare = self._server(tracer.ring)
+        try:
+            url = f"http://127.0.0.1:{bare.port}/debug/trace/diagnosis"
+            assert requests.get(url, timeout=5).status_code == 404
+        finally:
+            bare.stop()
+        wired = self._server(
+            tracer.ring, trace_stitch=coll.stitch, trace_diagnosis=coll.diagnosis
+        )
+        try:
+            url = f"http://127.0.0.1:{wired.port}/debug/trace/diagnosis"
+            body = requests.get(url, timeout=5).json()
+            assert "upstreams" in body["diagnosis"]
+            # a ?uid= query carries the stitched section alongside the ring
+            origin = time.time() - 0.010
+            frame = _traced_frame(2, origin, origin + 0.002)
+            coll.note_receive("cluster-a", [frame], origin + 0.004)
+            coll.adopt("cluster-a", [frame], origin + 0.004, origin + 0.005, origin + 0.006)
+            traces = requests.get(
+                f"http://127.0.0.1:{wired.port}/debug/trace?uid=uid-2", timeout=5
+            ).json()
+            assert traces["stitched"]["journeys"]
+        finally:
+            wired.stop()
+
+
+class TestTraceFederationSchema:
+    def _raw(self, trace_fed, *, federation_on=True, trace_on=True):
+        raw = {
+            "serve": {"enabled": True},
+            "trace": {"enabled": trace_on, "federation": trace_fed},
+        }
+        if federation_on:
+            raw["federation"] = {
+                "enabled": True,
+                "upstreams": [{"name": "a", "url": "http://a:1"}],
+            }
+        return raw
+
+    def test_valid_block_parses(self):
+        from k8s_watcher_tpu.config.schema import AppConfig
+
+        cfg = AppConfig.from_raw(
+            self._raw({"enabled": True, "forward_spans": False, "max_joined": 32}),
+            "development",
+        )
+        assert cfg.trace.federation.enabled is True
+        assert cfg.trace.federation.forward_spans is False
+        assert cfg.trace.federation.max_joined == 32
+
+    def test_defaults_off_bounded(self):
+        from k8s_watcher_tpu.config.schema import AppConfig
+
+        cfg = AppConfig.from_raw({}, "development")
+        assert cfg.trace.federation.enabled is False
+        assert cfg.trace.federation.forward_spans is True
+        assert cfg.trace.federation.max_joined == 256
+
+    def test_requires_trace_enabled(self):
+        from k8s_watcher_tpu.config.schema import AppConfig, SchemaError
+
+        with pytest.raises(SchemaError, match="requires trace.enabled"):
+            AppConfig.from_raw(
+                self._raw({"enabled": True}, trace_on=False), "development"
+            )
+
+    def test_requires_federation_enabled(self):
+        from k8s_watcher_tpu.config.schema import AppConfig, SchemaError
+
+        with pytest.raises(SchemaError, match="requires\n?\\s*federation.enabled"):
+            AppConfig.from_raw(
+                self._raw({"enabled": True}, federation_on=False), "development"
+            )
+
+    def test_max_joined_floor_and_unknown_keys(self):
+        from k8s_watcher_tpu.config.schema import AppConfig, SchemaError
+
+        with pytest.raises(SchemaError, match="max_joined"):
+            AppConfig.from_raw(self._raw({"enabled": True, "max_joined": 0}), "development")
+        with pytest.raises(SchemaError, match="unknown config key"):
+            AppConfig.from_raw(self._raw({"enabled": True, "bogus": 1}), "development")
+
+
+class TestCollectorWireHardening:
+    """Wire data is upstream-controlled: malformed frames skip their
+    journey, unknown stage names mint no labeled series — neither may
+    ever raise into the federation subscriber thread."""
+
+    def test_malformed_ts_and_spans_never_raise(self):
+        coll, tracer = _collector(MetricsRegistry())
+        now = time.time()
+        frames = [
+            {"type": "UPSERT", "ts": [None, 1.0],
+             "trace": {"id": "x", "uid": "u1", "spans": []}},
+            {"type": "UPSERT", "ts": "bogus",
+             "trace": {"id": "y", "uid": "u2", "spans": []}},
+            {"type": "UPSERT", "ts": [now, now + 0.001],
+             "trace": {"id": "z", "uid": "u3",
+                       "spans": [["pipeline", "not-a-number", None]]}},
+            # spans that are not even lists of triples: len()/iteration
+            # must not raise out of note_receive either
+            {"type": "UPSERT", "ts": [now, now + 0.001],
+             "trace": {"id": "w", "uid": "u4", "spans": [42]}},
+            {"type": "UPSERT", "ts": [now, now + 0.001],
+             "trace": {"id": "v", "uid": "u5", "spans": 7}},
+        ]
+        coll.note_receive("c", frames, now + 0.002)
+        assert coll.adopt("c", frames, now + 0.002, now + 0.003, now + 0.004) == 0
+        assert tracer.ring.snapshot(8) == []
+
+    def test_unknown_wire_stage_mints_no_labeled_series(self):
+        reg = MetricsRegistry()
+        coll, tracer = _collector(reg)
+        origin = time.time() - 0.010
+        frame = _traced_frame(4, origin, origin + 0.002)
+        frame["trace"]["spans"].append(["warp_drive", 0.004, 0.005])
+        coll.note_receive("c", [frame], origin + 0.004)
+        assert coll.adopt("c", [frame], origin + 0.004, origin + 0.005, origin + 0.006) == 1
+        family = reg.histogram("trace_stage_seconds")
+        labeled_stages = {dict(c.labelset)["stage"] for c in family.children()}
+        assert "warp_drive" not in labeled_stages
+        # the joined trace in the ring still carries the span verbatim
+        [joined] = tracer.ring.snapshot(4, uid="uid-4")
+        assert "warp_drive" in {s["stage"] for s in joined["spans"]}
