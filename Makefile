@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: test check check-scale integration integration-kind integration-mock bench bench-smoke trace-smoke serve-smoke history-smoke federation-smoke obs-smoke health-smoke analytics-smoke dryrun dryrun-128 accept
+.PHONY: test check check-scale integration integration-kind integration-mock bench bench-smoke trace-smoke serve-smoke history-smoke federation-smoke obs-smoke health-smoke analytics-smoke relay-smoke dryrun dryrun-128 accept
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -124,6 +124,18 @@ health-smoke:
 # artifacts/analytics_smoke.json.
 analytics-smoke:
 	$(PY) scripts/analytics_smoke.py
+
+# Relay-tier smoke: one mock-backed root WatcherApp + one relay
+# WatcherApp as a real SUBPROCESS mirroring it over the raw-bytes
+# passthrough. Gates: the relay serves the root's exact view (same
+# instance/rv line), zero relay re-encodes across the process boundary,
+# a sequence-checked consumer stays gapless through churn AND through a
+# relay kill+restart (backfill re-warms the journal, zero resyncs), the
+# consumer's relay-carried token reads from the root directly, and the
+# relay stamps depth 1. The >=100k 2-level-tree SCALE gate runs in
+# bench-smoke (bench_relay_tree). Artifact: artifacts/relay_smoke.json.
+relay-smoke:
+	$(PY) scripts/relay_smoke.py
 
 dryrun:
 	$(PY) __graft_entry__.py 8

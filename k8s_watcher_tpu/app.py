@@ -242,6 +242,22 @@ class WatcherApp:
                 # a downstream federator reads this process's local spans
                 # from (its federation config only knows the serve URL)
                 self.serve.attach_trace(self.tracer.ring)
+        # relay/edge fan-out tier (relay/): this serve node's view is an
+        # upstream serving plane MIRRORED over the raw-bytes passthrough
+        # — same view instance id, same rv line, the upstream's frame
+        # bytes re-broadcast verbatim (zero re-encode; the PR-7
+        # shared-bytes invariant across processes). The local pipeline
+        # deliberately does NOT publish into a relayed view: its deltas
+        # would mint rvs on a foreign rv space (schema forbids pairing
+        # relay with federation/history for the same reason).
+        self.relay = None
+        if config.relay.enabled:
+            from k8s_watcher_tpu.relay import RelayPlane
+
+            self.relay = RelayPlane(
+                config.relay, self.serve.view, metrics=self.metrics
+            )
+            self.serve.attach_relay(self.relay)
         # multi-cluster federation plane (federate/): N upstream serving
         # planes subscribed (resume-protocol consumers with durable
         # tokens) and merged into THIS process's FleetView under
@@ -385,7 +401,7 @@ class WatcherApp:
         # including ones the critical gate suppresses from notification)
         self._notify_sink = (
             self.serve.wrap_sink(self.dispatcher.submit)
-            if self.serve is not None
+            if self.serve is not None and self.relay is None
             else self.dispatcher.submit
         )
         self.source = source or build_source(
@@ -430,7 +446,9 @@ class WatcherApp:
             metrics=self.metrics,
             audit=self.audit,
             tracer=self.tracer,
-            view=self.serve.view if self.serve is not None else None,
+            # a relayed view mirrors the UPSTREAM's rv line: the local
+            # pipeline must not publish into it (see relay wiring above)
+            view=self.serve.view if self.serve is not None and self.relay is None else None,
             resource_key=config.tpu.resource_key,
             topology_label=config.tpu.topology_label,
             accelerator_label=config.tpu.accelerator_label,
@@ -453,6 +471,14 @@ class WatcherApp:
     def run(self) -> None:
         """Blocking steady-state loop (parity: pod_watcher.py:243-277)."""
         self.dispatcher.start()
+        if self.relay is not None:
+            # BEFORE the serve plane binds: the first local subscriber
+            # must find an adopted (upstream-mirrored) view, not a cold
+            # one on the wrong rv line. wait_synced is bounded — an
+            # unreachable upstream degrades health instead of wedging
+            # startup (availability over strictness).
+            self.relay.start()
+            self.relay.wait_synced(self.config.relay.sync_timeout_seconds)
         if self.serve is not None:
             # before the status server so /healthz's serve verdict always
             # reflects a STARTED plane (never a transiently-absent server)
@@ -510,6 +536,10 @@ class WatcherApp:
                 # ... and the federation plane: a stale upstream means a
                 # slice of the global view has gone dark
                 federation=self.federation.health if self.federation is not None else None,
+                # relay-tier detail (depth, upstream connectivity, the
+                # zero-re-encode counters) at /debug/relay; the verdict
+                # itself rides the serve fold's body
+                relay=self.relay.health if self.relay is not None else None,
                 # freshness watermarks + propagation histograms (the
                 # "how stale is what I'm serving" surface)
                 freshness=self._freshness_snapshot if self.serve is not None else None,
@@ -549,6 +579,8 @@ class WatcherApp:
                 ", /debug/history" if self.history is not None else ""
             ) + (
                 ", /debug/federation" if self.federation is not None else ""
+            ) + (
+                ", /debug/relay" if self.relay is not None else ""
             ) + (
                 ", /debug/freshness" if self.serve is not None else ""
             ) + (
@@ -836,6 +868,9 @@ class WatcherApp:
             # subscribers are view producers, and the terminal history
             # snapshot must anchor AFTER their last delta
             self.federation.stop()
+        if self.relay is not None:
+            # same producer contract: the relay subscriber feeds the view
+            self.relay.stop()
         if self.serve is not None:
             self.serve.stop()
         if self._probe_agent is not None:

@@ -1445,6 +1445,136 @@ class FederationConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class RelayConfig:
+    """The ``relay:`` section — net-new relay/edge fan-out tier
+    (relay/): this serve node's FleetView mirrors ONE upstream serving
+    plane over the raw-bytes passthrough (same view instance id, same
+    rv line, the upstream's wire frames re-broadcast VERBATIM — zero
+    re-encode), forming a depth-stamped fan-out tree that carries 100k+
+    streaming subscribers off one publisher. Requires ``serve.enabled``;
+    mutually exclusive with ``federation.enabled`` and
+    ``history.enabled`` (both would mint/persist rvs against a foreign
+    rv line). See ARCHITECTURE.md "Relay tier".
+    """
+
+    enabled: bool = False
+    upstream: Optional[FederationUpstream] = None  # required when enabled
+    # tree-depth bound, counted from the root (a root serve plane is
+    # depth 0, its relays are depth 1, ...). The loop-breaker: a
+    # mis-wired relay cycle re-discovers a growing depth every reconnect
+    # and self-quarantines at the limit instead of circulating frames.
+    depth_limit: int = 2
+    # upstream wire codec preference (mirrors federation.codec): the
+    # passthrough stores whatever shape actually rides the wire; local
+    # subscribers on other shapes pay the usual lazy once-per-delta fill
+    codec: str = "auto"
+    # negotiate ?fresh=1 upstream (default on: depth-stamped per-hop
+    # freshness reads the ts field, and stamped frames pass through to
+    # leaves so tier-N consumers measure true end-to-end age)
+    fresh: bool = True
+    # negotiate ?trace=1 upstream (trace implies fresh on the wire):
+    # sampled journeys' in-band trace dicts pass through verbatim
+    trace: bool = False
+    # journal warm-up on (re)connect: subscribe this many rvs BELOW the
+    # snapshot (floored by the upstream's retention) so resume tokens
+    # minted before a relay restart keep resuming gapless against the
+    # new process. 0 disables (tokens older than the restart re-snapshot)
+    backfill: int = 4096
+    # an upstream with no frame (delta or SYNC) for this long is stale:
+    # the relay reconnects and its health body degrades
+    stale_after_seconds: float = 10.0
+    # reconnect/resync backoff base (jittered, exponential)
+    resync_backoff_seconds: float = 1.0
+    # how long app startup waits for the first upstream adopt before
+    # serving anyway (degraded): bounded availability-over-strictness
+    sync_timeout_seconds: float = 15.0
+
+    @classmethod
+    def from_raw(cls, raw: Mapping[str, Any]) -> "RelayConfig":
+        path = "relay"
+        _check_known(
+            raw,
+            ("enabled", "upstream", "depth_limit", "codec", "fresh", "trace",
+             "backfill", "stale_after_seconds", "resync_backoff_seconds",
+             "sync_timeout_seconds"),
+            path,
+        )
+        enabled = _opt_bool(raw, "enabled", path, False)
+        upstream = None
+        raw_upstream = raw.get("upstream")
+        if raw_upstream is not None:
+            entry_path = f"{path}.upstream"
+            _expect(raw_upstream, (dict,), entry_path)
+            _check_known(raw_upstream, ("name", "url", "token"), entry_path)
+            url = _opt_str(raw_upstream, "url", entry_path, None)
+            if not url:
+                raise SchemaError(
+                    f"config key '{entry_path}.url': required (the upstream "
+                    f"serving plane this relay mirrors)"
+                )
+            name = _opt_str(raw_upstream, "name", entry_path, None)
+            if not name:
+                from urllib.parse import urlsplit
+
+                parts = urlsplit(url if "//" in url else f"http://{url}")
+                name = parts.netloc or "upstream"
+            upstream = FederationUpstream(
+                url=url, name=name,
+                token=_opt_str(raw_upstream, "token", entry_path, None) or None,
+            )
+        if enabled and upstream is None:
+            raise SchemaError(
+                "config key 'relay.upstream': required when relay.enabled "
+                "(a relay with nothing to relay)"
+            )
+        depth_limit = _opt_int(raw, "depth_limit", path, 2)
+        if depth_limit < 1:
+            raise SchemaError(
+                f"config key '{path}.depth_limit': must be >= 1 (a relay is "
+                f"at least depth 1), got {depth_limit}"
+            )
+        codec = _opt_str(raw, "codec", path, "auto")
+        if codec not in VALID_SERVE_CODECS:
+            raise SchemaError(
+                f"config key '{path}.codec': must be one of "
+                f"{', '.join(VALID_SERVE_CODECS)}, got {codec!r}"
+            )
+        backfill = _opt_int(raw, "backfill", path, 4096)
+        if backfill < 0:
+            raise SchemaError(
+                f"config key '{path}.backfill': must be >= 0 (0 disables the "
+                f"journal warm-up), got {backfill}"
+            )
+        stale_after = _opt_num(raw, "stale_after_seconds", path, 10.0)
+        if stale_after <= 0:
+            raise SchemaError(
+                f"config key '{path}.stale_after_seconds': must be > 0, got {stale_after}"
+            )
+        backoff = _opt_num(raw, "resync_backoff_seconds", path, 1.0)
+        if backoff <= 0:
+            raise SchemaError(
+                f"config key '{path}.resync_backoff_seconds': must be > 0, got {backoff}"
+            )
+        sync_timeout = _opt_num(raw, "sync_timeout_seconds", path, 15.0)
+        if sync_timeout < 0:
+            raise SchemaError(
+                f"config key '{path}.sync_timeout_seconds': must be >= 0, got {sync_timeout}"
+            )
+        return cls(
+            enabled=enabled,
+            upstream=upstream,
+            depth_limit=depth_limit,
+            codec=codec,
+            fresh=_opt_bool(raw, "fresh", path, True),
+            trace=_opt_bool(raw, "trace", path, False),
+            backfill=backfill,
+            stale_after_seconds=stale_after,
+            resync_backoff_seconds=backoff,
+            sync_timeout_seconds=sync_timeout,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
 class StateConfig:
     """The ``state:`` section — net-new checkpoint/resume (SURVEY.md §5).
 
@@ -1480,17 +1610,18 @@ class AppConfig:
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     history: HistoryConfig = dataclasses.field(default_factory=HistoryConfig)
     federation: FederationConfig = dataclasses.field(default_factory=FederationConfig)
+    relay: RelayConfig = dataclasses.field(default_factory=RelayConfig)
     metrics: MetricsConfig = dataclasses.field(default_factory=MetricsConfig)
     slo: SloConfig = dataclasses.field(default_factory=SloConfig)
     health: HealthConfig = dataclasses.field(default_factory=HealthConfig)
     analytics: AnalyticsConfig = dataclasses.field(default_factory=AnalyticsConfig)
 
-    TOP_LEVEL_KEYS = ("environment", "watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest", "trace", "serve", "history", "federation", "metrics", "slo", "health", "analytics")
+    TOP_LEVEL_KEYS = ("environment", "watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest", "trace", "serve", "history", "federation", "relay", "metrics", "slo", "health", "analytics")
 
     @classmethod
     def from_raw(cls, raw: Mapping[str, Any], environment: str) -> "AppConfig":
         _check_known(raw, cls.TOP_LEVEL_KEYS, "<root>")
-        for section in ("watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest", "trace", "serve", "history", "federation", "metrics", "slo", "health", "analytics"):
+        for section in ("watcher", "clusterapi", "kubernetes", "tpu", "state", "ingest", "trace", "serve", "history", "federation", "relay", "metrics", "slo", "health", "analytics"):
             _expect(raw.get(section) or {}, (dict,), section)
         # The reference's development.yaml declared `environment: local` while
         # the CLI only accepted development|staging|production, leaving the
@@ -1514,6 +1645,30 @@ class AppConfig:
                 "merged global view republishes through the serving plane's "
                 "FleetView; without it the fan-in has nowhere to land)"
             )
+        relay = RelayConfig.from_raw(raw.get("relay") or {})
+        if relay.enabled:
+            if not serve.enabled:
+                raise SchemaError(
+                    "config key 'relay.enabled': requires serve.enabled (a relay "
+                    "IS a serve node — the mirrored view re-broadcasts through "
+                    "the serving plane's fan-out core)"
+                )
+            if federation.enabled:
+                raise SchemaError(
+                    "config key 'relay.enabled': conflicts with "
+                    "federation.enabled — federation MINTS local rvs into the "
+                    "view while a relay MIRRORS its upstream's rv line verbatim; "
+                    "one view cannot serve both rv spaces. Run them as separate "
+                    "processes (relay in front of a federator works fine)."
+                )
+            if history.enabled:
+                raise SchemaError(
+                    "config key 'relay.enabled': conflicts with history.enabled "
+                    "— a relay is a stateless edge on its UPSTREAM's rv line; "
+                    "durability (and the restart-surviving token story) belongs "
+                    "to the root that owns the line. Relay restarts re-warm "
+                    "their journal via relay.backfill instead."
+                )
         trace = TraceConfig.from_raw(raw.get("trace") or {})
         if trace.federation.enabled:
             # schema-enforced pairing (same posture as health.sources.*):
@@ -1570,6 +1725,7 @@ class AppConfig:
             serve=serve,
             history=history,
             federation=federation,
+            relay=relay,
             metrics=MetricsConfig.from_raw(raw.get("metrics") or {}),
             slo=SloConfig.from_raw(raw.get("slo") or {}),
             health=health,

@@ -16,6 +16,7 @@ from k8s_watcher_tpu.federate.client import (
     Snapshot,
     TokenStore,
     apply_wire_delta,
+    apply_wire_deltas,
     model_from_objects,
 )
 from k8s_watcher_tpu.federate.merge import (
@@ -40,6 +41,7 @@ __all__ = [
     "Snapshot",
     "TokenStore",
     "apply_wire_delta",
+    "apply_wire_deltas",
     "global_key",
     "merged_equals_union",
     "model_from_objects",

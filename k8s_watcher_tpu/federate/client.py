@@ -482,7 +482,8 @@ class FleetClient:
         read_timeout: Optional[float] = None,
         limit: Optional[int] = None,
         on_conn: Optional[Callable[[http.client.HTTPConnection], None]] = None,
-    ) -> Iterator[List[Dict[str, Any]]]:
+        raw: bool = False,
+    ) -> Iterator[List[Any]]:
         """One ``?watch=1`` stream window, yielding frame BATCHES: every
         chunked read off the socket (``read1``, up to ``WATCH_READ_BYTES``)
         decodes into one list of frames (SYNC / UPSERT / DELETE /
@@ -512,7 +513,18 @@ class FleetClient:
         Codec: negotiated per the client preference; msgpack frames are
         self-delimiting (fed through a streaming unpacker), JSON frames
         are newline-delimited lines — either way one read yields one
-        batch, and the decoded dicts are identical across codecs."""
+        batch, and the decoded dicts are identical across codecs.
+
+        ``raw=True`` is the relay tier's zero-re-encode passthrough:
+        each batch item becomes a ``(frame, raw_bytes)`` pair, where
+        ``raw_bytes`` is the frame's codec payload EXACTLY as the
+        upstream encoded it (the JSON line including its trailing
+        newline; the msgpack ``packb`` span) — the decoded dict carries
+        the control metadata (type/rv/ts/...) while the untouched bytes
+        ride beside it, so a relay can re-broadcast the same bytes
+        without ever re-serializing. Spans are exact under partial-tail
+        carry too: a frame split across reads is delivered once,
+        complete, with its original bytes."""
         params = {"watch": "1", "rv": rv, "timeout": window_seconds}
         if view:
             params["view"] = view
@@ -535,11 +547,32 @@ class FleetClient:
             self._note_codec(codec)
             if codec == CODEC_MSGPACK:
                 unpacker = _msgpack.Unpacker(raw=False, strict_map_key=False)
+                # raw mode keeps a sliding copy of the fed bytes; each
+                # unpacked frame's span is cut by Unpacker.tell() (the
+                # cumulative stream position), so the raw bytes are the
+                # upstream's packb output verbatim — a partial tail just
+                # stays in `fed` until the next read completes the frame
+                fed = bytearray()
+                consumed = 0  # stream offset of fed[0]
+                pos = 0  # stream position of the last unpacked frame end
                 while True:
                     chunks, eof = self._drain_chunks(resp, conn.sock)
                     for data in chunks:
                         unpacker.feed(data)
-                    batch = [frame for frame in unpacker]
+                        if raw:
+                            fed += data
+                    if raw:
+                        batch = []
+                        for frame in unpacker:
+                            end = unpacker.tell()
+                            batch.append(
+                                (frame, bytes(fed[pos - consumed:end - consumed]))
+                            )
+                            pos = end
+                        del fed[: pos - consumed]
+                        consumed = pos
+                    else:
+                        batch = [frame for frame in unpacker]
                     if batch:
                         yield batch
                     if eof:
@@ -553,7 +586,17 @@ class FleetClient:
                     if b"\n" in data:
                         lines = buf.split(b"\n")
                         buf = lines.pop()  # partial tail carries over
-                        batch = [json.loads(line) for line in lines if line.strip()]
+                        if raw:
+                            # the upstream frames one JSON line + "\n"
+                            # per delta: line + b"\n" IS the original
+                            # payload byte-for-byte
+                            batch = [
+                                (json.loads(line), line + b"\n")
+                                for line in lines
+                                if line.strip()
+                            ]
+                        else:
+                            batch = [json.loads(line) for line in lines if line.strip()]
                         if batch:
                             yield batch
                     if eof:
@@ -716,7 +759,11 @@ class FleetSubscriber:
     wire-read's worth of UPSERT/DELETE frames in one call (the fan-in
     batching unit — the federation plane folds it under one lock), or
     ``on_delta(frame)`` folds them one at a time when no batch handler
-    is given. The ``SequenceChecker`` rides every delivery either way."""
+    is given. ``on_raw_batch(pairs)`` is the relay tier's handler: the
+    stream runs in raw-passthrough mode and each delivered run is a list
+    of ``(frame, raw_bytes)`` pairs — decoded control metadata beside
+    the upstream's untouched frame bytes (see ``watch_batches(raw=)``).
+    The ``SequenceChecker`` rides every delivery either way."""
 
     def __init__(
         self,
@@ -725,6 +772,7 @@ class FleetSubscriber:
         on_snapshot: Optional[Callable[[Snapshot], None]] = None,
         on_delta: Optional[Callable[[Dict[str, Any]], None]] = None,
         on_batch: Optional[Callable[[List[Dict[str, Any]]], None]] = None,
+        on_raw_batch: Optional[Callable[[List[Tuple[Dict[str, Any], bytes]]], None]] = None,
         token_store: Optional[TokenStore] = None,
         stale_after_seconds: float = 10.0,
         backoff_seconds: float = 1.0,
@@ -738,6 +786,7 @@ class FleetSubscriber:
         self.on_snapshot = on_snapshot
         self.on_delta = on_delta
         self.on_batch = on_batch
+        self.on_raw_batch = on_raw_batch
         self.token_store = token_store
         # the stream heartbeats every 2 s when idle; anything sub-3s
         # would call a healthy idle stream dead
@@ -927,14 +976,18 @@ class FleetSubscriber:
             except OSError:
                 pass
 
-    def _deliver(self, run: List[Dict[str, Any]]) -> None:
+    def _deliver(self, run: List[Any]) -> None:
         """Hand one contiguous UPSERT/DELETE run downstream: one
-        ``on_batch`` call (the batched fan-in path) or per-frame
-        ``on_delta`` fallback. Sequence checking and cursor advance
-        already happened — delivery is pure application."""
+        ``on_raw_batch`` call (raw-passthrough mode — items are
+        ``(frame, raw_bytes)`` pairs), one ``on_batch`` call (the
+        batched fan-in path), or per-frame ``on_delta`` fallback.
+        Sequence checking and cursor advance already happened —
+        delivery is pure application."""
         if not run:
             return
-        if self.on_batch is not None:
+        if self.on_raw_batch is not None:
+            self.on_raw_batch(run)
+        elif self.on_batch is not None:
             self.on_batch(run)
         elif self.on_delta is not None:
             for frame in run:
@@ -944,12 +997,14 @@ class FleetSubscriber:
         assert self.rv is not None
         compacted_until = -1  # COMPACTED sanctions skips up to this rv
         deltas_since_save = 0
+        raw_mode = self.on_raw_batch is not None
         for batch in self.client.watch_batches(
             self.rv,
             view=self.view,
             window_seconds=self.window_seconds,
             read_timeout=self.stale_after_seconds,
             on_conn=self._register_conn,
+            raw=raw_mode,
         ):
             if self._stop.is_set():
                 # BEFORE applying: a batch racing stop() must not reach
@@ -967,7 +1022,7 @@ class FleetSubscriber:
             # raises a retried exception class mid-apply, the reconnect
             # resumes from the last delivered rv and the run is simply
             # redelivered — never silently skipped.
-            run: List[Dict[str, Any]] = []
+            run: List[Any] = []
             run_watermark: Optional[float] = None
             prev_rv = self.rv or 0
 
@@ -985,13 +1040,16 @@ class FleetSubscriber:
                     run_watermark = None
                 self.rv = max(self.rv, prev_rv)
 
-            for frame in batch:
+            for item in batch:
+                # raw mode delivers (frame, raw_bytes) pairs; the decoded
+                # dict drives all control/sequence logic either way
+                frame = item[0] if raw_mode else item
                 ftype = frame.get("type")
                 if ftype in (UPSERT, DELETE):
                     rv = frame["rv"]
                     self.checker.observe_stream_rv(prev_rv, rv, rv <= compacted_until)
                     self.wire_rv = max(self.wire_rv, rv)
-                    run.append(frame)
+                    run.append(item)
                     prev_rv = max(prev_rv, rv)
                     deltas_since_save += 1
                     # watermark candidate: the negotiated origin stamp

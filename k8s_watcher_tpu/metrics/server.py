@@ -34,6 +34,13 @@ class QuietThreadingHTTPServer(ThreadingHTTPServer):
     (status, serve, mock apiserver) — consumers disconnecting at will is
     the steady state for all three."""
 
+    # socketserver's default listen backlog is 5: a relay-tier reconnect
+    # herd (thousands of subscribers re-homing after a relay restart)
+    # would see connection refusals for no structural reason. The kernel
+    # clamps to somaxconn; memory cost is a queue of accepted-but-
+    # unhandled connections, bounded and transient.
+    request_queue_size = 1024
+
     def handle_error(self, request, client_address):
         import sys
 
@@ -199,6 +206,10 @@ class _StatusHandler(BaseHTTPRequestHandler):
     # per-upstream staleness/connectivity); folded into /healthz and
     # served in full at /debug/federation when federation is enabled
     federation = None
+    # Callable[[], dict]: relay-plane detail (RelayPlane.health — depth,
+    # upstream connectivity, zero-re-encode counters) -> /debug/relay,
+    # when the relay tier is enabled
+    relay = None
     # Callable[[], dict]: freshness watermarks (local view + per-upstream)
     # -> /debug/freshness, when the serving plane is enabled
     freshness = None
@@ -394,6 +405,11 @@ class _StatusHandler(BaseHTTPRequestHandler):
                 self._json(404, {"error": "federation plane not enabled (federation.enabled)"})
                 return
             self._json(200, {"federation": self.federation()})
+        elif parsed.path == "/debug/relay":
+            if self.relay is None:
+                self._json(404, {"error": "relay plane not enabled (relay.enabled)"})
+                return
+            self._json(200, {"relay": self.relay()})
         elif parsed.path == "/debug/freshness":
             if self.freshness is None:
                 self._json(404, {"error": "freshness plane not wired (serve.enabled)"})
@@ -437,6 +453,7 @@ class StatusServer:
         egress=None,  # Callable[[], dict] -> egress liveness folded into /healthz
         serve=None,  # Callable[[], dict] -> serving-plane liveness folded into /healthz
         federation=None,  # Callable[[], dict] -> federation liveness, /healthz + /debug/federation
+        relay=None,  # Callable[[], dict] -> /debug/relay (RelayPlane.health)
         freshness=None,  # Callable[[], dict] -> /debug/freshness (watermarks + propagation)
         slo=None,  # Callable[[], dict] -> /debug/slo (SLOPlane.snapshot)
         slo_health=None,  # Callable[[], dict] -> /healthz body fold (SLOPlane.health)
@@ -463,6 +480,7 @@ class StatusServer:
                 "egress": staticmethod(egress) if egress else None,
                 "serve": staticmethod(serve) if serve else None,
                 "federation": staticmethod(federation) if federation else None,
+                "relay": staticmethod(relay) if relay else None,
                 "freshness": staticmethod(freshness) if freshness else None,
                 "slo": staticmethod(slo) if slo else None,
                 "slo_health": staticmethod(slo_health) if slo_health else None,

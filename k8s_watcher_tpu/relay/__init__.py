@@ -1,0 +1,13 @@
+"""Relay/edge fan-out tier: zero-re-encode frame relaying (relay/plane.py).
+
+A relay node consumes ONE upstream ``?watch=1`` stream via the
+federation client's raw-bytes passthrough and re-broadcasts the
+already-encoded wire frames verbatim through the existing serve
+broadcast core — the PR-7 encode-once invariant extended across
+processes, forming a depth-stamped fan-out tree that carries 100k+
+streaming subscribers off one publisher.
+"""
+
+from k8s_watcher_tpu.relay.plane import RelayPlane
+
+__all__ = ["RelayPlane"]

@@ -281,10 +281,22 @@ class _LoopWorker(threading.Thread):
             return
         self._needs_pull.clear()
         budget = self.loop.sub_buffer_bytes
+        instance = self.loop.view.instance
         for client in targets:
             if client.closing or client.buf_bytes >= budget:
                 # over budget: stop pulling — the cursor lags and the
                 # NEXT pull rides read-time latest-wins compaction
+                continue
+            if client.view_id != instance:
+                # the view swapped rv spaces UNDER this stream (a relay
+                # re-adopted after its upstream restarted): grafting the
+                # new line onto the old cursor would serve wrong deltas —
+                # terminate with the documented GONE recovery instead
+                self._queue_control(
+                    client,
+                    {"type": "GONE", "rv": client.sub.rv, "view": instance},
+                )
+                self._finish(client)
                 continue
             if client.sub.rv >= view_rv:
                 continue
@@ -317,6 +329,23 @@ class _LoopWorker(threading.Thread):
                     )
                 self._queue_frames(client, result.frames)
                 client.last_frame = time.monotonic()
+            elif result.compacted:
+                # sparse relay journal: the cursor advanced over an
+                # upstream-sanctioned hole with NOTHING to send. The skip
+                # must still reach the wire — COMPACTED sanctions the
+                # range, the SYNC moves the consumer's resume token past
+                # it so the next live delta reads contiguous (a silent
+                # advance here would surface downstream as a false gap)
+                self._queue_control(
+                    client,
+                    {"type": "COMPACTED", "from_rv": result.from_rv,
+                     "to_rv": result.to_rv},
+                )
+                self._queue_control(
+                    client,
+                    {"type": "SYNC", "rv": client.sub.rv, "view": client.view_id},
+                )
+                client.last_frame = time.monotonic()
             self._flush(client)
 
     def _timers(self, now: float) -> None:
@@ -329,7 +358,19 @@ class _LoopWorker(threading.Thread):
             return
         self._last_timer_sweep = now
         next_due = float("inf")
+        instance = self.loop.view.instance
         for client in list(self._clients.values()):
+            if not client.closing and client.view_id != instance:
+                # idle streams see a mid-life view swap here (the pump
+                # only walks clients with pending deltas): same GONE →
+                # re-snapshot recovery, within one sweep interval
+                self._queue_control(
+                    client,
+                    {"type": "GONE", "rv": client.sub.rv, "view": instance},
+                )
+                self._finish(client)
+                if client.fd not in self._clients:
+                    continue
             if client.closing:
                 if now >= client.hard_deadline:
                     # peer never drained its final bytes: tear down

@@ -569,9 +569,15 @@ class _ServeHandler(BaseHTTPRequestHandler):
 
         deadline = time.monotonic() + timeout
         last_frame = time.monotonic()
+        stream_view = self.view.instance
         try:
-            write_frames([{"type": "SYNC", "rv": sub.rv, "view": self.view.instance}])
+            write_frames([{"type": "SYNC", "rv": sub.rv, "view": stream_view}])
             while time.monotonic() < deadline:
+                if self.view.instance != stream_view:
+                    # mid-stream view swap (relay re-adopt): terminate
+                    # with the GONE recovery instead of grafting rv lines
+                    write_frames([{"type": "GONE", "rv": sub.rv, "view": self.view.instance}])
+                    break
                 result = sub.pull(
                     timeout=min(0.5, max(0.0, deadline - time.monotonic())),
                     limit=limit,
@@ -591,6 +597,18 @@ class _ServeHandler(BaseHTTPRequestHandler):
                         })
                     frames.extend(d.to_wire(fresh=fresh, trace=traced) for d in result.deltas)
                     write_frames(frames)
+                    last_frame = time.monotonic()
+                elif result.compacted:
+                    # sparse relay journal: the cursor advanced over an
+                    # upstream-sanctioned hole with nothing to send —
+                    # COMPACTED sanctions the range, SYNC moves the
+                    # resume token past it so the next live delta reads
+                    # contiguous instead of surfacing as a false gap
+                    write_frames([
+                        {"type": "COMPACTED", "from_rv": result.from_rv,
+                         "to_rv": result.to_rv},
+                        {"type": "SYNC", "rv": sub.rv, "view": self.view.instance},
+                    ])
                     last_frame = time.monotonic()
                 elif time.monotonic() - last_frame >= SYNC_INTERVAL_SECONDS:
                     write_frames([{"type": "SYNC", "rv": sub.rv, "view": self.view.instance}])
@@ -748,6 +766,11 @@ class ServePlane:
         # routes GET /debug/trace on the serve port (the lazy-stitch
         # surface a downstream federator reads this process's spans from)
         self.trace_ring = None
+        # relay.RelayPlane, attached by the app when relay.enabled: the
+        # view is fed by the upstream mirror instead of a local pipeline,
+        # and health() folds the relay verdict (downstream relays read
+        # their depth off the /serve/healthz body here)
+        self.relay = None
 
     def attach_analytics(self, analytics) -> None:
         """Wire the analytics plane; call before ``start()`` so the HTTP
@@ -758,6 +781,12 @@ class ServePlane:
         """Wire the tracing ring; call before ``start()`` so the HTTP
         handler binds /debug/trace on the serve port."""
         self.trace_ring = ring
+
+    def attach_relay(self, relay) -> None:
+        """Wire the relay plane: its verdict (and its ``depth`` — the
+        thing a downstream relay stamps its own off) folds into the
+        /serve/healthz body."""
+        self.relay = relay
 
     def wrap_sink(self, sink):
         """Tap a notification sink: every Notification folds into the view
@@ -838,5 +867,15 @@ class ServePlane:
             history_health = self.history.health()
             body["history"] = history_health
             if server is not None and not history_health["healthy"]:
+                body["healthy"] = False
+        if self.relay is not None:
+            # the relay fold: depth (downstream relays stamp off it) +
+            # upstream connectivity. Only a DEAD subscriber thread flips
+            # the top-level verdict (local fault, restart fixes it); a
+            # dark upstream degrades this section only — restarting the
+            # relay cannot revive its upstream (the federation posture)
+            relay_health = self.relay.health()
+            body["relay"] = relay_health
+            if relay_health.get("started") and not relay_health.get("thread_alive", True):
                 body["healthy"] = False
         return body

@@ -80,7 +80,7 @@ import json
 import os
 import threading
 import time
-from bisect import bisect_right
+from bisect import bisect_left, bisect_right
 from typing import Any, Callable, Dict, List, Mapping, NamedTuple, Optional, Tuple
 
 from k8s_watcher_tpu.pipeline.phase import pod_key, pod_ready
@@ -228,7 +228,14 @@ def chunk_frame(obj: Mapping[str, Any], codec: str = CODEC_JSON) -> bytes:
     unchanged. Used for every frame on a watch stream: per-delta frames
     (encoded at most once per codec) and the small per-connection
     SYNC/COMPACTED/GONE control frames."""
-    payload = frame_body(obj, codec)
+    return chunk_wrap(frame_body(obj, codec))
+
+
+def chunk_wrap(payload: bytes) -> bytes:
+    """Wrap already-encoded payload bytes in the per-frame
+    chunked-transfer framing — the ONE place the framing shape lives.
+    The relay's raw passthrough calls this directly: upstream payload
+    bytes re-framed (a length prefix, never a re-serialization)."""
     return b"%x\r\n" % len(payload) + payload + b"\r\n"
 
 
@@ -314,6 +321,13 @@ class FleetView:
         # invalidates by bumping rv) — a msgpack snapshot read must not
         # evict the JSON body, or an A/B-consuming tier would thrash both
         self._snapshot_cache: Dict[str, Tuple[int, bytes]] = {}
+        # relay mode: the journal may be SPARSE below this rv — an
+        # upstream that latest-wins-compacted the relay's own stream
+        # skips rvs the relay can never journal. Reads whose resume token
+        # falls below it are flagged compacted (the skip is sanctioned
+        # downstream exactly the way the upstream sanctioned it to us);
+        # 0 = dense (every local publish path keeps it 0).
+        self._relay_sparse_rv = 0
         # rv-keyed per-kind object tables (snapshot_tables): ONE object
         # walk per rv shared by every per-kind consumer — the health
         # plane's phase collector and the analytics encoder both read
@@ -429,6 +443,7 @@ class FleetView:
             # tokens older than the preloaded tail 410 — the compaction-
             # horizon contract, now spanning incarnations
             self._oldest_rv = journal[0].rv - 1 if journal else rv
+            self._relay_sparse_rv = 0
             if self._rv_gauge is not None:
                 self._rv_gauge.set(self._rv)
 
@@ -444,6 +459,143 @@ class FleetView:
         (objects are replaced, never mutated, so the copy is shallow)."""
         with self._cond:
             return self._rv, dict(self._objects)
+
+    # -- relay mode (upstream-mirrored rv line; relay/plane.py) ------------
+
+    def adopt_relay(
+        self,
+        *,
+        instance: str,
+        rv: int,
+        objects: Dict[Tuple[str, str], Dict[str, Any]],
+    ) -> None:
+        """Adopt an UPSTREAM serving plane's state wholesale: its view
+        instance id, its rv, its objects — the relay tier's snapshot
+        reconcile. Unlike ``restore()`` (which runs before any serving),
+        this can happen MID-LIFE (upstream restart / relay fell past the
+        upstream horizon), so parked waiters are woken and the wakeup
+        hooks fire: existing subscribers discover the resync as
+        GONE/INVALID (410 → re-snapshot FROM THIS RELAY — the recovery
+        herd lands here, not on the root) instead of idling against a
+        swapped rv space. The journal resets empty; ``publish_relayed``
+        backfill entries re-extend ``oldest_rv`` downward afterwards so
+        recent resume tokens keep working across the adopt."""
+        with self._cond:
+            self.instance = instance
+            self._rv = rv
+            self._objects = dict(objects)
+            self._delta_rvs = []
+            self._deltas = []
+            self._frames = {variant: [] for variant in FRAME_VARIANTS}
+            self._snapshot_cache = {}
+            self._tables_cache = None
+            self._relay_sparse_rv = 0
+            self._oldest_rv = rv
+            if self._rv_gauge is not None:
+                self._rv_gauge.set(rv)
+            self._cond.notify_all()
+        for fn in self._wakeups:
+            fn()
+
+    def publish_relayed(
+        self,
+        entries,
+        *,
+        variant: str = CODEC_JSON,
+        fold_objects: bool = True,
+    ) -> int:
+        """Append upstream-journaled deltas VERBATIM at their upstream
+        rvs — the relay tier's publish path. ``entries`` is a list of
+        ``(Delta, frame_or_None)`` pairs: the Delta carries the decoded
+        wire metadata (its ``rv`` is the UPSTREAM's — rv is adopted, not
+        minted), and ``frame`` is the upstream's frame payload already
+        chunk-framed, stored into the ``variant`` frame array untouched.
+        That is the zero-re-encode contract: ``serve_frame_encodes*``
+        stays 0 for relayed deltas; every other variant journals a hole
+        that the usual lazy ``_fill_frames`` path fills (at most once
+        per delta per variant) for subscribers that negotiated a shape
+        the upstream wire didn't carry.
+
+        ``fold_objects=False`` is the BACKFILL path: entries older than
+        the adopted snapshot extend the journal (and lower
+        ``oldest_rv``) without touching object state — the snapshot
+        already reflects them, and replaying them into the map would
+        expose intermediate states to concurrent readers.
+
+        A skip in the upstream rv sequence (the upstream latest-wins-
+        compacted OUR stream) marks the journal sparse up to that rv;
+        reads resuming below the mark are flagged compacted so
+        downstream gap checkers get the same sanction we did.
+
+        Deliberately NOT wired to the history WAL: a relay is a
+        stateless edge (schema forbids relay+history) — durability
+        belongs to the root that owns the rv line."""
+        if not entries:
+            return 0
+        appended = 0
+        first_rv = None
+        with self._cond:
+            for delta, frame in entries:
+                rv = delta.rv
+                if self._delta_rvs:
+                    last = self._delta_rvs[-1]
+                    if rv <= last:
+                        continue  # overlap with already-journaled wire reads
+                    if rv > last + 1:
+                        # upstream-sanctioned skip (its COMPACTED covered
+                        # it); sanction our own readers below this rv
+                        self._relay_sparse_rv = max(self._relay_sparse_rv, rv)
+                elif fold_objects and rv > self._rv + 1:
+                    # first live entry after an adopt already skips past
+                    # the snapshot rv: same upstream-sanctioned hole
+                    self._relay_sparse_rv = max(self._relay_sparse_rv, rv)
+                if fold_objects:
+                    map_key = (delta.kind, delta.key)
+                    if delta.type == DELETE:
+                        self._objects.pop(map_key, None)
+                    else:
+                        self._objects[map_key] = delta.object
+                self._delta_rvs.append(rv)
+                self._deltas.append(delta)
+                for v in FRAME_VARIANTS:
+                    self._frames[v].append(frame if v == variant else None)
+                if first_rv is None:
+                    first_rv = rv
+                appended += 1
+            if appended:
+                self._rv = max(self._rv, self._delta_rvs[-1])
+                # backfill lowers the horizon: tokens minted against the
+                # pre-adopt journal resume from memory again
+                self._oldest_rv = min(self._oldest_rv, first_rv - 1)
+                self._trim_locked()
+                if self._rv_gauge is not None:
+                    self._rv_gauge.set(self._rv)
+                self._cond.notify_all()
+        if appended:
+            if self._deltas_published is not None:
+                self._deltas_published.inc(appended)
+            for fn in self._wakeups:
+                fn()
+        return appended
+
+    def note_upstream_rv(self, rv: int) -> int:
+        """Adopt an upstream rv seen WITHOUT a journal entry (a SYNC
+        heartbeat that outran the deltas we hold — only possible when
+        the upstream compacted/paged our stream). The journal goes
+        sparse up to ``rv`` so the jump is sanctioned, exactly like a
+        delta-carried skip. Returns the (possibly unchanged) view rv."""
+        with self._cond:
+            if rv > self._rv:
+                self._rv = rv
+                self._relay_sparse_rv = max(self._relay_sparse_rv, rv)
+                if self._rv_gauge is not None:
+                    self._rv_gauge.set(self._rv)
+                self._cond.notify_all()
+            else:
+                return self._rv
+        for fn in self._wakeups:
+            fn()
+        return rv
 
     # -- writing (pipeline thread + sink taps) ----------------------------
 
@@ -931,9 +1083,10 @@ class FleetView:
         """Encode the ``None`` holes in one pulled frame slice (OFF the
         publish lock — a large catch-up read must not stall publishers
         behind O(pending) serialization), then memoize the results back
-        into the master array under a short lock hold. The journal's rv
-        space is dense, so a delta's position is ``rv - base`` — front
-        trims that happened while we encoded just shift ``base``; an
+        into the master array under a short lock hold. A delta's
+        position is found by rv bisect, not ``rv - base`` arithmetic: a
+        RELAY journal can be sparse (upstream-compacted holes), and the
+        lookup is equally trim-safe on dense local journals — an
         already-trimmed delta simply isn't memoized. Two racing readers
         may both encode the same hole (identical bytes; last write wins)
         — the eager JSON publish path never races because its frames are
@@ -973,12 +1126,15 @@ class FleetView:
             counter.inc(len(encoded))
         with self._cond:
             master = self._frames[variant]
-            if not self._delta_rvs:
+            rvs = self._delta_rvs
+            if not rvs:
                 return
-            base = self._delta_rvs[0]
             for frame_rv, frame in encoded:
-                pos = frame_rv - base
-                if 0 <= pos < len(master) and master[pos] is None:
+                # bisect, not rv-base arithmetic: a RELAY journal can be
+                # sparse (upstream-compacted holes), so position is found
+                # by rv lookup — O(log n), trim-safe, dense-safe too
+                pos = bisect_left(rvs, frame_rv)
+                if pos < len(master) and rvs[pos] == frame_rv and master[pos] is None:
                     master[pos] = frame
 
     def _read(
@@ -1023,9 +1179,21 @@ class FleetView:
             deltas = self._deltas[idx:]
             if want_frames:
                 frames = self._frames[variant][idx:]
+            sparse_rv = self._relay_sparse_rv
+        if not deltas:
+            # only reachable on a sparse relay journal (note_upstream_rv
+            # advanced rv past a journal with no entries pending): an
+            # empty batch advancing to to_rv, sanctioned by the sparse
+            # mark so the skip never reads as a gap
+            return (OK, rv, to_rv, rv < sparse_rv, [], [])
         oldest_pending_t = deltas[0].t
         if pending <= max_deltas:
-            compacted = False
+            # a relay journal may be sparse below _relay_sparse_rv (the
+            # upstream compacted our stream): a resume token under the
+            # mark gets the compacted flag so the rv skips are sanctioned
+            # downstream — per-key latest-wins still holds (the upstream's
+            # compaction was latest-wins, and anything newer is here)
+            compacted = rv < sparse_rv
         else:
             # latest-wins per key over the slice; the journal is
             # rv-ascending, so keeping each key's last INDEX and sorting

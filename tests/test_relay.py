@@ -1,0 +1,799 @@
+"""Relay/edge fan-out tier (relay/plane.py + the raw-bytes passthrough).
+
+What this file pins:
+
+- ``FleetClient.watch_batches(raw=True)``: the decoded frame and the
+  upstream's UNTOUCHED payload bytes ride side by side, byte-identical
+  to what re-encoding the decoded dict produces (both codecs — the
+  determinism the relay's lazy cross-variant fills lean on), with
+  partial-tail carry preserved across chunk boundaries;
+- ``FleetView`` relay primitives: ``adopt_relay`` (mid-life rv-space
+  swap, subscribers discover it as GONE), ``publish_relayed`` (verbatim
+  bytes at upstream rvs, zero encodes, sparse-compacted sanctioning,
+  object-untouched backfill), ``note_upstream_rv``;
+- the ``RelayPlane`` end to end over real HTTP: upstream mirroring,
+  byte-identical fan-out, resume tokens valid across relay and root in
+  BOTH directions, 410/GONE propagation, depth stamping + the
+  depth_limit loop-breaker, restart backfill;
+- the relay config schema (cross-checks included).
+
+The 100k-subscriber 2-level-tree SCALE gate is bench.py's
+``bench_relay_tree``; the process-lifecycle drill (relay restart under
+a live consumer) is ``make relay-smoke``.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from k8s_watcher_tpu.config.schema import (
+    AppConfig,
+    RelayConfig,
+    SchemaError,
+)
+from k8s_watcher_tpu.federate.client import (
+    FleetClient,
+    FleetSubscriber,
+    ResyncRequired,
+    SequenceChecker,
+)
+from k8s_watcher_tpu.metrics import MetricsRegistry
+from k8s_watcher_tpu.metrics.server import QuietThreadingHTTPServer
+from k8s_watcher_tpu.relay import RelayPlane
+from k8s_watcher_tpu.serve import FleetView, ServeServer, SubscriptionHub, chunk_frame
+from k8s_watcher_tpu.serve.view import (
+    CODEC_JSON,
+    CODEC_MSGPACK,
+    Delta,
+    frame_body,
+    frame_payload,
+    frame_variant,
+    msgpack_available,
+)
+
+
+def _serve(view, *, metrics=None, max_subscribers=64, queue_depth=1024, plane=None):
+    hub = SubscriptionHub(
+        view, max_subscribers=max_subscribers, queue_depth=queue_depth, metrics=metrics
+    )
+    server = ServeServer(
+        view, hub, host="127.0.0.1", port=0, metrics=metrics, plane=plane
+    ).start()
+    return hub, server
+
+
+class _FakePlane:
+    """Just enough of ServePlane.health() for backfill/depth discovery."""
+
+    def __init__(self, view, relay=None):
+        self.view = view
+        self.relay = relay
+
+    def health(self):
+        body = {
+            "healthy": True,
+            "view_rv": self.view.rv,
+            "oldest_rv": self.view.oldest_rv,
+        }
+        if self.relay is not None:
+            body["relay"] = self.relay.health()
+        return body
+
+
+def _churn(view, n, start=0, keys=7):
+    for i in range(start, start + n):
+        key = f"pod-{i % keys}"
+        if i % 23 == 22:
+            view.apply("pod", key, None)
+        else:
+            view.apply("pod", key, {"kind": "pod", "key": key, "seq": i})
+
+
+def _collect_raw(port, rv, *, codec="json", fresh=False, trace=False, window=1.0):
+    cli = FleetClient(f"http://127.0.0.1:{port}", codec=codec, fresh=fresh, trace=trace)
+    out = []
+    for batch in cli.watch_batches(rv, window_seconds=window, raw=True):
+        out.extend(batch)
+    return out
+
+
+def _deltas_only(pairs):
+    return [(f, r) for f, r in pairs if f.get("type") in ("UPSERT", "DELETE")]
+
+
+def _start_relay(upstream_port, *, metrics=None, **overrides):
+    raw = {
+        "enabled": True,
+        "upstream": {"name": "root", "url": f"http://127.0.0.1:{upstream_port}"},
+        "stale_after_seconds": 5,
+        "resync_backoff_seconds": 0.1,
+        "backfill": 1024,
+    }
+    raw.update(overrides)
+    cfg = RelayConfig.from_raw(raw)
+    reg = metrics if metrics is not None else MetricsRegistry()
+    view = FleetView(compact_horizon=4096, metrics=reg)
+    relay = RelayPlane(cfg, view, metrics=reg)
+    return relay, view, reg
+
+
+# -- raw-bytes passthrough (FleetClient.watch_batches(raw=True)) --------------
+
+
+class TestRawPassthrough:
+    def test_json_raw_bytes_identical_to_reencode(self):
+        view = FleetView(compact_horizon=1024)
+        _hub, server = _serve(view)
+        try:
+            _churn(view, 30)
+            pairs = _deltas_only(_collect_raw(server.port, 0, codec="json"))
+            assert len(pairs) == 30
+            for frame, raw in pairs:
+                # the raw bytes ARE the upstream's encoding — and the
+                # decoded dict re-encodes to the identical bytes (the
+                # relay's lazy cross-variant fill leans on exactly this)
+                assert raw == frame_body(frame, CODEC_JSON)
+        finally:
+            server.stop()
+
+    def test_json_raw_bytes_are_the_journal_frames(self):
+        view = FleetView(compact_horizon=1024)
+        _hub, server = _serve(view)
+        try:
+            _churn(view, 12)
+            pairs = _deltas_only(_collect_raw(server.port, 0, codec="json"))
+            journal_payloads = [frame_payload(f) for f in view._frames[CODEC_JSON]]
+            assert [raw for _f, raw in pairs] == journal_payloads
+        finally:
+            server.stop()
+
+    @pytest.mark.skipif(not msgpack_available(), reason="msgpack not importable")
+    def test_msgpack_raw_bytes_identical_to_reencode(self):
+        view = FleetView(compact_horizon=1024)
+        _hub, server = _serve(view)
+        try:
+            _churn(view, 30)
+            pairs = _deltas_only(_collect_raw(server.port, 0, codec="msgpack"))
+            assert len(pairs) == 30
+            for frame, raw in pairs:
+                assert raw == frame_body(frame, CODEC_MSGPACK)
+        finally:
+            server.stop()
+
+    def test_fresh_raw_bytes_carry_stamps(self):
+        view = FleetView(compact_horizon=1024)
+        _hub, server = _serve(view)
+        try:
+            _churn(view, 5)
+            pairs = _deltas_only(_collect_raw(server.port, 0, codec="json", fresh=True))
+            for frame, raw in pairs:
+                assert "ts" in frame
+                assert raw == frame_body(frame, CODEC_JSON)
+        finally:
+            server.stop()
+
+    def test_raw_and_decoded_modes_agree(self):
+        view = FleetView(compact_horizon=1024)
+        _hub, server = _serve(view)
+        try:
+            _churn(view, 20)
+            raw_pairs = _collect_raw(server.port, 0, codec="json")
+            cli = FleetClient(f"http://127.0.0.1:{server.port}", codec="json")
+            plain = []
+            for batch in cli.watch_batches(0, window_seconds=1.0):
+                plain.extend(batch)
+            assert [f for f, _r in raw_pairs] == plain
+        finally:
+            server.stop()
+
+    def _scripted_chunks(self, chunks, codec=CODEC_JSON):
+        """A raw HTTP server that scripts EXACT chunk boundaries (a real
+        server frames one frame per chunk; the partial-tail carry needs
+        frames split ACROSS chunks)."""
+        from http.server import BaseHTTPRequestHandler
+
+        content_type = (
+            "application/x-msgpack" if codec == CODEC_MSGPACK else "application/json"
+        )
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):  # noqa: N802
+                self.send_response(200)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                for chunk in chunks:
+                    self.wfile.write(b"%x\r\n" % len(chunk) + chunk + b"\r\n")
+                    self.wfile.flush()
+                    time.sleep(0.05)  # separate reads -> the tail carries
+                self.wfile.write(b"0\r\n\r\n")
+                self.close_connection = True
+
+        server = QuietThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        server.daemon_threads = True
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        return server
+
+    def test_json_partial_tail_carry_preserves_raw_bytes(self):
+        frames = [
+            {"type": "UPSERT", "rv": 1, "kind": "pod", "key": "a", "object": {"kind": "pod", "key": "a", "seq": 1}},
+            {"type": "UPSERT", "rv": 2, "kind": "pod", "key": "b", "object": {"kind": "pod", "key": "b", "seq": 2}},
+        ]
+        stream = b"".join(frame_body(f, CODEC_JSON) for f in frames)
+        cut = len(frame_body(frames[0], CODEC_JSON)) + 7  # mid-second-frame
+        server = self._scripted_chunks([stream[:cut], stream[cut:]])
+        try:
+            cli = FleetClient(f"http://127.0.0.1:{server.server_address[1]}", codec="json")
+            pairs = []
+            for batch in cli.watch_batches(0, window_seconds=2.0, raw=True):
+                pairs.extend(batch)
+            assert [f for f, _r in pairs] == frames
+            assert [r for _f, r in pairs] == [frame_body(f, CODEC_JSON) for f in frames]
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    @pytest.mark.skipif(not msgpack_available(), reason="msgpack not importable")
+    def test_msgpack_partial_tail_carry_preserves_raw_bytes(self):
+        frames = [
+            {"type": "UPSERT", "rv": 1, "kind": "pod", "key": "a", "object": {"kind": "pod", "key": "a", "seq": 1}},
+            {"type": "UPSERT", "rv": 2, "kind": "pod", "key": "b", "object": {"kind": "pod", "key": "b", "seq": 2}},
+            {"type": "SYNC", "rv": 2, "view": "v"},
+        ]
+        bodies = [frame_body(f, CODEC_MSGPACK) for f in frames]
+        stream = b"".join(bodies)
+        cut1 = len(bodies[0]) - 3  # mid-first-frame
+        cut2 = len(bodies[0]) + len(bodies[1]) + 1  # mid-third-frame
+        server = self._scripted_chunks(
+            [stream[:cut1], stream[cut1:cut2], stream[cut2:]], codec=CODEC_MSGPACK
+        )
+        try:
+            cli = FleetClient(
+                f"http://127.0.0.1:{server.server_address[1]}", codec="msgpack"
+            )
+            pairs = []
+            for batch in cli.watch_batches(0, window_seconds=2.0, raw=True):
+                pairs.extend(batch)
+            assert [f for f, _r in pairs] == frames
+            assert [r for _f, r in pairs] == bodies
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_subscriber_on_raw_batch_delivers_pairs_in_wire_order(self):
+        view = FleetView(compact_horizon=1024)
+        _hub, server = _serve(view)
+        try:
+            _churn(view, 15)
+            delivered = []
+            sub = FleetSubscriber(
+                FleetClient(f"http://127.0.0.1:{server.port}", codec="json"),
+                on_raw_batch=delivered.extend,
+                backoff_seconds=0.05,
+            )
+            # resume from 0 (no snapshot): the published backlog streams
+            sub.rv, sub.view = 0, view.instance
+            thread = threading.Thread(target=sub.run, daemon=True)
+            thread.start()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and len(delivered) < 15:
+                time.sleep(0.02)
+            sub.stop()
+            thread.join(timeout=5)
+            frames = [f for f, _r in delivered]
+            assert [f["rv"] for f in frames][:15] == list(range(view.rv - 14, view.rv + 1))
+            for frame, raw in delivered:
+                assert raw == frame_body(frame, CODEC_JSON)
+            assert sub.checker.gaps == 0 and sub.checker.dups == 0
+        finally:
+            server.stop()
+
+
+# -- FleetView relay primitives ----------------------------------------------
+
+
+class TestRelayViewPrimitives:
+    def _relayed_entries(self, frames, codec=CODEC_JSON):
+        entries = []
+        for f in frames:
+            ts = f.get("ts")
+            delta = Delta(
+                f["rv"], f.get("kind", ""), f.get("key", ""), f["type"],
+                f.get("object"), time.monotonic(),
+                ts[0] if ts else None, ts[1] if ts else 0.0, f.get("trace"),
+            )
+            entries.append((delta, chunk_frame(f, codec)))
+        return entries
+
+    def test_publish_relayed_zero_encodes_shared_bytes(self):
+        reg = MetricsRegistry()
+        view = FleetView(compact_horizon=1024, metrics=reg)
+        view.adopt_relay(instance="up-1", rv=0, objects={})
+        frames = [
+            {"type": "UPSERT", "rv": i + 1, "kind": "pod", "key": f"p{i}",
+             "object": {"kind": "pod", "key": f"p{i}", "seq": i}}
+            for i in range(8)
+        ]
+        entries = self._relayed_entries(frames)
+        assert view.publish_relayed(entries, variant=CODEC_JSON) == 8
+        result = view.read_frames_since(0, max_deltas=64)
+        # the served frames ARE the relayed bytes objects (shared refs)
+        assert [id(f) for f in result.frames] == [id(e[1]) for e in entries]
+        assert reg.counter("serve_frame_encodes").value == 0
+        assert reg.counter("serve_frame_encodes_msgpack").value == 0
+        assert view.rv == 8
+
+    def test_publish_relayed_other_variant_fills_lazily_and_byte_golden(self):
+        reg = MetricsRegistry()
+        view = FleetView(compact_horizon=1024, metrics=reg)
+        view.adopt_relay(instance="up-1", rv=0, objects={})
+        now = time.time()
+        frames = [
+            {"type": "UPSERT", "rv": 1, "kind": "pod", "key": "a",
+             "object": {"kind": "pod", "key": "a", "seq": 0}, "ts": [now - 1, now]},
+        ]
+        view.publish_relayed(
+            self._relayed_entries(frames), variant=frame_variant(CODEC_JSON, True)
+        )
+        # stamped variant: passthrough bytes, zero encodes
+        stamped = view.read_frames_since(0, max_deltas=8, fresh=True)
+        assert frame_payload(stamped.frames[0]) == frame_body(frames[0], CODEC_JSON)
+        assert reg.counter("serve_frame_encodes_fresh").value == 0
+        # plain variant: lazy once-per-delta fill, ts stripped, golden
+        plain = view.read_frames_since(0, max_deltas=8)
+        decoded = json.loads(frame_payload(plain.frames[0]))
+        assert "ts" not in decoded
+        expected = dict(frames[0])
+        expected.pop("ts")
+        assert frame_payload(plain.frames[0]) == frame_body(expected, CODEC_JSON)
+        assert reg.counter("serve_frame_encodes").value == 1
+        view.read_frames_since(0, max_deltas=8)  # memoized — no second encode
+        assert reg.counter("serve_frame_encodes").value == 1
+
+    def test_backfill_entries_do_not_touch_objects_and_lower_horizon(self):
+        view = FleetView(compact_horizon=1024)
+        objects = {("pod", "a"): {"kind": "pod", "key": "a", "seq": 99}}
+        view.adopt_relay(instance="up-1", rv=10, objects=objects)
+        stale = [
+            {"type": "UPSERT", "rv": 9, "kind": "pod", "key": "a",
+             "object": {"kind": "pod", "key": "a", "seq": 1}},
+            {"type": "UPSERT", "rv": 10, "kind": "pod", "key": "a",
+             "object": {"kind": "pod", "key": "a", "seq": 99}},
+        ]
+        view.publish_relayed(
+            self._relayed_entries(stale), variant=CODEC_JSON, fold_objects=False
+        )
+        assert view.oldest_rv == 8
+        # the snapshot state never saw the intermediate seq=1
+        _rv, objs = view.snapshot()
+        assert objs == [{"kind": "pod", "key": "a", "seq": 99}]
+        # but a token inside the backfilled window reads the journal
+        result = view.read_since(8, max_deltas=64)
+        assert [d.rv for d in result.deltas] == [9, 10]
+
+    def test_sparse_relayed_journal_flags_compacted(self):
+        view = FleetView(compact_horizon=1024)
+        view.adopt_relay(instance="up-1", rv=0, objects={})
+        frames = [
+            {"type": "UPSERT", "rv": 1, "kind": "pod", "key": "a",
+             "object": {"kind": "pod", "key": "a", "seq": 1}},
+            # rv 2..3 were latest-wins-compacted away by the upstream
+            {"type": "UPSERT", "rv": 4, "kind": "pod", "key": "b",
+             "object": {"kind": "pod", "key": "b", "seq": 4}},
+        ]
+        view.publish_relayed(self._relayed_entries(frames), variant=CODEC_JSON)
+        result = view.read_since(0, max_deltas=64)
+        assert result.compacted  # the skip is sanctioned downstream
+        checker = SequenceChecker()
+        assert checker.observe(
+            result.from_rv, result.to_rv, result.compacted,
+            [d.rv for d in result.deltas],
+        )
+        assert checker.gaps == 0
+        # a token PAST the sparse region reads dense, unflagged
+        dense = view.read_since(4, max_deltas=64)
+        assert not dense.compacted
+
+    def test_note_upstream_rv_sanctions_empty_advance(self):
+        view = FleetView(compact_horizon=1024)
+        view.adopt_relay(instance="up-1", rv=5, objects={})
+        assert view.note_upstream_rv(9) == 9
+        assert view.rv == 9
+        result = view.read_since(5, max_deltas=64)
+        assert result.to_rv == 9 and result.deltas == [] and result.compacted
+
+    def test_adopt_relay_mid_life_gones_old_tokens(self):
+        view = FleetView(compact_horizon=1024)
+        view.adopt_relay(instance="up-1", rv=0, objects={})
+        frames = [
+            {"type": "UPSERT", "rv": i + 1, "kind": "pod", "key": f"p{i}",
+             "object": {"kind": "pod", "key": f"p{i}", "seq": i}}
+            for i in range(4)
+        ]
+        view.publish_relayed(self._relayed_entries(frames), variant=CODEC_JSON)
+        # upstream restarted into a fresh (smaller) rv space
+        view.adopt_relay(instance="up-2", rv=1, objects={})
+        from k8s_watcher_tpu.serve.view import GONE, INVALID
+
+        assert view.token_status(0) == GONE  # below the new horizon
+        assert view.token_status(3) == INVALID  # ahead of the new line
+        assert view.instance == "up-2"
+
+    def test_publish_relayed_skips_already_journaled_rvs(self):
+        view = FleetView(compact_horizon=1024)
+        view.adopt_relay(instance="up-1", rv=0, objects={})
+        frames = [
+            {"type": "UPSERT", "rv": 1, "kind": "pod", "key": "a",
+             "object": {"kind": "pod", "key": "a", "seq": 1}},
+        ]
+        entries = self._relayed_entries(frames)
+        assert view.publish_relayed(entries, variant=CODEC_JSON) == 1
+        assert view.publish_relayed(entries, variant=CODEC_JSON) == 0  # overlap
+        assert view.rv == 1
+
+
+# -- RelayPlane over real HTTP ------------------------------------------------
+
+
+class TestRelayPlane:
+    def _root(self, *, n=20, metrics=None, horizon=4096):
+        view = FleetView(compact_horizon=horizon, metrics=metrics)
+        plane = _FakePlane(view)
+        hub, server = _serve(view, plane=plane, metrics=metrics)
+        _churn(view, n)
+        return view, hub, server
+
+    def test_relay_mirrors_upstream_and_serves_identical_bytes(self):
+        up_view, _uh, up_srv = self._root()
+        relay, r_view, reg = _start_relay(up_srv.port)
+        _rh, r_srv = _serve(r_view, metrics=reg)
+        try:
+            relay.start()
+            assert relay.wait_synced(10)
+            assert r_view.instance == up_view.instance
+            _churn(up_view, 20, start=20)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and r_view.rv < up_view.rv:
+                time.sleep(0.02)
+            assert r_view.rv == up_view.rv
+            assert dict(r_view._objects) == dict(up_view._objects)
+            # stamped streams from relay and root are byte-identical
+            codec = "msgpack" if msgpack_available() else "json"
+            via_relay = _deltas_only(
+                _collect_raw(r_srv.port, 0, codec=codec, fresh=True)
+            )
+            via_root = _deltas_only(
+                _collect_raw(up_srv.port, 0, codec=codec, fresh=True)
+            )
+            assert [r for _f, r in via_relay] == [r for _f, r in via_root]
+            assert len(via_relay) == up_view.rv
+            # the cross-process encode-once invariant: zero relay encodes
+            assert relay.frame_encodes() == 0
+            health = relay.health()
+            assert health["healthy"] and health["depth"] == 1
+            assert health["gaps"] == 0 and health["dups"] == 0
+        finally:
+            relay.stop()
+            r_srv.stop()
+            up_srv.stop()
+
+    def test_resume_token_transfers_between_relay_and_root(self):
+        up_view, _uh, up_srv = self._root()
+        relay, r_view, reg = _start_relay(up_srv.port)
+        _rh, r_srv = _serve(r_view, metrics=reg)
+        try:
+            relay.start()
+            assert relay.wait_synced(10)
+            # token minted at the ROOT resumes at the RELAY...
+            root_cli = FleetClient(f"http://127.0.0.1:{up_srv.port}")
+            snap = root_cli.snapshot()
+            _churn(up_view, 10, start=100)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and r_view.rv < up_view.rv:
+                time.sleep(0.02)
+            relay_cli = FleetClient(f"http://127.0.0.1:{r_srv.port}")
+            batch = relay_cli.long_poll(snap.rv, view=snap.view, timeout=0.2)
+            checker = SequenceChecker()
+            assert checker.observe(
+                batch.from_rv, batch.to_rv, batch.compacted,
+                [i["rv"] for i in batch.items],
+            )
+            assert batch.to_rv == up_view.rv
+            # ...and the advanced token moves BACK to the root, gapless
+            root_batch = root_cli.long_poll(batch.to_rv, view=snap.view, timeout=0.2)
+            assert root_batch.from_rv == batch.to_rv
+        finally:
+            relay.stop()
+            r_srv.stop()
+            up_srv.stop()
+
+    def test_gone_propagates_through_relay_resync(self):
+        # tiny root horizon: the relay's own resume token falls behind
+        # while disconnected -> upstream 410 -> relay re-adopts -> its
+        # subscribers' old tokens answer 410 AT THE RELAY
+        up_view, _uh, up_srv = self._root(n=10, horizon=64)
+        relay, r_view, reg = _start_relay(up_srv.port, backfill=0)
+        _rh, r_srv = _serve(r_view, metrics=reg)
+        try:
+            relay.start()
+            assert relay.wait_synced(10)
+            first_instance_rv = r_view.rv
+            # sever the relay (stop it), churn the root far past the
+            # horizon, then bring a NEW relay plane up on the same view
+            relay.stop()
+            _churn(up_view, 500, start=1000)
+            relay2, r_view2, reg2 = _start_relay(up_srv.port, backfill=0)
+            _rh2, r_srv2 = _serve(r_view2, metrics=reg2)
+            relay2.start()
+            assert relay2.wait_synced(10)
+            assert relay2.health()["resyncs"] == 0  # fresh plane snapshots
+            # a consumer holding the OLD token gets the documented 410
+            # recovery from the relay — and the re-snapshot (served from
+            # the relay's byte cache) carries the full state
+            cli = FleetClient(f"http://127.0.0.1:{r_srv2.port}")
+            with pytest.raises(ResyncRequired):
+                cli.long_poll(first_instance_rv, view=r_view2.instance, timeout=0.2)
+            snap = cli.snapshot()
+            assert snap.rv == up_view.rv
+            assert len(snap.objects) == up_view.object_count()
+            r_srv2.stop()
+            relay2.stop()
+        finally:
+            relay.stop()
+            r_srv.stop()
+            up_srv.stop()
+
+    def test_restart_backfill_keeps_consumer_tokens_alive(self):
+        up_view, _uh, up_srv = self._root(n=40)
+        relay, r_view, reg = _start_relay(up_srv.port)
+        _rh, r_srv = _serve(r_view, metrics=reg)
+        try:
+            relay.start()
+            assert relay.wait_synced(10)
+            token_rv = 5  # minted long before the relay "restart"
+            relay.stop()
+            r_srv.stop()
+            # a brand-new relay process: fresh view, same upstream
+            relay2, r_view2, reg2 = _start_relay(up_srv.port)
+            _rh2, r_srv2 = _serve(r_view2, metrics=reg2)
+            relay2.start()
+            assert relay2.wait_synced(10)
+            # backfill warmed the journal below the snapshot: the old
+            # token resumes WITHOUT a 410 — gapless through the restart
+            assert r_view2.oldest_rv <= token_rv
+            cli = FleetClient(f"http://127.0.0.1:{r_srv2.port}")
+            batch = cli.long_poll(token_rv, view=r_view2.instance, timeout=0.2)
+            checker = SequenceChecker()
+            assert checker.observe(
+                batch.from_rv, batch.to_rv, batch.compacted,
+                [i["rv"] for i in batch.items],
+            )
+            assert checker.clean and batch.to_rv == up_view.rv
+            assert reg2.counter("relay_backfill_deltas").value > 0
+            relay2.stop()
+            r_srv2.stop()
+        finally:
+            relay.stop()
+            up_srv.stop()
+
+    def test_second_tier_relay_depth_and_limit(self):
+        up_view, _uh, up_srv = self._root()
+        # tier 1
+        relay1, r_view1, reg1 = _start_relay(up_srv.port)
+        plane1 = _FakePlane(r_view1, relay=relay1)
+        _rh1, r_srv1 = _serve(r_view1, metrics=reg1, plane=plane1)
+        # tier 2 chained off tier 1, depth_limit 2 -> allowed
+        relay2, r_view2, reg2 = _start_relay(r_srv1.port, depth_limit=2)
+        _rh2, r_srv2 = _serve(r_view2, metrics=reg2)
+        # tier 2 with depth_limit 1 -> self-quarantines, never adopts
+        relay3, r_view3, _reg3 = _start_relay(r_srv1.port, depth_limit=1)
+        try:
+            relay1.start()
+            assert relay1.wait_synced(10)
+            relay2.start()
+            assert relay2.wait_synced(10)
+            assert relay1.health()["depth"] == 1
+            assert relay2.health()["depth"] == 2
+            assert r_view2.instance == up_view.instance
+            relay3.start()
+            assert not relay3.wait_synced(1.0)
+            health3 = relay3.health()
+            assert health3["depth_exceeded"] and not health3["healthy"]
+            assert r_view3.rv == 0  # never adopted
+            # the quarantine must HOLD across retries: churn the root and
+            # sit through several resync backoffs — a quarantined relay
+            # must keep re-snapshotting (depth re-checked every attempt),
+            # never fall through to a watch window that folds frames
+            # into the never-adopted view
+            for i in range(5):
+                up_view.apply(
+                    "pod", f"post-quarantine-{i}",
+                    {"kind": "pod", "key": f"post-quarantine-{i}", "seq": i},
+                )
+            deadline = time.monotonic() + 1.5
+            while time.monotonic() < deadline:
+                assert r_view3.rv == 0, "quarantined relay folded upstream frames"
+                time.sleep(0.1)
+            assert relay3.health()["depth_exceeded"]
+            assert relay3.subscriber.resyncs >= 2  # re-checked, not wedged
+        finally:
+            relay3.stop()
+            relay2.stop()
+            relay1.stop()
+            r_srv2.stop()
+            r_srv1.stop()
+            up_srv.stop()
+
+    def test_sparse_hole_reaches_wire_sanctioned(self):
+        # note_upstream_rv with NOTHING pending for a live stream must
+        # still put the skip on the wire (COMPACTED + SYNC): a silent
+        # server-side cursor advance would read as a false gap at the
+        # next live delta
+        view = FleetView(compact_horizon=4096)
+        view.adopt_relay(instance="up-1", rv=0, objects={})
+
+        def relayed(rv):
+            f = {"type": "UPSERT", "rv": rv, "kind": "pod", "key": f"p{rv}",
+                 "object": {"kind": "pod", "key": f"p{rv}", "seq": rv}}
+            return (
+                Delta(rv, "pod", f["key"], "UPSERT", f["object"],
+                      time.monotonic(), None, 0.0, None),
+                chunk_frame(f, CODEC_JSON),
+            )
+
+        view.publish_relayed([relayed(1), relayed(2)], variant=CODEC_JSON)
+        _hub, srv = _serve(view)
+        applied = []
+        caught_up = threading.Event()
+
+        def on_delta(f):
+            applied.append(f["rv"])
+            if f["rv"] >= 7:
+                caught_up.set()
+
+        sub = FleetSubscriber(
+            FleetClient(f"http://127.0.0.1:{srv.port}", codec="json"),
+            on_delta=on_delta,
+            window_seconds=2.0,
+        )
+        runner = threading.Thread(target=sub.run, daemon=True)
+        try:
+            runner.start()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and (sub.rv or 0) < 2:
+                time.sleep(0.02)
+            assert (sub.rv or 0) >= 2
+            # the upstream compacted our stream: rvs 3..6 never journaled.
+            # The skip must arrive PROMPTLY (the pump wakes on
+            # note_upstream_rv) — the 1.2 s bound is deliberately under
+            # the 2 s SYNC heartbeat, which would eventually paper over a
+            # silent advance and mask the regression
+            view.note_upstream_rv(6)
+            deadline = time.monotonic() + 1.2
+            while time.monotonic() < deadline and (sub.rv or 0) < 6:
+                time.sleep(0.02)
+            assert sub.rv == 6, "empty sparse advance never reached the wire"
+            view.publish_relayed([relayed(7)], variant=CODEC_JSON)
+            assert caught_up.wait(5)
+            assert sub.checker.gaps == 0 and sub.checker.dups == 0
+            assert sub.checker.compacted_batches >= 1
+            # rvs 1..2 arrive via the initial snapshot, not the stream;
+            # the hole 3..6 delivers nothing; 7 is the only streamed delta
+            assert applied == [7]
+        finally:
+            sub.stop()
+            runner.join(5)
+            srv.stop()
+
+    def test_trace_dicts_pass_through_verbatim(self):
+        up_view, _uh, up_srv = self._root(n=0)
+        trace_dict = {"id": "t1", "uid": "u1", "spans": [["pipeline", 0.0, 0.001]]}
+        up_view.apply(
+            "pod", "traced", {"kind": "pod", "key": "traced", "seq": 1},
+            trace=trace_dict,
+        )
+        # pin json so the downstream json+trace collect rides the
+        # passthrough variant (auto would store msgpack and lazily fill)
+        relay, r_view, reg = _start_relay(up_srv.port, trace=True, codec="json")
+        _rh, r_srv = _serve(r_view, metrics=reg)
+        try:
+            relay.start()
+            assert relay.wait_synced(10)
+            pairs = _deltas_only(
+                _collect_raw(r_srv.port, 0, codec="json", fresh=True, trace=True)
+            )
+            assert pairs and pairs[-1][0].get("trace") == trace_dict
+            # verbatim: relay bytes == root bytes for the traced frame
+            root_pairs = _deltas_only(
+                _collect_raw(up_srv.port, 0, codec="json", fresh=True, trace=True)
+            )
+            assert [r for _f, r in pairs] == [r for _f, r in root_pairs]
+            assert relay.frame_encodes() == 0
+        finally:
+            relay.stop()
+            r_srv.stop()
+            up_srv.stop()
+
+
+# -- schema -------------------------------------------------------------------
+
+
+class TestRelaySchema:
+    def _raw(self, **relay):
+        return {
+            "serve": {"enabled": True},
+            "relay": {
+                "enabled": True,
+                "upstream": {"name": "root", "url": "http://127.0.0.1:1"},
+                **relay,
+            },
+        }
+
+    def test_defaults(self):
+        cfg = RelayConfig.from_raw({})
+        assert not cfg.enabled
+        assert cfg.depth_limit == 2 and cfg.backfill == 4096
+        assert cfg.fresh and not cfg.trace and cfg.codec == "auto"
+
+    def test_full_config_parses(self):
+        config = AppConfig.from_raw(self._raw(), "development")
+        assert config.relay.enabled
+        assert config.relay.upstream.name == "root"
+
+    def test_enabled_requires_upstream(self):
+        with pytest.raises(SchemaError, match="relay.upstream"):
+            RelayConfig.from_raw({"enabled": True})
+
+    def test_upstream_url_required(self):
+        with pytest.raises(SchemaError, match="url"):
+            RelayConfig.from_raw({"enabled": True, "upstream": {"name": "x"}})
+
+    def test_requires_serve(self):
+        raw = self._raw()
+        raw["serve"]["enabled"] = False
+        with pytest.raises(SchemaError, match="requires serve.enabled"):
+            AppConfig.from_raw(raw, "development")
+
+    def test_conflicts_with_federation(self):
+        raw = self._raw()
+        raw["federation"] = {
+            "enabled": True,
+            "upstreams": [{"name": "a", "url": "http://127.0.0.1:2"}],
+        }
+        with pytest.raises(SchemaError, match="federation"):
+            AppConfig.from_raw(raw, "development")
+
+    def test_conflicts_with_history(self):
+        raw = self._raw()
+        raw["history"] = {"enabled": True, "dir": "/tmp/x"}
+        with pytest.raises(SchemaError, match="history"):
+            AppConfig.from_raw(raw, "development")
+
+    def test_depth_limit_bounds(self):
+        with pytest.raises(SchemaError, match="depth_limit"):
+            RelayConfig.from_raw(self._raw(depth_limit=0)["relay"])
+
+    def test_codec_vocabulary(self):
+        with pytest.raises(SchemaError, match="codec"):
+            RelayConfig.from_raw(self._raw(codec="cbor")["relay"])
+
+    def test_backfill_non_negative(self):
+        with pytest.raises(SchemaError, match="backfill"):
+            RelayConfig.from_raw(self._raw(backfill=-1)["relay"])
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(SchemaError, match="unknown"):
+            RelayConfig.from_raw({"enabled": False, "bogus": 1})
+
+    def test_name_defaults_to_netloc(self):
+        cfg = RelayConfig.from_raw(
+            {"enabled": True, "upstream": {"url": "http://host:8090"}}
+        )
+        assert cfg.upstream.name == "host:8090"
