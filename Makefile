@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: test check check-scale integration integration-kind integration-mock bench bench-smoke trace-smoke serve-smoke history-smoke federation-smoke obs-smoke health-smoke analytics-smoke relay-smoke dryrun dryrun-128 accept
+.PHONY: test check check-scale integration integration-kind integration-mock bench bench-smoke trace-smoke serve-smoke history-smoke federation-smoke obs-smoke health-smoke analytics-smoke relay-smoke ingest-smoke dryrun dryrun-128 accept
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -136,6 +136,19 @@ analytics-smoke:
 # bench-smoke (bench_relay_tree). Artifact: artifacts/relay_smoke.json.
 relay-smoke:
 	$(PY) scripts/relay_smoke.py
+
+# Multi-process ingest smoke: a mock-backed WatcherApp with
+# ingest.shards: 2 / ingest.processes: 2 — two REAL spawned shard-reader
+# processes over real HTTP. Churn ramp, then one reader SIGKILLed
+# mid-churn: the supervisor must respawn it, the new incarnation must
+# RESUME from its per-shard rv checkpoint file (not relist), and a
+# sequence-checked serve consumer must stay gapless (0 gaps/dups/resyncs)
+# with the terminal view equal to the mock cluster's truth — kill-window
+# events are replayed, never skipped. The >=100k ev/s multi-process
+# THROUGHPUT gate runs in bench-smoke (bench_ingest_procs). Artifact:
+# artifacts/ingest_smoke.json.
+ingest-smoke:
+	$(PY) scripts/ingest_smoke.py
 
 dryrun:
 	$(PY) __graft_entry__.py 8

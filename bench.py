@@ -1397,6 +1397,445 @@ def bench_frame_scan(n_frames: int = 4000, tpu_fraction: float = 0.05) -> dict:
     return result
 
 
+class _ScriptedWatchHandler(BaseHTTPRequestHandler):
+    """One-shot chunked watch stream for the prefilter A/B: the first GET
+    of a round streams the scripted corpus with ``Transfer-Encoding:
+    chunked`` (the real apiserver shape — what engages the scan_chunk fast
+    path); every further GET answers 500 so the resilient source's retry
+    accounting (``max_reconnects=0``) terminates the round
+    deterministically instead of reconnecting forever."""
+
+    protocol_version = "HTTP/1.1"
+    disable_nagle_algorithm = True
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        if self.path.startswith("/version"):
+            body = b'{"major":"1","minor":"31"}'
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if getattr(self.server, "round_served", False):
+            self.send_response(500)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self.server.round_served = True
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        buf = self.server.corpus
+        write = self.wfile.write
+        for off in range(0, len(buf), 64 * 1024):
+            chunk = buf[off : off + 64 * 1024]
+            write(f"{len(chunk):X}\r\n".encode() + chunk + b"\r\n")
+        write(b"0\r\n\r\n")
+
+
+def bench_ingest_prefilter_ab(
+    n_frames: int = 24_000, tpu_every: int = 16, rounds: int = 3
+) -> dict:
+    """Prefiltered vs full-parse decode, same run, on the REAL ingest
+    stack: scripted chunked-HTTP watch body -> ``K8sClient._watch`` ->
+    ``KubernetesWatchSource`` (rv bookkeeping included) -> batched
+    ``EventPipeline.process_batch`` -> ``FleetView``. The A side decodes
+    every frame (``scanner=None``, the reference behavior); the B side
+    runs the production scan-before-parse path (``make_scanner`` auto).
+
+    Correctness FIRST, never retried away: the two sides' terminal views
+    must be IDENTICAL (a skipped frame must be provably non-significant),
+    both checkpoint rv lines must be monotone with the SAME final resume
+    point (a skipped run still advances the checkpoint), and the B side
+    must have actually skipped frames. Only then does the
+    min-of-interleaved-rounds speedup count."""
+    import gc
+
+    from k8s_watcher_tpu.config.schema import RetryPolicy
+    from k8s_watcher_tpu.k8s.client import K8sApiError, K8sClient
+    from k8s_watcher_tpu.k8s.kubeconfig import K8sConnection
+    from k8s_watcher_tpu.k8s.watch import KubernetesWatchSource
+    from k8s_watcher_tpu.metrics import MetricsRegistry
+    from k8s_watcher_tpu.native.scanner import NativeFrameScanner, make_scanner
+    from k8s_watcher_tpu.pipeline.phase import PhaseTracker
+    from k8s_watcher_tpu.pipeline.pipeline import EventPipeline
+    from k8s_watcher_tpu.serve import FleetView
+    from k8s_watcher_tpu.slices.tracker import SliceTracker
+    from k8s_watcher_tpu.watch.fake import build_pod
+
+    frames = []
+    for i in range(n_frames):
+        pod = build_pod(
+            f"ab-{i}", "default", uid=f"ab-uid-{i}",
+            tpu_chips=8 if i % tpu_every == 0 else 0,
+            phase="Running" if i % 3 else "Pending",
+            labels={"app.kubernetes.io/name": f"svc-{i % 97}", "team": "infra"},
+            resource_version=str(i + 1),
+        )
+        frames.append(json.dumps({"type": "MODIFIED", "object": pod}).encode())
+    corpus = b"\n".join(frames) + b"\n"
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _ScriptedWatchHandler)
+    server.daemon_threads = True
+    server.corpus = corpus
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+
+    class _RecordingCheckpoint:
+        """Minimal checkpoint protocol capturing the rv line."""
+
+        def __init__(self):
+            self.rvs = []
+
+        def resource_version(self):
+            return None
+
+        def update_resource_version(self, rv):
+            self.rvs.append(rv)
+
+        def get(self, key, default=None):
+            return default
+
+        def put(self, *a, **k):
+            pass
+
+    def run_side(scanner):
+        server.round_served = False
+        checkpoint = _RecordingCheckpoint()
+        metrics = MetricsRegistry()
+        view = FleetView(compact_horizon=1 << 17)
+        tracker = PhaseTracker()
+        pipeline = EventPipeline(
+            environment="production", sink=lambda notification: None,
+            phase_tracker=tracker, slice_tracker=SliceTracker("production"),
+            view=view, metrics=metrics,
+        )
+        source = KubernetesWatchSource(
+            K8sClient(K8sConnection(server=url), request_timeout=10.0),
+            scanner=scanner,
+            checkpoint=checkpoint,
+            resource_version="0",  # skip the LIST phase: watch-decode only
+            max_reconnects=0,  # the post-corpus 500 ends the round
+            retry=RetryPolicy(delay_seconds=0.01, max_delay_seconds=0.01),
+            metrics=metrics,
+        )
+        gc.collect()
+        batch = []
+        t0 = time.perf_counter()
+        try:
+            for event in source.events():
+                batch.append(event)
+                if len(batch) >= 256:
+                    pipeline.process_batch(batch)
+                    batch = []
+        except K8sApiError:
+            pass  # the scripted 500: round complete
+        if batch:
+            pipeline.process_batch(batch)
+        elapsed = time.perf_counter() - t0
+        state = {(o["kind"], o["key"]): o for o in view.snapshot()[1]}
+        return {
+            "elapsed": elapsed,
+            "state": state,
+            "rvs": checkpoint.rvs,
+            "prefiltered": int(metrics.counter("events_prefiltered").value),
+        }
+
+    scanner_b = make_scanner("google.com/tpu", mode="auto")
+    try:
+        best_a, best_b = None, None
+        correctness_ok = True
+        # the three invariants reported SEPARATELY so a red artifact names
+        # the one that actually broke (on failure they hold the failing
+        # round's verdicts; a green run reports the last round's)
+        views_identical = rv_lines_ok = frames_skipped_ok = True
+        skipped_frames = None
+        for r in range(max(1, rounds)):
+            # alternate A/B order so co-tenant drift can't bias one side
+            order = ("full", "pre") if r % 2 == 0 else ("pre", "full")
+            results = {}
+            for side in order:
+                results[side] = run_side(None if side == "full" else scanner_b)
+            a, b = results["full"], results["pre"]
+            views_identical = a["state"] == b["state"]
+            rv_lines_ok = bool(
+                a["rvs"] and b["rvs"]
+                and a["rvs"][-1] == b["rvs"][-1] == str(n_frames)
+                and all(int(x) <= int(y) for x, y in zip(a["rvs"], a["rvs"][1:]))
+                and all(int(x) <= int(y) for x, y in zip(b["rvs"], b["rvs"][1:]))
+            )
+            frames_skipped_ok = b["prefiltered"] > 0
+            if not (views_identical and rv_lines_ok and frames_skipped_ok):
+                correctness_ok = False  # never retried away: stop cold
+                best_a, best_b = a, b
+                break
+            skipped_frames = b["prefiltered"]
+            if best_a is None or a["elapsed"] < best_a["elapsed"]:
+                best_a = a
+            if best_b is None or b["elapsed"] < best_b["elapsed"]:
+                best_b = b
+    finally:
+        server.shutdown()
+        server.server_close()
+
+    speedup = (
+        best_a["elapsed"] / best_b["elapsed"] if best_b["elapsed"] else 0.0
+    )
+    return {
+        "frames": n_frames,
+        "tpu_every": tpu_every,
+        "rounds": rounds,
+        "scanner": type(scanner_b).__name__,
+        "native": isinstance(scanner_b, NativeFrameScanner),
+        "full_parse_events_per_sec": round(n_frames / best_a["elapsed"], 1),
+        "prefiltered_events_per_sec": round(n_frames / best_b["elapsed"], 1),
+        "skipped_frames": skipped_frames,
+        "views_identical": views_identical,
+        "rv_lines_ok": rv_lines_ok,
+        "frames_skipped_ok": frames_skipped_ok,
+        "speedup": round(speedup, 2),
+        "speedup_floor": 1.5,
+        "ok": correctness_ok and speedup >= 1.5,
+    }
+
+
+class _ProcReplaySource:
+    """One ingest worker's replay stream for ``bench_ingest_procs``: a
+    deterministic raw-byte watch body (two alternating phase-flip tiles,
+    mostly non-TPU pods) decoded through the REAL production path —
+    ``decode_watch_chunks`` + the auto scanner, ``scan_chunk`` before any
+    ``json.loads`` — inside the worker process. Significant events become
+    WatchEvents on the wire to the parent; skipped frames are counted
+    (``prefiltered``) and never touch the interpreter."""
+
+    def __init__(self, proc_index: int, spec: dict):
+        self.proc_index = proc_index
+        self.spec = spec
+        self.prefiltered = 0
+        self._stop = False
+
+    def _tiles(self):
+        from k8s_watcher_tpu.watch.fake import build_pod
+
+        spec = self.spec
+        tiles = []
+        for phase in ("Pending", "Running"):
+            frames = []
+            for i in range(spec["pods"]):
+                pod = build_pod(
+                    f"w{self.proc_index}-p{i}", "default",
+                    uid=f"w{self.proc_index}-uid-{i}",
+                    tpu_chips=8 if i % spec["tpu_every"] == 0 else 0,
+                    phase=phase,
+                    labels={"app.kubernetes.io/name": f"svc-{i % 53}"},
+                    resource_version=str(i + 1),
+                )
+                frames.append(
+                    json.dumps({"type": "MODIFIED", "object": pod}).encode()
+                )
+            tiles.append(b"\n".join(frames) + b"\n")
+        return tiles
+
+    def events(self):
+        from k8s_watcher_tpu.k8s.client import decode_watch_chunks
+        from k8s_watcher_tpu.native.scanner import make_scanner
+        from k8s_watcher_tpu.watch.source import WatchEvent
+
+        tiles = self._tiles()  # pre-generated: producer cost, not decode cost
+
+        def chunks():
+            for t in range(self.spec["tiles"]):
+                if self._stop:
+                    return
+                yield tiles[t % 2]
+
+        scanner = make_scanner("google.com/tpu", mode="auto")
+        for raw in decode_watch_chunks(chunks(), scanner):
+            if self._stop:
+                return
+            etype = raw.get("type")
+            if etype == "PREFILTERED":
+                self.prefiltered += raw.get("count", 1)
+                continue
+            obj = raw.get("object") or {}
+            yield WatchEvent(
+                type=etype,
+                pod=obj,
+                resource_version=(obj.get("metadata") or {}).get("resourceVersion"),
+            )
+
+    def stop(self):
+        self._stop = True
+
+
+def _ingest_procs_factory(plan):
+    """procpool source_factory seam (module-level: spawn-picklable)."""
+    return [_ProcReplaySource(plan.proc_index, plan.factory_arg)]
+
+
+def bench_ingest_procs(
+    processes: int = 4,
+    pods: int = 2048,
+    tiles: int = 96,
+    tpu_every: int = 32,  # ~3% TPU pods: the real-cluster shape the
+    # prefilter exists for (bench_frame_scan models 5%)
+    min_rate: float = 100_000.0,
+    attempts: int = 2,
+) -> dict:
+    """The multi-process full-stack ingest gate (ROADMAP item 2): N REAL
+    shard-reader processes (spawned ``watch/procpool.py`` workers, the
+    production supervision/wire code) each decoding a deterministic raw
+    watch byte stream through the REAL prefilter-first decode path,
+    feeding the parent's bounded queue -> batched ``EventPipeline`` ->
+    async dispatcher -> HTTP notify sink. The throughput number counts
+    EVERY offered frame (prefiltered ones included — that is precisely
+    the work the prefilter deletes and exactly how a production stream's
+    ev/s is counted); the parent pays full price for every significant
+    event.
+
+    Correctness gated before any number, never retried away: zero wire
+    gaps, every significant event folded (exact count), every TPU pod's
+    terminal phase correct, and the workers' prefiltered counts exactly
+    the non-TPU remainder. ``saturating_stage`` names the wall when the
+    rate misses ``min_rate`` (the old in-process wall was the ingest loop
+    itself; with N reader processes it should be nothing)."""
+    from k8s_watcher_tpu.metrics import MetricsRegistry
+    from k8s_watcher_tpu.notify.client import ClusterApiClient
+    from k8s_watcher_tpu.notify.dispatcher import Dispatcher
+    from k8s_watcher_tpu.pipeline.phase import PhaseTracker
+    from k8s_watcher_tpu.pipeline.pipeline import EventPipeline
+    from k8s_watcher_tpu.slices.tracker import SliceTracker
+    from k8s_watcher_tpu.trace import Tracer
+    from k8s_watcher_tpu.watch.procpool import ProcessShardedWatchSource, WorkerPlan
+
+    spec = {"pods": pods, "tiles": tiles, "tpu_every": tpu_every}
+    sig_per_tile = (pods + tpu_every - 1) // tpu_every
+    expected_sig = processes * sig_per_tile * tiles
+    total_frames = processes * pods * tiles
+    expected_prefiltered = total_frames - expected_sig
+    queue_capacity = 65536
+
+    def run_once() -> dict:
+        server = ThreadingHTTPServer(("127.0.0.1", 0), _SinkHandler)
+        server.daemon_threads = True
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        metrics = MetricsRegistry()
+        tracer = Tracer(sample_rate=256, ring_size=256, metrics=metrics)
+        client = ClusterApiClient(
+            f"http://127.0.0.1:{server.server_address[1]}", timeout=5.0
+        )
+        dispatcher = Dispatcher(
+            client.update_pod_status, capacity=queue_capacity, workers=4,
+            metrics=metrics, tracer=tracer,
+        )
+        dispatcher.start()
+        tracker = PhaseTracker()
+        pipeline = EventPipeline(
+            environment="production", sink=dispatcher.submit,
+            phase_tracker=tracker, slice_tracker=SliceTracker("production"),
+            metrics=metrics, tracer=tracer,
+        )
+        plans = [
+            WorkerPlan(
+                proc_index=p, processes=processes,
+                owned_shards=(p,), shards=processes,
+                batch_max=256, queue_capacity=8192,
+                source_factory=_ingest_procs_factory, factory_arg=spec,
+            )
+            for p in range(processes)
+        ]
+        source = ProcessShardedWatchSource(
+            plans, batch_max=256, queue_capacity=queue_capacity,
+            metrics=metrics, tracer=tracer,
+        )
+        processed = 0
+        t_first = None
+        try:
+            try:
+                for batch in source.batches():
+                    if t_first is None:
+                        t_first = time.monotonic()
+                    pipeline.process_batch(batch)
+                    processed += len(batch)
+                t_end = time.monotonic()
+            finally:
+                source.stop()
+                source.join(10.0)
+            dispatcher.drain(30.0)
+        finally:
+            # teardown must survive a pipeline/drain exception: a leaked
+            # dispatcher (4 threads) + listening sink would skew every
+            # subsequent tier in this process
+            dispatcher.stop()
+            server.shutdown()
+            server.server_close()
+        elapsed = (t_end - t_first) if t_first is not None else 0.0
+        stats = source.worker_stats()
+        phases = tracker.snapshot()
+        terminal_ok = all(
+            phases.get(f"w{p}-uid-{i}") == "Running"
+            for p in range(processes)
+            for i in range(0, pods, tpu_every)
+        )
+        rate = total_frames / elapsed if elapsed > 0 else 0.0
+        correctness_ok = (
+            stats["wire_gaps"] == 0
+            and processed == expected_sig
+            and stats["prefiltered"] == expected_prefiltered
+            and terminal_ok
+            and stats["respawns"] == 0
+        )
+        if rate >= min_rate:
+            saturating = None
+        elif (
+            source.queue.put_blocked > 0
+            or source.queue.high_water >= 0.9 * queue_capacity
+        ):
+            saturating = "pipeline_drain"
+        else:
+            saturating = "ingest_workers"
+        return {
+            "processes": processes,
+            "pods_per_worker": pods,
+            "tiles": tiles,
+            "tpu_every": tpu_every,
+            "total_frames": total_frames,
+            "significant_events": processed,
+            "expected_significant": expected_sig,
+            "prefiltered": stats["prefiltered"],
+            "expected_prefiltered": expected_prefiltered,
+            "wire_gaps": stats["wire_gaps"],
+            "respawns": stats["respawns"],
+            "terminal_phases_ok": terminal_ok,
+            "ingest_seconds": round(elapsed, 3),
+            "events_per_sec": round(rate, 1),
+            "significant_per_sec": round(processed / elapsed, 1) if elapsed else 0.0,
+            "queue_high_water": source.queue.high_water,
+            "rate_floor": min_rate,
+            "saturating_stage": saturating,
+            "correctness_ok": correctness_ok,
+            "ok": correctness_ok and rate >= min_rate,
+        }
+
+    best = None
+    try:
+        for _ in range(max(1, attempts)):
+            result = run_once()
+            if best is None or result["events_per_sec"] > best["events_per_sec"]:
+                best = result
+            if result["ok"] or not result["correctness_ok"]:
+                # green, or a correctness failure a retry must never vote away
+                best = result
+                break
+    except Exception as exc:  # one failed tier must not sink the whole bench
+        return {"error": str(exc), "ok": False}
+    return best
+
+
 def bench_virtual_probes(n_devices: int = 8) -> dict:
     """The multi-device collective probes over a VIRTUAL CPU mesh, in a
     subprocess so the platform forcing can't disturb this process's real
@@ -3820,6 +4259,14 @@ def main(smoke: bool = False) -> int:
         # analytics plane: batched what-if replay >= 5x the sequential
         # Python fold at 10k pods, verdicts + aggregates exactly equal
         analytics_stats = bench_analytics()
+        # multi-process ingest: 4 REAL reader processes x the prefilter-
+        # first decode path -> pipe wire -> parent pipeline/dispatcher;
+        # the >=100k full-stack gate + exact-fold correctness (~10 s)
+        ingest_procs = bench_ingest_procs()
+        # prefiltered vs full-parse decode on the real watch stack —
+        # identical terminal views + checkpoint rv lines FIRST, then the
+        # min-of-interleaved-rounds speedup (~5 s)
+        prefilter_ab = bench_ingest_prefilter_ab(n_frames=16_000)
         skipped = {"skipped": "smoke"}
         pipeline_stats = pipeline_500 = scan_stats = skipped
         relist_50k = checkpoint_50k = virtual_stats = probe_stats = skipped
@@ -3844,6 +4291,8 @@ def main(smoke: bool = False) -> int:
         federation = bench_federation(seconds=4.0)
         health_stats = bench_health(ticks=80)
         analytics_stats = bench_analytics(n_scenarios=12)
+        ingest_procs = bench_ingest_procs(tiles=160)
+        prefilter_ab = bench_ingest_prefilter_ab()
         scan_stats = bench_frame_scan()
         relist_stats = bench_relist_scale()
         relist_50k = bench_relist_scale(n_pods=50_000)
@@ -3869,6 +4318,8 @@ def main(smoke: bool = False) -> int:
         "federation": federation,
         "health": health_stats,
         "analytics": analytics_stats,
+        "ingest_procs": ingest_procs,
+        "ingest_prefilter_ab": prefilter_ab,
         "frame_scan": scan_stats,
         "relist_10k": relist_stats,
         "relist_50k": relist_50k,
@@ -3904,8 +4355,26 @@ def main(smoke: bool = False) -> int:
         "unit": "ms",
         "vs_baseline": vs_baseline,
         "e2e_completed": f"{e2e_stats.get('completed', 0)}/{e2e_stats.get('offered', 0)}",
-        "max_sustained_events_per_sec": saturation.get("max_sustained_events_per_sec"),
-        "saturating_stage": saturation.get("first_saturating_stage"),
+        # full-stack sustained ingest: the multi-process tier's number
+        # (real reader processes + prefilter-first decode + pipe wire +
+        # pipeline/dispatcher). The old in-process ceiling stays in the
+        # detail artifact (details.saturation); if the procs tier errored
+        # the headline falls back to it so the field never goes dark.
+        "max_sustained_events_per_sec": (
+            ingest_procs["events_per_sec"]
+            if "events_per_sec" in ingest_procs  # measured (even 0.0): never
+            # mix the procs verdict with the in-process number's provenance
+            else saturation.get("max_sustained_events_per_sec")
+        ),
+        "saturating_stage": (
+            ingest_procs.get("saturating_stage")
+            if "events_per_sec" in ingest_procs
+            else saturation.get("first_saturating_stage")
+        ),
+        # the prefilter A/B's verdict rides the detail artifact
+        # (details.ingest_prefilter_ab.ok, gated in test_bench_smoke) —
+        # the 1 KB headline budget spends its bytes on the procs gate
+        "ingest_procs_ok": ingest_procs.get("ok", False),
         "max_sustained_notify_per_sec": egress.get("max_sustained_notify_per_sec"),
         "egress_saturating_stage": egress.get("first_saturating_stage"),
         "burst_drain_notify_per_sec": burst_stats.get("drain_notify_per_sec"),
@@ -3996,9 +4465,13 @@ def main(smoke: bool = False) -> int:
             if headline.get(key) is None:
                 headline.pop(key, None)
         # the relay fields pushed the smoke headline against the 1 KB
-        # tail budget: drop two informational numbers the detail
-        # artifact (and the full tier) still carry — neither is gated
-        for key in ("relist_shard_speedup", "checkpoint_10k_mb"):
+        # tail budget, and the ingest_procs gate pushed it again: drop
+        # informational numbers the detail artifact (and the full tier)
+        # still carry — none of them gated on the headline
+        for key in (
+            "relist_shard_speedup", "checkpoint_10k_mb",
+            "checkpoint_10k_flush_ms",
+        ):
             headline.pop(key, None)
         # the probe tiers are skipped wholesale in smoke; their
         # always-false ok fields say nothing and the analytics fields

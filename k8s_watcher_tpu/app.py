@@ -83,6 +83,25 @@ def build_source(
             tracer=tracer,
         )
 
+    if ingest.processes > 0:
+        # multi-process ingest tier (watch/procpool.py): the shard streams,
+        # their prefilters and their per-shard rv checkpoints move into N
+        # supervised reader processes; this process keeps the pipeline,
+        # the view, and ONE control-plane client. ingest.processes: 0 is
+        # today's in-process path below, untouched.
+        from k8s_watcher_tpu.watch.procpool import build_process_source
+
+        logger.info(
+            "Multi-process ingest: %d reader processes x %d shard streams "
+            "(prefilter=%s; per-shard checkpoints under %s)",
+            ingest.processes, ingest.shards,
+            ingest.resolved_prefilter(config.tpu.prefilter),
+            config.state.checkpoint_path,
+        )
+        return build_process_source(
+            config, metrics=metrics, tracer=tracer, heartbeat=heartbeat
+        )
+
     from k8s_watcher_tpu.k8s.client import K8sClient
     from k8s_watcher_tpu.k8s.kubeconfig import load_connection
     from k8s_watcher_tpu.k8s.watch import KubernetesWatchSource
@@ -97,19 +116,24 @@ def build_source(
     version = first_client.get_api_version()
     logger.info("Successfully connected to Kubernetes API version: %s", version)
 
+    prefilter_mode = ingest.resolved_prefilter(config.tpu.prefilter)
+
     def make_shard_scanner():
-        if not config.tpu.prefilter:
-            return None
         from k8s_watcher_tpu.native.scanner import make_scanner
 
         # one scanner PER shard stream: the native scanner's record buffers
         # are per-instance scratch, not thread-safe across shard pumps.
         # uid extraction (the pre-parse foreign-shard skip) only matters
         # when there IS more than one shard
-        return make_scanner(config.tpu.resource_key, extract_uid=shards > 1)
+        return make_scanner(
+            config.tpu.resource_key, mode=prefilter_mode, extract_uid=shards > 1
+        )
 
-    if config.tpu.prefilter:
-        logger.info("Watch-frame prefilter enabled (%s)", config.tpu.resource_key)
+    if prefilter_mode != "off":
+        logger.info(
+            "Watch-frame prefilter enabled (%s, mode=%s)",
+            config.tpu.resource_key, prefilter_mode,
+        )
     shards = ingest.shards
     sources = []
     for shard in range(shards):

@@ -47,6 +47,8 @@ class SchemaError(ValueError):
 # config against it and remediate.NodeActuator validates its argument
 # against it (schema is the dependency-light layer, so it lives here).
 VALID_TAINT_EFFECTS = ("NoSchedule", "PreferNoSchedule", "NoExecute")
+# ingest.prefilter (native/scanner.py make_scanner) vocabulary
+VALID_PREFILTER_MODES = ("auto", "native", "python", "off")
 
 
 def _type_name(value: Any) -> str:
@@ -656,10 +658,34 @@ class IngestConfig:
     shards: int = 1
     batch_max: int = 128
     queue_capacity: int = 8192
+    # multi-process shard readers (watch/procpool.py): split the shard
+    # streams across `processes` OS worker processes, each owning its
+    # streams + prefilter + per-shard rv checkpoint, feeding the parent's
+    # pipeline over a length-prefixed pipe wire. 0 = in-process (today's
+    # behavior, the io_threads-0 legacy-reference pattern). Requires
+    # checkpointing (state.checkpoint_path) — the crash-respawn resume
+    # contract needs durable per-shard rv lines (AppConfig cross-check).
+    processes: int = 0
+    # watch-frame prefilter mode (native/scanner.py make_scanner):
+    # auto (native when it builds, Python otherwise — one INFO on the
+    # downgrade) | native (pinned: same fallback, WARNING) | python |
+    # off (full json.loads on every frame — the reference behavior).
+    # tpu.prefilter: false (legacy bool) forces off.
+    prefilter: str = "auto"
+
+    def resolved_prefilter(self, tpu_prefilter: bool = True) -> str:
+        """Effective prefilter mode: the legacy ``tpu.prefilter: false``
+        bool still forces ``off`` (one release of overlap, same posture as
+        metrics.legacy_suffix_names)."""
+        return "off" if not tpu_prefilter else self.prefilter
 
     @classmethod
     def from_raw(cls, raw: Mapping[str, Any]) -> "IngestConfig":
-        _check_known(raw, ("shards", "batch_max", "queue_capacity"), "ingest")
+        _check_known(
+            raw,
+            ("shards", "batch_max", "queue_capacity", "processes", "prefilter"),
+            "ingest",
+        )
         shards = _opt_int(raw, "shards", "ingest", 1)
         if shards < 1:
             raise SchemaError(f"config key 'ingest.shards': must be >= 1, got {shards}")
@@ -673,7 +699,37 @@ class IngestConfig:
                 f"({batch_max}), got {queue_capacity} (a queue smaller than one "
                 f"batch can never fill a batch and would throttle the drain)"
             )
-        return cls(shards=shards, batch_max=batch_max, queue_capacity=queue_capacity)
+        processes = _opt_int(raw, "processes", "ingest", 0)
+        if processes < 0:
+            raise SchemaError(
+                f"config key 'ingest.processes': must be >= 0 (0 = in-process), got {processes}"
+            )
+        if processes > shards:
+            raise SchemaError(
+                f"config key 'ingest.processes': must be <= ingest.shards "
+                f"({shards}), got {processes} (a worker process owns >= 1 whole "
+                f"shard stream; more processes than shards would idle)"
+            )
+        raw_prefilter = raw.get("prefilter")
+        if isinstance(raw_prefilter, bool):
+            # YAML 1.1 reads a bare `off`/`on` as a boolean — honor the
+            # obvious intent (and the legacy tpu.prefilter bool semantics)
+            # instead of rejecting the natural spelling
+            prefilter = "auto" if raw_prefilter else "off"
+        else:
+            prefilter = _opt_str(raw, "prefilter", "ingest", "auto")
+        if prefilter not in VALID_PREFILTER_MODES:
+            raise SchemaError(
+                f"config key 'ingest.prefilter': must be one of "
+                f"{', '.join(VALID_PREFILTER_MODES)}, got {prefilter!r}"
+            )
+        return cls(
+            shards=shards,
+            batch_max=batch_max,
+            queue_capacity=queue_capacity,
+            processes=processes,
+            prefilter=prefilter,
+        )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1693,6 +1749,25 @@ class AppConfig:
                 "columnar encoder's source of truth is the serving plane's "
                 "FleetView, and /serve/analytics rides its HTTP surface)"
             )
+        ingest = IngestConfig.from_raw(raw.get("ingest") or {})
+        state = StateConfig.from_raw(raw.get("state") or {})
+        kubernetes = KubernetesConfig.from_raw(raw.get("kubernetes") or {})
+        if ingest.processes > 0:
+            if not state.checkpoint_path:
+                raise SchemaError(
+                    "config key 'ingest.processes': requires checkpointing "
+                    "(state.checkpoint_path) — each shard-reader process resumes "
+                    "its watch from a durable per-shard resourceVersion after a "
+                    "crash/respawn; without it every worker death replays or "
+                    "relists the whole shard"
+                )
+            if kubernetes.use_mock:
+                raise SchemaError(
+                    "config key 'ingest.processes': conflicts with "
+                    "kubernetes.use_mock — the in-process fake pod lifecycle "
+                    "cannot cross process boundaries; point the workers at a "
+                    "real (or mock-apiserver) URL instead"
+                )
         health = HealthConfig.from_raw(raw.get("health") or {})
         if health.enabled:
             # each enabled source must have the plane it reads — a silently
@@ -1717,10 +1792,10 @@ class AppConfig:
             environment=environment,
             watcher=WatcherConfig.from_raw(raw.get("watcher") or {}),
             clusterapi=ClusterApiConfig.from_raw(raw.get("clusterapi") or {}),
-            kubernetes=KubernetesConfig.from_raw(raw.get("kubernetes") or {}),
+            kubernetes=kubernetes,
             tpu=TpuConfig.from_raw(raw.get("tpu") or {}),
-            state=StateConfig.from_raw(raw.get("state") or {}),
-            ingest=IngestConfig.from_raw(raw.get("ingest") or {}),
+            state=state,
+            ingest=ingest,
             trace=trace,
             serve=serve,
             history=history,

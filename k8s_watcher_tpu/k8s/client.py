@@ -63,6 +63,54 @@ class K8sNotFoundError(K8sApiError):
     """HTTP 404 — object does not exist."""
 
 
+def decode_watch_chunks(
+    chunks: Iterator[bytes], scanner, shard=None
+) -> Iterator[Dict[str, Any]]:
+    """The watch decode hot path: raw chunked-transfer byte chunks ->
+    watch-event dicts, with ``scanner.scan_chunk`` running BEFORE any
+    ``json.loads`` so non-significant frames (no accelerator key;
+    foreign-shard uids when ``shard=(i, n)``) skip the parse entirely and
+    surface as coalesced rv-only PREFILTERED markers.
+
+    Factored out of the HTTP client so every consumer of raw frame bytes —
+    the live watch (``K8sClient._watch``), the multi-process shard readers'
+    replay seam, and the bench's A/B legs — decodes through the IDENTICAL
+    code. Frame boundaries are ours to find (they don't align with HTTP
+    chunks): the unconsumed tail of each chunk is prepended to the next;
+    a non-empty tail at end-of-stream is the final (unterminated) frame.
+    """
+    scan_chunk = scanner.scan_chunk
+    tail = b""
+    for chunk in chunks:
+        if not chunk:
+            continue
+        buf = tail + chunk if tail else chunk
+        records, consumed = scan_chunk(buf, shard=shard)
+        tail = buf[consumed:]
+        # skip-runs arrive pre-coalesced from the scanner; merge runs
+        # that continue across chunk boundaries so a non-TPU event storm
+        # costs one marker per chunk at most
+        skip_rv, skipped = None, 0
+        for start, length, rv, count in records:
+            if rv is not None:
+                skip_rv, skipped = rv, skipped + count
+                continue
+            if skipped:
+                yield K8sClient._prefiltered_marker(skip_rv, skipped)
+                skip_rv, skipped = None, 0
+            yield K8sClient._parse_frame(buf[start : start + length])
+        if skipped:
+            yield K8sClient._prefiltered_marker(skip_rv, skipped)
+    if tail.strip():
+        # stream closed mid-line without a trailing newline: the tail is
+        # the final frame
+        scan = scanner.scan(tail)
+        if scan.skippable or (shard is not None and scan.foreign_shard(*shard)):
+            yield K8sClient._prefiltered_marker(scan.resource_version)
+        else:
+            yield K8sClient._parse_frame(tail)
+
+
 class K8sClient:
     def __init__(self, connection: K8sConnection, *, request_timeout: float = 30.0):
         self.connection = connection
@@ -650,11 +698,12 @@ class K8sClient:
         - no scanner: iter_lines + parse (reference-equivalent behavior).
 
         ``shard`` (``(i, n)``) adds the client-side shard ownership skip on
-        the per-frame path: a frame whose scanned uid hashes to another
-        shard becomes an rv-only PREFILTERED marker without a JSON parse.
-        The chunk path has no per-frame uid, so foreign-shard frames there
-        parse and are dropped by the watch source — correctness is always
-        the source's post-parse filter; this is only the fast path.
+        BOTH scanner paths: a frame whose scanned uid hashes to another
+        shard becomes an rv-only PREFILTERED marker without a JSON parse
+        (the chunk path computes the verdict natively — crc32 in C). A
+        frame with no extractable uid full-parses and is dropped by the
+        watch source's post-parse ownership filter — correctness is always
+        the source's filter; the scanner is only the fast path.
         """
         if scanner is None:
             for line in response.iter_lines():
@@ -667,48 +716,20 @@ class K8sClient:
         # each transfer chunk as it lands. On a close-delimited body a
         # fixed-size read would block until the buffer fills, so fall back
         # to the per-frame path there.
-        scan_chunk = getattr(scanner, "scan_chunk", None)
-        if not getattr(response.raw, "chunked", False):
-            scan_chunk = None
-        if scan_chunk is None:
-            for line in response.iter_lines():
-                if not line:
-                    continue
-                scan = scanner.scan(line)
-                if scan.skippable or (shard is not None and scan.foreign_shard(*shard)):
-                    yield self._prefiltered_marker(scan.resource_version)
-                else:
-                    yield self._parse_frame(line)
+        if getattr(scanner, "scan_chunk", None) is not None and getattr(
+            response.raw, "chunked", False
+        ):
+            yield from decode_watch_chunks(
+                response.raw.stream(64 * 1024, decode_content=True),
+                scanner,
+                shard,
+            )
             return
-
-        tail = b""
-        # urllib3's stream() handles transfer-chunk reassembly; frame
-        # boundaries are ours to find (they don't align with HTTP chunks)
-        for chunk in response.raw.stream(64 * 1024, decode_content=True):
-            if not chunk:
+        for line in response.iter_lines():
+            if not line:
                 continue
-            buf = tail + chunk if tail else chunk
-            records, consumed = scan_chunk(buf)
-            tail = buf[consumed:]
-            # skip-runs arrive pre-coalesced from the scanner; merge runs
-            # that continue across chunk boundaries so a non-TPU event storm
-            # costs one marker per chunk at most
-            skip_rv, skipped = None, 0
-            for start, length, rv, count in records:
-                if rv is not None:
-                    skip_rv, skipped = rv, skipped + count
-                    continue
-                if skipped:
-                    yield self._prefiltered_marker(skip_rv, skipped)
-                    skip_rv, skipped = None, 0
-                yield self._parse_frame(buf[start : start + length])
-            if skipped:
-                yield self._prefiltered_marker(skip_rv, skipped)
-        if tail.strip():
-            # server closed mid-line without a trailing newline: the tail is
-            # the final frame
-            scan = scanner.scan(tail)
+            scan = scanner.scan(line)
             if scan.skippable or (shard is not None and scan.foreign_shard(*shard)):
                 yield self._prefiltered_marker(scan.resource_version)
             else:
-                yield self._parse_frame(tail)
+                yield self._parse_frame(line)

@@ -62,6 +62,33 @@ int read_quoted_value(const char* p, const char* end, char* out, long cap) {
     return 0;
 }
 
+// CRC-32 (IEEE 802.3, the zlib/crc32 polynomial) over the uid bytes — MUST
+// match Python's zlib.crc32 exactly, because the shard verdict computed here
+// has to agree with watch/sharded.py shard_of() (a disagreement would make
+// the native prefilter drop frames the Python partition owns). The table is
+// a C++11 magic static (constructor-initialized): concurrent first calls
+// from N shard pump threads get a thread-safe one-time init — a hand-rolled
+// `static bool ready` flag here would be a data race.
+struct Crc32Table {
+    unsigned int t[256];
+    Crc32Table() {
+        for (unsigned int i = 0; i < 256; ++i) {
+            unsigned int c = i;
+            for (int k = 0; k < 8; ++k)
+                c = (c & 1u) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+            t[i] = c;
+        }
+    }
+};
+
+unsigned int crc32_ieee(const char* data, long len) {
+    static const Crc32Table table;  // thread-safe magic-static init
+    unsigned int crc = 0xFFFFFFFFu;
+    for (long i = 0; i < len; ++i)
+        crc = table.t[(crc ^ static_cast<unsigned char>(data[i])) & 0xFFu] ^ (crc >> 8);
+    return crc ^ 0xFFFFFFFFu;
+}
+
 }  // namespace
 
 extern "C" {
@@ -134,9 +161,21 @@ typedef struct {
 // buf[*consumed:] as the tail for the next chunk. Empty lines are consumed
 // without a record. When more than `cap` frames are present the caller
 // simply calls again with the unconsumed remainder.
+//
+// shard/shards: the caller's uid-hash partition (watch/sharded.py). With
+// shards > 1, a frame whose first `"uid"` value hashes (crc32 % shards) to
+// ANOTHER shard is skippable (bit 3) even when the resource key is present
+// — the owning shard's stream will deliver it; this stream only needs the
+// resourceVersion. A uid that cannot be extracted cleanly (escape, missing,
+// overflow) yields no shard verdict and the frame full-parses — the watch
+// source's post-parse ownership filter keeps the partition correct. Pass
+// shards <= 1 to disable.
 long fastscan_chunk(const char* buf, long len,
                     const char* key, long key_len,
+                    long shard, long shards,
                     FastScanRec* out, long cap, long* consumed) {
+    static const char kUid[] = "\"uid\"";
+    char uid_buf[128];
     long n = 0;
     long pos = 0;
     *consumed = 0;
@@ -163,6 +202,28 @@ long fastscan_chunk(const char* buf, long len,
                 if (strcmp(t, "ADDED") == 0 || strcmp(t, "MODIFIED") == 0 ||
                     strcmp(t, "DELETED") == 0) {
                     rec->flags |= 8;
+                }
+            }
+            // foreign-shard skip: key presence does NOT matter here — the
+            // owning shard's stream delivers the event; this one only needs
+            // the resume point. Gated on the same type+rv extraction the
+            // key skip needs (rv-only treatment must still advance resume).
+            if (shards > 1 && rec->flags >= 0 && (rec->flags & 6) == 6 &&
+                !(rec->flags & 8)) {
+                const char* t = rec->type;
+                if (strcmp(t, "ADDED") == 0 || strcmp(t, "MODIFIED") == 0 ||
+                    strcmp(t, "DELETED") == 0) {
+                    const char* u = find_token(buf + pos, frame_len, kUid,
+                                               sizeof(kUid) - 1);
+                    if (u != nullptr &&
+                        read_quoted_value(u + sizeof(kUid) - 1,
+                                          buf + pos + frame_len,
+                                          uid_buf, sizeof(uid_buf)) == 0 &&
+                        uid_buf[0] != '\0') {
+                        long owner = crc32_ieee(uid_buf,
+                                                strlen(uid_buf)) % shards;
+                        if (owner != shard) rec->flags |= 8;
+                    }
                 }
             }
             // coalesce a run of skippable frames into the previous record:

@@ -104,7 +104,7 @@ class PythonFrameScanner:
             uid=uid.group(1).decode() if uid else None,
         )
 
-    def scan_chunk(self, buf: bytes):
+    def scan_chunk(self, buf: bytes, shard: Optional[tuple] = None):
         """Split ``buf`` into newline-delimited frames and scan each.
 
         Returns ``(records, consumed)``: records are
@@ -114,6 +114,12 @@ class PythonFrameScanner:
         the caller must full-parse ``buf[start:start+length]``.
         ``buf[consumed:]`` is the incomplete tail to prepend to the next
         chunk.
+
+        ``shard`` (``(i, n)``) adds the uid-hash ownership skip: a frame
+        whose extracted uid provably belongs to another ingest shard is
+        skippable even when the resource key is present (the owning stream
+        delivers it; this one only needs the resume point). No extractable
+        uid -> no shard verdict -> full parse (``foreign_shard`` contract).
         """
         records = []
         pos = 0
@@ -127,12 +133,15 @@ class PythonFrameScanner:
                 end -= 1
             if end > pos:
                 scan = self.scan(buf[pos:end])
-                if scan.skippable and records and records[-1][2] is not None:
+                skip = scan.skippable or (
+                    shard is not None and scan.foreign_shard(*shard)
+                )
+                if skip and records and records[-1][2] is not None:
                     # coalesce the skip-run (rv monotonic: keep the last)
                     start, length, _, count = records[-1]
                     records[-1] = (start, end - start, scan.resource_version, count + 1)
                 else:
-                    rv = scan.resource_version if scan.skippable else None
+                    rv = scan.resource_version if skip else None
                     records.append((pos, end - pos, rv, 1))
             pos = nl + 1
         return records, pos
@@ -175,16 +184,20 @@ class NativeFrameScanner:
         self._chunk_fn.argtypes = [
             ctypes.c_char_p, ctypes.c_long,
             ctypes.c_char_p, ctypes.c_long,
+            ctypes.c_long, ctypes.c_long,
             ctypes.POINTER(_FastScanRec), ctypes.c_long,
             ctypes.POINTER(ctypes.c_long),
         ]
         self._recs = (_FastScanRec * _CHUNK_RECS)()
 
-    def scan_chunk(self, buf: bytes):
+    def scan_chunk(self, buf: bytes, shard: Optional[tuple] = None):
         """Batch counterpart of ``scan``: one native call decodes up to
-        ``_CHUNK_RECS`` frames; the skip verdict (flags bit 3) is computed in
-        C so the per-skipped-frame Python cost is one flag test. Same return
+        ``_CHUNK_RECS`` frames; the skip verdict (flags bit 3) — the
+        key-absence test AND the ``shard`` uid-hash ownership test (C-side
+        crc32, identical to ``shard_of``) — is computed in C so the
+        per-skipped-frame Python cost is one flag test. Same return
         contract as ``PythonFrameScanner.scan_chunk``."""
+        shard_idx, shards = shard if shard is not None else (0, 0)
         records = []
         base = 0
         view = buf
@@ -193,6 +206,7 @@ class NativeFrameScanner:
             n = self._chunk_fn(
                 view, len(view),
                 self._quoted_key, len(self._quoted_key),
+                shard_idx, shards,
                 self._recs, _CHUNK_RECS,
                 ctypes.byref(consumed),
             )
@@ -246,17 +260,49 @@ class NativeFrameScanner:
         )
 
 
-def make_scanner(resource_key: str, *, prefer_native: bool = True, extract_uid: bool = True):
-    """Best available scanner for ``resource_key`` (native, else Python).
+def make_scanner(
+    resource_key: str,
+    *,
+    prefer_native: bool = True,
+    extract_uid: bool = True,
+    mode: str = "auto",
+):
+    """Scanner for ``resource_key`` per ``mode`` (``ingest.prefilter``):
+
+    - ``auto``  — native when it builds/loads, else Python, one INFO log on
+      the downgrade (the default: degradation is expected on hosts without
+      a toolchain and must not look like a fault);
+    - ``native`` — pinned: the same fallback, but the downgrade logs a
+      WARNING (the operator asked for native and is not getting it — the
+      analytics backend-pin posture);
+    - ``python`` — the pure-Python scanner, no build attempted;
+    - ``off``   — None (caller runs the full-parse path).
+
+    NEVER raises: any build/load failure — missing compiler, broken cache
+    dir, unloadable object — degrades to ``PythonFrameScanner``.
     ``extract_uid=False`` for unsharded streams skips the per-frame uid
-    work nothing would consume."""
-    if prefer_native:
-        from k8s_watcher_tpu.native.build import build_fastscan
+    work nothing would consume. ``prefer_native=False`` is the legacy
+    spelling of ``mode="python"``.
+    """
+    if mode == "off":
+        return None
+    if mode == "python" or not prefer_native:
+        return PythonFrameScanner(resource_key, extract_uid=extract_uid)
+    pinned = mode == "native"
+    reason = None
+    try:
+        from k8s_watcher_tpu.native.build import build_fastscan, last_build_error
 
         lib_path = build_fastscan()
         if lib_path is not None:
-            try:
-                return NativeFrameScanner(resource_key, lib_path, extract_uid=extract_uid)
-            except OSError as exc:
-                logger.warning("native fastscan unloadable (%s); using Python scanner", exc)
+            return NativeFrameScanner(resource_key, lib_path, extract_uid=extract_uid)
+        reason = last_build_error()
+    except Exception as exc:  # noqa: BLE001 — degrade, never kill app start
+        reason = str(exc)
+    logger.log(
+        logging.WARNING if pinned else logging.INFO,
+        "native fastscan unavailable (%s)%s; using Python scanner",
+        reason or "unknown",
+        " — ingest.prefilter pinned to 'native'" if pinned else "",
+    )
     return PythonFrameScanner(resource_key, extract_uid=extract_uid)

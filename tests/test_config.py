@@ -189,6 +189,60 @@ class TestStrictSchema:
         assert cfg.tpu.remediation_confirm_cycles == 5
         assert cfg.tpu.remediation_taint_effect == "PreferNoSchedule"
 
+    def test_ingest_processes_parsed_with_checkpointing(self, tmp_path):
+        d = self._write(
+            tmp_path,
+            "ingest:\n  shards: 4\n  processes: 2\n  prefilter: native\n"
+            "state:\n  checkpoint_path: /var/lib/w/ck.json\n",
+        )
+        cfg = load_config("development", d, env={})
+        assert cfg.ingest.processes == 2
+        assert cfg.ingest.prefilter == "native"
+        assert cfg.ingest.resolved_prefilter(True) == "native"
+        # the legacy tpu.prefilter bool still forces off (overlap release)
+        assert cfg.ingest.resolved_prefilter(False) == "off"
+
+    def test_ingest_processes_requires_checkpointing(self, tmp_path):
+        # the resume contract: a respawned shard reader must have a
+        # durable per-shard rv line to resume from
+        d = self._write(tmp_path, "ingest:\n  shards: 2\n  processes: 2\n")
+        with pytest.raises(ConfigError, match="requires checkpointing"):
+            load_config("development", d, env={})
+
+    def test_ingest_processes_conflicts_with_use_mock(self, tmp_path):
+        d = self._write(
+            tmp_path,
+            "ingest:\n  shards: 2\n  processes: 2\n"
+            "state:\n  checkpoint_path: /tmp/ck.json\n"
+            "kubernetes:\n  use_mock: true\n",
+        )
+        with pytest.raises(ConfigError, match="use_mock"):
+            load_config("development", d, env={})
+
+    def test_ingest_processes_bounds(self, tmp_path):
+        d = self._write(
+            tmp_path,
+            "ingest:\n  processes: -1\nstate:\n  checkpoint_path: /tmp/c\n",
+        )
+        with pytest.raises(ConfigError, match="processes"):
+            load_config("development", d, env={})
+        # more processes than shard streams would idle: declared error
+        d = self._write(
+            tmp_path,
+            "ingest:\n  shards: 2\n  processes: 3\n"
+            "state:\n  checkpoint_path: /tmp/c\n",
+        )
+        with pytest.raises(ConfigError, match="<= ingest.shards"):
+            load_config("development", d, env={})
+
+    def test_ingest_prefilter_vocabulary(self, tmp_path):
+        for mode in ("auto", "native", "python", "off"):
+            d = self._write(tmp_path, f"ingest:\n  prefilter: {mode}\n")
+            assert load_config("development", d, env={}).ingest.prefilter == mode
+        d = self._write(tmp_path, "ingest:\n  prefilter: turbo\n")
+        with pytest.raises(ConfigError, match="prefilter"):
+            load_config("development", d, env={})
+
     def test_remediation_bad_values_rejected(self, tmp_path):
         d = self._write(tmp_path, "tpu:\n  remediation:\n    taint_effect: EvictEverything\n")
         with pytest.raises(ConfigError, match="taint_effect"):

@@ -181,6 +181,281 @@ class TestChunkScan:
         assert stream[consumed:] == CORPUS[1][:40]
 
 
+def classify_stream(scanner, stream: bytes, chunk_size: int, shard=None):
+    """Drive ``scan_chunk`` across chunk boundaries and expand every record
+    into a per-frame classification: ``("skip", rv)`` for each coalesced
+    skip, ``("parse", <decoded name or raw>)`` for full-parse frames. The
+    golden-parity currency: two scanners agree iff these lists agree."""
+    out = []
+    tail = b""
+    for off in range(0, len(stream), chunk_size):
+        buf = tail + stream[off : off + chunk_size]
+        records, consumed = scanner.scan_chunk(buf, shard=shard)
+        tail = buf[consumed:]
+        for start, length, rv, count in records:
+            if rv is not None:
+                out.append(("skip", rv, count))
+            else:
+                assert count == 1
+                out.append(("parse", bytes(buf[start : start + length])))
+    assert not tail.strip(), "unconsumed complete frames left in tail"
+    return out
+
+
+def expand_skips(classified):
+    """Order-preserving (kind-per-frame, final-rv) shape that is invariant
+    to coalescing granularity differences between implementations."""
+    kinds = []
+    for rec in classified:
+        if rec[0] == "skip":
+            kinds.extend(["skip"] * rec[2])
+        else:
+            kinds.append(rec[1])
+    last_rv = next((r[1] for r in reversed(classified) if r[0] == "skip"), None)
+    return kinds, last_rv
+
+
+class TestChunkScanEdgeCases:
+    """Frame boundaries split at the nastiest possible offsets: the tail
+    carry must reassemble them with classification identical to the
+    unsplit stream, on BOTH scanners (the analytics jax==numpy posture)."""
+
+    # multibyte UTF-8 in names/labels: é (2 bytes), ✓ (3), 🚀 (4) — RAW
+    # bytes on the wire (ensure_ascii=False), so chunk splits land inside
+    # multibyte sequences; default json.dumps would \\u-escape them away
+    UTF8_CORPUS = [
+        json.dumps(
+            {"type": t, "object": pod}, ensure_ascii=False
+        ).encode()
+        for t, pod in [
+            ("MODIFIED", build_pod("plain-é", resource_version="201")),
+            ("MODIFIED", build_pod(
+                "tpu-✓", tpu_chips=4, resource_version="202",
+                labels={"note": "🚀🚀🚀"},
+            )),
+            ("MODIFIED", build_pod(
+                "plain-🚀", resource_version="203",
+                labels={"emoji": "✓✓é🚀"},
+            )),
+            ("DELETED", build_pod("plain-last", resource_version="204")),
+        ]
+    ]
+
+    def _parity_all_splits(self, native_scanner, stream: bytes):
+        """Every chunk size from 1 byte up hits every possible boundary —
+        mid-UTF-8 sequences, mid-token, between \\r and \\n — and every
+        split must classify exactly like the unsplit stream."""
+        py = PythonFrameScanner(KEY)
+        reference = classify_stream(py, stream, len(stream) or 1)
+        for chunk_size in (1, 2, 3, 7, 64, len(stream) or 1):
+            for scanner in (native_scanner, py):
+                got = classify_stream(scanner, stream, chunk_size)
+                assert expand_skips(got) == expand_skips(reference), (
+                    scanner, chunk_size,
+                )
+
+    def test_split_mid_utf8_sequence(self, native_scanner):
+        stream = b"\n".join(self.UTF8_CORPUS) + b"\n"
+        self._parity_all_splits(native_scanner, stream)
+        # and the parsed set is exactly the TPU frame
+        kinds, last_rv = expand_skips(
+            classify_stream(PythonFrameScanner(KEY), stream, 3)
+        )
+        parsed = [k for k in kinds if k != "skip"]
+        assert len(parsed) == 1 and b"tpu-\xe2\x9c\x93" in parsed[0]
+        assert last_rv == "204"
+
+    def test_split_mid_uid_key(self, native_scanner):
+        # force boundaries INSIDE the '"uid"' token bytes themselves: the
+        # 1..7-byte chunk sizes in _parity_all_splits guarantee several
+        # splits land mid-token; sharded classification must still agree
+        stream = b"\n".join(
+            frame("MODIFIED", build_pod(f"u{i}", uid=f"uid-{i}", resource_version=str(300 + i)))
+            for i in range(6)
+        ) + b"\n"
+        py = PythonFrameScanner(KEY)
+        for chunk_size in (1, 4, 9, len(stream)):
+            for shard in (None, (0, 3), (2, 3)):
+                n = classify_stream(native_scanner, stream, chunk_size, shard=shard)
+                p = classify_stream(py, stream, chunk_size, shard=shard)
+                assert expand_skips(n) == expand_skips(p), (chunk_size, shard)
+
+    def test_crlf_chunked_extension_tails(self, native_scanner):
+        # CRLF-terminated frames with the chunk boundary landing exactly
+        # between \r and \n (the chunked-transfer tail shape), plus
+        # blank CRLF keep-alive lines between frames
+        body = CORPUS[0] + b"\r\n" + b"\r\n" + CORPUS[1] + b"\r\n" + CORPUS[2] + b"\r\n"
+        self._parity_all_splits(native_scanner, body)
+        # explicit boundary: split right after the \r of frame 0
+        cut = len(CORPUS[0]) + 1
+        py = PythonFrameScanner(KEY)
+        for scanner in (native_scanner, py):
+            r1, c1 = scanner.scan_chunk(body[:cut])
+            tail = body[:cut][c1:]
+            assert tail == CORPUS[0] + b"\r"  # \r waits for its \n
+            r2, c2 = scanner.scan_chunk(tail + body[cut:])
+            kinds, _ = expand_skips(
+                [(("skip", r[2], r[3]) if r[2] is not None else ("parse", b"x")) for r in r1 + r2]
+            )
+            assert kinds.count("skip") == 2  # frames 0 and 2 (non-TPU)
+
+    def test_adversarial_golden_parity(self, native_scanner):
+        """One adversarial corpus, both scanners, identical classification
+        at every split — the golden gate that pins NativeFrameScanner to
+        PythonFrameScanner semantics forever."""
+        adversarial = [
+            CORPUS[0],                       # plain skippable
+            CORPUS[1],                       # TPU: must parse
+            CORPUS[3],                       # key only in a label value
+            CORPUS[4],                       # BOOKMARK: full path
+            b'{"type":"MODIFIED","object":{"metadata":{"uid":"esc\\"aped","resourceVersion":"7"}}}',
+            b'{"type":"MODIFIED","object":{"metadata":{"resourceVersion":"8"}}}',  # no uid
+            b'{"type":"ADDED","object":{"metadata":{"uid":"u-42","resourceVersion":"9"}}}',
+            b'  \t{"type" :\t"DELETED", "object": {"metadata": {"uid": "u-43", "resourceVersion": "10"}}}',
+            b"garbage not json",
+            b"[]",
+            b"{}",
+            frame("MODIFIED", build_pod("zz-final", resource_version="999")),
+        ]
+        stream = b"\n".join(adversarial) + b"\n"
+        py = PythonFrameScanner(KEY)
+        for chunk_size in (1, 5, 17, 128, len(stream)):
+            for shard in (None, (1, 4)):
+                n = classify_stream(native_scanner, stream, chunk_size, shard=shard)
+                p = classify_stream(py, stream, chunk_size, shard=shard)
+                assert expand_skips(n) == expand_skips(p), (chunk_size, shard)
+
+
+class TestShardAwareChunkScan:
+    """The crc32 foreign-shard skip on the chunk path: C verdict ==
+    Python verdict == watch/sharded.shard_of, and doubt always parses."""
+
+    def make_stream(self, n=240, tpu_every=6):
+        frames_ = [
+            frame(
+                "MODIFIED",
+                build_pod(
+                    f"s{i}", uid=f"shard-uid-{i}",
+                    tpu_chips=8 if i % tpu_every == 0 else 0,
+                    resource_version=str(i + 1),
+                ),
+            )
+            for i in range(n)
+        ]
+        return b"\n".join(frames_) + b"\n"
+
+    @pytest.mark.parametrize("shard", [(0, 4), (3, 4), (1, 2)])
+    def test_foreign_shard_skipped_exactly(self, native_scanner, shard):
+        from k8s_watcher_tpu.watch.sharded import shard_of
+
+        stream = self.make_stream()
+        py = PythonFrameScanner(KEY)
+        for scanner in (native_scanner, py):
+            got = classify_stream(scanner, stream, 64 * 1024, shard=shard)
+            parsed = [r[1] for r in got if r[0] == "parse"]
+            # parsed set == exactly the OWNED TPU pods (foreign TPU pods
+            # skip too: the owning shard's stream delivers them)
+            expected = [
+                f"s{i}".encode()
+                for i in range(240)
+                if i % 6 == 0 and shard_of(f"shard-uid-{i}", shard[1]) == shard[0]
+            ]
+            names = [json.loads(p)["object"]["metadata"]["name"].encode() for p in parsed]
+            assert names == expected, scanner
+            skipped = sum(r[2] for r in got if r[0] == "skip")
+            assert skipped == 240 - len(expected)
+
+    def test_unextractable_uid_routes_to_full_parse(self, native_scanner):
+        # escaped uid on a frame the KEY skip cannot claim (it carries the
+        # accelerator key): no shard verdict — the frame must PARSE even
+        # when its (unknowable) owner is another shard; correctness stays
+        # with the watch source's post-parse filter
+        raw = (
+            b'{"type":"MODIFIED","object":{"metadata":{"uid":"e\\"x",'
+            b'"resourceVersion":"5"},"spec":{"containers":[{"resources":'
+            b'{"requests":{"google.com/tpu":"8"}}}]}}}\n'
+        )
+        for scanner in (native_scanner, PythonFrameScanner(KEY)):
+            records, consumed = scanner.scan_chunk(raw, shard=(1, 8))
+            assert consumed == len(raw)
+            assert [r[2] for r in records] == [None], scanner
+
+    def test_shard_disabled_matches_plain(self, native_scanner):
+        stream = self.make_stream(n=60)
+        plain = classify_stream(native_scanner, stream, 512)
+        nil = classify_stream(native_scanner, stream, 512, shard=None)
+        assert expand_skips(plain) == expand_skips(nil)
+
+
+class TestBuildDegradation:
+    """native/build.py failure posture: degrade to PythonFrameScanner,
+    one INFO log (WARNING when ingest.prefilter pins 'native'), NEVER a
+    raise at app start."""
+
+    @pytest.fixture
+    def broken_build(self, monkeypatch, tmp_path):
+        import subprocess as _subprocess
+
+        from k8s_watcher_tpu.native import build as build_mod
+
+        # cache miss (fresh dir) + compiler failure = the no-toolchain host
+        monkeypatch.setenv("K8S_WATCHER_TPU_NATIVE_CACHE", str(tmp_path / "cache"))
+
+        def failing_run(*a, **k):
+            raise _subprocess.SubprocessError("g++: not found")
+
+        monkeypatch.setattr(build_mod.subprocess, "run", failing_run)
+        return build_mod
+
+    def test_auto_degrades_with_one_info_log(self, broken_build, caplog):
+        import logging
+
+        with caplog.at_level(logging.INFO, logger="k8s_watcher_tpu.native.scanner"):
+            scanner = make_scanner(KEY, mode="auto")
+        assert isinstance(scanner, PythonFrameScanner)
+        downgrades = [
+            r for r in caplog.records
+            if "using Python scanner" in r.getMessage()
+            and r.name == "k8s_watcher_tpu.native.scanner"
+        ]
+        assert len(downgrades) == 1 and downgrades[0].levelno == logging.INFO
+
+    def test_pinned_native_warns_but_never_raises(self, broken_build, caplog):
+        import logging
+
+        with caplog.at_level(logging.INFO, logger="k8s_watcher_tpu.native.scanner"):
+            scanner = make_scanner(KEY, mode="native")
+        assert isinstance(scanner, PythonFrameScanner)
+        downgrades = [
+            r for r in caplog.records
+            if "using Python scanner" in r.getMessage()
+        ]
+        assert len(downgrades) == 1 and downgrades[0].levelno == logging.WARNING
+        assert "pinned" in downgrades[0].getMessage()
+
+    def test_broken_cache_dir_degrades(self, monkeypatch, tmp_path):
+        # _cache pointing at a FILE: mkdir fails with OSError — the
+        # "broken _cache" shape; still a clean Python fallback
+        blocker = tmp_path / "blocker"
+        blocker.write_text("not a directory")
+        monkeypatch.setenv("K8S_WATCHER_TPU_NATIVE_CACHE", str(blocker / "sub"))
+        assert isinstance(make_scanner(KEY, mode="auto"), PythonFrameScanner)
+
+    def test_failure_reason_recorded(self, broken_build):
+        assert broken_build.build_fastscan() is None
+        assert "g++" in (broken_build.last_build_error() or "")
+
+    def test_mode_off_and_python(self, monkeypatch):
+        from k8s_watcher_tpu.native import build as build_mod
+
+        def must_not_build(*a, **k):  # pragma: no cover - tripwire
+            raise AssertionError("python/off modes must never attempt a build")
+
+        monkeypatch.setattr(build_mod, "build_fastscan", must_not_build)
+        assert make_scanner(KEY, mode="off") is None
+        assert isinstance(make_scanner(KEY, mode="python"), PythonFrameScanner)
+
+
 class TestPrefilteredWatch:
     """End-to-end: client + watch source skip non-TPU frames unparsed while
     the resume version still advances."""
