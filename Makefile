@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: test check check-scale integration integration-kind integration-mock bench bench-smoke trace-smoke serve-smoke history-smoke federation-smoke obs-smoke health-smoke analytics-smoke relay-smoke ingest-smoke dryrun dryrun-128 accept
+.PHONY: test check check-scale integration integration-kind integration-mock bench bench-smoke trace-smoke serve-smoke history-smoke federation-smoke obs-smoke health-smoke analytics-smoke relay-smoke ingest-smoke fanin-smoke dryrun dryrun-128 accept
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -149,6 +149,22 @@ relay-smoke:
 # artifacts/ingest_smoke.json.
 ingest-smoke:
 	$(PY) scripts/ingest_smoke.py
+
+# Sharded fan-in smoke: two mock-backed upstream WatcherApps + one
+# federator with federation.processes: 2 — two REAL spawned merge-worker
+# processes, each owning a disjoint hash(cluster) upstream partition and
+# shipping prepared deltas to the parent sequencer over msgpack pipes.
+# One worker SIGKILLed mid-churn (supervisor respawns it, the respawn
+# resumes from per-upstream token files, the global consumer stays
+# gapless with zero resyncs), then one upstream darkened (healthz must
+# degrade on the WORKER's staleness verdict — the parent only mirrors —
+# and recover on restart). Terminal merged view == union of upstreams,
+# with fanin_passthrough_frames > 0 and zero pipe sequence gaps. The
+# merge THROUGHPUT + sharded-vs-single-process A/B byte-identity gate
+# runs in bench-smoke (bench_fanin_sharded). Artifact:
+# artifacts/fanin_smoke.json.
+fanin-smoke:
+	$(PY) scripts/fanin_smoke.py
 
 dryrun:
 	$(PY) __graft_entry__.py 8

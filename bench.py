@@ -930,7 +930,12 @@ def bench_trace_overhead(n_events: int = 20_000) -> dict:
         _hot_path_replay(replay_events, trace_sample=0)
         _hot_path_replay(replay_events, trace_sample=256)
         best = {0: float("inf"), 256: float("inf")}
-        min_rounds, max_rounds = 4, 12
+        # 24 max rounds (was 12): on a slow co-tenant-noisy single-core
+        # host the per-side quiet floor can take >12 interleaved rounds
+        # to surface (measured: the same build flapping 2.1%..4.5%
+        # between adjacent runs at 12). Extension remains sound per the
+        # argument above — a real >3% regression stays >3% at any count
+        min_rounds, max_rounds = 4, 24
         rounds_run = 0
         overhead_pct = float("inf")
         while rounds_run < max_rounds:
@@ -2797,6 +2802,315 @@ def bench_fanin_ramp(
             srv.stop()
 
 
+def _fanin_upstreams_main(args_json: str) -> int:
+    """Subprocess body hosting a herd of upstream serving planes for the
+    sharded fan-in bench: churn publishes NATIVELY inside this process,
+    so the bench parent's interpreter never pays for upstream publishing
+    while it times the merge (publishing 100k+ deltas/s from the parent
+    would contend its own sequencer off the GIL and the measurement
+    would be of the bench, not the federator). Protocol on stdio:
+    prints ``READY <port>...`` once listening; ``CHURN
+    <deltas_per_upstream>`` blasts unpaced churn across all hosted
+    views and prints ``DONE <published>`` (published = rv advance, so
+    no-op deletes never inflate the catch-up target); ``STOP`` or EOF
+    exits."""
+    import threading as _threading
+
+    from k8s_watcher_tpu.serve import FleetView, ServeServer, SubscriptionHub
+
+    args = json.loads(args_json)
+    n = int(args.get("n", 4))
+    n_keys = int(args.get("n_keys", 512))
+    stacks = []
+    for _ in range(n):
+        v = FleetView(compact_horizon=args.get("compact_horizon", 1 << 18))
+        hub = SubscriptionHub(v, max_subscribers=8, queue_depth=1 << 16)
+        srv = ServeServer(v, hub, host="127.0.0.1", port=0).start()
+        stacks.append((v, srv))
+    print("READY " + " ".join(str(srv.port) for _, srv in stacks), flush=True)
+    try:
+        for line in sys.stdin:
+            parts = line.split()
+            if not parts or parts[0] == "STOP":
+                break
+            if parts[0] != "CHURN":
+                continue
+            per_upstream = int(parts[1])
+            published = [0] * n
+
+            def blast(ui: int) -> None:
+                v, _ = stacks[ui]
+                base = int(v.rv)
+                for i in range(per_upstream):
+                    seq = base + i
+                    key = f"pod-{seq % n_keys}"
+                    if seq % 37 == 36:
+                        v.apply("pod", key, None)
+                    else:
+                        v.apply("pod", key, {
+                            "kind": "pod", "key": key, "seq": seq,
+                            "phase": ("Pending", "Running")[seq % 2],
+                        })
+                published[ui] = int(v.rv) - base
+
+            threads = [
+                _threading.Thread(target=blast, args=(ui,), daemon=True)
+                for ui in range(n)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            print(f"DONE {sum(published)}", flush=True)
+    finally:
+        for _, srv in stacks:
+            srv.stop()
+    return 0
+
+
+def bench_fanin_sharded(
+    n_children: int = 4,
+    upstreams_per_child: int = 4,
+    processes: int = 4,
+    deltas_per_upstream: int = 6500,
+    ab_deltas_per_upstream: int = 500,
+    kill_deltas_per_upstream: int = 500,
+) -> dict:
+    """Sharded fan-in: ``federation.processes`` merge-worker processes
+    consuming ``n_children x upstreams_per_child`` REAL upstream serving
+    planes (hosted in publisher subprocesses so upstream churn costs the
+    bench parent nothing), raw-frame passthrough on, in three legs:
+
+    1. throughput — an unpaced ~``16 x deltas_per_upstream`` churn storm;
+       the number is merged deltas/s from churn start to global-view
+       catch-up, with ONLY the sharded plane attached (attaching the
+       in-process reference here would have its 16 decode threads
+       contending the parent's GIL and corrupt the timing);
+    2. same-run A/B — the single-process reference plane attaches to the
+       SAME upstreams, both planes fold the same live churn, and the
+       terminal views must be byte-identical (sorted-objects JSON),
+       with zero sharded re-encodes (the encode-once invariant across
+       the process boundary: workers ship upstream bytes, the parent
+       splices rvs);
+    3. kill/respawn — SIGKILL one merge worker mid-churn; the respawn
+       resumes from its durable per-upstream tokens and the watermark
+       dedup makes the replay window exactly-once: both planes converge
+       byte-identical again with zero gaps/dups and zero wire gaps.
+    """
+    import os as _os
+    import signal as _signal
+    import subprocess as _subprocess
+    import tempfile as _tempfile
+
+    from k8s_watcher_tpu.config.schema import FederationConfig
+    from k8s_watcher_tpu.federate import FederationPlane, merged_equals_union
+    from k8s_watcher_tpu.federate.client import FleetClient
+    from k8s_watcher_tpu.metrics import MetricsRegistry
+    from k8s_watcher_tpu.serve import FleetView
+
+    bench_path = _os.path.abspath(__file__)
+    n_upstreams = n_children * upstreams_per_child
+    children: list = []
+    plane_a = plane_b = None
+    token_tmp = _tempfile.TemporaryDirectory(prefix="fanin-bench-tokens-")
+    try:
+        for _ in range(n_children):
+            children.append(_subprocess.Popen(
+                [sys.executable, bench_path, "--fanin-upstreams",
+                 json.dumps({"n": upstreams_per_child})],
+                stdin=_subprocess.PIPE, stdout=_subprocess.PIPE,
+                stderr=_subprocess.DEVNULL, text=True,
+                cwd=_os.path.dirname(bench_path),
+            ))
+        ports = []
+        for proc in children:
+            line = (proc.stdout.readline() or "").split()
+            if not line or line[0] != "READY":
+                raise RuntimeError(f"fan-in upstream child failed to start: {line}")
+            ports.extend(int(p) for p in line[1:])
+        urls = [f"http://127.0.0.1:{p}" for p in ports]
+
+        def fed_cfg(n_procs: int) -> FederationConfig:
+            return FederationConfig.from_raw({
+                "enabled": True,
+                "processes": n_procs,
+                "upstreams": [
+                    {"name": f"c{i}", "url": u} for i, u in enumerate(urls)
+                ],
+                "stale_after_seconds": 5,
+                "resync_backoff_seconds": 0.2,
+            })
+
+        def churn_all(per_upstream: int) -> int:
+            for proc in children:
+                proc.stdin.write(f"CHURN {per_upstream}\n")
+                proc.stdin.flush()
+            total = 0
+            for proc in children:
+                line = (proc.stdout.readline() or "").split()
+                if not line or line[0] != "DONE":
+                    raise RuntimeError(f"fan-in upstream child churn failed: {line}")
+                total += int(line[1])
+            return total
+
+        def wait(predicate, timeout: float) -> bool:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if predicate():
+                    return True
+                time.sleep(0.005)
+            return False
+
+        reg_b = MetricsRegistry()
+        gview_b = FleetView(compact_horizon=1 << 18, metrics=reg_b)
+        plane_b = FederationPlane(
+            fed_cfg(processes), gview_b, metrics=reg_b, token_dir=token_tmp.name
+        ).start()
+        sharded_connected = wait(
+            lambda: all(
+                plane_b.fanin.upstream_report().get(f"c{i}", {}).get("snapshots", 0) > 0
+                for i in range(n_upstreams)
+            ),
+            timeout=60.0,
+        )
+
+        # leg 1: throughput, sharded plane only. Two rates: end-to-end
+        # (churn start -> catch-up, publisher cost included) and DRAIN
+        # (backlog remaining when the publishers finish / time to fold
+        # it) — the drain is the merge tier's own rate, same stage-
+        # isolation the ingest/egress tiers use. On a multi-core host
+        # they converge (publishers run beside the workers); on a
+        # single-core container every process serializes and end-to-end
+        # reads the whole topology's bill.
+        g_before = gview_b.rv
+        t0 = time.monotonic()
+        published = churn_all(deltas_per_upstream)
+        t_publish_done = time.monotonic()
+        folded_during_churn = gview_b.rv - g_before
+        caught_up = wait(lambda: gview_b.rv - g_before >= published, timeout=120.0)
+        t_end = time.monotonic()
+        elapsed = t_end - t0
+        e2e_deltas_per_sec = round(published / elapsed, 1) if elapsed else 0.0
+        backlog = published - folded_during_churn
+        drain_elapsed = t_end - t_publish_done
+        deltas_per_sec = (
+            round(backlog / drain_elapsed, 1)
+            if backlog > 0 and drain_elapsed > 0.05
+            else e2e_deltas_per_sec  # kept up with the storm: e2e IS the rate
+        )
+
+        # leg 2: same-run A/B against the single-process reference
+        reg_a = MetricsRegistry()
+        gview_a = FleetView(compact_horizon=1 << 18, metrics=reg_a)
+        plane_a = FederationPlane(fed_cfg(0), gview_a, metrics=reg_a).start()
+        ref_connected = wait(
+            lambda: all(u.subscriber.snapshots > 0 for u in plane_a.upstreams),
+            timeout=60.0,
+        )
+
+        def views_identical() -> bool:
+            key = lambda o: (o["kind"], o["key"])  # noqa: E731
+            a = json.dumps(sorted(gview_a.snapshot()[1], key=key))
+            b = json.dumps(sorted(gview_b.snapshot()[1], key=key))
+            return a == b
+
+        ga, gb = gview_a.rv, gview_b.rv
+        ab_published = churn_all(ab_deltas_per_upstream)
+        wait(lambda: gview_b.rv - gb >= ab_published, timeout=60.0)
+        wait(lambda: gview_a.rv - ga >= ab_published, timeout=60.0)
+        ab_identical = wait(views_identical, timeout=30.0)
+        # encode-once across the process boundary: every sharded frame so
+        # far arrived as rewritten upstream bytes (rv spliced, never
+        # re-encoded) — resets after the kill leg legitimately encode
+        encodes_before_kill = reg_b.counter("serve_frame_encodes").value
+        wait(lambda: plane_b.fanin.worker_stats()["passthrough"] > 0, timeout=15.0)
+
+        # leg 3: SIGKILL one merge worker mid-churn
+        victim = next((p for p in plane_b.fanin.worker_pids() if p), None)
+        ga, gb = gview_a.rv, gview_b.rv
+        for proc in children:
+            proc.stdin.write(f"CHURN {kill_deltas_per_upstream}\n")
+            proc.stdin.flush()
+        if victim is not None:
+            _os.kill(victim, _signal.SIGKILL)
+        kill_published = 0
+        for proc in children:
+            line = (proc.stdout.readline() or "").split()
+            kill_published += int(line[1]) if len(line) == 2 else 0
+        kill_caught_up = wait(
+            lambda: gview_b.rv - gb >= kill_published, timeout=120.0
+        )
+        wait(lambda: gview_a.rv - ga >= kill_published, timeout=60.0)
+        kill_identical = wait(views_identical, timeout=30.0)
+
+        # terminal union gate over the real wire (snapshots fetched from
+        # the child-hosted upstreams over HTTP)
+        upstream_objects = {}
+        for i, url in enumerate(urls):
+            upstream_objects[f"c{i}"] = FleetClient(url, timeout=10.0).snapshot().objects
+        merged_matches = merged_equals_union(gview_b.snapshot()[1], upstream_objects)
+
+        stats = plane_b.fanin.worker_stats()
+        report = plane_b.fanin.upstream_report()
+        gaps = sum(b.get("gaps", 0) for b in report.values())
+        dups = sum(b.get("dups", 0) for b in report.values())
+        kill_ok = (
+            victim is not None and kill_caught_up and kill_identical
+            and stats["respawns"] >= 1
+        )
+        return {
+            "upstreams": n_upstreams,
+            "processes": processes,
+            # the sharded win is decode parallelism ACROSS cores; on a
+            # 1-core host every worker serializes and the rate reads the
+            # interpreter, not the architecture — travel the context
+            "cores": len(_os.sched_getaffinity(0)) if hasattr(_os, "sched_getaffinity") else _os.cpu_count(),
+            "connected": sharded_connected and ref_connected,
+            "published": published,
+            "seconds": round(elapsed, 3),
+            "deltas_per_sec": deltas_per_sec,
+            "e2e_deltas_per_sec": e2e_deltas_per_sec,
+            "caught_up": caught_up,
+            "ab_identical": ab_identical,
+            "encodes_before_kill": encodes_before_kill,
+            "passthrough": plane_b.fanin.worker_stats()["passthrough"],
+            "wire_gaps": stats["wire_gaps"],
+            "gaps": gaps,
+            "dups": dups,
+            "respawns": stats["respawns"],
+            "kill": {
+                "published": kill_published,
+                "caught_up": kill_caught_up,
+                "identical": kill_identical,
+            },
+            "staleness_owner": plane_b.staleness_owner,
+            "ok": (
+                sharded_connected and ref_connected and caught_up
+                and ab_identical and kill_ok and merged_matches
+                and encodes_before_kill == 0 and gaps == 0 and dups == 0
+                and stats["wire_gaps"] == 0 and deltas_per_sec > 0
+            ),
+            "merged_matches": merged_matches,
+        }
+    finally:
+        if plane_a is not None:
+            plane_a.stop()
+        if plane_b is not None:
+            plane_b.stop()
+        for proc in children:
+            try:
+                proc.stdin.write("STOP\n")
+                proc.stdin.flush()
+            except (BrokenPipeError, OSError, ValueError):
+                pass
+        for proc in children:
+            try:
+                proc.wait(timeout=10)
+            except _subprocess.TimeoutExpired:
+                proc.kill()
+        token_tmp.cleanup()
+
+
 def bench_codec_ab(n_objects: int = 200, n_frames: int = 2000) -> dict:
     """Codec A/B: (1) cross-codec equivalence over the REAL wire — the
     same snapshot / long-poll / watch-stream content decoded from a
@@ -4237,13 +4551,23 @@ def main(smoke: bool = False) -> int:
         # federation fan-in: 3 upstream serving planes over real HTTP into
         # one merged global view — the pod-event->global-view p50 gate +
         # merged-state/zero-gap correctness, a few seconds per attempt.
-        # The fan-in A/B, churn-doubling ramp and codec legs run at
-        # reduced scale (fewer A/B deltas, one fewer ramp step — the 16k
-        # ceiling is kept so the headline sustained number is comparable)
+        # The churn-doubling ramp and codec legs run at reduced scale
+        # (one fewer ramp step — the 16k ceiling is kept so the headline
+        # sustained number is comparable). The A/B deltas stay at the
+        # full tier's 30k: the trace-overhead gate's min-of-rounds needs
+        # folds long enough to converge on a noisy host — at 20k the
+        # per-fold time is short enough that scheduler noise routinely
+        # eats the 3% budget and the gate flaps
         federation = bench_federation(
-            seconds=2.0, fanin_ab_deltas=20_000,
-            ramp_start_eps=2000.0, codec_frames=1000,
+            seconds=2.0, ramp_start_eps=2000.0, codec_frames=1000,
         )
+        # sharded fan-in at SMOKE scale: the full 4 merge workers x 16
+        # upstreams topology (the partition/kill/passthrough machinery
+        # doesn't shrink meaningfully below that) with a smaller churn
+        # storm — the A/B identity, encode-once and kill/respawn gates
+        # all run end to end; the 100k+ deltas/s claim is the full
+        # tier's
+        fanin_sharded = bench_fanin_sharded(deltas_per_upstream=1500)
         # relay tree at SMOKE scale: 2 relay processes x 400 leaves each
         # (plus checked leaves) — the whole machinery end to end (byte-
         # identity across every leaf, zero relay re-encodes, flat root,
@@ -4289,6 +4613,10 @@ def main(smoke: bool = False) -> int:
         # identical streams + zero relay re-encodes + flat root CPU
         relay_tree = bench_relay_tree()
         federation = bench_federation(seconds=4.0)
+        # the PR-16 scale gate: >=16 upstreams through 4 merge-worker
+        # processes, ~104k-delta churn storm, target >=100k merged
+        # deltas/s with byte-identical A/B and a survived worker kill
+        fanin_sharded = bench_fanin_sharded()
         health_stats = bench_health(ticks=80)
         analytics_stats = bench_analytics(n_scenarios=12)
         ingest_procs = bench_ingest_procs(tiles=160)
@@ -4316,6 +4644,7 @@ def main(smoke: bool = False) -> int:
         "serve_fanout": serve_fanout,
         "relay_tree": relay_tree,
         "federation": federation,
+        "fanin_sharded": fanin_sharded,
         "health": health_stats,
         "analytics": analytics_stats,
         "ingest_procs": ingest_procs,
@@ -4413,6 +4742,12 @@ def main(smoke: bool = False) -> int:
         "federation_fanin_deltas_per_sec": (federation.get("fanin_ramp") or {}).get(
             "max_sustained_deltas_per_sec"
         ),
+        # sharded fan-in: 16 upstreams -> 4 merge-worker processes; ok =
+        # byte-identical same-run A/B vs the single-process fold + zero
+        # sharded re-encodes + zero gaps/dups/wire-gaps through a
+        # SIGKILLed worker's token-resume respawn
+        "fanin_sharded_ok": fanin_sharded.get("ok", False),
+        "fanin_deltas_per_sec": fanin_sharded.get("deltas_per_sec"),
         # codec negotiation: msgpack == JSON decoded on every read shape
         # over the real wire, msgpack actually negotiated when available
         "serve_codec_ok": (federation.get("codec_ab") or {}).get("ok", False),
@@ -4468,9 +4803,12 @@ def main(smoke: bool = False) -> int:
         # tail budget, and the ingest_procs gate pushed it again: drop
         # informational numbers the detail artifact (and the full tier)
         # still carry — none of them gated on the headline
+        # ... and the two fanin_sharded fields pushed it again:
+        # vs_baseline is derivable from value (target_ms / value) and
+        # rides the detail artifact + the full tier
         for key in (
             "relist_shard_speedup", "checkpoint_10k_mb",
-            "checkpoint_10k_flush_ms",
+            "checkpoint_10k_flush_ms", "vs_baseline",
         ):
             headline.pop(key, None)
         # the probe tiers are skipped wholesale in smoke; their
@@ -4503,6 +4841,8 @@ if __name__ == "__main__":
         sys.exit(_relay_child_main(sys.argv[2]))
     if len(sys.argv) > 1 and sys.argv[1] == "--relay-leaves":
         sys.exit(_relay_leaves_main(sys.argv[2]))
+    if len(sys.argv) > 1 and sys.argv[1] == "--fanin-upstreams":
+        sys.exit(_fanin_upstreams_main(sys.argv[2]))
     if len(sys.argv) > 1 and sys.argv[1] == "--virtual-probes":
         n = int(sys.argv[2]) if len(sys.argv) > 2 else 8
         sys.exit(_virtual_probes_child(n))

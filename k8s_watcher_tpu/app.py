@@ -351,6 +351,18 @@ class WatcherApp:
                 resume_tokens_valid=tokens_valid,
                 trace_collector=self.trace_collector,
             )
+            if config.federation.processes > 0:
+                # sharded fan-in (federation.processes): merge workers in
+                # supervised OS processes own the upstream subscribers and
+                # the staleness verdicts; the plane above is the sequencer.
+                # The token_dir/tokens_valid plumbing is IDENTICAL — the
+                # workers read and clear the same per-upstream token files.
+                logger.info(
+                    "Federation fan-in sharded across %d merge worker process(es) "
+                    "(%d upstream(s); staleness owner: merge workers)",
+                    config.federation.processes,
+                    len(config.federation.upstreams),
+                )
         # fleet analytics & what-if plane (analytics/): the FleetView's
         # columnar twin + jitted kernels + /serve/analytics. Built after
         # federation so the encoder covers the merged global fleet from

@@ -1409,6 +1409,15 @@ class FederationConfig:
     # wire captures). The codec changes wire bytes only — decoded
     # frames are identical.
     codec: str = "auto"
+    # merge-worker OS processes for the fan-in (0 = today's in-process
+    # path, byte for byte). > 0 shards upstreams across supervised
+    # worker processes by hash(cluster): each worker consumes its
+    # upstreams' frames and ships prepared view batches to the parent
+    # sequencer over the length-prefixed msgpack pipe — the ingest
+    # tier's processes knob, applied to the fan-in (ARCHITECTURE.md
+    # "Sharded fan-in"). More processes than upstreams waste nothing
+    # (surplus workers own zero clusters and exit idle-cheap).
+    processes: int = 0
 
     @classmethod
     def from_raw(cls, raw: Mapping[str, Any]) -> "FederationConfig":
@@ -1416,7 +1425,7 @@ class FederationConfig:
         _check_known(
             raw,
             ("enabled", "upstreams", "stale_after_seconds",
-             "resync_backoff_seconds", "drop_stale", "codec"),
+             "resync_backoff_seconds", "drop_stale", "codec", "processes"),
             path,
         )
         enabled = _opt_bool(raw, "enabled", path, False)
@@ -1490,6 +1499,11 @@ class FederationConfig:
                 f"config key '{path}.codec': must be one of "
                 f"{', '.join(VALID_SERVE_CODECS)}, got {codec!r}"
             )
+        processes = _opt_int(raw, "processes", path, 0)
+        if processes < 0 or processes > 64:
+            raise SchemaError(
+                f"config key '{path}.processes': must be in [0, 64], got {processes}"
+            )
         return cls(
             enabled=enabled,
             upstreams=tuple(upstreams),
@@ -1497,6 +1511,7 @@ class FederationConfig:
             resync_backoff_seconds=backoff,
             drop_stale=_opt_bool(raw, "drop_stale", path, False),
             codec=codec,
+            processes=processes,
         )
 
 
@@ -1741,6 +1756,13 @@ class AppConfig:
                     "config key 'trace.federation.enabled': requires "
                     "federation.enabled (trace joining happens on the federation "
                     "fan-in path; without upstreams there is nothing to join)"
+                )
+            if federation.processes > 0:
+                raise SchemaError(
+                    "config key 'trace.federation.enabled': requires "
+                    "federation.processes: 0 (the joined-trace collector rides "
+                    "the in-process fan-in thread; sharded merge workers "
+                    "negotiate trace off and would silently join nothing)"
                 )
         analytics = AnalyticsConfig.from_raw(raw.get("analytics") or {})
         if analytics.enabled and not serve.enabled:

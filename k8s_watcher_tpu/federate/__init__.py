@@ -25,12 +25,16 @@ from k8s_watcher_tpu.federate.merge import (
     merged_equals_union,
     split_global_key,
 )
+from k8s_watcher_tpu.federate.fanin import FaninPlan, ShardedFanin, fanin_plans
 from k8s_watcher_tpu.federate.plane import FederationPlane
 
 __all__ = [
     "AuthRejected",
     "Batch",
+    "FaninPlan",
     "FederationPlane",
+    "ShardedFanin",
+    "fanin_plans",
     "FleetClient",
     "FleetSubscriber",
     "GlobalMerge",

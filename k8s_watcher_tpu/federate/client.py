@@ -888,6 +888,26 @@ class FleetSubscriber:
             if token is not None:
                 self.rv, self.view = token
                 self._saved_token = token
+        try:
+            self._run_loop()
+        finally:
+            # persist the EXACT live position on the way out: the periodic
+            # save cadence (SYNC / every 256 deltas / window end) can leave
+            # the durable token up to a window behind, which a stopped-and-
+            # respawned consumer (a drained merge worker) would replay —
+            # harmless but not free. Never on an invalidated line (that
+            # must re-snapshot) and never let a disk error mask the exit.
+            if (
+                self.rv is not None
+                and self.view is not None
+                and not self._invalidate.is_set()
+            ):
+                try:
+                    self._save_token(self.rv, self.view)
+                except OSError:
+                    pass
+
+    def _run_loop(self) -> None:
         backoff = self.backoff_seconds
         while not self._stop.is_set():
             try:

@@ -251,6 +251,32 @@ class GlobalMerge:
             self._set_gauge_locked()
         return changed
 
+    def apply_view_batch(self, cluster: str, view_items) -> int:
+        """Fold a batch of ALREADY-PREPARED view items from ``cluster`` —
+        the sharded fan-in's parent-side sequencer path. A merge worker
+        did the per-frame work (decode, re-key, decorate, stamp/trace
+        extraction, optional raw-frame passthrough) in its own process;
+        items arrive as ``(kind, global_key, obj_or_None, ts_wall, trace,
+        frame_bytes_or_None)`` and go straight into ONE view publish-lock
+        hold. The parent keeps the ONLY key registry (it must survive
+        worker respawns, or reconciles could never delete ghosts), so the
+        registry fold happens here, from the global keys. Returns the
+        number of global-view deltas minted."""
+        changed = self.view.apply_batch(view_items)
+        with self._lock:
+            keys = self._keys.setdefault(cluster, set())
+            before = len(keys)
+            for item in view_items:
+                kind, gkey, obj = item[0], item[1], item[2]
+                entry = (kind, split_global_key(gkey)[1])
+                if obj is None:
+                    keys.discard(entry)
+                else:
+                    keys.add(entry)
+            self._count += len(keys) - before
+            self._set_gauge_locked()
+        return changed
+
     def drop_cluster(self, cluster: str) -> int:
         """The ``drop_stale: true`` policy arm: remove a dark upstream's
         objects from the global view (one batched publish). Returns

@@ -18,6 +18,17 @@ bookkeeping no single subscriber can: per-upstream staleness verdicts
 counts into the metrics registry. ``health()`` folds per-upstream
 liveness into the status plane's /healthz — a federator serving a
 half-dark global view must say so.
+
+``federation.processes > 0`` swaps the in-process subscriber fleet for
+the SHARDED fan-in (federate/fanin.py): supervised merge-worker
+processes own the subscribers and ship prepared deltas over pipes, and
+this plane becomes the thin parent — sequencer fold into the view plus
+MIRRORING worker-reported state into the same gauges/health/freshness
+surfaces. Staleness ownership is explicit (``staleness_owner``): the
+monitor tick computes per-upstream staleness verdicts ONLY in the
+in-process mode; in sharded mode the workers own the verdict (they
+hold the live subscriber clocks) and the tick only mirrors it — so a
+sharded deploy never double-reports ``federation_upstream_stale``.
 """
 
 from __future__ import annotations
@@ -307,6 +318,97 @@ class _Upstream:
         return body
 
 
+class _UpstreamMirror:
+    """Sharded mode's parent-side stand-in for ``_Upstream``: no
+    subscriber lives here (a merge worker owns it, clocks and all); the
+    monitor tick folds the worker-REPORTED status into the same labeled
+    gauges, health fields and the stale-transition counter. The
+    staleness verdict is MIRRORED, never recomputed — the plane's
+    ``staleness_owner`` is ``"merge-workers"`` and exactly one
+    component may ever flip ``federation_upstream_stale`` per upstream.
+    (No legacy suffix-mangled gauge names here: sharded mode postdates
+    the label migration, so there is no dashboard continuity to keep.)
+    """
+
+    def __init__(self, plane: "FederationPlane", cfg):
+        self.cfg = cfg
+        self.name = cfg.name
+        self.stale = False  # last mirrored verdict (transition edge detect)
+        self.last: Dict[str, Any] = {}
+        metrics = plane.metrics
+        if metrics is not None:
+            label = {"upstream": self.name}
+            self.lag_rv_gauge = metrics.gauge("federation_upstream_lag_rv").labels(**label)
+            self.lag_seconds_gauge = metrics.gauge("federation_upstream_lag_seconds").labels(**label)
+            self.stale_gauge = metrics.gauge("federation_upstream_stale").labels(**label)
+            self.watermark_age_gauge = metrics.gauge(
+                "federation_upstream_watermark_age_seconds"
+            ).labels(**label)
+            self.last_delta_age_gauge = metrics.gauge(
+                "federation_upstream_last_delta_age_seconds"
+            ).labels(**label)
+            self.oldest_unpropagated_gauge = metrics.gauge(
+                "federation_upstream_oldest_unpropagated_seconds"
+            ).labels(**label)
+        else:
+            self.lag_rv_gauge = None
+            self.lag_seconds_gauge = None
+            self.stale_gauge = None
+            self.watermark_age_gauge = None
+            self.last_delta_age_gauge = None
+            self.oldest_unpropagated_gauge = None
+
+    def fold(self, body: Dict[str, Any], plane: "FederationPlane") -> None:
+        self.last = body
+        stale = bool(body.get("stale"))
+        if stale and not self.stale and plane.stale_transitions_counter is not None:
+            plane.stale_transitions_counter.inc()
+        self.stale = stale
+        if self.lag_rv_gauge is not None:
+            self.lag_rv_gauge.set(body.get("lag_rv") or 0)
+            age = body.get("last_frame_age_seconds")
+            if age is not None:
+                self.lag_seconds_gauge.set(age)
+            self.stale_gauge.set(1.0 if stale else 0.0)
+            watermark = body.get("watermark_age_seconds")
+            if watermark is not None:
+                self.watermark_age_gauge.set(watermark)
+            delta_age = body.get("last_delta_age_seconds")
+            if delta_age is not None:
+                self.last_delta_age_gauge.set(delta_age)
+            self.oldest_unpropagated_gauge.set(
+                body.get("oldest_unpropagated_seconds") or 0.0
+            )
+
+    def freshness(self) -> Dict[str, Any]:
+        """The ``_Upstream.freshness()`` block, from the last worker
+        report (readings age by at most one stats interval)."""
+        body = self.last
+        return {
+            "connected": bool(body.get("connected")),
+            "stale": self.stale,
+            "rv": body.get("rv"),
+            "wire_rv": body.get("wire_rv", 0),
+            "lag_rv": body.get("lag_rv", 0),
+            "last_frame_age_seconds": body.get("last_frame_age_seconds"),
+            "last_delta_age_seconds": body.get("last_delta_age_seconds"),
+            "watermark_age_seconds": body.get("watermark_age_seconds"),
+            "oldest_unpropagated_seconds": body.get("oldest_unpropagated_seconds", 0.0),
+        }
+
+    def status(self, plane: "FederationPlane") -> Dict[str, Any]:
+        body = dict(self.last) if self.last else {"name": self.name, "connected": False}
+        body.update(
+            {
+                "url": self.cfg.url,
+                "stale": self.stale,
+                "objects": plane.merge.cluster_object_count(self.name),
+                "mirrored": True,  # worker-reported, not locally measured
+            }
+        )
+        return body
+
+
 class FederationPlane:
     """Runs the upstream subscriber fleet against the app's FleetView.
 
@@ -392,9 +494,42 @@ class FederationPlane:
                 "federation_upstream_oldest_unpropagated_seconds",
             ):
                 metrics.gauge(family_name).max_label_sets = cap
-        self.upstreams: List[_Upstream] = [
-            _Upstream(self, u, i) for i, u in enumerate(config.upstreams)
-        ]
+        # sharded fan-in (federation.processes > 0): merge workers own
+        # the subscribers AND the staleness verdicts; this plane is the
+        # sequencer + mirror. Exactly one staleness owner, ever — the
+        # field makes the split greppable and testable instead of a
+        # tick-time accident (a sharded deploy must never double-report
+        # federation_upstream_stale from two clocks).
+        self.processes = int(getattr(config, "processes", 0) or 0)
+        self.staleness_owner = "merge-workers" if self.processes > 0 else "monitor"
+        self.fanin = None
+        self.mirrors: List[_UpstreamMirror] = []
+        if self.processes > 0:
+            from k8s_watcher_tpu.federate.fanin import ShardedFanin
+
+            if trace_collector is not None:
+                # schema forbids the pairing (trace.federation requires
+                # processes: 0); guard direct constructions too — merge
+                # workers negotiate trace off, so the collector would
+                # silently join nothing
+                logger.warning(
+                    "Joined-trace collection is not available with the sharded "
+                    "fan-in (federation.processes > 0); ignoring the collector"
+                )
+                self.trace_collector = None
+            self.fanin = ShardedFanin(
+                config,
+                self.merge,
+                metrics=metrics,
+                token_dir=token_dir,
+                resume_tokens_valid=resume_tokens_valid,
+            )
+            self.upstreams: List[_Upstream] = []
+            self.mirrors = [_UpstreamMirror(self, u) for u in config.upstreams]
+        else:
+            self.upstreams = [
+                _Upstream(self, u, i) for i, u in enumerate(config.upstreams)
+            ]
         # staleness floor mirrors FleetSubscriber's: the wire heartbeats
         # every 2 s when idle, so a sub-3s threshold would call every
         # healthy idle upstream dead between SYNCs
@@ -415,6 +550,23 @@ class FederationPlane:
         self._stop.clear()
         self._started = True
         self._started_t = time.monotonic()
+        if self.fanin is not None:
+            # token clearing on an invalid resume line happens inside
+            # the fan-in (same files, same warning shape)
+            self.fanin.start()
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, name="federate-monitor", daemon=True
+            )
+            self._monitor.start()
+            logger.info(
+                "Federation plane started (sharded fan-in): %d merge worker(s) "
+                "over %d upstream(s) (stale_after=%.1fs, drop_stale=%s, "
+                "staleness_owner=%s)",
+                len(self.fanin.endpoints), len(self.config.upstreams),
+                self.config.stale_after_seconds, self.config.drop_stale,
+                self.staleness_owner,
+            )
+            return self
         if not self.resume_tokens_valid:
             for upstream in self.upstreams:
                 store = upstream.subscriber.token_store
@@ -443,6 +595,13 @@ class FederationPlane:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.fanin is not None:
+            self.fanin.stop()
+            if self._monitor is not None:
+                self._monitor.join(timeout=2.0)
+                self._monitor = None
+            self._started = False
+            return
         for upstream in self.upstreams:
             upstream.subscriber.stop()
         for upstream in self.upstreams:
@@ -472,6 +631,9 @@ class FederationPlane:
             self._tick()
 
     def _tick(self) -> None:
+        if self.fanin is not None:
+            self._tick_sharded()
+            return
         now = time.monotonic()
         grace_over = now - self._started_t > self.stale_threshold
         connected = 0
@@ -520,6 +682,23 @@ class FederationPlane:
         if self.connected_gauge is not None:
             self.connected_gauge.set(connected)
 
+    def _tick_sharded(self) -> None:
+        """Mirror-only tick (``staleness_owner == "merge-workers"``):
+        fold worker-reported per-upstream status into the gauges and
+        health state. The staleness verdicts — and the drop-stale arm —
+        are computed in the workers, never recomputed here; an upstream
+        whose worker is mid-respawn simply keeps its last report."""
+        report = self.fanin.upstream_report()
+        connected = 0
+        for mirror in self.mirrors:
+            body = report.get(mirror.name)
+            if body:
+                mirror.fold(body, self)
+            if mirror.last.get("connected"):
+                connected += 1
+        if self.connected_gauge is not None:
+            self.connected_gauge.set(connected)
+
     # -- freshness ---------------------------------------------------------
 
     def freshness(self) -> Dict[str, Any]:
@@ -534,7 +713,11 @@ class FederationPlane:
         clocks (skew shifts readings; the monotonic-local/wall-remote
         split is documented in ARCHITECTURE.md)."""
         out: Dict[str, Any] = {
-            "upstreams": {u.name: u.freshness() for u in self.upstreams},
+            "upstreams": (
+                {m.name: m.freshness() for m in self.mirrors}
+                if self.fanin is not None
+                else {u.name: u.freshness() for u in self.upstreams}
+            ),
         }
         if self.watch_to_global is not None:
             out["watch_to_global_view_seconds"] = self.watch_to_global.summary()
@@ -551,6 +734,22 @@ class FederationPlane:
         restarting the federator cannot revive a dark remote cluster, and
         a liveness kill would wipe the last-known state the keep policy
         serves. Readiness probes and alerts key off ``healthy`` here."""
+        if self.fanin is not None:
+            upstreams = {m.name: m.status(self) for m in self.mirrors}
+            healthy = not self._started or (
+                self.fanin.workers_alive()
+                and not any(m.stale for m in self.mirrors)
+            )
+            return {
+                "healthy": healthy,
+                "started": self._started,
+                "upstreams": upstreams,
+                "merged_objects": self.merge.object_count(),
+                "drop_stale": self.config.drop_stale,
+                "stale_after_seconds": self.stale_threshold,
+                "staleness_owner": self.staleness_owner,
+                "workers": self.fanin.worker_stats(),
+            }
         upstreams = {u.name: u.status() for u in self.upstreams}
         healthy = not self._started or all(
             not u.stale and u.thread.is_alive() for u in self.upstreams
@@ -562,4 +761,5 @@ class FederationPlane:
             "merged_objects": self.merge.object_count(),
             "drop_stale": self.config.drop_stale,
             "stale_after_seconds": self.stale_threshold,
+            "staleness_owner": self.staleness_owner,
         }

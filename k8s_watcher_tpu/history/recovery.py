@@ -41,8 +41,10 @@ from k8s_watcher_tpu.history.wal import (
     OP_DELETE,
     SNAP,
     decode_record,
+    item_object,
     list_segments,
     read_frames,
+    record_items,
 )
 
 logger = logging.getLogger(__name__)
@@ -96,10 +98,11 @@ def _fold_records(
             rv = snap_rv
             instance = record.get("instance") or instance
         elif rtype == DELTAS:
-            for item in record.get("items", ()):
+            for item in record_items(record):
                 try:
                     delta_rv, kind, key, op, obj = item
                     delta_rv = int(delta_rv)
+                    obj = item_object(obj)
                 except (TypeError, ValueError):
                     continue
                 if delta_rv <= rv and rv:
@@ -152,7 +155,7 @@ def _first_rv(records, fallback: int) -> int:
         if record.get("t") == SNAP:
             return int(record.get("rv", fallback))
         if record.get("t") == DELTAS:
-            items = record.get("items") or ()
+            items = record_items(record) or ()
             if items:
                 try:
                     return int(items[0][0])
@@ -341,10 +344,11 @@ def reconstruct_at(directory: Path | str, at_rv: int):
                 rv = snap_rv
                 reached = rv <= at_rv
             elif rtype == DELTAS:
-                for item in record.get("items", ()):
+                for item in record_items(record):
                     try:
                         delta_rv, kind, key, op, obj = item
                         delta_rv = int(delta_rv)
+                        obj = item_object(obj)
                     except (TypeError, ValueError):
                         continue
                     if delta_rv <= rv and rv:

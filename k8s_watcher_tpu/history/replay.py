@@ -32,7 +32,15 @@ import json
 from pathlib import Path
 from typing import Any, Dict, NamedTuple, Optional, Tuple
 
-from k8s_watcher_tpu.history.wal import DELTAS, OP_DELETE, SNAP, list_segments, read_frames
+from k8s_watcher_tpu.history.wal import (
+    DELTAS,
+    OP_DELETE,
+    SNAP,
+    item_object,
+    list_segments,
+    read_frames,
+    record_items,
+)
 
 
 class ReplayResult(NamedTuple):
@@ -109,10 +117,11 @@ def replay_wal(directory: Path | str, *, at: Optional[int] = None) -> ReplayResu
                 rv = snap_rv
             elif rtype == DELTAS:
                 past_at = False
-                for item in record.get("items", ()):
+                for item in record_items(record):
                     try:
                         delta_rv, kind, key, op, obj = item
                         delta_rv = int(delta_rv)
+                        obj = item_object(obj)
                     except (TypeError, ValueError):
                         continue
                     if delta_rv <= rv and rv:

@@ -80,15 +80,22 @@ SNAP = "snap"  # full shadow-state snapshot (opens every segment)
 #: a BATCH of FleetView deltas: one framed record per writer drain, so
 #: the per-delta cost is one list element inside one json.dumps — not a
 #: dict build + dumps + crc + frame each (the <5% bench_wal_overhead
-#: budget is won here). items: [[rv, kind, key, op, obj-or-null], ...],
-#: rv-ascending and contiguous within a record.
+#: budget is won here). items: [[rv, kind, key, op, obj-or-null], ...]
+#: — or, on the msgpack codec when the publisher handed over the delta's
+#: already-encoded serve frame, the obj column holds the frame's JSON
+#: payload BYTES instead of the dict (packed as bin = one memcpy, no
+#: per-field re-pack; ``item_object`` decodes on read). rv-ascending and
+#: contiguous within a record.
 DELTAS = "d"
 #: delta ops inside a DELTAS record
 OP_UPSERT = "U"
 OP_DELETE = "D"
 #: bound on deltas per record: keeps one frame's blast radius (a torn
-#: tail loses at most one frame) and memory bounded under huge drains
-MAX_DELTAS_PER_RECORD = 4096
+#: tail loses at most one frame) and memory bounded under huge drains.
+#: 16384 (vs the original 4096) quarters the per-record overhead (wall
+#: stamp, CRC frame, dict envelope) under sustained drains — a record is
+#: still at most a few MB of pod skeletons, far under MAX_RECORD_BYTES
+MAX_DELTAS_PER_RECORD = 16384
 
 FSYNC_POLICIES = ("never", "interval", "always")
 
@@ -199,17 +206,84 @@ def snapshot_record(
     return record
 
 
-def deltas_record(deltas) -> Dict[str, Any]:
+def deltas_record(deltas, frames=None) -> Dict[str, Any]:
     """A batch of serve.view.Delta -> ONE WAL record (see ``DELTAS``).
-    One wall stamp per record (forensics), not per delta."""
+    One wall stamp per record (forensics), not per delta.
+
+    ``frames`` (parallel to ``deltas``, entries may be None) carries each
+    delta's already-encoded chunk-framed JSON serve frame. On the msgpack
+    codec, when EVERY delta in the batch has its frame (the eager-encode
+    publish paths always do), the record is the frames CONCATENATED as
+    one bin blob (``"f"``) — a join plus one memcpy into the record, no
+    per-delta re-serialization at all; the chunk framing keeps the blob
+    self-delimiting and each payload line carries rv/type/kind/key/object
+    in full (``record_items`` decodes). A batch with holes falls back to
+    the per-item ``"items"`` column shape, reusing frame payload bytes as
+    the obj column where present (``item_object`` decodes). The JSON
+    fallback codec cannot embed bytes, so it keeps packing dicts
+    (correctness first — the <5% budget is a msgpack deployment's)."""
+    wall = round(time.time(), 3)
+    if (
+        frames is not None
+        and _msgpack is not None
+        and len(frames) == len(deltas)
+        and None not in frames
+    ):
+        return {"t": DELTAS, "wall": wall, "f": b"".join(frames)}
+    items = []
+    reuse = frames is not None and _msgpack is not None
+    for i, d in enumerate(deltas):
+        if d.object is None:
+            items.append([d.rv, d.kind, d.key, OP_DELETE, None])
+            continue
+        obj: Any = d.object
+        if reuse:
+            fr = frames[i]
+            if fr is not None:
+                head_end = fr.index(b"\r\n")
+                obj = bytes(fr[head_end + 2:-2])  # strip chunk framing
+        items.append([d.rv, d.kind, d.key, OP_UPSERT, obj])
     return {
         "t": DELTAS,
-        "wall": round(time.time(), 3),
-        "items": [
-            [d.rv, d.kind, d.key, OP_DELETE if d.object is None else OP_UPSERT, d.object]
-            for d in deltas
-        ],
+        "wall": wall,
+        "items": items,
     }
+
+
+def item_object(obj):
+    """The obj column of one DELTAS item -> the object dict (or None).
+    Frame-payload BYTES columns (see ``deltas_record``) decode through
+    the wire line's ``object`` field; dict columns pass through."""
+    if isinstance(obj, (bytes, bytearray)):
+        return json.loads(obj).get("object")
+    return obj
+
+
+def record_items(record: Dict[str, Any]):
+    """One DELTAS record -> its ``[rv, kind, key, op, obj-or-bytes]``
+    items, whichever shape the writer chose (``"items"`` column lists,
+    or the ``"f"`` concatenated-frames blob — decoded here by walking
+    the chunk framing and reading each payload line's wire fields).
+    Callers still pass the obj column through ``item_object``."""
+    blob = record.get("f")
+    if not blob:
+        return record.get("items", ())
+    items = []
+    off, size = 0, len(blob)
+    while off < size:
+        head_end = blob.index(b"\r\n", off)
+        length = int(blob[off:head_end], 16)
+        start = head_end + 2
+        line = json.loads(blob[start:start + length])
+        off = start + length + 2
+        items.append([
+            line.get("rv"),
+            line.get("kind"),
+            line.get("key"),
+            OP_DELETE if line.get("type") == "DELETE" else OP_UPSERT,
+            line.get("object"),
+        ])
+    return items
 
 
 class _Segment:
@@ -294,7 +368,8 @@ class HistoryStore:
         self.state_provider = None
 
         self._cond = threading.Condition()
-        self._queue: collections.deque = collections.deque()  # deque[Delta]
+        # deque[(deltas, frames-or-None)] — see publish()
+        self._queue: collections.deque = collections.deque()
         self._queued = 0
         self._overrun = False  # queue blew past the cap; writer must rebase
         self._stop = False
@@ -458,15 +533,18 @@ class HistoryStore:
 
     # -- hot path ---------------------------------------------------------
 
-    def publish(self, deltas: Sequence) -> None:
+    def publish(self, deltas: Sequence, frames: Optional[Sequence] = None) -> None:
         """O(1) hand-off, called under the view's publish lock (that
-        ordering IS the WAL's rv ordering). Never blocks on IO."""
+        ordering IS the WAL's rv ordering). Never blocks on IO.
+        ``frames`` (optional, parallel to ``deltas``, entries may be
+        None) lets the writer reuse already-encoded serve frame bytes
+        instead of re-packing objects — see ``deltas_record``."""
         with self._cond:
             if self._stop:
                 return
             # callers hand over a fresh slice (never mutated after) — no
             # defensive copy on the hot path
-            self._queue.append(deltas)
+            self._queue.append((deltas, frames))
             self._queued += len(deltas)
             if self._queued > self.max_queue_deltas:
                 # wedged disk: drop the backlog, rebase with a snapshot
@@ -555,14 +633,22 @@ class HistoryStore:
             return
         t0 = time.monotonic()
         self._maybe_rotate()
-        flat = [delta for batch in batches for delta in batch]
+        flat = []
+        flat_frames = []
+        for deltas, frames in batches:
+            flat.extend(deltas)
+            if frames is None:
+                flat_frames.extend([None] * len(deltas))
+            else:
+                flat_frames.extend(frames)
         count = len(flat)
         last_rv = self._rv
         buf = bytearray()
         nrecords = 0
         for start in range(0, count, MAX_DELTAS_PER_RECORD):
             chunk = flat[start:start + MAX_DELTAS_PER_RECORD]
-            buf += frame(encode_record(deltas_record(chunk)))
+            fchunk = flat_frames[start:start + MAX_DELTAS_PER_RECORD]
+            buf += frame(encode_record(deltas_record(chunk, fchunk)))
             nrecords += 1
         if flat:
             last_rv = flat[-1].rv

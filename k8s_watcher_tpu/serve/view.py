@@ -247,6 +247,31 @@ def frame_payload(frame: bytes) -> bytes:
     return rest[:size]
 
 
+_RV_MARK = b'"rv": '
+
+
+def splice_frame_rv(payload: bytes, rv: int) -> Optional[bytes]:
+    """Replace the frame-level ``rv`` number in an already-encoded JSON
+    frame payload (a ``frame_body`` line, pre-chunk-framing) with ``rv``
+    — the fan-in passthrough's ONLY byte mutation on this side of the
+    process boundary. The first ``"rv": `` in the line is always the
+    frame's own (the wire dict opens ``{"type": ..., "rv": ...`` and
+    ``type`` is drawn from UPSERT/DELETE). Returns None when the shape
+    is not recognized — the caller falls back to a lazy re-encode hole,
+    never a corrupt frame."""
+    i = payload.find(_RV_MARK)
+    if i < 0:
+        return None
+    j = i + len(_RV_MARK)
+    k = j
+    n = len(payload)
+    while k < n and payload[k] in b"-0123456789":
+        k += 1
+    if k == j or k >= n or payload[k] not in b",}":
+        return None
+    return b"%s%d%s" % (payload[:j], rv, payload[k:])
+
+
 class ReadResult(NamedTuple):
     """One ``read_since`` pull.
 
@@ -641,6 +666,7 @@ class FleetView:
         ts_wall: Optional[float] = None,
         pub_wall: float = 0.0,
         trace: Optional[Any] = None,
+        frame: Optional[bytes] = None,
     ) -> bool:
         """One delta under the lock. Returns False for no-ops (identical
         upsert, delete of an absent key) — no rv burn, no journal entry.
@@ -648,7 +674,12 @@ class FleetView:
         every codec's frame array instead of paying json.dumps here; the
         first read in a codec fills it. ``ts_wall``/``pub_wall`` are the
         freshness plane's origin/publish stamps; ``trace`` is the sampled
-        journey the ?trace=1 wire forwards (see ``Delta``)."""
+        journey the ?trace=1 wire forwards (see ``Delta``). ``frame`` is
+        the fan-in passthrough's pre-encoded JSON payload (an upstream
+        ``frame_body`` line, already re-keyed): the minted rv is spliced
+        into the bytes and the result fills the plain-JSON frame slot —
+        no encode here, no lazy re-encode later. An unrecognized shape
+        falls back to the hole (correctness over the fast path)."""
         map_key = (kind, key)
         if obj is None:
             if self._objects.pop(map_key, None) is None:
@@ -663,7 +694,14 @@ class FleetView:
         delta = Delta(self._rv, kind, key, delta_type, obj, now, ts_wall, pub_wall, trace)
         self._delta_rvs.append(self._rv)
         self._deltas.append(delta)
-        self._frames[CODEC_JSON].append(self._encode_locked(delta) if encode else None)
+        if encode:
+            json_frame: Optional[bytes] = self._encode_locked(delta)
+        elif frame is not None:
+            spliced = splice_frame_rv(frame, self._rv)
+            json_frame = chunk_wrap(spliced) if spliced is not None else None
+        else:
+            json_frame = None
+        self._frames[CODEC_JSON].append(json_frame)
         # every other variant (msgpack, and both freshness-stamped
         # shapes) is ALWAYS lazy: most deployments never attach such a
         # subscriber, and the ones that do pay once, at read time
@@ -709,8 +747,12 @@ class FleetView:
             if changed:
                 if self._history is not None:
                     # BEFORE the trim: a horizon shorter than the burst
-                    # must never cost the WAL a delta
-                    self._history.publish(self._deltas[-1:])
+                    # must never cost the WAL a delta. The already-encoded
+                    # JSON frame rides along so the WAL writer reuses the
+                    # bytes instead of re-packing the object
+                    self._history.publish(
+                        self._deltas[-1:], frames=self._frames[CODEC_JSON][-1:]
+                    )
                 self._trim_locked()
                 if self._rv_gauge is not None:
                     self._rv_gauge.set(self._rv)
@@ -742,7 +784,12 @@ class FleetView:
         keeps measuring true end-to-end age (and a second-tier federator
         propagates it again). A fifth element carries the upstream's
         compact ``trace`` dict (the ?trace=1 field) so the merged view's
-        republished frames keep the journey's identity across hops."""
+        republished frames keep the journey's identity across hops. A
+        sixth element is the sharded fan-in's PASSTHROUGH frame: the
+        upstream's already-encoded JSON payload (re-keyed by the merge
+        worker), which fills this view's plain-JSON frame slot with only
+        an rv splice — the encode-once invariant held across the process
+        boundary."""
         now = time.monotonic()
         wall = time.time()
         changed = 0
@@ -751,17 +798,22 @@ class FleetView:
                 kind, key, obj = item[0], item[1], item[2]
                 ts = item[3] if len(item) > 3 and item[3] is not None else wall
                 tr = item[4] if len(item) > 4 else None
+                fr = item[5] if len(item) > 5 else None
                 if self._apply_locked(
                     kind, key, obj, now, encode=False, ts_wall=ts, pub_wall=wall,
-                    trace=tr,
+                    trace=tr, frame=fr,
                 ):
                     changed += 1
             if changed:
                 if self._history is not None:
                     # pre-trim, one O(1) hand-off for the whole batch —
                     # the deltas are the journal tail (appended under
-                    # THIS lock hold, so they are contiguous)
-                    self._history.publish(self._deltas[-changed:])
+                    # THIS lock hold, so they are contiguous); passthrough
+                    # frames ride along for WAL byte reuse (holes re-pack)
+                    self._history.publish(
+                        self._deltas[-changed:],
+                        frames=self._frames[CODEC_JSON][-changed:],
+                    )
                 self._trim_locked()
                 if self._rv_gauge is not None:
                     self._rv_gauge.set(self._rv)
@@ -841,7 +893,10 @@ class FleetView:
                     # latency lives on the WAL writer thread — see
                     # history_wal_write_seconds)
                     t_wal = time.monotonic()
-                    self._history.publish(self._deltas[-changed:])
+                    self._history.publish(
+                        self._deltas[-changed:],
+                        frames=self._frames[CODEC_JSON][-changed:],
+                    )
                 self._trim_locked()
                 if self._rv_gauge is not None:
                     self._rv_gauge.set(self._rv)

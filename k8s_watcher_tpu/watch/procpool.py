@@ -38,16 +38,14 @@ path is untouched, byte for byte.
 from __future__ import annotations
 
 import dataclasses
-import json
 import logging
-import multiprocessing
 import os
-import random
 import signal
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from k8s_watcher_tpu.parallel.procpool import SupervisedEndpoint, pack, unpack
 from k8s_watcher_tpu.watch.sharded import ShardedWatchSource
 from k8s_watcher_tpu.watch.source import WatchEvent
 
@@ -60,30 +58,21 @@ except Exception:  # noqa: BLE001 — absence is a supported configuration
 
 
 # -- wire codec (worker -> parent) ------------------------------------------
-# One message per Connection frame (multiprocessing's own length-prefixed
-# pipe framing); payload is a dict, msgpack when available else JSON, the
-# first byte tagging the codec so a mixed pair (e.g. a test stripping
-# msgpack in one side only) still interoperates.
+# The generic tagged codec lives in parallel/procpool (shared with the
+# federation fan-in tier); these wrappers bind it to THIS module's msgpack
+# global so a test can strip one side's codec and the pair still
+# interoperates via the per-frame tag.
 
 _TAG_MSGPACK = b"M"
 _TAG_JSON = b"J"
 
 
 def _pack(obj: Dict[str, Any]) -> bytes:
-    if msgpack is not None:
-        return _TAG_MSGPACK + msgpack.packb(obj, use_bin_type=True)
-    return _TAG_JSON + json.dumps(obj).encode()
+    return pack(obj, codec=msgpack)
 
 
 def _unpack(data: bytes) -> Dict[str, Any]:
-    tag, payload = data[:1], data[1:]
-    if tag == _TAG_MSGPACK:
-        if msgpack is None:
-            raise ValueError("msgpack frame received but msgpack is unavailable")
-        return msgpack.unpackb(payload, raw=False)
-    if tag == _TAG_JSON:
-        return json.loads(payload)
-    raise ValueError(f"unknown wire codec tag {tag!r}")
+    return unpack(data, codec=msgpack)
 
 
 # -- worker plan -------------------------------------------------------------
@@ -459,15 +448,14 @@ def _worker_entry(plan: WorkerPlan, conn) -> None:
 # -- parent side -------------------------------------------------------------
 
 
-class _WorkerEndpoint:
+class _WorkerEndpoint(SupervisedEndpoint):
     """One supervised shard-reader subprocess, presented as a WatchSource.
 
-    ``events()`` is consumed by a ``ShardedWatchSource`` pump thread in the
-    parent: it spawns the worker, decodes pipe frames into ``WatchEvent``s,
-    and on an unexpected death (EOF without EOS) respawns with jittered
-    exponential backoff — each incarnation resumes its shards from their
-    durable checkpoints. Per-spawn sequence numbers make wire loss a
-    counted invariant violation (``ingest_wire_gaps``), not a silent hole.
+    Supervision (spawn/respawn/backoff/seq/hello/stats/EOS) is the shared
+    ``parallel.procpool.SupervisedEndpoint``; this subclass adds the
+    ingest-specific pieces: decoding pipe batch items into ``WatchEvent``s
+    and folding the worker's cumulative ``prefiltered`` stat into the
+    parent's ``events_prefiltered`` counter across incarnations.
     """
 
     def __init__(
@@ -479,93 +467,29 @@ class _WorkerEndpoint:
         respawn_backoff: float = 0.5,
         respawn_backoff_max: float = 15.0,
     ):
-        self.plan = plan
-        self.metrics = metrics
-        self.heartbeat = heartbeat or (lambda: None)
-        self.respawn_backoff = respawn_backoff
-        self.respawn_backoff_max = respawn_backoff_max
-        self.last_hello: Optional[Dict[str, Any]] = None
-        self.last_stats: Dict[str, Any] = {}
-        self.spawns = 0
-        self.respawns = 0
-        self.wire_gaps = 0
-        self.events_delivered = 0
+        super().__init__(
+            plan,
+            target=_worker_entry,
+            name=f"ingest-reader-{plan.proc_index}",
+            index=plan.proc_index,
+            metrics=metrics,
+            heartbeat=heartbeat,
+            respawn_backoff=respawn_backoff,
+            respawn_backoff_max=respawn_backoff_max,
+            gap_counter="ingest_wire_gaps",
+            respawn_counter="ingest_worker_respawns",
+            label="Ingest worker",
+            respawn_note="resume from per-shard checkpoints",
+        )
         # cumulative ACROSS incarnations (a respawned worker's counters
         # restart at zero; parent-side totals must not)
         self.prefiltered_total = 0
-        self._stop = threading.Event()
-        self._lock = threading.Lock()
-        self._proc: Optional[multiprocessing.process.BaseProcess] = None
-        self._conn = None
-        self._ctx = multiprocessing.get_context("spawn")
         self._prefiltered_seen = 0
 
-    # -- lifecycle ---------------------------------------------------------
+    def on_spawn(self) -> None:
+        self._prefiltered_seen = 0  # per-incarnation cumulative counters
 
-    def _spawn(self):
-        with self._lock:
-            if self._stop.is_set():
-                return None
-            recv_conn, send_conn = self._ctx.Pipe(duplex=False)
-            proc = self._ctx.Process(
-                target=_worker_entry,
-                args=(self.plan, send_conn),
-                name=f"ingest-reader-{self.plan.proc_index}",
-                daemon=True,  # safety net only; stop() drains via SIGTERM
-            )
-            proc.start()
-            send_conn.close()  # child holds the write end now; EOF tracks it
-            self._proc, self._conn = proc, recv_conn
-            self.spawns += 1
-            return recv_conn
-
-    def _reap(self) -> None:
-        with self._lock:
-            proc, conn = self._proc, self._conn
-            self._proc = self._conn = None
-        if conn is not None:
-            try:
-                conn.close()
-            except OSError:
-                pass
-        if proc is not None:
-            proc.join(timeout=5.0)
-            if proc.is_alive():
-                proc.kill()
-                proc.join(timeout=5.0)
-
-    @property
-    def pid(self) -> Optional[int]:
-        proc = self._proc
-        return proc.pid if proc is not None else None
-
-    def stop(self) -> None:
-        """SIGTERM the worker (clean drain: it flushes checkpoints, sends
-        EOS, closes the pipe — which unblocks the parent's reader)."""
-        self._stop.set()
-        proc = self._proc
-        if proc is not None and proc.is_alive():
-            try:
-                proc.terminate()
-            except OSError:
-                pass
-
-    def kill(self) -> None:
-        """Hard-stop a worker that ignored the drain grace."""
-        self._stop.set()
-        proc = self._proc
-        if proc is not None and proc.is_alive():
-            proc.kill()
-        conn = self._conn
-        if conn is not None:
-            try:
-                conn.close()
-            except OSError:
-                pass
-
-    # -- stream ------------------------------------------------------------
-
-    def _fold_stats(self, stats: Dict[str, Any]) -> None:
+    def on_stats(self, stats: Dict[str, Any]) -> None:
         self.last_stats = stats
         prefiltered = stats.get("prefiltered")
         if prefiltered is not None:
@@ -577,75 +501,16 @@ class _WorkerEndpoint:
             self._prefiltered_seen = prefiltered
 
     def events(self):
-        backoff = self.respawn_backoff
-        while not self._stop.is_set():
-            conn = self._spawn()
-            if conn is None:
-                return
-            self._prefiltered_seen = 0  # per-incarnation cumulative counters
-            clean_eos = False
-            delivered_this_spawn = 0
-            expected_seq = 0
-            try:
-                while True:
-                    try:
-                        data = conn.recv_bytes()
-                    except (EOFError, OSError):
-                        break  # worker died (or drained and closed)
-                    self.heartbeat()  # any frame = a live reader process
-                    msg = _unpack(data)
-                    batch = msg.get("b")
-                    if batch is not None:
-                        seq = msg.get("s", expected_seq)
-                        if seq != expected_seq:
-                            # pipes cannot reorder; this is a tripwire for
-                            # codec/framing bugs, counted, never silent
-                            self.wire_gaps += 1
-                            if self.metrics is not None:
-                                self.metrics.counter("ingest_wire_gaps").inc()
-                        expected_seq = seq + len(batch)
-                        delivered_this_spawn += len(batch)
-                        self.events_delivered += len(batch)
-                        for etype, pod, rv, mono, wall, legacy in batch:
-                            yield WatchEvent(
-                                type=etype,
-                                pod=pod,
-                                resource_version=rv,
-                                received_monotonic=mono,
-                                received_at=wall,
-                                legacy_tombstone=bool(legacy),
-                            )
-                        continue
-                    if "stats" in msg:
-                        self._fold_stats(msg["stats"])
-                        continue
-                    if "hello" in msg:
-                        self.last_hello = msg["hello"]
-                        continue
-                    if msg.get("eos"):
-                        clean_eos = True
-                        break
-            finally:
-                self._reap()
-            if clean_eos or self._stop.is_set():
-                return
-            # unexpected death: respawn and resume from the per-shard
-            # checkpoints. A spawn that delivered events was healthy —
-            # reset the escalation so one crash after hours of service
-            # doesn't pay the accumulated backoff.
-            if delivered_this_spawn > 0:
-                backoff = self.respawn_backoff
-            self.respawns += 1
-            if self.metrics is not None:
-                self.metrics.counter("ingest_worker_respawns").inc()
-            logger.warning(
-                "Ingest worker %d died (spawn %d); respawning in <=%.1fs "
-                "(resume from per-shard checkpoints)",
-                self.plan.proc_index, self.spawns, backoff * 1.5,
-            )
-            if self._stop.wait(backoff * (0.5 + random.random())):
-                return
-            backoff = min(backoff * 2.0, self.respawn_backoff_max)
+        for msg in self.frames():
+            for etype, pod, rv, mono, wall, legacy in msg["b"]:
+                yield WatchEvent(
+                    type=etype,
+                    pod=pod,
+                    resource_version=rv,
+                    received_monotonic=mono,
+                    received_at=wall,
+                    legacy_tombstone=bool(legacy),
+                )
 
 
 class ProcessShardedWatchSource(ShardedWatchSource):

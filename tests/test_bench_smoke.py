@@ -23,9 +23,10 @@ def test_bench_smoke_headline_within_budget():
         [sys.executable, str(REPO_ROOT / "bench.py"), "--smoke"],
         capture_output=True,
         text=True,
-        timeout=300,  # generous wall budget: sandboxed CI hosts stall; the
+        timeout=420,  # generous wall budget: sandboxed CI hosts stall; the
         # MEASURED budget inside the smoke tier is ~5 s of benchmark work
-        # (+ ~10 s of relay-tree subprocess lifecycle)
+        # (+ ~10 s of relay-tree subprocess lifecycle + ~60 s of
+        # fanin-sharded worker/publisher subprocess lifecycle)
         cwd=str(REPO_ROOT),
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
@@ -127,6 +128,14 @@ def test_bench_smoke_headline_within_budget():
     assert headline["analytics_ok"] is True, headline
     assert headline["analytics_speedup"] is not None, headline
     assert headline["analytics_speedup"] >= 5.0, headline
+    # sharded fan-in: merge workers as real processes over real sockets —
+    # ok folds connectivity, catch-up, the sharded-vs-single-process A/B
+    # byte-identity leg, the worker-kill leg, and zero gaps/dups/wire
+    # gaps; the rate is the merge tier's drain rate (detail carries the
+    # e2e rate and the core count the run actually had)
+    assert headline["fanin_sharded_ok"] is True, headline
+    assert headline["fanin_deltas_per_sec"] is not None, headline
+    assert headline["fanin_deltas_per_sec"] > 0, headline
     detail = json.loads((REPO_ROOT / "artifacts" / "bench_smoke.json").read_text())
     assert detail["details"]["relist_10k"]["events"] == detail["details"]["relist_10k"]["n_pods"]
     # multi-process ingest correctness legs behind the >=100k number: zero
@@ -213,6 +222,22 @@ def test_bench_smoke_headline_within_budget():
     assert tf["joined"] == tf["traced_frames"] > 0, tf
     assert tf["journeys_complete"] and tf["correctness_ok"], tf
     assert tf["within_budget"], tf
+    # sharded fan-in correctness legs behind the headline verdict: the
+    # sharded terminal view byte-identical to the in-process reference
+    # (same-run A/B), gapless THROUGH a merge-worker SIGKILL (respawn
+    # resumed from tokens — at least one respawn must have happened for
+    # the leg to count), encode-once across the process boundary (zero
+    # view-side encodes while raw passthrough frames flowed), and the
+    # workers own the staleness verdicts
+    fanin = detail["details"]["fanin_sharded"]
+    assert fanin["ab_identical"], fanin
+    assert fanin["kill"]["identical"] and fanin["kill"]["caught_up"], fanin
+    assert fanin["respawns"] >= 1, fanin
+    assert fanin["encodes_before_kill"] == 0 and fanin["passthrough"] > 0, fanin
+    assert fanin["gaps"] == 0 and fanin["dups"] == 0 and fanin["wire_gaps"] == 0, fanin
+    assert fanin["merged_matches"], fanin
+    assert fanin["staleness_owner"] == "merge-workers", fanin
+    assert fanin["upstreams"] >= 16 and fanin["processes"] >= 4, fanin
     health = detail["details"]["health"]
     assert health["within_budget"], health
     assert health["verdicts_exact"], health

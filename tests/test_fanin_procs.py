@@ -1,0 +1,427 @@
+"""Sharded federation fan-in (federate/fanin.py): the raw-passthrough
+rewrite byte-contract, the partition/plan math, the parent sequencer's
+watermark dedup, the explicit staleness-owner split, and — slow-marked —
+a 3-seed property test that the sharded merge equals the single-process
+merge (terminal views, rv line, resume tokens) under churn + a merge-
+worker SIGKILL + (one seed) an upstream restart resync.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import socket
+import time
+
+import pytest
+
+from k8s_watcher_tpu.config.schema import FederationConfig
+from k8s_watcher_tpu.federate import FederationPlane, GlobalMerge, global_key
+from k8s_watcher_tpu.federate.fanin import (
+    FaninPlan,
+    ShardedFanin,
+    fanin_plans,
+    rewrite_passthrough,
+    strip_ts_tail,
+    token_path,
+)
+from k8s_watcher_tpu.federate.merge import merged_equals_union
+from k8s_watcher_tpu.metrics import MetricsRegistry
+from k8s_watcher_tpu.serve import FleetView, ServeServer, SubscriptionHub
+from k8s_watcher_tpu.serve.view import chunk_wrap, splice_frame_rv
+from k8s_watcher_tpu.watch.sharded import shard_of
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_for(predicate, timeout=10.0, interval=0.02, message="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {message}")
+
+
+def _upstream_frame(ftype, rv, kind, key, obj=None, ts=(1.25, 2.5)):
+    """One upstream serve frame's raw JSON line, exactly as the serve
+    plane encodes it (default json.dumps separators + trailing newline;
+    fresh-negotiated ts tail last)."""
+    wire = {"type": ftype, "rv": rv, "kind": kind, "key": key}
+    if obj is not None:
+        wire["object"] = obj
+    if ts is not None:
+        wire["ts"] = list(ts)
+    return (json.dumps(wire) + "\n").encode()
+
+
+# -- raw passthrough rewrite --------------------------------------------------
+
+
+class TestPassthroughRewrite:
+    def test_upsert_rewrite_is_byte_identical_to_single_process_encode(self):
+        obj = {"kind": "pod", "key": "p-1", "phase": "Running", "node": "n/1"}
+        raw = _upstream_frame("UPSERT", 42, "pod", "p-1", obj)
+        rewritten = rewrite_passthrough(raw, cluster="east", kind="pod", key="p-1", obj=obj)
+        assert rewritten is not None
+        # the single-process reference: Delta(kind, gkey, _decorate(...)).to_wire()
+        # at the parent view's rv (spliced at apply time)
+        reference = (
+            json.dumps(
+                {
+                    "type": "UPSERT",
+                    "rv": 7,
+                    "kind": "pod",
+                    "key": "east/p-1",
+                    "object": GlobalMerge._decorate("east", "pod", "p-1", obj),
+                }
+            )
+            + "\n"
+        ).encode()
+        assert splice_frame_rv(rewritten, 7) == reference
+
+    def test_delete_rewrite(self):
+        raw = _upstream_frame("DELETE", 43, "pod", "p-1")
+        rewritten = rewrite_passthrough(raw, cluster="east", kind="pod", key="p-1", obj=None)
+        reference = (
+            json.dumps({"type": "DELETE", "rv": 9, "kind": "pod", "key": "east/p-1"}) + "\n"
+        ).encode()
+        assert splice_frame_rv(rewritten, 9) == reference
+
+    def test_no_ts_upstream_is_eligible(self):
+        obj = {"kind": "pod", "key": "x"}
+        raw = _upstream_frame("UPSERT", 5, "pod", "x", obj, ts=None)
+        assert rewrite_passthrough(raw, cluster="c", kind="pod", key="x", obj=obj) is not None
+
+    def test_strip_ts_tail_contract(self):
+        assert strip_ts_tail(b'{"type": "SYNC", "rv": 1}\n') == b'{"type": "SYNC", "rv": 1}\n'
+        assert (
+            strip_ts_tail(b'{"type": "DELETE", "rv": 1, "ts": [1.0, 2.0]}\n')
+            == b'{"type": "DELETE", "rv": 1}\n'
+        )
+        # a ts NOT in tail position (unknown producer): refuse, don't guess
+        assert strip_ts_tail(b'{"ts": [1.0], "rv": 1}\n') is None
+
+    def test_ineligible_falls_back_never_guesses(self):
+        # object missing the view key convention
+        raw = _upstream_frame("UPSERT", 1, "pod", "a", {"kind": "pod"})
+        assert rewrite_passthrough(raw, cluster="c", kind="pod", key="a", obj={"kind": "pod"}) is None
+        # kind mismatch between frame and object
+        obj = {"kind": "node", "key": "a"}
+        raw = _upstream_frame("UPSERT", 1, "pod", "a", obj)
+        assert rewrite_passthrough(raw, cluster="c", kind="pod", key="a", obj=obj) is None
+        # already decorated (a federator federating a federator)
+        obj = {"kind": "pod", "key": "a", "cluster": "z", "origin_key": "a"}
+        raw = _upstream_frame("UPSERT", 1, "pod", "a", obj)
+        assert rewrite_passthrough(raw, cluster="c", kind="pod", key="a", obj=obj) is None
+        # a nested dict whose "key" field collides with the needle
+        obj = {"kind": "pod", "key": "y", "ref": {"key": "y"}}
+        raw = _upstream_frame("UPSERT", 1, "pod", "y", obj)
+        assert rewrite_passthrough(raw, cluster="c", kind="pod", key="y", obj=obj) is None
+        # not a JSON line at all (codec downgrade)
+        assert rewrite_passthrough(b"\x82\xa4type", cluster="c", kind="pod", key="a", obj=None) is None
+
+    def test_spliced_passthrough_applies_into_the_view_encode_free(self):
+        reg = MetricsRegistry()
+        view = FleetView(metrics=reg)
+        obj = {"kind": "pod", "key": "p", "seq": 1}
+        raw = _upstream_frame("UPSERT", 99, "pod", "p", obj)
+        rewritten = rewrite_passthrough(raw, cluster="c", kind="pod", key="p", obj=obj)
+        decorated = GlobalMerge._decorate("c", "pod", "p", obj)
+        view.apply_batch([("pod", "c/p", decorated, 1.25, None, rewritten)])
+        assert reg.counter("serve_frame_encodes").value == 0
+        rv, objects = view.snapshot()
+        assert objects == [decorated]
+        # the journaled frame is the worker's bytes with the view's rv
+        assert view._frames["json"][-1] == chunk_wrap(splice_frame_rv(rewritten, rv))
+
+
+# -- plans / partition --------------------------------------------------------
+
+
+def _config(names, processes, **kw):
+    raw = {
+        "enabled": True,
+        "processes": processes,
+        "upstreams": [
+            {"name": n, "url": f"http://127.0.0.1:{9000 + i}"} for i, n in enumerate(names)
+        ],
+        "stale_after_seconds": kw.pop("stale_after_seconds", 1.0),
+        "resync_backoff_seconds": 0.1,
+    }
+    raw.update(kw)
+    return FederationConfig.from_raw(raw)
+
+
+class TestFaninPlans:
+    def test_partition_is_pure_and_covers_every_upstream(self):
+        names = [f"cluster-{i}" for i in range(11)]
+        cfg = _config(names, 4)
+        plans = fanin_plans(cfg, "/tmp/tokens")
+        assert sorted(n for p in plans for n in p.owned) == sorted(names)
+        for plan in plans:
+            assert all(shard_of(n, 4) == plan.proc_index for n in plan.owned)
+        # pure function of (name, processes): same answer every time
+        again = fanin_plans(cfg, "/tmp/tokens")
+        assert [p.owned for p in again] == [p.owned for p in plans]
+
+    def test_ownerless_workers_are_not_spawned(self):
+        cfg = _config(["only"], 8)
+        plans = fanin_plans(cfg)
+        assert len(plans) == 1 and plans[0].owned == ("only",)
+
+    def test_token_path_matches_in_process_plane(self, tmp_path):
+        # a name needing metric-suffix sanitization: both sides must
+        # land on the SAME file or flipping `processes` forgets tokens
+        cfg = _config(["east-1.prod:8443"], 0)
+        plane = FederationPlane(cfg, FleetView(), token_dir=str(tmp_path))
+        store = plane.token_store_for("east-1.prod:8443")
+        assert store.path == token_path(str(tmp_path), "east-1.prod:8443")
+        plane.stop()
+
+    def test_schema_rejects_trace_join_with_sharded_fanin(self):
+        from k8s_watcher_tpu.config.schema import AppConfig, SchemaError
+
+        raw = {
+            "serve": {"enabled": True},
+            "trace": {"enabled": True, "federation": {"enabled": True}},
+            "federation": {
+                "enabled": True,
+                "processes": 2,
+                "upstreams": [{"name": "a", "url": "http://127.0.0.1:1"}],
+            },
+        }
+        with pytest.raises(SchemaError, match="federation.processes"):
+            AppConfig.from_raw(raw, "development")
+
+
+# -- parent sequencer fold ----------------------------------------------------
+
+
+class TestSequencerFold:
+    def _fanin(self):
+        reg = MetricsRegistry()
+        view = FleetView(metrics=reg)
+        merge = GlobalMerge(view, metrics=reg)
+        cfg = _config(["east"], 2)
+        return ShardedFanin(cfg, merge, metrics=reg), view, reg
+
+    def test_watermark_drops_crash_replay_window(self):
+        fanin, view, reg = self._fanin()
+        item = lambda key, urv: ["pod", f"east/{key}", {"kind": "pod", "key": f"east/{key}",
+                                 "cluster": "east", "origin_key": key, "u": urv}, None, None, urv, None]
+        fanin._fold({"c": "east", "e": "ep1", "w": 0, "r": 1, "b": []})
+        fanin._fold({"c": "east", "e": "ep1", "b": [item("a", 1), item("b", 2)]})
+        assert view.object_count() == 2
+        rv_before = view.snapshot()[0]
+        # the respawned worker replays urv 1..2 then delivers 3
+        fanin._fold({"c": "east", "e": "ep1", "b": [item("a", 1), item("b", 2), item("c", 3)]})
+        assert view.object_count() == 3
+        # replayed items were dropped BEFORE the view (no dedup-burned rvs)
+        assert view.snapshot()[0] == rv_before + 1
+        assert reg.counter("federation_deltas_applied").value == 3
+
+    def test_epoch_change_resets_the_watermark(self):
+        fanin, view, _ = self._fanin()
+        item = lambda key, urv: ["pod", f"east/{key}", {"kind": "pod", "key": f"east/{key}",
+                                 "cluster": "east", "origin_key": key}, None, None, urv, None]
+        fanin._fold({"c": "east", "e": "ep1", "b": [item("a", 100)]})
+        # upstream restarted into a fresh rv space: urv 5 < 100 must apply
+        fanin._fold({"c": "east", "e": "ep2", "w": 4, "r": 1, "b": []})
+        fanin._fold({"c": "east", "e": "ep2", "b": [item("b", 5)]})
+        assert {o["key"] for o in view.snapshot()[1]} == {"east/b"}
+
+    def test_reset_folds_through_reset_cluster(self):
+        fanin, view, _ = self._fanin()
+        objs = [{"kind": "pod", "key": "a", "seq": 0}, {"kind": "pod", "key": "b", "seq": 1}]
+        fanin._fold({"c": "east", "e": "ep1", "w": 10, "r": 1, "b": objs})
+        assert {o["key"] for o in view.snapshot()[1]} == {"east/a", "east/b"}
+        # deltas at-or-below the snapshot rv are replay — dropped
+        fanin._fold({"c": "east", "e": "ep1",
+                     "b": [["pod", "east/a", None, None, None, 9, None]]})
+        assert view.object_count() == 2
+        # the drop verdict removes the cluster wholesale
+        fanin._fold({"c": "east", "drop": 1, "b": []})
+        assert view.object_count() == 0
+
+
+# -- staleness owner (the double-report fix) ----------------------------------
+
+
+class TestStalenessOwner:
+    def test_in_process_plane_owns_the_verdict(self):
+        plane = FederationPlane(_config(["east"], 0), FleetView())
+        assert plane.staleness_owner == "monitor"
+        assert plane.fanin is None and plane.mirrors == []
+        assert plane.health()["staleness_owner"] == "monitor"
+        plane.stop()
+
+    def test_sharded_plane_only_mirrors_worker_verdicts(self):
+        reg = MetricsRegistry()
+        plane = FederationPlane(_config(["east", "west"], 2), FleetView(metrics=reg), metrics=reg)
+        try:
+            assert plane.staleness_owner == "merge-workers"
+            assert plane.upstreams == [] and len(plane.mirrors) == 2
+            # ticks without any worker report NEVER invent a verdict —
+            # even long past stale_after (the monitor does not own it)
+            plane._started_t = time.monotonic() - 60.0
+            plane._tick()
+            plane._tick()
+            assert reg.counter("federation_stale_transitions").value == 0
+            assert all(not m.stale for m in plane.mirrors)
+            # a worker-reported verdict is mirrored, transition counted once
+            plane.fanin.endpoints[0].upstream_stats = {
+                "east": {"connected": False, "stale": True, "lag_rv": 0}
+            }
+            plane._tick()
+            plane._tick()
+            health = plane.health()
+            assert health["staleness_owner"] == "merge-workers"
+            assert health["upstreams"]["east"]["stale"] is True
+            assert health["upstreams"]["east"]["mirrored"] is True
+            assert reg.counter("federation_stale_transitions").value == 1
+            assert reg.gauge("federation_upstream_stale").labels(upstream="east").value == 1.0
+        finally:
+            plane.stop()
+
+
+# -- live sharded fan-in (slow) ----------------------------------------------
+
+
+def _upstream_stack(port=0):
+    view = FleetView(compact_horizon=4096)
+    hub = SubscriptionHub(view, max_subscribers=8, queue_depth=1024)
+    server = ServeServer(view, hub, host="127.0.0.1", port=port).start()
+    return view, server
+
+
+RESYNC_SEED = 2
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1, RESYNC_SEED])
+def test_sharded_merge_equals_single_process_merge(seed, tmp_path):
+    """The property the bench's A/B gate measures, as a seeded test:
+    same upstreams, same churn — the sharded fold (2 merge workers, one
+    SIGKILLed mid-window) and the in-process fold converge to identical
+    terminal views; on non-resync seeds the rv lines match exactly (the
+    watermark dedup means a worker kill burns zero extra rvs); the
+    durable resume tokens parse and point at the live upstream epochs.
+    Seed 2 additionally restarts an upstream mid-churn (epoch change ->
+    410 resync through the sharded path)."""
+    rng = random.Random(seed)
+    ports = [_free_port() for _ in range(3)]
+    stacks = [_upstream_stack(p) for p in ports]
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+
+    def fed_cfg(processes):
+        return FederationConfig.from_raw(
+            {
+                "enabled": True,
+                "processes": processes,
+                "upstreams": [{"name": f"c{i}", "url": u} for i, u in enumerate(urls)],
+                "stale_after_seconds": 5.0,
+                "resync_backoff_seconds": 0.1,
+            }
+        )
+
+    reg_a, reg_b = MetricsRegistry(), MetricsRegistry()
+    gview_a, gview_b = FleetView(metrics=reg_a), FleetView(metrics=reg_b)
+    plane_a = FederationPlane(fed_cfg(0), gview_a, metrics=reg_a).start()
+    plane_b = FederationPlane(
+        fed_cfg(2), gview_b, metrics=reg_b, token_dir=str(tmp_path)
+    ).start()
+    try:
+        # both sides fully snapshotted (empty upstreams) BEFORE churn:
+        # from here every object flows as a watch delta on both paths,
+        # which is what makes the rv lines comparable
+        _wait_for(
+            lambda: all(u.subscriber.snapshots > 0 for u in plane_a.upstreams),
+            message="in-process snapshots",
+        )
+        _wait_for(
+            lambda: all(
+                plane_b.fanin.upstream_report().get(f"c{i}", {}).get("snapshots", 0) > 0
+                for i in range(3)
+            ),
+            timeout=20.0,
+            message="sharded snapshots",
+        )
+        killed = False
+        for round_no in range(3):
+            for v, _s in stacks:
+                for _ in range(25):
+                    k = f"p{rng.randrange(40)}"
+                    if rng.random() < 0.25:
+                        v.apply("pod", k, None)
+                    else:
+                        v.apply(
+                            "pod", k,
+                            {"kind": "pod", "key": k, "seq": rng.randrange(1000),
+                             "phase": rng.choice(["Pending", "Running", "Succeeded"])},
+                        )
+            if round_no == 0:
+                # SIGKILL one merge worker mid-stream: the respawn must
+                # resume from its tokens with zero gaps AND zero dups
+                pid = plane_b.fanin.worker_pids()[0]
+                assert pid is not None
+                os.kill(pid, signal.SIGKILL)
+                killed = True
+            if round_no == 1 and seed == RESYNC_SEED:
+                # upstream restart: fresh view instance on the same port
+                # (epoch change -> full reconcile through both paths)
+                v_old, s_old = stacks[0]
+                s_old.stop()
+                stacks[0] = _upstream_stack(ports[0])
+            time.sleep(0.3)
+
+        def converged(gview):
+            ups = {f"c{i}": stacks[i][0].snapshot()[1] for i in range(3)}
+            return merged_equals_union(gview.snapshot()[1], ups)
+
+        _wait_for(lambda: converged(gview_a), timeout=30.0, message="in-process convergence")
+        _wait_for(lambda: converged(gview_b), timeout=30.0, message="sharded convergence")
+
+        # terminal views identical (the A/B property)
+        a = {(o["kind"], o["key"]): o for o in gview_a.snapshot()[1]}
+        b = {(o["kind"], o["key"]): o for o in gview_b.snapshot()[1]}
+        assert a == b
+        if seed != RESYNC_SEED:
+            # no resync: both paths minted exactly one rv per real delta —
+            # the kill/respawn replay window burned none (watermark dedup)
+            assert gview_a.snapshot()[0] == gview_b.snapshot()[0]
+
+        # passthrough reaches the parent via the periodic worker stats
+        # message — wait one cadence rather than racing it
+        _wait_for(
+            lambda: plane_b.fanin.worker_stats()["passthrough"] > 0,
+            message="passthrough counter fold",
+        )
+        stats = plane_b.fanin.worker_stats()
+        assert stats["wire_gaps"] == 0
+        assert killed and stats["respawns"] >= 1
+        report = plane_b.fanin.upstream_report()
+        for i in range(3):
+            body = report.get(f"c{i}")
+            assert body is not None
+            assert body["gaps"] == 0 and body["dups"] == 0
+    finally:
+        plane_b.stop()
+        plane_a.stop()
+        for _v, s in stacks:
+            s.stop()
+
+    # tokens persisted the exact live positions on the way out: valid
+    # JSON carrying the live upstream's view instance + a reachable rv
+    for i in range(3):
+        with open(token_path(str(tmp_path), f"c{i}")) as f:
+            token = json.load(f)
+        up_rv, _objects = stacks[i][0].snapshot()
+        assert isinstance(token["view"], str) and token["view"]
+        assert 0 <= token["rv"] <= up_rv

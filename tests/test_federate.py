@@ -613,6 +613,9 @@ class TestFederationPlaneLive:
             _wait_for(lambda: plane.health()["healthy"], message="health convergence")
             health = plane.health()
             assert health["merged_objects"] == 8
+            # in-process mode: the monitor tick owns the staleness
+            # verdict (sharded mode hands it to the merge workers)
+            assert health["staleness_owner"] == "monitor"
             assert all(u["gaps"] == 0 and u["dups"] == 0 for u in health["upstreams"].values())
             assert reg.counter("federation_deltas_applied").value > 0
         finally:

@@ -1165,7 +1165,7 @@ class TestApplyBatch:
             pass
 
         history = FakeHistory()
-        history.publish = lambda deltas: published.append(list(deltas))
+        history.publish = lambda deltas, frames=None: published.append(list(deltas))
         view = FleetView()
         view.attach_history(history)
         view.register_wakeup(lambda: wakes.append(1))
