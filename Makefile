@@ -1,6 +1,6 @@
 PY ?= python
 
-.PHONY: test check check-scale integration integration-kind integration-mock bench bench-smoke trace-smoke serve-smoke history-smoke federation-smoke obs-smoke health-smoke analytics-smoke relay-smoke ingest-smoke fanin-smoke dryrun dryrun-128 accept
+.PHONY: test check check-scale integration integration-kind integration-mock bench bench-smoke trace-smoke serve-smoke history-smoke federation-smoke obs-smoke health-smoke analytics-smoke relay-smoke ingest-smoke fanin-smoke columnar-smoke dryrun dryrun-128 accept
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -165,6 +165,21 @@ ingest-smoke:
 # artifacts/fanin_smoke.json.
 fanin-smoke:
 	$(PY) scripts/fanin_smoke.py
+
+# Columnar-core smoke: a mock-backed WatcherApp materializes a ~50k-pod
+# TPU fleet through the live relist/watch pipeline on the columnar view
+# core (serve.columnar: auto), churns it (phase flips, parked-Pending
+# pods, deletions, a degraded slice), and folds a dict-core shadow view
+# from the live journal at every stage. Gates: rv line + snapshot
+# objects + snapshot BODIES (both codecs, including the bytes actually
+# served by GET /serve/fleet) byte-identical across the cores, the
+# columnar store's deep-walked resident bytes under 0.75x the dict
+# shadow's (with the O(1) view_resident_bytes estimate tracking the
+# walk), and health-plane ticks/terminal states + analytics summaries
+# identical on both cores. The 1M-pod >=5x/<=0.5x claims run in
+# bench.py (bench_columnar_view). Artifact: artifacts/columnar_smoke.json.
+columnar-smoke:
+	$(PY) scripts/columnar_smoke.py
 
 dryrun:
 	$(PY) __graft_entry__.py 8

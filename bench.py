@@ -3864,6 +3864,297 @@ def bench_analytics(
     }
 
 
+def _retained_bytes(root) -> int:
+    """Deep ``sys.getsizeof`` walk with id-memoization — bytes RETAINED
+    by ``root``'s object graph (shared objects counted once). Handles
+    dicts/sequences/instances; numpy arrays report their buffer via
+    ``getsizeof``. This is the store-structure sizing the columnar
+    memory gate uses: identical accounting for both cores, no
+    tracemalloc sampling noise."""
+    import sys as _sys
+
+    seen = set()
+    stack = [root]
+    total = 0
+    while stack:
+        obj = stack.pop()
+        oid = id(obj)
+        if oid in seen:
+            continue
+        seen.add(oid)
+        total += _sys.getsizeof(obj)
+        if isinstance(obj, dict):
+            stack.extend(obj.keys())
+            stack.extend(obj.values())
+        elif isinstance(obj, (list, tuple, set, frozenset)):
+            stack.extend(obj)
+        elif hasattr(obj, "__dict__"):
+            stack.append(obj.__dict__)
+    return total
+
+
+def bench_columnar_view(
+    n_pods: int = 1_000_000,
+    n_ab_pods: int = 20_000,
+    read_rounds: int = 6,
+    deltas_per_round: int = 64,
+    raw_apply_deltas: int = 8192,
+    min_speedup: float = 5.0,
+    max_mem_ratio: float = 0.5,
+) -> dict:
+    """Columnar view core vs the dict core, same run, two gates in order:
+
+    **Byte-identity FIRST** (at ``n_ab_pods`` with a real WAL attached,
+    then re-checked on the JSON body at full ``n_pods`` scale): the two
+    cores fed the identical mutation script — batched applies, eager
+    singles, identical-upsert no-ops, deletes (present and absent),
+    side-table slice churn, a pre-flush insert+delete — must agree on
+    the rv line, the apply return values, every wire frame, the
+    snapshot bodies in BOTH codecs, and the ``?at=`` historical
+    reconstruction from the WALs each core wrote. Any divergence fails
+    the bench before a single speedup number is looked at, and is never
+    retried away.
+
+    **Then the scale gates** at ``n_pods`` (the ISSUE's 1M-pod fleet;
+    the smoke tier runs reduced):
+
+    - per-delta apply cost under readers >= ``min_speedup`` x: the
+      serving-plane workload — every ``deltas_per_round``-delta batch
+      is followed by a snapshot read (dashboards/relays keep the
+      snapshot hot), so the dict core pays a full O(fleet)
+      ``json.dumps`` per round while the columnar core pays a
+      fragment flush + one join;
+    - cold snapshot rebuild after a single delta >= ``min_speedup`` x;
+    - resident store bytes <= ``max_mem_ratio`` x the dict core's,
+      measured by the same deep-walk accounting on both stores.
+
+    Honesty notes: pods are minted through a ``json.dumps``/``loads``
+    round-trip because that is what the ingest path hands the view —
+    per-object key strings, not shared literals (building dicts in
+    Python understates the dict core's real footprint ~2x). The RAW
+    apply path (no reader between batches) is reported un-gated as
+    ``raw_apply_ratio``: the columnar hot path is a pending-dict write
+    and costs ~parity with a dict store, not 5x — the 5x claim is the
+    apply-under-readers workload above, where the incremental body
+    maintenance pays off. ``first_build_seconds`` reports the one-time
+    deferred-serialization cost the columnar core pays on its FIRST
+    snapshot after a bulk load (it is slower than one monolithic
+    dumps; every rebuild after it is the gated fast path)."""
+    import os
+    import shutil
+    import tempfile
+
+    from k8s_watcher_tpu.history import HistoryStore
+    from k8s_watcher_tpu.history.recovery import reconstruct_at
+    from k8s_watcher_tpu.serve.view import FleetView, msgpack_available
+
+    clusters = ("", "cluster-a", "cluster-b")
+
+    def make_pod(i: int, seq: int = 0) -> dict:
+        cluster = clusters[i % len(clusters)]
+        prefix = f"{cluster}/" if cluster else ""
+        pod = {
+            "kind": "pod", "key": f"{prefix}default/pod-{i}",
+            "name": f"p-{i}", "namespace": "default",
+            "phase": "Running" if (i + seq) % 9 else "Pending",
+            "ready": bool((i + seq) % 9),
+            "node": f"{cluster or 'local'}-node-{i // 8}",
+        }
+        if cluster:
+            pod["cluster"] = cluster
+        if seq:
+            pod["seq"] = seq
+        # ingest-faithful: the watch path hands the view json-decoded
+        # objects with per-object key strings — NOT interned literals
+        return json.loads(json.dumps(pod))
+
+    def make_slice(s: int) -> dict:
+        return json.loads(json.dumps({
+            "kind": "slice", "key": f"default/slice-{s}",
+            "slice": f"default/slice-{s}", "expected_workers": 4,
+            "observed_workers": 4, "ready_workers": 3 + (s % 2),
+            "chips_per_worker": 4,
+            "phase": "Ready" if s % 2 else "Degraded", "workers": [],
+        }))
+
+    def bulk_load(view: FleetView, count: int, batch: int = 4096) -> None:
+        items = []
+        for i in range(count):
+            pod = make_pod(i)
+            items.append(("pod", pod["key"], pod))
+            if len(items) >= batch:
+                view.apply_batch(items)
+                items = []
+        if items:
+            view.apply_batch(items)
+
+    def churn_round(view: FleetView, count: int, seq: int, n: int) -> None:
+        items = []
+        for j in range(n):
+            pod = make_pod((seq * 7919 + j * 13) % count, seq=seq)
+            items.append(("pod", pod["key"], pod))
+        view.apply_batch(items)
+
+    # -- phase 1: A/B byte-identity at n_ab_pods, WAL attached ------------
+    def build_ab(columnar: bool, wal_dir: str) -> FleetView:
+        view = FleetView(compact_horizon=n_ab_pods * 8, columnar=columnar)
+        view.instance = "bench-columnar-ab"  # bodies embed the view
+        # incarnation; pin it so byte-compares compare STATE, not uuids
+        store = HistoryStore(wal_dir, fsync="never", segment_max_bytes=256 * 1024 * 1024)
+        store.recover()
+        store.open(view.instance)
+        view.attach_history(store)
+        returns = []
+        bulk_load(view, n_ab_pods)
+        for s in range(n_ab_pods // 100):            # side-table residents
+            returns.append(view.apply("slice", make_slice(s)["key"], make_slice(s)))
+        # eager singles (encoded frames) + batched holes + no-ops +
+        # deletes + re-adds + a pre-flush insert/delete pair
+        for i in range(0, n_ab_pods, 97):
+            returns.append(view.apply("pod", make_pod(i, seq=1)["key"], make_pod(i, seq=1)))
+        returns.append(view.apply("pod", make_pod(0, seq=1)["key"], make_pod(0, seq=1)))  # identical: no-op
+        for i in range(0, n_ab_pods, 131):
+            returns.append(view.apply("pod", make_pod(i)["key"], None))
+        returns.append(view.apply("pod", "default/pod-ghost", None))     # absent: no-op
+        churn_round(view, n_ab_pods, seq=2, n=512)
+        ephemeral = json.loads('{"kind": "pod", "key": "default/pod-eph", "phase": "Pending"}')
+        returns.append(view.apply("pod", "default/pod-eph", ephemeral))  # insert...
+        returns.append(view.apply("pod", "default/pod-eph", None))       # ...delete pre-flush
+        for s in range(0, n_ab_pods // 100, 3):                          # side churn
+            obj = make_slice(s)
+            obj["ready_workers"] = 4
+            returns.append(view.apply("slice", obj["key"], obj))
+        view._ab_returns = returns
+        store.close()
+        return view
+
+    shm = "/dev/shm"
+    tmp_root = tempfile.mkdtemp(
+        prefix="bench-columnar-", dir=shm if os.path.isdir(shm) else None
+    )
+    ab = {}
+    try:
+        dir_c = os.path.join(tmp_root, "wal-columnar")
+        dir_d = os.path.join(tmp_root, "wal-dict")
+        view_c = build_ab(True, dir_c)
+        view_d = build_ab(False, dir_d)
+        ab["rv_equal"] = view_c._rv == view_d._rv
+        ab["returns_equal"] = view_c._ab_returns == view_d._ab_returns
+        ab["objects_equal"] = view_c.snapshot() == view_d.snapshot()
+        ab["json_equal"] = view_c.snapshot_bytes() == view_d.snapshot_bytes()
+        ab["msgpack_equal"] = (
+            view_c.snapshot_bytes("msgpack") == view_d.snapshot_bytes("msgpack")
+            if msgpack_available() else None
+        )
+        fr_c = view_c.read_frames_since(0, max_deltas=1 << 30)
+        fr_d = view_d.read_frames_since(0, max_deltas=1 << 30)
+        ab["frames_equal"] = (
+            fr_c.status == fr_d.status == "ok"
+            and list(fr_c.frames) == list(fr_d.frames)
+        )
+        mid_rv = view_c._rv - 300
+        rec_c = reconstruct_at(dir_c, mid_rv)
+        rec_d = reconstruct_at(dir_d, mid_rv)
+        ab["at_equal"] = rec_c == rec_d and rec_c[0] == "ok"
+        del view_c, view_d, fr_c, fr_d, rec_c, rec_d
+    finally:
+        shutil.rmtree(tmp_root, ignore_errors=True)
+    ab_ok = all(v is not False for v in ab.values())
+
+    # -- phase 2: the scale gates at n_pods --------------------------------
+    def timed_build(columnar: bool):
+        view = FleetView(compact_horizon=2048, columnar=columnar)
+        view.instance = "bench-columnar-scale"
+        t0 = time.perf_counter()
+        bulk_load(view, n_pods)
+        return view, time.perf_counter() - t0
+
+    view_c, t_build_c = timed_build(True)
+    t0 = time.perf_counter()
+    body_c = view_c.snapshot_bytes()
+    t_first_build = time.perf_counter() - t0
+    view_d, t_build_d = timed_build(False)
+    scale_json_equal = body_c == view_d.snapshot_bytes()
+    body_mb = round(len(body_c) / 1e6, 1)
+    del body_c
+
+    def cold_rebuild_best(view: FleetView) -> float:
+        best = float("inf")
+        for seq in (3, 4):
+            churn_round(view, n_pods, seq=seq, n=1)
+            t0 = time.perf_counter()
+            view.snapshot_bytes()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    def apply_under_readers(view: FleetView) -> float:
+        t0 = time.perf_counter()
+        for seq in range(10, 10 + read_rounds):
+            churn_round(view, n_pods, seq=seq, n=deltas_per_round)
+            view.snapshot_bytes()
+        return time.perf_counter() - t0
+
+    def raw_apply(view: FleetView) -> float:
+        t0 = time.perf_counter()
+        churn_round(view, n_pods, seq=99, n=raw_apply_deltas)
+        return time.perf_counter() - t0
+
+    t_snap_c = cold_rebuild_best(view_c)
+    t_snap_d = cold_rebuild_best(view_d)
+    t_work_c = apply_under_readers(view_c)
+    t_work_d = apply_under_readers(view_d)
+    t_raw_c = raw_apply(view_c)
+    t_raw_d = raw_apply(view_d)
+    view_c.snapshot_bytes()  # flush the raw churn before sizing
+    mem_c = _retained_bytes(view_c._objects)
+    mem_d = _retained_bytes(view_d._objects)
+    est_c = view_c._objects.resident_bytes()
+    del view_c, view_d
+
+    workload_deltas = read_rounds * deltas_per_round
+    speedup_apply = round(t_work_d / t_work_c, 2) if t_work_c > 0 else 0.0
+    speedup_snapshot = round(t_snap_d / t_snap_c, 2) if t_snap_c > 0 else 0.0
+    mem_ratio = round(mem_c / mem_d, 3) if mem_d > 0 else 1.0
+    ok = (
+        ab_ok
+        and scale_json_equal
+        and speedup_apply >= min_speedup
+        and speedup_snapshot >= min_speedup
+        and mem_ratio <= max_mem_ratio
+    )
+    return {
+        "ok": ok,
+        "pods": n_pods,
+        "ab_pods": n_ab_pods,
+        "ab": ab,
+        "ab_ok": ab_ok,
+        "scale_json_equal": scale_json_equal,
+        "body_mb": body_mb,
+        "apply_under_readers_per_delta_us_columnar": round(t_work_c / workload_deltas * 1e6, 1),
+        "apply_under_readers_per_delta_us_dict": round(t_work_d / workload_deltas * 1e6, 1),
+        "apply_speedup": speedup_apply,
+        "snapshot_rebuild_seconds_columnar": round(t_snap_c, 4),
+        "snapshot_rebuild_seconds_dict": round(t_snap_d, 4),
+        "snapshot_speedup": speedup_snapshot,
+        "min_speedup": min_speedup,
+        # un-gated honesty numbers: bulk load + no-reader apply run at
+        # ~parity BY DESIGN (pending-dict hot path); the gated wins are
+        # the reader-coupled paths above
+        "build_seconds_columnar": round(t_build_c, 2),
+        "build_seconds_dict": round(t_build_d, 2),
+        "first_build_seconds": round(t_first_build, 2),
+        "raw_apply_ratio": round(t_raw_d / t_raw_c, 2) if t_raw_c > 0 else 0.0,
+        "raw_apply_deltas": raw_apply_deltas,
+        "resident_mb_columnar": round(mem_c / 1e6, 1),
+        "resident_mb_dict": round(mem_d / 1e6, 1),
+        "mem_ratio": mem_ratio,
+        "max_mem_ratio": max_mem_ratio,
+        # the O(1) gauge estimate vs the deep walk (view_resident_bytes'
+        # honesty check)
+        "resident_estimate_error_pct": round((est_c - mem_c) / mem_c * 100, 1) if mem_c else 0.0,
+    }
+
+
 # -- relay tree: 2-level fan-out to 100k+ streaming subscribers ---------------
 
 
@@ -4583,6 +4874,11 @@ def main(smoke: bool = False) -> int:
         # analytics plane: batched what-if replay >= 5x the sequential
         # Python fold at 10k pods, verdicts + aggregates exactly equal
         analytics_stats = bench_analytics()
+        # columnar view core at SMOKE scale (120k pods; the 1M-pod
+        # claim is the full tier's): the full A/B identity script +
+        # WAL ?at= reconstruction + all three gates (apply-under-
+        # readers, cold rebuild, resident memory) run end to end
+        columnar_view = bench_columnar_view(n_pods=120_000, n_ab_pods=8000)
         # multi-process ingest: 4 REAL reader processes x the prefilter-
         # first decode path -> pipe wire -> parent pipeline/dispatcher;
         # the >=100k full-stack gate + exact-fold correctness (~10 s)
@@ -4619,6 +4915,10 @@ def main(smoke: bool = False) -> int:
         fanin_sharded = bench_fanin_sharded()
         health_stats = bench_health(ticks=80)
         analytics_stats = bench_analytics(n_scenarios=12)
+        # the ISSUE's million-object fleet gate: byte-identity first,
+        # then >=5x apply-under-readers + >=5x cold rebuild + <=0.5x
+        # resident memory vs the dict core, all in the same run
+        columnar_view = bench_columnar_view()
         ingest_procs = bench_ingest_procs(tiles=160)
         prefilter_ab = bench_ingest_prefilter_ab()
         scan_stats = bench_frame_scan()
@@ -4647,6 +4947,7 @@ def main(smoke: bool = False) -> int:
         "fanin_sharded": fanin_sharded,
         "health": health_stats,
         "analytics": analytics_stats,
+        "columnar_view": columnar_view,
         "ingest_procs": ingest_procs,
         "ingest_prefilter_ab": prefilter_ab,
         "frame_scan": scan_stats,
@@ -4765,6 +5066,10 @@ def main(smoke: bool = False) -> int:
         # just the throughput
         "analytics_ok": analytics_stats.get("ok", False),
         "analytics_speedup": analytics_stats.get("speedup"),
+        # columnar view core: ok = same-run A/B byte-identity (wire
+        # frames, both snapshot codecs, ?at=) AND the speed/memory
+        # gates; the component numbers ride the detail artifact
+        "columnar_ok": columnar_view.get("ok", False),
         "relist_10k_ms": relist_stats.get("relist_ms"),
         "relist_shard_speedup": relist_stats.get("shard_speedup"),
         "checkpoint_10k_flush_ms": checkpoint_stats.get("flush_ms_median"),
@@ -4806,9 +5111,14 @@ def main(smoke: bool = False) -> int:
         # ... and the two fanin_sharded fields pushed it again:
         # vs_baseline is derivable from value (target_ms / value) and
         # rides the detail artifact + the full tier
+        # ... and columnar_ok pushed it once more: the single-process
+        # fan-in rate is superseded by fanin_deltas_per_sec as the
+        # headline rate (its ok verdict stays; the number rides
+        # details.federation.fanin_ramp.max_sustained_deltas_per_sec)
         for key in (
             "relist_shard_speedup", "checkpoint_10k_mb",
             "checkpoint_10k_flush_ms", "vs_baseline",
+            "federation_fanin_deltas_per_sec",
         ):
             headline.pop(key, None)
         # the probe tiers are skipped wholesale in smoke; their

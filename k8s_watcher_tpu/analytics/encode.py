@@ -165,6 +165,68 @@ def _pod_row(obj: Mapping[str, Any], nodes: Interner, clusters: Interner) -> Tup
     )
 
 
+def build_slice_tables(
+    slices: Mapping[str, Mapping[str, Any]],
+    nodes: Interner,
+    clusters: Interner,
+) -> Dict[str, Any]:
+    """Build the slice + slice-worker columns from a keyed slice-object
+    map: the ``w_*``/``s_*``/``slice_names`` kwargs of ``FleetColumns``.
+
+    THE one spelling of the slice-table semantics (row order = sorted
+    keys, ``worker_up`` readiness, cluster/node interning), shared by
+    the analytics-edge ``FleetEncoder`` and the serve-core
+    ``ColumnarStore`` — crosscheck exactness between those two paths
+    holds by construction because they run this same function."""
+    slice_names = tuple(sorted(slices))
+    slice_row = {name: i for i, name in enumerate(slice_names)}
+    s_expected = np.empty(len(slice_names), dtype=np.int32)
+    s_observed = np.empty(len(slice_names), dtype=np.int32)
+    s_ready = np.empty(len(slice_names), dtype=np.int32)
+    s_phase = np.empty(len(slice_names), dtype=np.int32)
+    s_cluster = np.empty(len(slice_names), dtype=np.int32)
+    s_chips = np.empty(len(slice_names), dtype=np.int32)
+    w_slice: List[int] = []
+    w_node: List[int] = []
+    w_cluster: List[int] = []
+    w_up: List[int] = []
+    w_chips: List[int] = []
+    for name in slice_names:
+        obj = slices[name]
+        i = slice_row[name]
+        expected = obj.get("expected_workers")
+        chips_per_worker = int(obj.get("chips_per_worker") or 0)
+        cluster = clusters.code(str(obj.get("cluster") or LOCAL_CLUSTER))
+        s_expected[i] = -1 if expected is None else int(expected)
+        s_observed[i] = int(obj.get("observed_workers") or 0)
+        s_ready[i] = int(obj.get("ready_workers") or 0)
+        s_phase[i] = SLICE_PHASE_CODE.get(obj.get("phase") or "Forming", 0)
+        s_cluster[i] = cluster
+        s_chips[i] = chips_per_worker
+        for worker in obj.get("workers") or ():
+            node = worker.get("node")
+            up = worker_up(worker)
+            w_slice.append(i)
+            w_node.append(nodes.code(str(node)) if node else -1)
+            w_cluster.append(cluster)
+            w_up.append(1 if up else 0)
+            w_chips.append(chips_per_worker)
+    return {
+        "w_slice": np.asarray(w_slice, dtype=np.int32),
+        "w_node": np.asarray(w_node, dtype=np.int32),
+        "w_cluster": np.asarray(w_cluster, dtype=np.int32),
+        "w_up": np.asarray(w_up, dtype=np.int32),
+        "w_chips": np.asarray(w_chips, dtype=np.int32),
+        "s_expected": s_expected,
+        "s_observed": s_observed,
+        "s_ready": s_ready,
+        "s_phase": s_phase,
+        "s_cluster": s_cluster,
+        "s_chips_per_worker": s_chips,
+        "slice_names": slice_names,
+    }
+
+
 class FleetEncoder:
     """The incremental columnar store behind the analytics plane."""
 
@@ -268,56 +330,12 @@ class FleetEncoder:
         dirty generation, shared by reference afterwards."""
         if not self._dirty and self._cols is not None:
             return self._cols
-        slice_names = tuple(sorted(self._slices))
-        slice_row = {name: i for i, name in enumerate(slice_names)}
-        s_expected = np.empty(len(slice_names), dtype=np.int32)
-        s_observed = np.empty(len(slice_names), dtype=np.int32)
-        s_ready = np.empty(len(slice_names), dtype=np.int32)
-        s_phase = np.empty(len(slice_names), dtype=np.int32)
-        s_cluster = np.empty(len(slice_names), dtype=np.int32)
-        s_chips = np.empty(len(slice_names), dtype=np.int32)
-        w_slice: List[int] = []
-        w_node: List[int] = []
-        w_cluster: List[int] = []
-        w_up: List[int] = []
-        w_chips: List[int] = []
-        for name in slice_names:
-            obj = self._slices[name]
-            i = slice_row[name]
-            expected = obj.get("expected_workers")
-            chips_per_worker = int(obj.get("chips_per_worker") or 0)
-            cluster = self.clusters.code(str(obj.get("cluster") or LOCAL_CLUSTER))
-            s_expected[i] = -1 if expected is None else int(expected)
-            s_observed[i] = int(obj.get("observed_workers") or 0)
-            s_ready[i] = int(obj.get("ready_workers") or 0)
-            s_phase[i] = SLICE_PHASE_CODE.get(obj.get("phase") or "Forming", 0)
-            s_cluster[i] = cluster
-            s_chips[i] = chips_per_worker
-            for worker in obj.get("workers") or ():
-                node = worker.get("node")
-                up = worker_up(worker)
-                w_slice.append(i)
-                w_node.append(self.nodes.code(str(node)) if node else -1)
-                w_cluster.append(cluster)
-                w_up.append(1 if up else 0)
-                w_chips.append(chips_per_worker)
         self._cols = FleetColumns(
             pod_phase=np.asarray(self._pod_phase, dtype=np.int32),
             pod_ready=np.asarray(self._pod_ready, dtype=np.int32),
             pod_node=np.asarray(self._pod_node, dtype=np.int32),
             pod_cluster=np.asarray(self._pod_cluster, dtype=np.int32),
-            w_slice=np.asarray(w_slice, dtype=np.int32),
-            w_node=np.asarray(w_node, dtype=np.int32),
-            w_cluster=np.asarray(w_cluster, dtype=np.int32),
-            w_up=np.asarray(w_up, dtype=np.int32),
-            w_chips=np.asarray(w_chips, dtype=np.int32),
-            s_expected=s_expected,
-            s_observed=s_observed,
-            s_ready=s_ready,
-            s_phase=s_phase,
-            s_cluster=s_cluster,
-            s_chips_per_worker=s_chips,
-            slice_names=slice_names,
+            **build_slice_tables(self._slices, self.nodes, self.clusters),
             nodes=self.nodes,
             clusters=self.clusters,
         )

@@ -89,11 +89,24 @@ class AnalyticsPlane:
 
     # -- keeping the columns current --------------------------------------
 
-    def _refresh_locked(self) -> int:
-        """Fold everything the view published since the last request;
-        returns the rv the columns now reflect."""
+    def _refresh_locked(self):
+        """Bring the columns current; returns ``(rv, cols)``.
+
+        Columnar view core: the view's storage IS the columns — the
+        whole subscription protocol here (delta folds, GONE/INVALID
+        re-encodes, the shadow encoder) collapses to one shared-handle
+        read, materialized by the store at most once per dirty
+        generation. The encoder protocol below remains the dict core's
+        path (``serve.columnar: off``)."""
         t0 = time.perf_counter()
         view = self.view
+        if getattr(view, "columnar", False) and hasattr(view, "fleet_columns"):
+            rv, cols = view.fleet_columns()
+            self._rv = rv
+            self._instance = view.instance
+            if self._encode_seconds is not None:
+                self._encode_seconds.record(time.perf_counter() - t0)
+            return rv, cols
         if self._rv is not None and self._instance == view.instance:
             result = view.read_since(self._rv, max_deltas=REFRESH_MAX_DELTAS)
             if result.status == "ok":
@@ -107,7 +120,7 @@ class AnalyticsPlane:
                     self._encoder_deltas.inc(len(result.deltas))
                 if self._encode_seconds is not None:
                     self._encode_seconds.record(time.perf_counter() - t0)
-                return self._rv
+                return self._rv, self.encoder.columns()
             # GONE (fell behind the horizon between requests) or INVALID
             # (view restarted under us): fall through to the full walk
         rv, tables = view.snapshot_tables()
@@ -118,7 +131,7 @@ class AnalyticsPlane:
             self._encoder_resets.inc()
         if self._encode_seconds is not None:
             self._encode_seconds.record(time.perf_counter() - t0)
-        return rv
+        return rv, self.encoder.columns()
 
     # -- the request surface ----------------------------------------------
 
@@ -126,8 +139,7 @@ class AnalyticsPlane:
         """The no-scenario ``GET /serve/analytics`` body: fleet rollup +
         quorum/capacity stance + the declared scenario vocabulary."""
         with self._lock:
-            rv = self._refresh_locked()
-            cols = self.encoder.columns()
+            rv, cols = self._refresh_locked()
             t0 = time.perf_counter()
             body = evaluate_scenarios(cols, [Scenario("baseline")], self.kernels)
             phase_counts = self.kernels.pod_phase_counts(cols)
@@ -169,8 +181,7 @@ class AnalyticsPlane:
             raw_scenarios, max_scenarios=self.config.max_scenarios
         )
         with self._lock:
-            rv = self._refresh_locked()
-            cols = self.encoder.columns()
+            rv, cols = self._refresh_locked()
             t0 = time.perf_counter()
             body = evaluate_scenarios(cols, scenarios, self.kernels)
             check = self._crosscheck_locked(cols)

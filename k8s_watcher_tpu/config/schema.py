@@ -855,13 +855,18 @@ class ServeConfig:
     # unsent frames queue up to this, then the loop stops pulling for it
     # and its lag resolves through read-time latest-wins compaction
     sub_buffer_bytes: int = 1 << 20
+    # fleet-state core selector: "auto"/"on" = the columnar core
+    # (serve/columns.py — parts + int columns, the million-object
+    # representation), "off" = the legacy dict-of-dicts core (the A/B
+    # reference; byte-identical wire either way)
+    columnar: str = "auto"
 
     @classmethod
     def from_raw(cls, raw: Mapping[str, Any]) -> "ServeConfig":
         _check_known(
             raw,
             ("enabled", "port", "max_subscribers", "queue_depth", "compact_horizon",
-             "io_threads", "sub_buffer_bytes"),
+             "io_threads", "sub_buffer_bytes", "columnar"),
             "serve",
         )
         port = _opt_int(raw, "port", "serve", 0)
@@ -895,6 +900,13 @@ class ServeConfig:
                 f"config key 'serve.sub_buffer_bytes': must be >= 4096 (one "
                 f"outbound buffer must hold at least a frame), got {sub_buffer_bytes}"
             )
+        columnar = raw.get("columnar", "auto")
+        if columnar not in VALID_COLUMNAR_MODES:
+            raise SchemaError(
+                f"config key 'serve.columnar': must be one of "
+                f"{'/'.join(VALID_COLUMNAR_MODES)} ('auto' = on; 'off' keeps the "
+                f"legacy dict-of-dicts core), got {columnar!r}"
+            )
         return cls(
             enabled=_opt_bool(raw, "enabled", "serve", False),
             port=port,
@@ -903,7 +915,12 @@ class ServeConfig:
             compact_horizon=compact_horizon,
             io_threads=io_threads,
             sub_buffer_bytes=sub_buffer_bytes,
+            columnar=columnar,
         )
+
+
+#: accepted serve.columnar modes ("auto" resolves to the columnar core)
+VALID_COLUMNAR_MODES = ("auto", "on", "off")
 
 
 #: accepted history.fsync policies (mirrored by history/wal.py)

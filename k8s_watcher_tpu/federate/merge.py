@@ -116,9 +116,31 @@ class GlobalMerge:
         recovered view, or the first reconcile cannot delete objects that
         vanished upstream during the outage (ghost objects served forever),
         ``drop_cluster`` pops an empty set, and the merged-object gauge
-        reads 0 against a populated view. Returns the seeded count."""
-        _, objects = self.view.snapshot()
+        reads 0 against a populated view. Returns the seeded count.
+
+        On a columnar view this reads ``federated_keys()`` — cluster
+        membership answered off the int cluster column, no O(fleet)
+        object reconstruction just to drop all but the ``cluster`` and
+        ``key`` fields. The origin key is recovered from the global key
+        (``_decorate`` mints ``origin_key == split_global_key(key)[1]``,
+        so the derivation is exact for anything it decorated). The dict
+        core walks objects as before."""
         seeded = 0
+        if hasattr(self.view, "federated_keys"):
+            with self._lock:
+                for kind, gkey, cluster in self.view.federated_keys():
+                    _, origin = split_global_key(gkey)
+                    if not origin:
+                        continue
+                    keys = self._keys.setdefault(cluster, set())
+                    entry = (kind or "pod", origin)
+                    if entry not in keys:
+                        keys.add(entry)
+                        self._count += 1
+                    seeded += 1
+                self._set_gauge_locked()
+            return seeded
+        _, objects = self.view.snapshot()
         with self._lock:
             for obj in objects:
                 cluster = obj.get("cluster")

@@ -186,26 +186,39 @@ class HealthPlane:
         return self.detector._ticks_counter
 
     def _collect_phase(self, observations: List[Observation]) -> None:
-        """Per-node phase-transition latencies off the FleetView's bulk
-        per-kind tables (``snapshot_tables`` — ONE object walk per rv,
-        cached on the view and shared with the analytics encoder, so two
-        per-tick consumers cost one classification pass between them)."""
+        """Per-node phase-transition latencies off the view's fleet
+        state. Columnar core: the zero-copy ``fleet_handle`` — per-pod
+        key/phase/node sequences decoded from the int columns at most
+        once per dirty generation, no per-kind object tables built at
+        all (phases normalized to the fixed POD_PHASES vocabulary).
+        Dict core: the bulk per-kind ``snapshot_tables`` walk (one
+        object walk per rv, cached on the view). Identical transition
+        logic either way — the columnar smoke gates verdict identity."""
         now = time.monotonic()
-        _rv, tables = self.view.snapshot_tables()
+        view = self.view
+        if getattr(view, "columnar", False) and hasattr(view, "fleet_handle"):
+            _rv, handle = view.fleet_handle()
+            slice_objs = handle.slices
+            live_keys = set(handle.keys)
+            pod_triples = zip(handle.keys, handle.phases, handle.nodes)
+        else:
+            _rv, tables = view.snapshot_tables()
+            slice_objs = tables.get("slice", ())
+            pods = tables.get("pod", ())
+            live_keys = {obj.get("key") for obj in pods}
+            pod_triples = (
+                (obj.get("key"), obj.get("phase") or "Unknown", obj.get("node"))
+                for obj in pods
+            )
         node_slice: Dict[str, str] = {}
-        for obj in tables.get("slice", ()):
+        for obj in slice_objs:
             for worker in obj.get("workers") or ():
                 node = worker.get("node")
                 if node:
                     node_slice[node] = str(obj.get("key") or obj.get("slice") or "")
-        pods = tables.get("pod", ())
-        live_keys = {obj.get("key") for obj in pods}
         pending_age: Dict[str, float] = {}
         live_nodes = set()
-        for obj in pods:
-            key = obj.get("key")
-            phase = obj.get("phase") or "Unknown"
-            node = obj.get("node")
+        for key, phase, node in pod_triples:
             if node:
                 live_nodes.add(node)
             prev = self._pods.get(key)

@@ -19,6 +19,17 @@ The contract that makes restart-surviving resume tokens possible:
   the preloaded journal 410 — the same compaction-horizon semantics as
   steady state, now applied across restarts.
 
+Columnar view core: the recovered ``objects`` dict seeds
+``FleetView.restore`` which, on the columnar core, reseeds the store's
+columns IN PLACE — pods land in the lazy pending buffer (no O(fleet)
+``json.dumps`` on the boot path; the first snapshot-body build pays the
+serialization it was going to pay anyway) and the node/cluster interners
+keep their codes across the restore, so any cached analytics
+materializations stay decodable. The fold order below (snapshot objects,
+then deltas in rv order) is exactly the dict-insertion order the dict
+core would have ended with, which is what keeps post-restore snapshot
+bodies byte-identical across the two cores.
+
 Tear handling: a crash tears at most the tail of the *active* segment
 (one buffered write per drain), which the writer truncates on reopen. A
 torn *sealed* segment (bit rot, foreign truncation) does not end the

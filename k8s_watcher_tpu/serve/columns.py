@@ -1,0 +1,741 @@
+"""Columnar fleet-state store: the million-object core behind FleetView.
+
+PR 12 proved the columnar-int32-table + interner method at the analytics
+edge (``analytics/encode.py``, ~9.4x batched speedup) — but the tables
+there are a *cache* rebuilt from the dict-of-dicts view. This module
+promotes the representation to the CORE: ``ColumnarStore`` is the
+fleet-state storage itself, and every O(fleet) reader — snapshot bodies,
+health phase scans, the analytics kernels, federation reseeds — reads
+the same arrays instead of re-walking a million Python dicts.
+
+Layout
+------
+
+Pods (the million-row kind) live in append-only columnar rows:
+
+- ``_parts[row]``: the pod's serialized JSON fragment, stored WITH its
+  leading ``b", "`` element separator so the ``GET /serve/fleet`` body
+  is a header + one ``b"".join`` over the parts — byte-identical to
+  ``json.dumps`` of the dict core's body (default separators), built in
+  O(rows) C-speed joins instead of O(fleet) re-serialization.
+- int columns (``phase``/``ready``/``node``/``cluster``) in
+  capacity-doubling numpy arrays, codes drawn from the same fixed
+  POD_PHASES vocabulary and stable ``Interner`` dictionaries the
+  analytics encoder uses — health/analytics/SLO readers get these
+  arrays zero-copy (materialized at most once per dirty generation).
+- ``_rows``: key -> row. Deletes TOMBSTONE the row (empty part,
+  phase -1) instead of swap-removing it, because row order is the
+  body's object order and must reproduce the dict core's insertion
+  order byte-for-byte; tombstones are reclaimed by an amortized
+  order-preserving compaction once they outnumber half the table.
+
+Everything else — slice aggregates, probe verdicts, and the rare pod
+object that does not round-trip through JSON — stays object-shaped in a
+side table, each entry pinned to an ``anchor`` (the pod row index it
+was inserted before) so body assembly interleaves kinds in exact dict
+insertion order.
+
+Write path: ``upsert()`` is LAZY — the object lands in a pending map
+(one dict write, the same cost the dict core pays) and serialization is
+deferred to the next flush, which every reader triggers first. A key
+overwritten many times between reads is serialized once; the dumps a
+changed key pays at read time is the same dumps the snapshot body
+needed anyway. Identical-upsert dedup is exact dict-core parity:
+pending entries compare dict==dict; flushed rows compare fragment
+bytes, with a parse-and-compare fallback when lengths match so a
+key-order-shuffled-but-equal object still refuses to burn an rv.
+
+Object fidelity caveat (documented in ARCHITECTURE.md): flushed pods
+are canonicalized through JSON — an object holding tuples or non-string
+dict keys would not survive the round trip, so any pod object that
+fails or lies under ``json.dumps`` is kept object-shaped in the side
+table instead (correctness over the fast path). The real pipeline only
+ever stores JSON-decoded objects, so production rows all columnize.
+
+Concurrency contract: the OWNER (FleetView) serializes every call under
+its publish lock. Readers take a cheap structural snapshot
+(``snapshot_parts`` — a flush plus list copies) under the lock and do
+O(fleet) assembly/reconstruction OUTSIDE it; parts bytes are immutable
+and side objects are replaced-never-mutated, so the snapshot stays
+consistent while publishes continue.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Iterator, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from k8s_watcher_tpu.analytics.encode import (
+    LOCAL_CLUSTER,
+    POD_PHASES,
+    POD_PHASE_CODE,
+    FleetColumns,
+    Interner,
+    build_slice_tables,
+)
+
+#: the one pod kind the columnar table owns; every other kind (and the
+#: rare non-JSON-faithful pod) lives in the anchored side table
+POD_KIND = "pod"
+
+#: element separator baked into every stored fragment (json.dumps
+#: default separators — the PR-4 golden byte contract)
+SEP = b", "
+
+#: CPython bytes-object overhead, for the resident-bytes estimate
+_BYTES_OVERHEAD = 33
+#: rough per-entry dict/str bookkeeping (hash table slot + str header)
+_KEY_OVERHEAD = 130
+
+_dumps = json.dumps
+_loads = json.loads
+
+
+def _fragment(obj: Dict[str, Any]) -> bytes:
+    """``obj``'s body fragment (no separator) — byte-identical to its
+    slice of ``json.dumps`` over the whole body."""
+    return _dumps(obj).encode()
+
+
+def _side_fragment(obj: Dict[str, Any]) -> Optional[bytes]:
+    """``SEP + fragment`` for a side-table entry, or ``None`` when the
+    object does not serialize — taking a structural snapshot must never
+    raise (the side table is where non-JSON-faithful objects are pinned
+    object-shaped); only the JSON body assembly may, at build time."""
+    try:
+        return SEP + _fragment(obj)
+    except (TypeError, ValueError):
+        return None
+
+
+class BodySnapshot(NamedTuple):
+    """One consistent structural snapshot (taken under the publish
+    lock, consumed outside it): the pod parts in row order (tombstones
+    are empty), the side entries as ``(anchor, fragment, kind, key,
+    obj)`` sorted into body order, and the live object count."""
+
+    parts: List[bytes]
+    sides: List[Tuple[int, bytes, str, str, Dict[str, Any]]]
+    count: int
+    keys: Optional[List[Optional[str]]]  # row -> pod key (when requested)
+
+
+class PodHandle(NamedTuple):
+    """The health plane's zero-copy read handle: parallel per-pod
+    sequences (alive rows only, side-table pods appended) plus the live
+    slice objects — no per-kind dict tables, shared per generation.
+    Phases are normalized to the fixed POD_PHASES vocabulary."""
+
+    keys: List[str]
+    phases: List[str]
+    nodes: List[Optional[str]]
+    slices: List[Dict[str, Any]]
+
+
+class ColumnarStore:
+    """Append/tombstone columnar fleet store with dict-of-dicts
+    semantics (insertion order, identical-upsert dedup) — see module
+    docstring. NOT thread-safe; the owning FleetView serializes calls
+    under its publish lock."""
+
+    def __init__(self) -> None:
+        self.nodes = Interner()
+        self.clusters = Interner()
+        self.clusters.code(LOCAL_CLUSTER)  # code 0 = the local cluster
+        # flushed pod rows
+        self._rows: Dict[str, int] = {}  # live keys only
+        self._parts: List[bytes] = []  # b", "+fragment; b"" = tombstone
+        cap = 1024
+        self._phase = np.full(cap, -1, dtype=np.int8)
+        self._ready = np.zeros(cap, dtype=np.int8)
+        self._node = np.zeros(cap, dtype=np.int32)
+        self._cluster = np.zeros(cap, dtype=np.int32)
+        self._arr_len = 0  # arrays are valid for rows [0, _arr_len)
+        self._dead = 0  # tombstoned rows awaiting compaction
+        # lazy write buffer: key -> obj (upserts only; deletes are eager)
+        self._pending: Dict[str, Dict[str, Any]] = {}
+        self._pending_new = 0  # pending keys with no flushed row yet
+        # anchored side table: (kind, key) -> (anchor, obj). anchor =
+        # the pod row index this entry sorts before (dict insertion
+        # order across kinds); non-decreasing in insertion order.
+        self._side: Dict[Tuple[str, str], Tuple[int, Dict[str, Any]]] = {}
+        # generation: bumps on every logical mutation (not on flush);
+        # keys the materialization caches below
+        self._gen = 0
+        self._cols: Optional[FleetColumns] = None
+        self._cols_gen = -1
+        self._handle: Optional[PodHandle] = None
+        self._handle_gen = -1
+        # incrementally-maintained resident estimate (view_resident_bytes)
+        self._parts_bytes = 0  # sum of len(part) over live+dead rows
+        self._keys_bytes = 0  # key strings + per-entry bookkeeping
+
+    # -- write path (owner-locked) ----------------------------------------
+
+    def upsert(self, kind: str, key: str, obj: Dict[str, Any]) -> bool:
+        """Insert/replace one object. Returns False for the identical
+        no-op (dict-core dedup parity: no rv burn)."""
+        if kind != POD_KIND:
+            return self._side_upsert(kind, key, obj)
+        sk = (POD_KIND, key)
+        if sk in self._side:  # non-JSON-faithful pod pinned object-shaped
+            anchor, prev = self._side[sk]
+            if prev == obj:
+                return False
+            self._side[sk] = (anchor, obj)
+            self._gen += 1
+            return True
+        pend = self._pending.get(key)
+        if pend is not None:
+            if pend == obj:
+                return False
+            self._pending[key] = obj
+            self._gen += 1
+            return True
+        row = self._rows.get(key)
+        if row is None:
+            self._pending[key] = obj
+            self._pending_new += 1
+            self._gen += 1
+            return True
+        # flushed row: exact dedup against the stored fragment
+        try:
+            frag = _fragment(obj)
+        except (TypeError, ValueError):
+            # does not serialize: it cannot equal the (serialized) row.
+            # Tombstone the row and pin the object in the side table at
+            # the SAME position (anchor = the row index) — overwrite
+            # must not move the object to the end.
+            self._tombstone(key, row)
+            self._side[sk] = (row, obj)
+            self._gen += 1
+            return True
+        old = self._parts[row]
+        if len(old) - len(SEP) == len(frag):
+            if old[len(SEP):] == frag:
+                return False
+            # same length, different bytes: a reordered-but-equal dict
+            # still must not mint a delta (dict-core parity)
+            if _loads(old[len(SEP):]) == obj:
+                return False
+        self._set_row(row, SEP + frag, obj)
+        self._gen += 1
+        return True
+
+    def delete(self, kind: str, key: str) -> bool:
+        """Remove one object. Returns False when absent (dict-core
+        parity: no rv burn for deleting nothing)."""
+        if kind != POD_KIND:
+            if self._side.pop((kind, key), None) is None:
+                return False
+            self._gen += 1
+            return True
+        if self._side.pop((POD_KIND, key), None) is not None:
+            self._gen += 1
+            return True
+        if key in self._pending and key not in self._rows:
+            # a never-flushed insert. When no side anchor counts a
+            # pending row (anchors are minted as len(parts)+pending_new,
+            # so only anchors PAST len(parts) reference pending
+            # positions), this is a plain dict pop — dict-core
+            # semantics, zero flush. That keeps a churning pods-only
+            # stream (the fan-in shape: interleaved upserts/deletes, no
+            # reader between batches) entirely on the pending buffer's
+            # dict-equality dedup path instead of flushing the working
+            # set into rows whose every later update pays a json.dumps.
+            if all(anchor <= len(self._parts)
+                   for anchor, _obj in self._side.values()):
+                self._pending.pop(key)
+                self._pending_new -= 1
+                self._gen += 1
+                return True
+            # a side anchor references a pending position: materialize
+            # the whole pending set first so row order (and every side
+            # anchor counted against it) stays exactly dict insertion
+            # order, then tombstone
+            self._flush()
+        elif key in self._pending:
+            self._pending.pop(key)  # discard the pending overwrite
+        row = self._rows.get(key)
+        if row is None:
+            return False
+        self._tombstone(key, row)
+        self._gen += 1
+        if self._dead > 1024 and self._dead * 2 > len(self._parts):
+            self._compact()
+        return True
+
+    def reseed(self, objects) -> None:
+        """Adopt a full ``{(kind, key): obj}`` state (restore()/relay
+        adopt). Interners are KEPT — codes stay stable across reseeds,
+        the same contract the analytics encoder's ``reset`` keeps —
+        and nothing is serialized here (a restart must not pay O(fleet)
+        dumps before serving; the first body build flushes lazily)."""
+        self._rows.clear()
+        self._parts.clear()
+        self._phase[: self._arr_len] = -1
+        self._arr_len = 0
+        self._dead = 0
+        self._pending.clear()
+        self._pending_new = 0
+        self._side.clear()
+        self._parts_bytes = 0
+        self._keys_bytes = 0
+        for (kind, key), obj in objects.items():
+            if kind == POD_KIND:
+                self._pending[key] = obj
+                self._pending_new += 1
+            else:
+                self._side[(kind, key)] = (self._anchor(), obj)
+        self._gen += 1
+
+    def _side_upsert(self, kind: str, key: str, obj: Dict[str, Any]) -> bool:
+        sk = (kind, key)
+        prev = self._side.get(sk)
+        if prev is not None:
+            if prev[1] == obj:
+                return False
+            self._side[sk] = (prev[0], obj)  # replace keeps its position
+        else:
+            self._side[sk] = (self._anchor(), obj)
+        self._gen += 1
+        return True
+
+    def _anchor(self) -> int:
+        """The pod row index the next inserted side entry sorts before:
+        every pod inserted so far — flushed rows (dead ones still hold
+        their order slot) plus pending first-inserts."""
+        return len(self._parts) + self._pending_new
+
+    def _tombstone(self, key: str, row: int) -> None:
+        self._rows.pop(key, None)
+        old = self._parts[row]
+        self._parts[row] = b""
+        if row < self._arr_len:
+            self._phase[row] = -1
+        self._parts_bytes -= len(old)
+        self._keys_bytes -= _KEY_OVERHEAD + len(key)
+        self._dead += 1
+
+    def _set_row(self, row: int, part: bytes, obj: Dict[str, Any]) -> None:
+        self._parts_bytes += len(part) - len(self._parts[row])
+        self._parts[row] = part
+        self._phase[row] = POD_PHASE_CODE.get(obj.get("phase") or "Unknown", 0)
+        self._ready[row] = 1 if obj.get("ready") else 0
+        node = obj.get("node")
+        self._node[row] = self.nodes.code(str(node)) if node else -1
+        self._cluster[row] = self.clusters.code(str(obj.get("cluster") or LOCAL_CLUSTER))
+
+    # -- flush (pending -> columns; every reader's first step) -------------
+
+    def _grow(self, need: int) -> None:
+        cap = len(self._phase)
+        if need <= cap:
+            return
+        while cap < need:
+            cap *= 2
+        for name in ("_phase", "_ready", "_node", "_cluster"):
+            old = getattr(self, name)
+            fresh = np.full(cap, -1, dtype=old.dtype) if name == "_phase" else np.zeros(cap, dtype=old.dtype)
+            fresh[: self._arr_len] = old[: self._arr_len]
+            setattr(self, name, fresh)
+
+    def _flush(self) -> None:
+        """Serialize the pending buffer into rows. Amortized: O(keys
+        changed since the last reader), each dumps paid at most once per
+        changed key per read cycle — the same dumps the snapshot body
+        was going to spend."""
+        if not self._pending:
+            return
+        pending, self._pending = self._pending, {}
+        self._pending_new = 0
+        new_phase: List[int] = []
+        new_ready: List[int] = []
+        new_node: List[int] = []
+        new_cluster: List[int] = []
+        nodes_code = self.nodes.code
+        clusters_code = self.clusters.code
+        for key, obj in pending.items():
+            row = self._rows.get(key)
+            try:
+                part = SEP + _fragment(obj)
+            except (TypeError, ValueError):
+                # non-JSON-faithful: pin object-shaped at its position
+                if row is not None:
+                    self._tombstone(key, row)
+                    self._side[(POD_KIND, key)] = (row, obj)
+                else:
+                    self._side[(POD_KIND, key)] = (len(self._parts), obj)
+                continue
+            if row is None:
+                self._rows[key] = len(self._parts)
+                self._parts.append(part)
+                self._parts_bytes += len(part)
+                self._keys_bytes += _KEY_OVERHEAD + len(key)
+                new_phase.append(POD_PHASE_CODE.get(obj.get("phase") or "Unknown", 0))
+                new_ready.append(1 if obj.get("ready") else 0)
+                node = obj.get("node")
+                new_node.append(nodes_code(str(node)) if node else -1)
+                new_cluster.append(clusters_code(str(obj.get("cluster") or LOCAL_CLUSTER)))
+            else:
+                self._set_row(row, part, obj)
+        if new_phase:
+            n = self._arr_len
+            m = len(new_phase)
+            self._grow(n + m)
+            self._phase[n : n + m] = new_phase
+            self._ready[n : n + m] = new_ready
+            self._node[n : n + m] = new_node
+            self._cluster[n : n + m] = new_cluster
+        self._arr_len = len(self._parts)
+
+    def _compact(self) -> None:
+        """Amortized order-preserving tombstone reclaim: rewrite rows
+        keeping insertion order, remap the key index and side anchors.
+        O(rows), triggered only once tombstones outnumber live rows."""
+        self._flush()
+        n = len(self._parts)
+        mask = self._phase[:n] >= 0
+        idx = np.flatnonzero(mask)
+        before = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(mask, out=before[1:])
+        new_of_old = before[1:] - 1  # new row of each alive old row
+        self._parts = [self._parts[i] for i in idx.tolist()]
+        m = len(self._parts)
+        for name in ("_phase", "_ready", "_node", "_cluster"):
+            old = getattr(self, name)
+            fresh = np.full(max(1024, m), -1, dtype=old.dtype) if name == "_phase" else np.zeros(max(1024, m), dtype=old.dtype)
+            fresh[:m] = old[:n][mask]
+            setattr(self, name, fresh)
+        self._arr_len = m
+        for key, row in self._rows.items():
+            self._rows[key] = int(new_of_old[row])
+        if self._side:
+            self._side = {
+                sk: (int(before[min(anchor, n)]), obj)
+                for sk, (anchor, obj) in self._side.items()
+            }
+        self._dead = 0
+
+    # -- structural snapshots (owner-locked; assembly happens outside) ----
+
+    def snapshot_parts(self, *, with_keys: bool = False) -> BodySnapshot:
+        """Flush and hand out a consistent body-order snapshot: list
+        copies only — parts bytes are immutable and side objects are
+        replaced-never-mutated, so the caller assembles/reconstructs
+        OUTSIDE the publish lock."""
+        self._flush()
+        # key on the anchor ALONE: equal anchors (consecutive side
+        # inserts with no pod flushed between) must keep side-table
+        # insertion order — the dict core's order. The stable sort over
+        # the insertion-ordered dict gives exactly that; a full-tuple
+        # sort would break ties on fragment BYTES ("slice-10" before
+        # "slice-2"). Anchors are non-decreasing in insertion order
+        # (parts only shrink in _compact, which remaps monotonically),
+        # so anchor-then-insertion IS body order.
+        # fragments are computed TOLERANTLY (None when the object does
+        # not serialize): the side table is exactly where non-JSON-
+        # faithful objects live pinned object-shaped, and the object-
+        # shaped readers (iter_snapshot_objects, the msgpack assembly)
+        # must keep serving them — dict-core parity, where snapshot()
+        # works and only the body json.dumps raises. _body_chunks
+        # re-raises at JSON-body-build time.
+        sides = sorted(
+            ((anchor, _side_fragment(obj), kind, key, obj)
+             for (kind, key), (anchor, obj) in self._side.items()),
+            key=lambda entry: entry[0],
+        ) if self._side else []
+        keys: Optional[List[Optional[str]]] = None
+        if with_keys:
+            keys = [None] * len(self._parts)
+            for key, row in self._rows.items():
+                keys[row] = key
+        return BodySnapshot(
+            parts=self._parts.copy(),
+            sides=sides,
+            count=len(self._rows) + len(self._side),
+            keys=keys,
+        )
+
+    # -- zero-copy reader handles ------------------------------------------
+
+    def fleet_columns(self) -> FleetColumns:
+        """The analytics plane's arrays, materialized at most once per
+        dirty generation (the FleetEncoder contract, now served by the
+        storage itself): alive pod rows masked out of the columns,
+        side-table pods appended, slice/worker tables built from the
+        live slice objects through the same shared builder."""
+        self._flush()
+        if self._cols is not None and self._cols_gen == self._gen:
+            return self._cols
+        n = self._arr_len
+        mask = self._phase[:n] >= 0
+        pod_phase = self._phase[:n][mask].astype(np.int32)
+        pod_ready = self._ready[:n][mask].astype(np.int32)
+        pod_node = self._node[:n][mask].copy()
+        pod_cluster = self._cluster[:n][mask].copy()
+        slices: Dict[str, Dict[str, Any]] = {}
+        extra: List[Tuple[int, int, int, int]] = []
+        for (kind, key), (_anchor, obj) in self._side.items():
+            if kind == "slice":
+                slices[key] = obj
+            elif kind == POD_KIND:
+                node = obj.get("node")
+                extra.append((
+                    POD_PHASE_CODE.get(obj.get("phase") or "Unknown", 0),
+                    1 if obj.get("ready") else 0,
+                    self.nodes.code(str(node)) if node else -1,
+                    self.clusters.code(str(obj.get("cluster") or LOCAL_CLUSTER)),
+                ))
+        if extra:
+            ex = np.asarray(extra, dtype=np.int32)
+            pod_phase = np.concatenate([pod_phase, ex[:, 0]])
+            pod_ready = np.concatenate([pod_ready, ex[:, 1]])
+            pod_node = np.concatenate([pod_node, ex[:, 2]])
+            pod_cluster = np.concatenate([pod_cluster, ex[:, 3]])
+        self._cols = FleetColumns(
+            pod_phase=pod_phase,
+            pod_ready=pod_ready,
+            pod_node=pod_node,
+            pod_cluster=pod_cluster,
+            **build_slice_tables(slices, self.nodes, self.clusters),
+            nodes=self.nodes,
+            clusters=self.clusters,
+        )
+        self._cols_gen = self._gen
+        return self._cols
+
+    def pod_handle(self) -> PodHandle:
+        """The health plane's per-pod sequences (see PodHandle), cached
+        per dirty generation alongside the columns."""
+        self._flush()
+        if self._handle is not None and self._handle_gen == self._gen:
+            return self._handle
+        n = self._arr_len
+        row_keys: List[Optional[str]] = [None] * n
+        for key, row in self._rows.items():
+            row_keys[row] = key
+        mask = self._phase[:n] >= 0
+        idx = np.flatnonzero(mask).tolist()
+        phase_codes = self._phase[:n][mask].tolist()
+        node_codes = self._node[:n][mask].tolist()
+        node_names = self.nodes.names
+        keys = [row_keys[i] for i in idx]
+        phases = [POD_PHASES[c] for c in phase_codes]
+        nodes = [node_names[c] if c >= 0 else None for c in node_codes]
+        slices: List[Dict[str, Any]] = []
+        for (kind, key), (_anchor, obj) in self._side.items():
+            if kind == "slice":
+                slices.append(obj)
+            elif kind == POD_KIND:
+                keys.append(key)
+                phases.append(str(obj.get("phase") or "Unknown"))
+                node = obj.get("node")
+                nodes.append(str(node) if node else None)
+        self._handle = PodHandle(keys=keys, phases=phases, nodes=nodes, slices=slices)
+        self._handle_gen = self._gen
+        return self._handle
+
+    def federated_entries(self) -> List[Tuple[str, str, str]]:
+        """``(kind, global_key, cluster_name)`` for every federated
+        object — the merge registry's reseed, straight off the cluster
+        column (no object reconstruction). Pod cluster membership reads
+        the int column; side entries read their object's field."""
+        self._flush()
+        out: List[Tuple[str, str, str]] = []
+        n = self._arr_len
+        cluster_col = self._cluster
+        names = self.clusters.names
+        for key, row in self._rows.items():
+            code = int(cluster_col[row]) if row < n else 0
+            if code > 0:
+                out.append((POD_KIND, key, names[code]))
+        for (kind, key), (_anchor, obj) in self._side.items():
+            cluster = obj.get("cluster")
+            if cluster:
+                out.append((kind, key, str(cluster)))
+        return out
+
+    def resident_bytes(self) -> int:
+        """O(1) resident estimate for the ``view_resident_bytes`` gauge:
+        fragment bytes + key bookkeeping + column capacity + a rough
+        bill for the unflushed pending buffer and side objects."""
+        arrays = (
+            self._phase.nbytes + self._ready.nbytes
+            + self._node.nbytes + self._cluster.nbytes
+        )
+        parts_list = len(self._parts) * 8 + (len(self._parts) - self._dead) * _BYTES_OVERHEAD
+        pending = len(self._pending) * 800  # unflushed objects, rough
+        side = len(self._side) * 900
+        return self._parts_bytes + parts_list + self._keys_bytes + arrays + pending + side
+
+    # -- dict-of-dicts compatibility (Mapping over (kind, key)) -----------
+
+    def __len__(self) -> int:
+        return len(self._rows) + self._pending_new + len(self._side)
+
+    def __contains__(self, map_key) -> bool:
+        kind, key = map_key
+        if kind == POD_KIND and (key in self._rows or key in self._pending):
+            return True
+        return map_key in self._side
+
+    def get(self, map_key, default=None):
+        kind, key = map_key
+        if kind == POD_KIND:
+            pend = self._pending.get(key)
+            if pend is not None:
+                return pend
+            row = self._rows.get(key)
+            if row is not None:
+                return _loads(self._parts[row][len(SEP):])
+        entry = self._side.get(map_key)
+        return entry[1] if entry is not None else default
+
+    def __getitem__(self, map_key):
+        obj = self.get(map_key)
+        if obj is None:
+            raise KeyError(map_key)
+        return obj
+
+    def __setitem__(self, map_key, obj) -> None:
+        self.upsert(map_key[0], map_key[1], obj)
+
+    def pop(self, map_key, default=None):
+        """O(1) removal without reconstruction (the relay fold path)."""
+        existed = map_key in self
+        self.delete(map_key[0], map_key[1])
+        return True if existed and default is None else (default if not existed else True)
+
+    def iter_items(self) -> Iterator[Tuple[Tuple[str, str], Dict[str, Any]]]:
+        """``((kind, key), obj)`` in dict insertion order — O(fleet)
+        reconstruction; prefer the structural snapshot + the module
+        helpers on hot paths."""
+        snap = self.snapshot_parts(with_keys=True)
+        for kind, key, obj in iter_snapshot_objects(snap):
+            yield (kind, key), obj
+
+    def items(self):
+        return self.iter_items()
+
+    def keys(self):
+        for map_key, _obj in self.iter_items():
+            yield map_key
+
+    def __iter__(self):
+        return self.keys()
+
+    def values(self):
+        for _map_key, obj in self.iter_items():
+            yield obj
+
+
+# -- body assembly / reconstruction (outside the publish lock) -------------
+
+
+def assemble_json_body(rv: int, instance: str, snap: BodySnapshot) -> bytes:
+    """The ``GET /serve/fleet`` JSON body from one structural snapshot —
+    byte-identical to ``json.dumps({"rv": rv, "view": instance,
+    "objects": [...]})`` over the dict core's object walk (PR-4 golden
+    separators), assembled as one join over already-serialized parts."""
+    header = ('{"rv": %d, "view": %s, "objects": [' % (rv, _dumps(instance))).encode()
+    chunks = _body_chunks(snap)
+    # ONE join, one scan: the first non-empty chunk sheds its leading
+    # separator up front (tombstones are empty and join away), so the
+    # body never pays the strip-and-reconcat double copy of the naive
+    # header + joined[2:] + footer shape — at 1M pods those were two
+    # extra full-body memcpys per rebuild
+    out = [header]
+    it = iter(chunks)
+    for chunk in it:
+        if chunk:
+            out.append(chunk[len(SEP):])
+            break
+    out.extend(it)
+    out.append(b"]}")
+    return b"".join(out)
+
+
+def _body_chunks(snap: BodySnapshot) -> List[bytes]:
+    """Parts and side fragments interleaved into body order (each chunk
+    keeps its leading separator; tombstones are empty and join away)."""
+    parts = snap.parts
+    if not snap.sides:
+        return parts
+    chunks: List[bytes] = []
+    prev = 0
+    for anchor, frag, _kind, _key, obj in snap.sides:
+        cut = min(anchor, len(parts))
+        if cut > prev:
+            chunks.extend(parts[prev:cut])
+            prev = cut
+        # a None fragment is a non-serializable side object: raise the
+        # dict core's exact error here, at JSON-body-build time
+        chunks.append(frag if frag is not None else SEP + _fragment(obj))
+    chunks.extend(parts[prev:])
+    return chunks
+
+
+def assemble_msgpack_body(rv: int, instance: str, snap: BodySnapshot, packb) -> bytes:
+    """The msgpack snapshot body, composed incrementally: the map/array
+    headers are written by hand and each element is packed on its own —
+    byte-identical to ``packb({"rv": ..., "view": ..., "objects":
+    [...]})`` because msgpack is compositional. Pod elements are parsed
+    back from their JSON fragments (exact round-trip; anything that
+    would not round-trip lives object-shaped in the side table), so this
+    path costs O(fleet) like the dict core's — the incremental win is
+    JSON's, the wire default."""
+    count = snap.count
+    if count < 16:
+        array_header = bytes([0x90 | count])
+    elif count < 1 << 16:
+        array_header = b"\xdc" + count.to_bytes(2, "big")
+    else:
+        array_header = b"\xdd" + count.to_bytes(4, "big")
+    out = [
+        b"\x83",
+        packb("rv"), packb(rv),
+        packb("view"), packb(instance),
+        packb("objects"), array_header,
+    ]
+    sep = len(SEP)
+    sides = snap.sides
+    parts = snap.parts
+    prev = 0
+    for anchor, _frag, _kind, _key, obj in sides:
+        cut = min(anchor, len(parts))
+        for i in range(prev, cut):
+            part = parts[i]
+            if part:
+                out.append(packb(_loads(part[sep:])))
+        prev = cut
+        out.append(packb(obj))
+    for i in range(prev, len(parts)):
+        part = parts[i]
+        if part:
+            out.append(packb(_loads(part[sep:])))
+    return b"".join(out)
+
+
+def iter_snapshot_objects(snap: BodySnapshot) -> Iterator[Tuple[str, str, Dict[str, Any]]]:
+    """``(kind, key, obj)`` in body order, reconstructed outside the
+    lock. Pod dicts parse back from their fragments (fresh dicts, equal
+    to what the dict core stored); side objects are the live references."""
+    sep = len(SEP)
+    parts = snap.parts
+    keys = snap.keys
+    prev = 0
+    for anchor, _frag, kind, key, obj in snap.sides:
+        cut = min(anchor, len(parts))
+        for i in range(prev, cut):
+            part = parts[i]
+            if part:
+                yield POD_KIND, (keys[i] if keys else ""), _loads(part[sep:])
+        prev = cut
+        yield kind, key, obj
+    for i in range(prev, len(parts)):
+        part = parts[i]
+        if part:
+            yield POD_KIND, (keys[i] if keys else ""), _loads(part[sep:])

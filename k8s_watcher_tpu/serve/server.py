@@ -709,7 +709,13 @@ class ServePlane:
     def __init__(self, config, *, metrics=None, auth_token: Optional[str] = None, history=None):
         self.config = config
         self.metrics = metrics
-        self.view = FleetView(compact_horizon=config.compact_horizon, metrics=metrics)
+        self.view = FleetView(
+            compact_horizon=config.compact_horizon,
+            metrics=metrics,
+            # serve.columnar: "auto"/"on" = the columnar core, "off" =
+            # the legacy dict core (byte-identical wire either way)
+            columnar=getattr(config, "columnar", "auto") != "off",
+        )
         # durable history plane (history.HistoryStore, already recovered):
         # restore the previous incarnation's rv line + instance + journal
         # tail into the fresh view, then open the WAL writer on this

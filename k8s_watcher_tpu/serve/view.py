@@ -85,6 +85,12 @@ from typing import Any, Callable, Dict, List, Mapping, NamedTuple, Optional, Tup
 
 from k8s_watcher_tpu.pipeline.phase import pod_key, pod_ready
 from k8s_watcher_tpu.pipeline.pipeline import NEVER_IN_VIEW as _NEVER_IN_VIEW
+from k8s_watcher_tpu.serve.columns import (
+    ColumnarStore,
+    assemble_json_body,
+    assemble_msgpack_body,
+    iter_snapshot_objects,
+)
 from k8s_watcher_tpu.watch.source import EventType
 
 # msgpack is baked into the image (history/wal.py measured it packing a
@@ -311,9 +317,16 @@ class FleetView:
         *,
         compact_horizon: int = 8192,
         metrics=None,  # metrics.MetricsRegistry, optional
+        columnar: bool = True,
     ):
         self.compact_horizon = max(1, int(compact_horizon))
         self.metrics = metrics
+        # the columnar core (serve/columns.py): fleet state as parts +
+        # int columns instead of a dict of dicts — same rv line, same
+        # dedup, byte-identical bodies/frames; ``columnar=False`` keeps
+        # the dict core (the A/B reference and the ``serve.columnar:
+        # off`` escape hatch)
+        self.columnar = bool(columnar)
         # This incarnation of the rv space. rv restarts at 0 with the
         # process ("the journal is the state" — and the journal dies with
         # it), so a resume token is only meaningful inside the instance
@@ -326,7 +339,10 @@ class FleetView:
         self._cond = threading.Condition()
         self._rv = 0
         self._oldest_rv = 0  # deltas with rv <= this are compacted away
-        self._objects: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        # dict-of-dicts on the reference core; the columnar store speaks
+        # the same (kind, key)-keyed mapping protocol, so the relay fold
+        # and the debug pokes read either shape
+        self._objects = ColumnarStore() if self.columnar else {}
         # parallel append-only arrays (trimmed together at the horizon):
         # bisect over _delta_rvs finds a resume point in O(log n);
         # _frames[codec][i] is _deltas[i]'s wire frame in that codec,
@@ -434,6 +450,15 @@ class FleetView:
         self._watch_to_local = (
             metrics.histogram("watch_to_local_view_seconds") if metrics is not None else None
         )
+        # the columnar core's own instruments (RUNBOOK "Columnar view
+        # core"): per-publish apply cost and the store's resident-bytes
+        # estimate (0 on the dict core — no cheap estimator there)
+        self._apply_seconds = (
+            metrics.histogram("view_apply_seconds") if metrics is not None else None
+        )
+        self._resident_bytes = (
+            metrics.gauge("view_resident_bytes") if metrics is not None else None
+        )
 
     # -- durable history (restart-surviving rv line) -----------------------
 
@@ -453,7 +478,14 @@ class FleetView:
         with self._cond:
             self.instance = instance
             self._rv = rv
-            self._objects = dict(objects)
+            if self.columnar:
+                # reseed the columns in place: interners KEEP their codes
+                # across the restore (the analytics-encoder stability
+                # contract, now a core property), and nothing serializes
+                # here — the first body build flushes lazily
+                self._objects.reseed(objects)
+            else:
+                self._objects = dict(objects)
             self._deltas = list(journal)
             self._delta_rvs = [d.rv for d in journal]
             # holes, not eager re-encodes: a restart must not pay
@@ -481,9 +513,18 @@ class FleetView:
 
     def state_for_history(self) -> Tuple[int, Dict[Tuple[str, str], Dict[str, Any]]]:
         """``(rv, {(kind, key): obj})`` — the WAL writer's rebase anchor
-        (objects are replaced, never mutated, so the copy is shallow)."""
+        (objects are replaced, never mutated, so the copy is shallow).
+        On the columnar core the structural snapshot is taken under the
+        lock and the O(fleet) object reconstruction happens outside it —
+        rebase is the rare overrun path, not a hot one."""
         with self._cond:
-            return self._rv, dict(self._objects)
+            if not self.columnar:
+                return self._rv, dict(self._objects)
+            rv = self._rv
+            snap = self._objects.snapshot_parts(with_keys=True)
+        return rv, {
+            (kind, key): obj for kind, key, obj in iter_snapshot_objects(snap)
+        }
 
     # -- relay mode (upstream-mirrored rv line; relay/plane.py) ------------
 
@@ -508,7 +549,10 @@ class FleetView:
         with self._cond:
             self.instance = instance
             self._rv = rv
-            self._objects = dict(objects)
+            if self.columnar:
+                self._objects.reseed(objects)
+            else:
+                self._objects = dict(objects)
             self._delta_rvs = []
             self._deltas = []
             self._frames = {variant: [] for variant in FRAME_VARIANTS}
@@ -680,16 +724,30 @@ class FleetView:
         into the bytes and the result fills the plain-JSON frame slot —
         no encode here, no lazy re-encode later. An unrecognized shape
         falls back to the hole (correctness over the fast path)."""
-        map_key = (kind, key)
-        if obj is None:
-            if self._objects.pop(map_key, None) is None:
-                return False
-            delta_type = DELETE
+        if self.columnar:
+            # the store owns dedup (exact dict-core parity: identical
+            # upsert / absent-key delete mint no rv) and defers pod
+            # serialization to the next reader's flush — the hot-path
+            # apply cost here is one pending-dict write
+            if obj is None:
+                if not self._objects.delete(kind, key):
+                    return False
+                delta_type = DELETE
+            else:
+                if not self._objects.upsert(kind, key, obj):
+                    return False
+                delta_type = UPSERT
         else:
-            if self._objects.get(map_key) == obj:
-                return False
-            self._objects[map_key] = obj
-            delta_type = UPSERT
+            map_key = (kind, key)
+            if obj is None:
+                if self._objects.pop(map_key, None) is None:
+                    return False
+                delta_type = DELETE
+            else:
+                if self._objects.get(map_key) == obj:
+                    return False
+                self._objects[map_key] = obj
+                delta_type = UPSERT
         self._rv += 1
         delta = Delta(self._rv, kind, key, delta_type, obj, now, ts_wall, pub_wall, trace)
         self._delta_rvs.append(self._rv)
@@ -760,6 +818,10 @@ class FleetView:
         if changed:
             if self._deltas_published is not None:
                 self._deltas_published.inc()
+            if self._apply_seconds is not None:
+                self._apply_seconds.record(time.monotonic() - now)
+            if self._resident_bytes is not None and self.columnar:
+                self._resident_bytes.set(self._objects.resident_bytes())
             for fn in self._wakeups:
                 fn()
         return changed
@@ -823,6 +885,10 @@ class FleetView:
                 self._deltas_published.inc(changed)
             if self._publish_seconds is not None:
                 self._publish_seconds.record(time.monotonic() - now)
+            if self._apply_seconds is not None:
+                self._apply_seconds.record(time.monotonic() - now)
+            if self._resident_bytes is not None and self.columnar:
+                self._resident_bytes.set(self._objects.resident_bytes())
             for fn in self._wakeups:
                 fn()
         return changed
@@ -911,6 +977,10 @@ class FleetView:
                 self._deltas_published.inc(changed)
             if self._publish_seconds is not None:
                 self._publish_seconds.record(t_end - t_start)
+            if self._apply_seconds is not None:
+                self._apply_seconds.record(t_end - t_start)
+            if self._resident_bytes is not None and self.columnar:
+                self._resident_bytes.set(self._objects.resident_bytes())
             if self._watch_to_local is not None:
                 # per applied delta: watch receive -> view visibility,
                 # both stamps monotonic on THIS host (no wall skew)
@@ -966,11 +1036,18 @@ class FleetView:
             return OK
 
     def snapshot(self) -> Tuple[int, List[Dict[str, Any]]]:
-        """``(rv, objects)`` — the GET-snapshot shape. Objects are the
-        live references (replaced on write, never mutated), so the copy
-        is shallow and O(objects)."""
+        """``(rv, objects)`` — the GET-snapshot shape. Dict core:
+        objects are the live references (replaced on write, never
+        mutated), so the copy is shallow and O(objects). Columnar core:
+        the structural snapshot is taken under the lock and pod dicts
+        are reconstructed from their fragments OUTSIDE it (equal by
+        value to what was stored; side objects are the live refs)."""
         with self._cond:
-            return self._rv, list(self._objects.values())
+            if not self.columnar:
+                return self._rv, list(self._objects.values())
+            rv = self._rv
+            snap = self._objects.snapshot_parts()
+        return rv, [obj for _kind, _key, obj in iter_snapshot_objects(snap)]
 
     def snapshot_bytes(self, codec: str = CODEC_JSON) -> bytes:
         """The serialized ``GET /serve/fleet`` body, rebuilt at most once
@@ -990,18 +1067,39 @@ class FleetView:
                     if self._snap_hits_legacy is not None:
                         self._snap_hits_legacy[codec].inc()
                 return cached[1]
-            rv, objects = self._rv, list(self._objects.values())
+            rv = self._rv
             instance = self.instance
+            if self.columnar:
+                snap = self._objects.snapshot_parts()
+                objects = None
+            else:
+                objects = list(self._objects.values())
         # serialize OUTSIDE the lock (O(fleet) work must not stall
-        # publishes); objects are replaced-never-mutated, so the shallow
-        # copy above is a consistent snapshot
-        body = {"rv": rv, "view": instance, "objects": objects}
-        if codec == CODEC_MSGPACK:
-            if _msgpack is None:
-                raise RuntimeError("msgpack codec requested but msgpack is not importable")
-            data = _msgpack.packb(body, use_bin_type=True)
+        # publishes); parts bytes are immutable and objects are
+        # replaced-never-mutated, so either snapshot shape is consistent
+        if objects is None:
+            # columnar: the JSON body is a join over already-serialized
+            # fragments (only keys CHANGED since the last reader pay a
+            # dumps, inside snapshot_parts' flush); msgpack composes the
+            # same parts element-by-element. Both byte-identical to the
+            # dict walk below.
+            if codec == CODEC_MSGPACK:
+                if _msgpack is None:
+                    raise RuntimeError("msgpack codec requested but msgpack is not importable")
+                data = assemble_msgpack_body(
+                    rv, instance, snap,
+                    lambda o: _msgpack.packb(o, use_bin_type=True),
+                )
+            else:
+                data = assemble_json_body(rv, instance, snap)
         else:
-            data = json.dumps(body).encode()
+            body = {"rv": rv, "view": instance, "objects": objects}
+            if codec == CODEC_MSGPACK:
+                if _msgpack is None:
+                    raise RuntimeError("msgpack codec requested but msgpack is not importable")
+                data = _msgpack.packb(body, use_bin_type=True)
+            else:
+                data = json.dumps(body).encode()
         with self._cond:
             # store keyed by the rv it was built at; if a publish landed
             # meanwhile, the next read sees the mismatch and rebuilds
@@ -1033,15 +1131,57 @@ class FleetView:
             if cached is not None and cached[0] == self._rv:
                 return cached
             rv = self._rv
-            items = list(self._objects.items())
+            if self.columnar:
+                snap = self._objects.snapshot_parts(with_keys=True)
+                items = None
+            else:
+                items = list(self._objects.items())
         tables: Dict[str, List[Dict[str, Any]]] = {}
-        for (kind, _key), obj in items:
-            tables.setdefault(kind, []).append(obj)
+        if items is None:
+            for kind, _key, obj in iter_snapshot_objects(snap):
+                tables.setdefault(kind, []).append(obj)
+        else:
+            for (kind, _key), obj in items:
+                tables.setdefault(kind, []).append(obj)
         result = (rv, tables)
         with self._cond:
             if self._rv == rv:
                 self._tables_cache = result
         return result
+
+    # -- zero-copy columnar readers (health/analytics/federation) ---------
+
+    def fleet_columns(self):
+        """``(rv, FleetColumns)`` straight off the columnar core — the
+        analytics plane's arrays, materialized at most once per dirty
+        generation and shared by reference (the per-request re-encode
+        collapses to this handle). Columnar core only; the dict core's
+        consumers keep the encoder/snapshot_tables path."""
+        with self._cond:
+            return self._rv, self._objects.fleet_columns()
+
+    def fleet_handle(self):
+        """``(rv, PodHandle)`` — the health plane's per-pod sequences
+        (keys/phases/nodes) plus the live slice objects, decoded from
+        the columns at most once per dirty generation. Columnar core
+        only. Treat every field as immutable; the handle is shared."""
+        with self._cond:
+            return self._rv, self._objects.pod_handle()
+
+    def federated_keys(self) -> List[Tuple[str, str, str]]:
+        """``(kind, global_key, cluster_name)`` for every federated
+        object — the merge registry's reseed, WITHOUT reconstructing a
+        million local pods (the columnar core answers off its cluster
+        column; the dict core walks objects)."""
+        with self._cond:
+            if self.columnar:
+                return self._objects.federated_entries()
+            entries = []
+            for (kind, key), obj in self._objects.items():
+                cluster = obj.get("cluster") if isinstance(obj, dict) else None
+                if cluster:
+                    entries.append((kind, key, str(cluster)))
+            return entries
 
     def freshness(self) -> Dict[str, Any]:
         """The local view's freshness watermark (the /debug/freshness

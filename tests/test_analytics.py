@@ -495,8 +495,11 @@ class TestAnalyticsPlane:
         assert metrics.counter("analytics_scenarios_evaluated").value == 1
 
     def test_refresh_is_incremental_between_requests(self):
+        # the encoder subscription protocol is the DICT core's path —
+        # the columnar core serves the plane a shared column handle and
+        # never touches the encoder (see test_columnar_view.py)
         metrics = MetricsRegistry()
-        plane, view = self._plane(metrics=metrics)
+        plane, view = self._plane(view=FleetView(columnar=False), metrics=metrics)
         _seed_view(view)
         plane.summary()
         assert metrics.counter("analytics_encoder_resets").value == 1
@@ -509,7 +512,7 @@ class TestAnalyticsPlane:
 
     def test_horizon_fall_behind_triggers_full_reencode(self):
         metrics = MetricsRegistry()
-        view = FleetView(compact_horizon=8)
+        view = FleetView(compact_horizon=8, columnar=False)
         plane, _ = self._plane(view=view, metrics=metrics)
         _seed_view(view)
         plane.summary()
@@ -521,7 +524,7 @@ class TestAnalyticsPlane:
 
     def test_view_restart_triggers_full_reencode(self):
         metrics = MetricsRegistry()
-        plane, view = self._plane(metrics=metrics)
+        plane, view = self._plane(view=FleetView(columnar=False), metrics=metrics)
         _seed_view(view)
         assert plane.summary()["fleet"]["pods"] == 6
         replacement = {("pod", "only"): pod_obj("only")}

@@ -1,0 +1,411 @@
+"""Columnar FleetView core (serve/columns.py) pinned against the dict core.
+
+The columnar core is only allowed to exist because it is OBSERVABLY the
+dict core: same rv line, same apply/dedup verdicts, same snapshot objects
+and insertion order, byte-identical snapshot bodies in both codecs, and
+byte-identical wire frames. The seeded property test drives both cores
+through the same randomized churn script — inserts, updates, identical
+and key-reordered no-op re-upserts, deletes (present and absent), side
+(slice) churn, a deletion wave heavy enough to trip the columnar store's
+tombstone compaction — then through a ``restore()`` round-trip (interner
+codes must survive: the analytics-encoder stability contract) and a
+federation reseed, comparing the full observable surface at every
+checkpoint. The unit tests below it pin the sharp edges individually:
+the side-table anchor-tie ordering, non-serializable side pods, the
+Mapping protocol, and pre-flush insert+delete ordering.
+"""
+
+import json
+import random
+
+import pytest
+
+from k8s_watcher_tpu.config.schema import (
+    SchemaError,
+    ServeConfig,
+    VALID_COLUMNAR_MODES,
+)
+from k8s_watcher_tpu.federate.merge import GlobalMerge
+from k8s_watcher_tpu.serve.view import (
+    CODEC_JSON,
+    CODEC_MSGPACK,
+    FleetView,
+    msgpack_available,
+)
+
+INSTANCE = "columnar-prop"
+# journal must hold the whole script: frames are compared from rv 0
+HORIZON = 1 << 20
+
+PHASES = ["Pending", "Running", "Succeeded", "Failed"]
+
+
+def _pair():
+    col = FleetView(compact_horizon=HORIZON, columnar=True)
+    ref = FleetView(compact_horizon=HORIZON, columnar=False)
+    # instance ids are per-view UUIDs and are embedded in every body:
+    # pin them or nothing byte-compares
+    col.instance = ref.instance = INSTANCE
+    return col, ref
+
+
+def _pod(rng, i, seq):
+    obj = {
+        "kind": "pod",
+        "key": f"ns-{i % 7}/pod-{i:05d}",
+        "name": f"pod-{i:05d}",
+        "namespace": f"ns-{i % 7}",
+        "phase": rng.choice(PHASES),
+        "ready": rng.random() < 0.8,
+        "node": f"node-{i % 97}" if rng.random() < 0.95 else None,
+        "pod_resource_version": str(1000 + seq),
+        "labels": {"job": f"job-{i % 13}", "idx": str(i)},
+        "tpu": {"chips": rng.choice([0, 4, 8]), "slice": f"s-{i % 11}"},
+    }
+    # fresh strings per call (the json round-trip): production pods
+    # arrive through per-frame json.loads, never as literal dicts with
+    # interned keys
+    return json.loads(json.dumps(obj))
+
+
+def _slice(rng, s, seq):
+    obj = {
+        "kind": "slice",
+        "key": f"slice-{s}",
+        "name": f"slice-{s}",
+        "workers": 8,
+        "ready_workers": rng.randrange(0, 9),
+        "rev": seq,
+        "nodes": [f"node-{(s * 8 + w) % 97}" for w in range(3)],
+    }
+    return json.loads(json.dumps(obj))
+
+
+def _reordered(obj):
+    """Same content, different key insertion order: dumps() bytes differ
+    (same length), dict equality holds — the flushed-row dedup must fall
+    back from byte compare to a parsed compare and still call it a no-op."""
+    out = {k: obj[k] for k in reversed(list(obj))}
+    assert list(out) != list(obj)
+    return out
+
+
+def _apply_both(col, ref, kind, key, obj):
+    # each view gets its OWN copy: the dict core stores the object by
+    # reference and must never alias the columnar view's input
+    obj_col = json.loads(json.dumps(obj)) if obj is not None else None
+    changed_col = col.apply(kind, key, obj_col)
+    changed_ref = ref.apply(kind, key, obj)
+    assert changed_col == changed_ref, (kind, key, changed_col, changed_ref)
+    return changed_ref
+
+
+def _assert_identical(col, ref):
+    rv_col, objs_col = col.snapshot()
+    rv_ref, objs_ref = ref.snapshot()
+    assert rv_col == rv_ref
+    assert objs_col == objs_ref
+    assert col.snapshot_bytes(CODEC_JSON) == ref.snapshot_bytes(CODEC_JSON)
+    if msgpack_available():
+        assert col.snapshot_bytes(CODEC_MSGPACK) == ref.snapshot_bytes(CODEC_MSGPACK)
+
+
+def _assert_frames_identical(col, ref, since_rv=0):
+    got_col = col.read_frames_since(since_rv, max_deltas=1 << 30)
+    got_ref = ref.read_frames_since(since_rv, max_deltas=1 << 30)
+    assert got_col.status == "ok" and got_ref.status == "ok"
+    assert list(got_col.frames) == list(got_ref.frames)
+
+
+@pytest.mark.parametrize("seed", [11, 23, 47])
+def test_columnar_equals_dict_core_property(seed):
+    rng = random.Random(seed)
+    col, ref = _pair()
+
+    last = {}  # key -> last applied pod object (for no-op re-upserts)
+    live = []  # insertion-ordered live pod keys
+    n_next = 0
+
+    def insert_pod(seq):
+        nonlocal n_next
+        i = n_next
+        n_next += 1
+        obj = _pod(rng, i, seq)
+        assert _apply_both(col, ref, "pod", obj["key"], obj) is True
+        last[obj["key"]] = obj
+        live.append(obj["key"])
+
+    # -- phase 1: bulk build (mix of single applies and batches) ----------
+    for seq in range(2400):
+        insert_pod(seq)
+        if seq % 40 == 0:
+            s = seq // 40
+            obj = _slice(rng, s, seq)
+            _apply_both(col, ref, "slice", obj["key"], obj)
+    # one batched leg: apply_batch must mint the same count on both cores
+    batch = []
+    for seq in range(2400, 2400 + 128):
+        i = n_next
+        n_next += 1
+        obj = _pod(rng, i, seq)
+        batch.append(("pod", obj["key"], obj))
+        last[obj["key"]] = obj
+        live.append(obj["key"])
+    minted_col = col.apply_batch(
+        [(k, key, json.loads(json.dumps(o))) for k, key, o in batch]
+    )
+    minted_ref = ref.apply_batch(batch)
+    assert minted_col == minted_ref == len(batch)
+    _assert_identical(col, ref)
+
+    # -- phase 2: mixed churn --------------------------------------------
+    for step in range(900):
+        op = rng.random()
+        seq = 10_000 + step
+        if op < 0.30 and live:  # update
+            key = rng.choice(live)
+            i = int(key.rsplit("-", 1)[1])
+            obj = _pod(rng, i, seq)
+            last[key] = obj
+            _apply_both(col, ref, "pod", key, obj)
+        elif op < 0.42 and live:  # identical re-upsert: no-op on both
+            key = rng.choice(live)
+            assert _apply_both(col, ref, "pod", key, last[key]) is False
+        elif op < 0.50 and live:  # key-reordered identical: still a no-op
+            key = rng.choice(live)
+            assert _apply_both(col, ref, "pod", key, _reordered(last[key])) is False
+        elif op < 0.62 and live:  # delete present
+            key = live.pop(rng.randrange(len(live)))
+            last.pop(key)
+            assert _apply_both(col, ref, "pod", key, None) is True
+        elif op < 0.68:  # delete absent: free on both
+            assert _apply_both(col, ref, "pod", f"ns-0/absent-{step}", None) is False
+        elif op < 0.80:  # insert
+            insert_pod(seq)
+        else:  # slice (side table) churn
+            s = rng.randrange(0, 70)
+            if rng.random() < 0.2:
+                _apply_both(col, ref, "slice", f"slice-{s}", None)
+            else:
+                obj = _slice(rng, s, seq)
+                _apply_both(col, ref, "slice", obj["key"], obj)
+        if step == 450:
+            _assert_identical(col, ref)
+
+    # -- phase 3: deletion wave deep enough to trip columnar compaction --
+    col.snapshot_bytes(CODEC_JSON)  # flush: the wave tombstones real rows
+    parts_before = len(col._objects._parts)
+    doomed = [key for idx, key in enumerate(live) if idx % 3 != 0]
+    for n, key in enumerate(doomed):
+        _apply_both(col, ref, "pod", key, None)
+        last.pop(key)
+        if n % 97 == 0:  # interleave inserts so the remap isn't trivial
+            insert_pod(20_000 + n)
+    live = [key for key in live if key in last]
+    assert len(doomed) > 1024
+    # _compact must actually have run (tombstones reclaimed), or this
+    # test isn't exercising the anchor remap at all
+    col.snapshot_bytes(CODEC_JSON)
+    assert len(col._objects._parts) < parts_before
+    assert col._objects._dead * 2 <= max(1, len(col._objects._parts))
+    _assert_identical(col, ref)
+    _assert_frames_identical(col, ref)
+
+    # -- phase 4: restore() round-trip (interner codes must survive) ------
+    rv, objects = ref.state_for_history()
+    node_codes = dict(col._objects.nodes._codes)
+    cluster_codes = dict(col._objects.clusters._codes)
+    col.restore(
+        instance="restored-" + INSTANCE,
+        rv=rv,
+        objects={k: json.loads(json.dumps(v)) for k, v in objects.items()},
+        journal=[],
+    )
+    ref.restore(instance="restored-" + INSTANCE, rv=rv, objects=objects, journal=[])
+    assert dict(col._objects.nodes._codes) == node_codes
+    assert dict(col._objects.clusters._codes) == cluster_codes
+    _assert_identical(col, ref)
+    for step in range(200):
+        seq = 30_000 + step
+        if step % 3 == 0 and live:
+            key = rng.choice(live)
+            i = int(key.rsplit("-", 1)[1])
+            obj = _pod(rng, i, seq)
+            last[key] = obj
+            _apply_both(col, ref, "pod", key, obj)
+        else:
+            insert_pod(seq)
+    _assert_identical(col, ref)
+    # post-restore journal starts at rv: frames compare from there
+    _assert_frames_identical(col, ref, since_rv=rv)
+
+    # -- phase 5: federation reseed --------------------------------------
+    merge_col = GlobalMerge(col)
+    merge_ref = GlobalMerge(ref)
+    upstream = [_pod(rng, 50_000 + i, 1) for i in range(40)]
+    upstream.append(_slice(rng, 900, 1))
+    minted_col = merge_col.reset_cluster("west", [dict(o) for o in upstream])
+    minted_ref = merge_ref.reset_cluster("west", upstream)
+    assert minted_col == minted_ref == len(upstream)
+    _assert_identical(col, ref)
+    # second reconcile drops a band: stale keys must delete identically
+    survivors = upstream[10:]
+    minted_col = merge_col.reset_cluster("west", [dict(o) for o in survivors])
+    minted_ref = merge_ref.reset_cluster("west", survivors)
+    assert minted_col == minted_ref == 10  # ten stale deletes, zero re-upserts
+    _assert_identical(col, ref)
+    # a fresh merge reseeding from each view must adopt the same registry
+    assert GlobalMerge(col).seed_from_view() == GlobalMerge(ref).seed_from_view()
+    assert sorted(col.federated_keys()) == sorted(ref.federated_keys())
+
+
+def test_serve_columnar_mode_vocabulary():
+    assert VALID_COLUMNAR_MODES == ("auto", "on", "off")
+    assert ServeConfig.from_raw({}).columnar == "auto"
+    for mode in VALID_COLUMNAR_MODES:
+        assert ServeConfig.from_raw({"columnar": mode}).columnar == mode
+    with pytest.raises(SchemaError, match="serve.columnar"):
+        ServeConfig.from_raw({"columnar": "fast"})
+
+
+def test_side_anchor_tie_ordering():
+    """Consecutive side inserts with no pod flushed between share an
+    anchor; body order must stay side-table INSERTION order, never
+    fragment-byte order (regression: "slice-10" sorting before
+    "slice-2")."""
+    rng = random.Random(7)
+    col, ref = _pair()
+    obj = _pod(rng, 0, 0)
+    _apply_both(col, ref, "pod", obj["key"], obj)
+    col.snapshot_bytes(CODEC_JSON)  # flush: sides below anchor past row 0
+    for s in [2, 10, 1, 30, 3, 21]:  # byte order != insertion order
+        sl = _slice(rng, s, 1)
+        _apply_both(col, ref, "slice", sl["key"], sl)
+    obj = _pod(rng, 1, 2)
+    _apply_both(col, ref, "pod", obj["key"], obj)
+    for s in [100, 20, 9]:  # second tie group at a later anchor
+        sl = _slice(rng, s, 3)
+        _apply_both(col, ref, "slice", sl["key"], sl)
+    _assert_identical(col, ref)
+    # updating a tied side entry must not move it
+    sl = _slice(rng, 10, 4)
+    _apply_both(col, ref, "slice", sl["key"], sl)
+    _assert_identical(col, ref)
+
+
+def test_non_serializable_pod_pins_to_side():
+    """A pod json.dumps can't encode routes to the side table but keeps
+    its position and Mapping visibility. Bodies can't be compared while
+    it's live (the dict core's dumps raises too — not a columnar
+    regression), so the pin is snapshot()/items() equality; bodies must
+    be byte-identical again once it's gone."""
+    rng = random.Random(9)
+    col, ref = _pair()
+    for i in range(6):
+        obj = _pod(rng, i, 0)
+        _apply_both(col, ref, "pod", obj["key"], obj)
+    col.snapshot_bytes(CODEC_JSON)  # flush so the overwrite hits a real row
+    key = "ns-2/pod-00002"
+    bad = {"kind": "pod", "key": key, "name": "pod-00002", "blob": {1, 2, 3}}
+    # apply() eagerly encodes the JSON wire frame, so an unserializable
+    # object can't enter through it ON EITHER CORE — it arrives through
+    # the paths that journal frames as holes (apply_batch: the federation
+    # fan-in) or feed the store directly (relay fold, reseed)
+    minted_col = col.apply_batch([("pod", key, {**bad, "blob": {1, 2, 3}})])
+    minted_ref = ref.apply_batch([("pod", key, bad)])
+    assert minted_col == minted_ref == 1
+    assert col.snapshot() == ref.snapshot()  # same position, set survives
+    assert col._objects[("pod", key)] == bad
+    with pytest.raises(TypeError):
+        col.snapshot_bytes(CODEC_JSON)
+    with pytest.raises(TypeError):
+        ref.snapshot_bytes(CODEC_JSON)
+    # a serializable re-upsert heals the body WITHOUT moving the pod
+    good = _pod(rng, 2, 5)
+    _apply_both(col, ref, "pod", key, good)
+    assert [o["key"] for o in col.snapshot()[1][:6]] == [
+        o["key"] for o in ref.snapshot()[1][:6]
+    ]
+    _assert_identical(col, ref)
+
+
+def test_mapping_protocol_parity():
+    """The store speaks dict-of-dicts: len/in/get/[]/pop/items in
+    insertion order — across the pending buffer, flushed rows,
+    tombstones, and the side table."""
+    rng = random.Random(3)
+    col, ref = _pair()
+    keys = []
+    for i in range(8):
+        obj = _pod(rng, i, 0)
+        _apply_both(col, ref, "pod", obj["key"], obj)
+        keys.append(obj["key"])
+    sl = _slice(rng, 1, 0)
+    _apply_both(col, ref, "slice", sl["key"], sl)
+    col.snapshot_bytes(CODEC_JSON)  # flush half the story...
+    for i in range(8, 12):
+        obj = _pod(rng, i, 1)  # ...and leave these pending
+        _apply_both(col, ref, "pod", obj["key"], obj)
+        keys.append(obj["key"])
+    _apply_both(col, ref, "pod", keys[1], None)  # flushed tombstone
+
+    store, mirror = col._objects, ref._objects
+    assert len(store) == len(mirror)
+    assert ("pod", keys[0]) in store and ("pod", keys[0]) in mirror
+    assert ("pod", keys[1]) not in store and ("pod", keys[1]) not in mirror
+    assert ("slice", "slice-1") in store
+    assert store.get(("pod", keys[1])) is None
+    assert store.get(("pod", keys[1]), "gone") == "gone"
+    assert store[("pod", keys[2])] == mirror[("pod", keys[2])]
+    assert store[("pod", keys[9])] == mirror[("pod", keys[9])]  # pending
+    assert store[("slice", "slice-1")] == mirror[("slice", "slice-1")]
+    with pytest.raises(KeyError):
+        store[("pod", keys[1])]
+    assert list(store.items()) == list(mirror.items())
+    assert list(store.keys()) == list(mirror.keys())
+    assert list(store.values()) == list(mirror.values())
+    # pop mirrors the relay fold's O(1) removal
+    store.pop(("pod", keys[3]))
+    mirror.pop(("pod", keys[3]))
+    assert list(store.items()) == list(mirror.items())
+
+
+def test_pending_delete_is_a_pop_not_a_flush():
+    """A churning pods-only stream with no reader between batches (the
+    fan-in shape) must stay entirely on the pending buffer: deleting a
+    never-flushed key is a dict pop, NOT a flush of the working set —
+    flushed rows pay a json.dumps per later update (regression: the
+    fan-in batched/per-delta ratio fell below its floor because every
+    37th-frame delete materialized all 64 hot keys into rows)."""
+    rng = random.Random(13)
+    col, ref = _pair()
+    for i in range(10):
+        obj = _pod(rng, i, 0)
+        _apply_both(col, ref, "pod", obj["key"], obj)
+    for i in (3, 7):
+        _apply_both(col, ref, "pod", f"ns-{i % 7}/pod-{i:05d}", None)
+    for i in range(10, 14):
+        obj = _pod(rng, i, 1)
+        _apply_both(col, ref, "pod", obj["key"], obj)
+    assert len(col._objects._parts) == 0  # nothing materialized
+    _assert_identical(col, ref)
+    _assert_frames_identical(col, ref)
+
+
+def test_preflush_insert_delete_ordering():
+    """An insert+delete that both land in the pending buffer (no flush
+    between) must vanish without disturbing neighbors' order."""
+    rng = random.Random(5)
+    col, ref = _pair()
+    a, b, c = (_pod(rng, i, 0) for i in range(3))
+    sl = _slice(rng, 0, 0)
+    _apply_both(col, ref, "pod", a["key"], a)
+    _apply_both(col, ref, "slice", sl["key"], sl)
+    _apply_both(col, ref, "pod", b["key"], b)
+    _apply_both(col, ref, "pod", b["key"], None)  # dies pre-flush
+    _apply_both(col, ref, "pod", c["key"], c)
+    _assert_identical(col, ref)
+    # re-inserting the pre-flush casualty appends at the end on both cores
+    _apply_both(col, ref, "pod", b["key"], _pod(rng, 1, 9))
+    _assert_identical(col, ref)
+    _assert_frames_identical(col, ref)
