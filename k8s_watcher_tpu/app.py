@@ -178,10 +178,6 @@ class WatcherApp:
     ):
         self.config = config
         self.metrics = metrics or MetricsRegistry()
-        # labeled-metrics migration continuity: set BEFORE the planes
-        # are built — they read the flag at construction to decide
-        # whether the old suffix-mangled series keep being emitted
-        self.metrics.legacy_suffix_names = config.metrics.legacy_suffix_names
         self.checkpoint = (
             CheckpointStore(
                 config.state.checkpoint_path,
@@ -350,6 +346,11 @@ class WatcherApp:
                 token_dir=token_dir,
                 resume_tokens_valid=tokens_valid,
                 trace_collector=self.trace_collector,
+                # sharded fan-in: merge-worker anomaly traces (stale/
+                # dropped upstream verdicts) land in the shared ring so
+                # /debug/trace?uid=<upstream> answers from the parent
+                trace_ring=self.tracer.ring if self.tracer is not None else None,
+                process_export=config.metrics.process_export,
             )
             if config.federation.processes > 0:
                 # sharded fan-in (federation.processes): merge workers in
@@ -546,6 +547,11 @@ class WatcherApp:
                 else None
             )
             stall_after = self.config.clusterapi.egress_stall_seconds
+            # worker-process supervision surface: only when a process
+            # tier is actually live (ingest.processes / federation.processes)
+            procs_live = self.config.ingest.processes > 0 or (
+                self.federation is not None and self.federation.fanin is not None
+            )
             self.status_server = StatusServer(
                 self.metrics,
                 self.liveness,
@@ -588,6 +594,11 @@ class WatcherApp:
                 # (degraded only — never the liveness verdict)
                 node_health=self.health.snapshot if self.health is not None else None,
                 node_health_fold=self.health.health if self.health is not None else None,
+                # per-worker-process supervision at /debug/processes; the
+                # stale-stats verdict folds into the /healthz BODY
+                # (degraded only — the supervisor owns worker revival)
+                processes=self._processes_snapshot if procs_live else None,
+                processes_fold=self._processes_health if procs_live else None,
                 slices=self.slice_tracker.debug_snapshot,
                 trend=agent_trend,
                 remediation=remediation_state,
@@ -623,6 +634,8 @@ class WatcherApp:
                 ", /debug/slo" if self.slo is not None else ""
             ) + (
                 ", /debug/health" if self.health is not None else ""
+            ) + (
+                ", /debug/processes" if procs_live else ""
             )
             logger.info("Status endpoint on :%d (%s)", self.status_server.port, routes)
         if self.config.watcher.leader_election.enabled:
@@ -878,6 +891,52 @@ class WatcherApp:
         if self.federation is not None:
             out["federation"] = self.federation.freshness()
         return out
+
+    def _process_reports(self) -> list:
+        """Per-worker supervision rows from every process tier that is
+        live (ingest shard readers + federation merge workers)."""
+        out = []
+        ingest_report = getattr(self.ingest, "process_report", None)
+        if callable(ingest_report):
+            out.extend(ingest_report())
+        if self.federation is not None:
+            out.extend(self.federation.process_report())
+        return out
+
+    def _processes_snapshot(self) -> dict:
+        """The /debug/processes body: supervision rows decorated with
+        each worker's top-N hottest process-labeled counter series."""
+        rows = self._process_reports()
+        top = self.config.metrics.process_top_series
+        for row in rows:
+            label = row.get("process")
+            if label:
+                row["hottest_series"] = self.metrics.hottest_series(label, top)
+        return {
+            "processes": len(rows),
+            "export": self.config.metrics.process_export,
+            "workers": rows,
+        }
+
+    def _processes_health(self) -> dict:
+        """The /healthz body fold: degraded (never liveness) while any
+        worker's stats are stale — the wire still delivering events with
+        no stats frames means the observability half is dark, and a dead
+        worker mid-respawn-backoff reads as stale too. Threshold is a
+        multiple of the stats cadence with a floor wide enough to absorb
+        respawn backoff jitter."""
+        rows = self._process_reports()
+        threshold = max(5.0, 10.0 * 0.5)  # 10x the 0.5 s stats cadence
+        stale = []
+        for row in rows:
+            age = row.get("last_stats_age_seconds")
+            if age is None or age > threshold:
+                stale.append(row.get("process"))
+        return {
+            "healthy": not stale,
+            "processes": len(rows),
+            "stale": stale,
+        }
 
     def stop(self) -> None:
         self._stop.set()

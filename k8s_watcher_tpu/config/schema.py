@@ -675,8 +675,7 @@ class IngestConfig:
 
     def resolved_prefilter(self, tpu_prefilter: bool = True) -> str:
         """Effective prefilter mode: the legacy ``tpu.prefilter: false``
-        bool still forces ``off`` (one release of overlap, same posture as
-        metrics.legacy_suffix_names)."""
+        bool still forces ``off`` (one release of overlap)."""
         return "off" if not tpu_prefilter else self.prefilter
 
     @classmethod
@@ -1014,23 +1013,44 @@ class HistoryConfig:
 class MetricsConfig:
     """The ``metrics:`` section — observability-plane knobs.
 
-    ``legacy_suffix_names``: PR 10 migrated the per-upstream federation
-    gauges (``federation_upstream_lag_{rv,seconds}_<name>``) and the
-    per-codec serve cache counters (``serve_snapshot_cache_*_{json,
-    msgpack}``) from name-suffix mangling onto real Prometheus labels
-    (``...{upstream="a"}`` / ``...{codec="json"}``). This flag keeps the
-    OLD suffixed series emitted alongside for one release so existing
-    dashboards/alerts keep working while they migrate — default on in
-    production.yaml, off elsewhere.
+    ``process_export``: with worker processes live (``ingest.processes``
+    / ``federation.processes``), each worker ships its full registry
+    sample (+ completed traces) on its periodic stats frame and the
+    parent folds it under a ``process`` label — one scrape sees the
+    whole fleet. Off = workers ship only the ad-hoc stats fields
+    (pre-PR-18 wire), for the bench A/B and byte-budget-critical
+    deploys.
+
+    ``process_top_series``: how many hottest (by recent rate) process-
+    labeled counter series ``/debug/processes`` reports per worker.
+
+    The PR-10 ``legacy_suffix_names`` migration flag is gone: the
+    suffix-mangled series (``federation_upstream_lag_*_<name>``,
+    ``serve_snapshot_cache_*_{json,msgpack}``) were promised one
+    release of overlap and the labeled forms have been canonical since.
     """
 
-    legacy_suffix_names: bool = False
+    process_export: bool = True
+    process_top_series: int = 5
 
     @classmethod
     def from_raw(cls, raw: Mapping[str, Any]) -> "MetricsConfig":
-        _check_known(raw, ("legacy_suffix_names",), "metrics")
+        if "legacy_suffix_names" in raw:
+            raise SchemaError(
+                "config key 'metrics.legacy_suffix_names': removed — the "
+                "suffix-mangled series are gone; use the labeled forms "
+                "(federation_upstream_lag_*{upstream=...}, "
+                "serve_snapshot_cache_*{codec=...})"
+            )
+        _check_known(raw, ("process_export", "process_top_series"), "metrics")
+        top = _opt_int(raw, "process_top_series", "metrics", 5)
+        if top < 1:
+            raise SchemaError(
+                f"config key 'metrics.process_top_series': must be >= 1, got {top}"
+            )
         return cls(
-            legacy_suffix_names=_opt_bool(raw, "legacy_suffix_names", "metrics", False),
+            process_export=_opt_bool(raw, "process_export", "metrics", True),
+            process_top_series=top,
         )
 
 

@@ -218,9 +218,20 @@ class FaninPlan:
     token_dir: Optional[str] = None
     stats_interval_seconds: float = 0.5
     client_factory: Optional[Callable[["FaninPlan", Any], Any]] = None
+    #: spawn generation, stamped by the parent at each (re)spawn and
+    #: echoed on every stats frame ("g") so stale frames are discarded
+    generation: int = 0
+    #: ship the worker registry's sample() (+ anomaly traces) on the
+    #: periodic stats frame (``metrics.process_export``)
+    export_registry: bool = True
 
 
-def fanin_plans(config, token_dir: Optional[str] = None) -> List[FaninPlan]:
+def fanin_plans(
+    config,
+    token_dir: Optional[str] = None,
+    *,
+    process_export: bool = True,
+) -> List[FaninPlan]:
     """Partition the upstream list across ``federation.processes``
     workers by ``shard_of(cluster_name, processes)`` — a pure function
     of (name, processes), so a worker always finds its upstreams' token
@@ -239,6 +250,7 @@ def fanin_plans(config, token_dir: Optional[str] = None) -> List[FaninPlan]:
             ),
             config=config,
             token_dir=token_dir,
+            export_registry=process_export,
         )
         for p in range(config.processes)
     ]
@@ -292,7 +304,9 @@ class _UpstreamPump:
     seq'd pipe batch of prepared view items (+ passthrough bytes when
     eligible). The worker's staleness tick reads the clocks here."""
 
-    def __init__(self, plan: FaninPlan, cfg, ship: _PipeShip, index: int):
+    def __init__(
+        self, plan: FaninPlan, cfg, ship: _PipeShip, index: int, registry=None
+    ):
         import random
 
         self.cfg = cfg
@@ -305,6 +319,25 @@ class _UpstreamPump:
         self.lag_since: Optional[float] = None
         self.passthrough = 0  # eligible frames shipped as raw bytes
         self.deltas = 0
+        # worker-registry counters under WORKER-ONLY names: the parent
+        # owns federation_deltas_applied (post-dedup) and
+        # fanin_passthrough_frames (ad-hoc fold of stats["passthrough"]),
+        # so the exported sample must never reuse those names or the
+        # unlabeled rollup would double-count
+        self._deltas_shipped = (
+            registry.counter("federation_worker_deltas_shipped").labels(
+                cluster=self.name
+            )
+            if registry is not None
+            else None
+        )
+        self._raw_passthrough = (
+            registry.counter("federation_worker_passthrough_frames").labels(
+                cluster=self.name
+            )
+            if registry is not None
+            else None
+        )
         # same role as the in-process plane's per-upstream drop_lock:
         # serializes the drop decision against this subscriber thread's
         # snapshot-reconcile/delta-ship, and — because every ship
@@ -397,6 +430,8 @@ class _UpstreamPump:
                 )
                 if rewritten is not None:
                     self.passthrough += 1
+                    if self._raw_passthrough is not None:
+                        self._raw_passthrough.inc()
                 items.append(
                     [
                         kind,
@@ -411,6 +446,8 @@ class _UpstreamPump:
                     ]
                 )
             self.deltas += len(items)
+            if self._deltas_shipped is not None and items:
+                self._deltas_shipped.inc(len(items))
             self.ship.payload(
                 {"c": self.name, "e": self.subscriber.view, "b": items}, len(items)
             )
@@ -469,9 +506,28 @@ def _fanin_worker_entry(plan: FaninPlan, conn) -> None:
         ),
     )
     ship = _PipeShip(conn)
+    registry = None
+    tracer = None
+    trace_export = None
+    if plan.export_registry:
+        # worker-side observability: a registry whose sample() rides the
+        # stats frame, plus an anomaly-only tracer (sample_rate=0 — the
+        # merge path has no per-event journey to head-sample; staleness
+        # and drop verdicts are the anomalies worth shipping)
+        import collections
+
+        from k8s_watcher_tpu.metrics import MetricsRegistry
+        from k8s_watcher_tpu.trace.trace import Tracer
+
+        registry = MetricsRegistry()
+        trace_export = collections.deque(maxlen=64)
+        tracer = Tracer(
+            sample_rate=0, ring_size=64, metrics=registry,
+            export_buffer=trace_export,
+        )
     owned = {u.name: u for u in plan.config.upstreams}
     pumps = [
-        _UpstreamPump(plan, owned[name], ship, index)
+        _UpstreamPump(plan, owned[name], ship, index, registry=registry)
         for index, name in enumerate(plan.owned)
     ]
     stopping = threading.Event()
@@ -501,13 +557,23 @@ def _fanin_worker_entry(plan: FaninPlan, conn) -> None:
     last_stats = started_t
 
     def stats_payload() -> Dict[str, Any]:
-        return {
-            "stats": {
-                "upstreams": {p.name: p.status() for p in pumps},
-                "passthrough": sum(p.passthrough for p in pumps),
-                "deltas": sum(p.deltas for p in pumps),
-            }
+        stats: Dict[str, Any] = {
+            "upstreams": {p.name: p.status() for p in pumps},
+            "passthrough": sum(p.passthrough for p in pumps),
+            "deltas": sum(p.deltas for p in pumps),
         }
+        if registry is not None:
+            stats["registry"] = registry.sample(include_series=True)
+        if trace_export is not None:
+            drained = []
+            while True:
+                try:
+                    drained.append(trace_export.popleft())
+                except IndexError:
+                    break
+            if drained:
+                stats["traces"] = drained
+        return {"stats": stats, "g": plan.generation}
 
     try:
         while not stopping.is_set() and not ship.broken.is_set():
@@ -528,6 +594,16 @@ def _fanin_worker_entry(plan: FaninPlan, conn) -> None:
                             "Fan-in upstream %s went stale (last frame %s ago)",
                             pump.name, f"{age:.1f}s" if age is not None else "never",
                         )
+                        if tracer is not None:
+                            # always-captured anomaly, queryable at the
+                            # PARENT's /debug/trace?uid=<upstream name>
+                            # once it rides the next stats frame
+                            trace = tracer.start_anomaly(
+                                uid=pump.name, name=pump.name,
+                                kind="upstream", t0=now,
+                            )
+                            if trace is not None:
+                                tracer.finish(trace, "stale")
                     if plan.config.drop_stale and not pump.dropped:
                         age_now = pump.subscriber.last_frame_age()
                         if age_now is None or age_now > stale_threshold:
@@ -536,6 +612,13 @@ def _fanin_worker_entry(plan: FaninPlan, conn) -> None:
                                 "Dropped stale upstream %s from the global view",
                                 pump.name,
                             )
+                            if tracer is not None:
+                                trace = tracer.start_anomaly(
+                                    uid=pump.name, name=pump.name,
+                                    kind="upstream", t0=now,
+                                )
+                                if trace is not None:
+                                    tracer.finish(trace, "dropped")
             if now - last_stats >= plan.stats_interval_seconds:
                 last_stats = now
                 ship.control(stats_payload())
@@ -579,6 +662,7 @@ class FaninEndpoint(SupervisedEndpoint):
         *,
         metrics=None,
         heartbeat=None,
+        trace_ring=None,
         respawn_backoff: float = 0.5,
         respawn_backoff_max: float = 15.0,
     ):
@@ -595,6 +679,8 @@ class FaninEndpoint(SupervisedEndpoint):
             respawn_counter="fanin_worker_respawns",
             label="Merge worker",
             respawn_note="resume from per-upstream tokens",
+            process_label=f"merge-worker-{plan.proc_index}",
+            trace_ring=trace_ring,
         )
         self.passthrough_total = 0
         self._passthrough_seen = 0
@@ -602,11 +688,12 @@ class FaninEndpoint(SupervisedEndpoint):
         self._synced: Dict[str, Dict[str, int]] = {}
 
     def on_spawn(self) -> None:
+        super().on_spawn()  # reset registry-fold watermarks
         self._passthrough_seen = 0  # per-incarnation cumulative counters
         self._synced = {}
 
     def on_stats(self, stats: Dict[str, Any]) -> None:
-        self.last_stats = stats
+        super().on_stats(stats)  # fold exported registry sample + traces
         passthrough = stats.get("passthrough")
         if passthrough is not None:
             delta = passthrough - self._passthrough_seen
@@ -659,6 +746,8 @@ class ShardedFanin:
         resume_tokens_valid: bool = True,
         respawn_backoff: float = 0.5,
         heartbeat=None,
+        trace_ring=None,
+        process_export: bool = True,
     ):
         self.config = config
         self.merge = merge
@@ -670,9 +759,10 @@ class ShardedFanin:
                 plan,
                 metrics=metrics,
                 heartbeat=heartbeat,
+                trace_ring=trace_ring,
                 respawn_backoff=respawn_backoff,
             )
-            for plan in fanin_plans(config, token_dir)
+            for plan in fanin_plans(config, token_dir, process_export=process_export)
         ]
         # cluster -> {"epoch": str, "urv": int}; single-writer per
         # cluster (its worker's pump thread), so no lock needed
@@ -813,3 +903,7 @@ class ShardedFanin:
             "passthrough": sum(e.passthrough_total for e in self.endpoints),
             "hellos": [e.last_hello for e in self.endpoints],
         }
+
+    def process_report(self) -> List[Dict[str, Any]]:
+        """Per-worker supervision rows for ``/debug/processes``."""
+        return [e.report() for e in self.endpoints]

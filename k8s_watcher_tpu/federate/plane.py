@@ -113,9 +113,7 @@ class _Upstream:
         )
         self._plane = plane
         metrics = plane.metrics
-        # per-upstream series as REAL labels (`...{upstream="a"}`);
-        # the pre-label suffix-mangled names stay for one release behind
-        # metrics.legacy_suffix_names (dashboard continuity)
+        # per-upstream series as REAL labels (`...{upstream="a"}`)
         if metrics is not None:
             label = {"upstream": self.name}
             self.lag_rv_gauge = metrics.gauge("federation_upstream_lag_rv").labels(**label)
@@ -143,18 +141,6 @@ class _Upstream:
             self.watermark_age_gauge = None
             self.last_delta_age_gauge = None
             self.oldest_unpropagated_gauge = None
-        legacy = metrics is not None and getattr(metrics, "legacy_suffix_names", False)
-        if legacy:
-            suffix = _metric_suffix(self.name)
-            self.legacy_lag_rv_gauge = metrics.gauge(f"federation_upstream_lag_rv_{suffix}")
-            self.legacy_lag_seconds_gauge = metrics.gauge(
-                f"federation_upstream_lag_seconds_{suffix}"
-            )
-            self.legacy_stale_gauge = metrics.gauge(f"federation_upstream_stale_{suffix}")
-        else:
-            self.legacy_lag_rv_gauge = None
-            self.legacy_lag_seconds_gauge = None
-            self.legacy_stale_gauge = None
 
     def _on_snapshot(self, snap: Snapshot) -> None:
         if self.epoch is not None and snap.view != self.epoch:
@@ -276,11 +262,6 @@ class _Upstream:
             if delta_age is not None:
                 self.last_delta_age_gauge.set(delta_age)
             self.oldest_unpropagated_gauge.set(oldest_unpropagated)
-        if self.legacy_lag_rv_gauge is not None:
-            self.legacy_lag_rv_gauge.set(lag_rv)
-            if age is not None:
-                self.legacy_lag_seconds_gauge.set(age)
-            self.legacy_stale_gauge.set(1.0 if self.stale else 0.0)
 
     def freshness(self) -> Dict[str, Any]:
         """This upstream's watermark block for /debug/freshness."""
@@ -326,8 +307,6 @@ class _UpstreamMirror:
     staleness verdict is MIRRORED, never recomputed — the plane's
     ``staleness_owner`` is ``"merge-workers"`` and exactly one
     component may ever flip ``federation_upstream_stale`` per upstream.
-    (No legacy suffix-mangled gauge names here: sharded mode postdates
-    the label migration, so there is no dashboard continuity to keep.)
     """
 
     def __init__(self, plane: "FederationPlane", cfg):
@@ -426,6 +405,8 @@ class FederationPlane:
         token_dir: Optional[str] = None,
         resume_tokens_valid: bool = True,
         trace_collector=None,  # trace.federation.FleetTraceCollector
+        trace_ring=None,  # trace.TraceRing: worker anomaly traces land here
+        process_export: bool = True,  # metrics.process_export
     ):
         self.config = config
         self.metrics = metrics
@@ -523,6 +504,8 @@ class FederationPlane:
                 metrics=metrics,
                 token_dir=token_dir,
                 resume_tokens_valid=resume_tokens_valid,
+                trace_ring=trace_ring,
+                process_export=process_export,
             )
             self.upstreams: List[_Upstream] = []
             self.mirrors = [_UpstreamMirror(self, u) for u in config.upstreams]
@@ -763,3 +746,8 @@ class FederationPlane:
             "stale_after_seconds": self.stale_threshold,
             "staleness_owner": self.staleness_owner,
         }
+
+    def process_report(self) -> List[Dict[str, Any]]:
+        """Per-merge-worker supervision rows for ``/debug/processes``
+        (empty in in-process mode — there are no worker processes)."""
+        return self.fanin.process_report() if self.fanin is not None else []

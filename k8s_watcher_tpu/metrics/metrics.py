@@ -364,6 +364,63 @@ class Histogram(_LabelFamily):
             out.append((bound, cum))
         return out, total, s
 
+    def ingest_bucket_deltas(self, items, n_delta: int, sum_delta: float) -> None:
+        """Add pre-differenced per-bucket increments from ANOTHER
+        histogram's cumulative sample (``_diff_cum_pairs``). Each item is
+        ``(upper_bound_seconds, count)``; counts land in this histogram's
+        bucket whose upper edge matches (sampled bounds come from the
+        same ``_log_buckets`` generator, so they align exactly; a
+        downsampled bound still lands at its own edge, keeping cumulative
+        reads correct at the exported resolution)."""
+        if not items and n_delta <= 0:
+            return
+        placed = []
+        max_hint = 0.0
+        for bound, count in items:
+            if count <= 0:
+                continue
+            b = float(bound)
+            idx = len(self._counts) - 1 if math.isinf(b) else bisect.bisect_left(self._bounds, b)
+            placed.append((min(idx, len(self._counts) - 1), count))
+            if not math.isinf(b) and b > max_hint:
+                max_hint = b
+        with self._lock:
+            for idx, count in placed:
+                self._counts[idx] += count
+            self._n += max(0, n_delta)
+            self._sum += sum_delta
+            if max_hint > self._max:
+                self._max = max_hint
+
+
+def _diff_cum_pairs(pairs, total, sum_value, state):
+    """Difference one cumulative bucket sample against the previous one
+    (``state``, caller-owned, reset per worker spawn generation) into
+    per-bucket increments. Returns ``(items, n_delta, sum_delta)`` and
+    updates ``state`` in place. A non-monotone total (fresh worker
+    incarnation reporting from zero against a stale watermark) resets the
+    baseline so nothing is double-counted or folded backwards."""
+    prev_cum = state.get("cum") or {}
+    prev_total = int(state.get("total") or 0)
+    prev_sum = float(state.get("sum") or 0.0)
+    if total < prev_total:
+        prev_cum, prev_total, prev_sum = {}, 0, 0.0
+    items = []
+    cum_now = {}
+    last_new = 0
+    for bound, cum in pairs:
+        b = float(bound)
+        cum_now[b] = cum
+        new_below = cum - prev_cum.get(b, 0)
+        inc = new_below - last_new
+        last_new = new_below
+        if inc > 0:
+            items.append((b, inc))
+    state["cum"] = cum_now
+    state["total"] = int(total)
+    state["sum"] = float(sum_value)
+    return items, int(total) - prev_total, float(sum_value) - prev_sum
+
 
 class MetricsRegistry:
     """Named counters/histograms for one watcher process.
@@ -374,14 +431,13 @@ class MetricsRegistry:
     to pay a fresh O(n log n) sort per request for a key set that
     changes only when a new metric first registers (startup, mostly).
 
-    ``legacy_suffix_names`` is the one-release dashboard-continuity
-    flag (config ``metrics.legacy_suffix_names``): planes that migrated
-    their per-upstream/per-codec series from name-suffix mangling
-    (``federation_upstream_lag_rv_<name>``) onto real labels consult it
-    to ALSO keep emitting the old suffixed series.
+    ``fold_sample`` is the multi-process half: a parent process imports
+    a worker registry's ``sample()`` under a ``process`` label, with
+    counter/histogram deltas differenced against caller-owned
+    per-spawn-generation watermarks (see ``parallel/procpool.py``).
     """
 
-    def __init__(self, *, legacy_suffix_names: bool = False):
+    def __init__(self):
         self._lock = threading.Lock()
         self._counters: Dict[str, Counter] = {}
         self._histograms: Dict[str, Histogram] = {}
@@ -391,7 +447,6 @@ class MetricsRegistry:
         self._sorted_counters: Optional[List[Tuple[str, Counter]]] = None
         self._sorted_histograms: Optional[List[Tuple[str, Histogram]]] = None
         self._sorted_gauges: Optional[List[Tuple[str, Gauge]]] = None
-        self.legacy_suffix_names = legacy_suffix_names
 
     def counter(self, name: str) -> Counter:
         with self._lock:
@@ -550,7 +605,7 @@ class MetricsRegistry:
                 out[name] = entry
         return out
 
-    def sample(self) -> Dict[str, Dict]:
+    def sample(self, *, include_series: bool = False) -> Dict[str, Dict]:
         """One raw point-in-time sample of every registered metric — the
         SLO plane's timeseries-ring tick. Deliberately cheaper and rawer
         than ``dump()``:
@@ -561,6 +616,12 @@ class MetricsRegistry:
           upstream staleness objectives gate the worst member);
         - histograms -> ``(cumulative_pairs, total, sum)`` so a window
           evaluation can difference two samples' buckets.
+
+        ``include_series=True`` (the procpool registry-export path) adds
+        a ``series`` key carrying counter/gauge label children as
+        ``[[[name, value], ...label pairs], total]`` rows, so a parent
+        process can fold per-label series too. The default stays the
+        flat PR-12 shape the SLO ring stores 1024 deep.
         """
         counters, gauges, histograms = self._sorted_items()
         out: Dict[str, Dict] = {"counters": {}, "gauges": {}, "histograms": {}}
@@ -572,4 +633,118 @@ class MetricsRegistry:
                 out["gauges"][name] = max(readings)
         for name, h in histograms:
             out["histograms"][name] = h.downsampled_buckets_with_totals()
+        if include_series:
+            c_series: Dict[str, List] = {}
+            for name, c in counters:
+                rows = [
+                    [[list(pair) for pair in ch.labelset], ch.value]
+                    for ch in c.children()
+                ]
+                if rows:
+                    c_series[name] = rows
+            g_series: Dict[str, List] = {}
+            for name, g in gauges:
+                rows = [
+                    [[list(pair) for pair in ch.labelset], reading]
+                    for ch in g.children()
+                    if (reading := ch.read()) is not None
+                ]
+                if rows:
+                    g_series[name] = rows
+            if c_series or g_series:
+                out["series"] = {"counters": c_series, "gauges": g_series}
         return out
+
+    def fold_sample(
+        self,
+        sample: Dict,
+        *,
+        process: str,
+        watermarks: Dict,
+        rollup_exclude=frozenset(),
+    ) -> None:
+        """Fold one worker registry ``sample()`` into this registry under
+        a ``process`` label.
+
+        ``watermarks`` is CALLER-OWNED per-spawn-generation state (a
+        plain dict): counter/histogram deltas are differenced against it,
+        and the caller must swap in a fresh dict whenever the worker
+        respawns — that is what makes a crash->respawn fold from the new
+        incarnation's zeros instead of double-counting or going backwards.
+
+        - counters: the delta goes to ``<name>{process=...}`` (always
+          registered, even at zero, so idle workers stay visible) AND to
+          the unlabeled parent total — unless the name is in
+          ``rollup_exclude``, for counters the parent already folds by
+          another path (e.g. ``events_prefiltered`` via the ad-hoc stats
+          field), which keeps unlabeled rollups exact.
+        - gauges: last-write point-in-time set on the process child; the
+          unlabeled parent is never touched (it is this process's own).
+        - histograms: cumulative-bucket deltas ingested into the process
+          child and (same ``rollup_exclude`` contract) the parent.
+        - label children ride ``sample()['series']``: the worker's label
+          set is extended with ``process`` (child-only; no unlabeled
+          rollup — the parent's own children own those totals).
+        """
+        wm_counters = watermarks.setdefault("counters", {})
+        wm_series = watermarks.setdefault("series", {})
+        wm_hist = watermarks.setdefault("histograms", {})
+        for name, total in (sample.get("counters") or {}).items():
+            family = self.counter(name)
+            child = family.labels(process=process)
+            delta = int(total) - wm_counters.get(name, 0)
+            wm_counters[name] = int(total)
+            if delta > 0:
+                child.inc(delta)
+                if name not in rollup_exclude:
+                    family.inc(delta)
+        for name, value in (sample.get("gauges") or {}).items():
+            self.gauge(name).labels(process=process).set(value)
+        for name, triple in (sample.get("histograms") or {}).items():
+            pairs, total, sum_value = triple
+            family = self.histogram(name)
+            child = family.labels(process=process)
+            items, n_delta, sum_delta = _diff_cum_pairs(
+                pairs, total, sum_value, wm_hist.setdefault(name, {})
+            )
+            child.ingest_bucket_deltas(items, n_delta, sum_delta)
+            if name not in rollup_exclude:
+                family.ingest_bucket_deltas(items, n_delta, sum_delta)
+        series = sample.get("series") or {}
+        for name, rows in (series.get("counters") or {}).items():
+            family = self.counter(name)
+            for pairs, total in rows:
+                labels = {str(k): v for k, v in pairs}
+                labels["process"] = process
+                key = (name,) + tuple(sorted((str(k), str(v)) for k, v in pairs))
+                child = family.labels(**labels)
+                delta = int(total) - wm_series.get(key, 0)
+                wm_series[key] = int(total)
+                if delta > 0:
+                    child.inc(delta)
+        for name, rows in (series.get("gauges") or {}).items():
+            family = self.gauge(name)
+            for pairs, value in rows:
+                labels = {str(k): v for k, v in pairs}
+                labels["process"] = process
+                family.labels(**labels).set(value)
+
+    def hottest_series(self, process: str, n: int = 5) -> List[Dict]:
+        """Top-``n`` counter series folded for one ``process`` label
+        value, ranked by 60 s rate then total — ``/debug/processes``'s
+        "which series is hot on that worker" answer."""
+        counters, _gauges, _histograms = self._sorted_items()
+        rows = []
+        for name, c in counters:
+            for child in c.children():
+                labels = dict(child.labelset)
+                if labels.get("process") != process:
+                    continue
+                rest = tuple(p for p in child.labelset if p[0] != "process")
+                rows.append({
+                    "series": name + render_labels(rest),
+                    "total": child.value,
+                    "per_minute": child.rate_per_minute(),
+                })
+        rows.sort(key=lambda r: (-r["per_minute"], -r["total"], r["series"]))
+        return rows[:n]

@@ -226,6 +226,15 @@ class _StatusHandler(BaseHTTPRequestHandler):
     # into the /healthz BODY — degraded only, never liveness (restarting
     # the watcher cannot fix a straggling machine)
     node_health_fold = None
+    # Callable[[], dict]: per-worker-process supervision detail (liveness,
+    # spawn generation, last-stats age, respawn/gap counters, hottest
+    # series) -> /debug/processes, when worker processes are live
+    processes = None
+    # Callable[[], dict]: worker-process verdict folded into the /healthz
+    # BODY — stale worker stats = degraded only, never liveness (the
+    # supervisor already respawns a dead worker; a kubelet restart of the
+    # PARENT would relist the world to fix a child)
+    processes_fold = None
     slices = None  # Callable[[], dict]: live slice states, optional
     trend = None  # Callable[[], dict]: probe trend anchors/windows, optional
     # Callable[[], Optional[dict]]: remediation policy state; the callable
@@ -329,6 +338,9 @@ class _StatusHandler(BaseHTTPRequestHandler):
                 # degraded-body only too: a confirmed straggler is a fleet
                 # fact, not a local fault a kubelet restart can fix
                 body["health"] = self.node_health_fold()
+            if self.processes_fold is not None:
+                # degraded-body only: the supervisor owns worker revival
+                body["processes"] = self.processes_fold()
             self._json(200 if alive else 503, body)
         elif parsed.path == "/debug/events":
             if self.audit is None:
@@ -420,6 +432,14 @@ class _StatusHandler(BaseHTTPRequestHandler):
                 self._json(404, {"error": "SLO engine not enabled (slo.enabled)"})
                 return
             self._json(200, {"slo": self.slo()})
+        elif parsed.path == "/debug/processes":
+            if self.processes is None:
+                self._json(404, {
+                    "error": "no worker processes "
+                             "(ingest.processes / federation.processes: 0)",
+                })
+                return
+            self._json(200, {"processes": self.processes()})
         elif parsed.path == "/debug/health":
             if self.node_health is None:
                 self._json(404, {"error": "health plane not enabled (health.enabled)"})
@@ -459,6 +479,8 @@ class StatusServer:
         slo_health=None,  # Callable[[], dict] -> /healthz body fold (SLOPlane.health)
         node_health=None,  # Callable[[], dict] -> /debug/health (HealthPlane.snapshot)
         node_health_fold=None,  # Callable[[], dict] -> /healthz body fold (HealthPlane.health)
+        processes=None,  # Callable[[], dict] -> /debug/processes (worker supervision)
+        processes_fold=None,  # Callable[[], dict] -> /healthz body fold (worker staleness)
         slices=None,  # Callable[[], dict] -> serves /debug/slices
         trend=None,  # Callable[[], dict] -> serves /debug/trend
         remediation=None,  # Callable[[], Optional[dict]] -> /debug/remediation
@@ -486,6 +508,8 @@ class StatusServer:
                 "slo_health": staticmethod(slo_health) if slo_health else None,
                 "node_health": staticmethod(node_health) if node_health else None,
                 "node_health_fold": staticmethod(node_health_fold) if node_health_fold else None,
+                "processes": staticmethod(processes) if processes else None,
+                "processes_fold": staticmethod(processes_fold) if processes_fold else None,
                 "slices": staticmethod(slices) if slices else None,
                 "trend": staticmethod(trend) if trend else None,
                 "remediation": staticmethod(remediation) if remediation else None,

@@ -28,12 +28,16 @@ streams vs upstream fleet subscribers) — both stay in their own modules.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import logging
 import multiprocessing
 import random
 import threading
+import time
 from typing import Any, Dict, Iterator, Optional
+
+from ..trace.trace import trace_from_wire
 
 logger = logging.getLogger(__name__)
 
@@ -99,6 +103,9 @@ class SupervisedEndpoint:
         respawn_counter: Optional[str] = None,
         label: str = "worker",
         respawn_note: str = "",
+        process_label: Optional[str] = None,
+        trace_ring=None,
+        rollup_exclude=frozenset(),
     ):
         self.plan = plan
         self.target = target
@@ -112,14 +119,34 @@ class SupervisedEndpoint:
         self.respawn_counter = respawn_counter
         self.label = label
         self.respawn_note = respawn_note
+        #: the worker's ``process`` label value on every folded series
+        #: (``ingest-shard-2``, ``merge-worker-0``); also the
+        #: /debug/processes key
+        self.process_label = process_label or name
+        #: parent TraceRing imported worker traces land in (the shared
+        #: /debug/trace ring when tracing is wired)
+        self.trace_ring = trace_ring
+        #: counter names whose UNLABELED parent totals another fold path
+        #: already owns (ad-hoc stats fields) — fold_sample skips the
+        #: unlabeled rollup for these so nothing double-counts
+        self.rollup_exclude = frozenset(rollup_exclude)
         self.last_hello: Optional[Dict[str, Any]] = None
         self.last_stats: Dict[str, Any] = {}
+        self.last_stats_at: Optional[float] = None
         self.spawns = 0
         self.respawns = 0
         self.wire_gaps = 0
+        self.stats_frames = 0
+        self.stale_stats_discarded = 0
+        self.traces_imported = 0
         # cumulative payload ITEMS delivered across incarnations (the
         # seq unit): watch events for ingest, merged deltas for fan-in
         self.events_delivered = 0
+        # per-spawn-generation registry-fold watermarks: swapped for a
+        # fresh dict in on_spawn so a respawned worker's from-zero
+        # counters fold as new deltas, never as a backwards step
+        self._sample_watermarks: Dict[str, Any] = {}
+        self._fold_errors = 0
         self._stop = threading.Event()
         self._lock = threading.Lock()
         self._proc: Optional[multiprocessing.process.BaseProcess] = None
@@ -131,16 +158,82 @@ class SupervisedEndpoint:
     def on_spawn(self) -> None:
         """Called after each (re)spawn, before any frame is read — reset
         per-incarnation fold state (cumulative in-child counters restart
-        at zero; parent-side totals must not)."""
+        at zero; parent-side totals must not). Subclasses overriding this
+        must call ``super().on_spawn()``."""
+        self._sample_watermarks = {}
 
     def on_hello(self, hello: Dict[str, Any]) -> None:
         self.last_hello = hello
 
     def on_stats(self, stats: Dict[str, Any]) -> None:
+        """Fold one stats frame: the generic registry/trace export first
+        (when the frame carries them), then whatever the tier subclass
+        adds. Subclasses must call ``super().on_stats(stats)``."""
         self.last_stats = stats
+        self.last_stats_at = time.monotonic()
+        self.stats_frames += 1
+        self._fold_exported(stats)
 
     def on_eos(self, msg: Dict[str, Any]) -> None:
         """A clean drain's terminal message (stats already folded)."""
+
+    def _fold_exported(self, stats: Dict[str, Any]) -> None:
+        """Fold the worker's exported registry sample + completed traces
+        off one stats frame. Defensive by contract: the fold runs on the
+        pump thread, so a malformed frame must count and continue, never
+        kill the event stream."""
+        registry = stats.get("registry")
+        if registry is not None and self.metrics is not None:
+            try:
+                self.metrics.fold_sample(
+                    registry,
+                    process=self.process_label,
+                    watermarks=self._sample_watermarks,
+                    rollup_exclude=self.rollup_exclude,
+                )
+            except Exception:
+                self._fold_errors += 1
+                self.metrics.counter("process_sample_fold_errors").inc()
+                if self._fold_errors == 1:  # first failure tells the story
+                    logger.warning(
+                        "%s %d: registry sample fold failed (counted from now on)",
+                        self.label, self.index, exc_info=True,
+                    )
+        traces = stats.get("traces")
+        if traces and self.trace_ring is not None:
+            imported = 0
+            for wire in traces:
+                try:
+                    self.trace_ring.record(
+                        trace_from_wire(wire, process=self.process_label)
+                    )
+                    imported += 1
+                except Exception:  # noqa: BLE001 — same never-kill contract
+                    continue
+            self.traces_imported += imported
+            if imported and self.metrics is not None:
+                self.metrics.counter("process_traces_imported").inc(imported)
+
+    def report(self) -> Dict[str, Any]:
+        """One worker's /debug/processes row: liveness, spawn generation,
+        stats freshness and the supervision counters."""
+        proc = self._proc
+        last = self.last_stats_at
+        return {
+            "process": self.process_label,
+            "alive": bool(proc is not None and proc.is_alive()),
+            "pid": proc.pid if proc is not None else None,
+            "generation": self.spawns,
+            "respawns": self.respawns,
+            "wire_gaps": self.wire_gaps,
+            "events_delivered": self.events_delivered,
+            "stats_frames": self.stats_frames,
+            "stale_stats_discarded": self.stale_stats_discarded,
+            "traces_imported": self.traces_imported,
+            "last_stats_age_seconds": (
+                round(time.monotonic() - last, 3) if last is not None else None
+            ),
+        }
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -148,17 +241,27 @@ class SupervisedEndpoint:
         with self._lock:
             if self._stop.is_set():
                 return None
+            generation = self.spawns + 1
+            plan = self.plan
+            if dataclasses.is_dataclass(plan) and hasattr(plan, "generation"):
+                # stamp the spawn generation into the child's plan: the
+                # worker echoes it on every stats frame ("g"), and the
+                # parent discards any frame whose generation is not the
+                # CURRENT incarnation's — a stale frame drained off a
+                # killed worker's pipe must never fold into fresh
+                # watermarks (it would double-count the old incarnation)
+                plan = dataclasses.replace(plan, generation=generation)
             recv_conn, send_conn = self._ctx.Pipe(duplex=False)
             proc = self._ctx.Process(
                 target=self.target,
-                args=(self.plan, send_conn),
+                args=(plan, send_conn),
                 name=self.name,
                 daemon=True,  # safety net only; stop() drains via SIGTERM
             )
             proc.start()
             send_conn.close()  # child holds the write end now; EOF tracks it
             self._proc, self._conn = proc, recv_conn
-            self.spawns += 1
+            self.spawns = generation
             return recv_conn
 
     def _reap(self) -> None:
@@ -240,6 +343,18 @@ class SupervisedEndpoint:
                         yield msg
                         continue
                     if "stats" in msg:
+                        gen = msg.get("g")
+                        if gen is not None and gen != self.spawns:
+                            # a frame from a previous incarnation (stale
+                            # pipe drain after a kill->respawn): folding
+                            # it against the fresh watermarks would
+                            # double-count — discard, visibly
+                            self.stale_stats_discarded += 1
+                            if self.metrics is not None:
+                                self.metrics.counter(
+                                    "procpool_stale_stats_discarded"
+                                ).inc()
+                            continue
                         self.on_stats(msg["stats"])
                         continue
                     if "hello" in msg:

@@ -420,10 +420,7 @@ class FleetView:
             metrics.counter("serve_snapshot_cache_misses") if metrics is not None else None
         )
         # per-codec breakdown as REAL labels (`...{codec="json"}`); the
-        # parents above keep the cross-codec totals. The pre-label
-        # suffix-mangled names are kept for one release behind
-        # metrics.legacy_suffix_names (dashboard continuity).
-        legacy = metrics is not None and getattr(metrics, "legacy_suffix_names", False)
+        # parents above keep the cross-codec totals
         self._snap_hits_by_codec = (
             {c: self._snap_hits.labels(codec=c) for c in CODECS}
             if metrics is not None
@@ -432,16 +429,6 @@ class FleetView:
         self._snap_misses_by_codec = (
             {c: self._snap_misses.labels(codec=c) for c in CODECS}
             if metrics is not None
-            else None
-        )
-        self._snap_hits_legacy = (
-            {c: metrics.counter(f"serve_snapshot_cache_hits_{c}") for c in CODECS}
-            if legacy
-            else None
-        )
-        self._snap_misses_legacy = (
-            {c: metrics.counter(f"serve_snapshot_cache_misses_{c}") for c in CODECS}
-            if legacy
             else None
         )
         # freshness plane: how long a mutation took from its origin stamp
@@ -1064,8 +1051,6 @@ class FleetView:
                 if self._snap_hits is not None:
                     self._snap_hits.inc()
                     self._snap_hits_by_codec[codec].inc()
-                    if self._snap_hits_legacy is not None:
-                        self._snap_hits_legacy[codec].inc()
                 return cached[1]
             rv = self._rv
             instance = self.instance
@@ -1107,8 +1092,6 @@ class FleetView:
         if self._snap_misses is not None:
             self._snap_misses.inc()
             self._snap_misses_by_codec[codec].inc()
-            if self._snap_misses_legacy is not None:
-                self._snap_misses_legacy[codec].inc()
         return data
 
     def object_count(self) -> int:

@@ -189,6 +189,9 @@ class SLOPlane:
     def tick(self) -> Dict[str, Dict[str, Any]]:
         """One sample + one evaluation pass (also the test seam)."""
         now = time.monotonic()
+        # flat sample (no per-label series): objectives are declared
+        # against parent totals, and the process-labeled fold keeps
+        # those exact regardless of worker-export state
         self.ring.append(now, self.metrics.sample())
         self._ticks += 1
         results = {o.name: self._evaluate(o, now) for o in self.config.objectives}

@@ -140,6 +140,7 @@ class Trace:
         "event_type",
         "kind",
         "cluster",
+        "process",
         "shard",
         "lane",
         "sampled_by",
@@ -173,6 +174,7 @@ class Trace:
         self.event_type = event_type
         self.kind = "pod"
         self.cluster: Optional[str] = None  # origin cluster (joined traces)
+        self.process: Optional[str] = None  # origin worker (imported traces)
         self.shard = shard
         self.lane: Optional[int] = None
         self.sampled_by = sampled_by  # "head" | "anomaly"
@@ -243,6 +245,10 @@ class Trace:
             # only joined (federation) traces carry a cluster; local
             # entries keep their pre-federation dict shape byte-for-byte
             out["cluster"] = self.cluster
+        if self.process is not None:
+            # only traces imported over the procpool stats frame carry
+            # the origin worker (same conditional-shape convention)
+            out["process"] = self.process
         return out
 
 
@@ -266,6 +272,61 @@ def wire_trace(trace: "Trace") -> Dict[str, Any]:
             for stage, start, end in list(trace.spans)
         ],
     }
+
+
+def export_trace(trace: "Trace") -> Dict[str, Any]:
+    """The procpool stats-frame form of a COMPLETED worker trace: the
+    compact ``wire_trace`` spans plus the terminal metadata the parent
+    ring needs to answer ``/debug/trace`` queries (outcome, anomaly
+    verdict, kind, duration). Span offsets stay worker-monotonic
+    differences — internally consistent, never compared across the
+    process boundary (there is no cross-process happens-before)."""
+    out = wire_trace(trace)
+    duration = trace.duration_seconds()
+    out.update(
+        name=trace.name,
+        event_type=trace.event_type,
+        kind=trace.kind,
+        shard=trace.shard,
+        sampled_by=trace.sampled_by,
+        outcome=trace.outcome,
+        anomaly=trace.anomaly,
+        duration=round(duration, 6) if duration is not None else None,
+    )
+    return out
+
+
+def trace_from_wire(wire: Dict, *, process: Optional[str] = None) -> Trace:
+    """Rehydrate an ``export_trace`` dict (read off a worker stats frame)
+    into a parent-ring ``Trace``. The rebuilt trace lives at origin
+    ``t0=0.0`` with the exported span offsets — correct durations and
+    stage attribution, no cross-process clock claims — and carries the
+    origin worker in ``process``."""
+    trace = Trace(
+        str(wire.get("id") or new_trace_id()),
+        uid=str(wire.get("uid") or ""),
+        name=str(wire.get("name") or ""),
+        event_type=str(wire.get("event_type") or ""),
+        t0=0.0,
+        shard=wire.get("shard"),
+        sampled_by=str(wire.get("sampled_by") or "head"),
+    )
+    trace.kind = str(wire.get("kind") or "pod")
+    trace.process = process
+    for span in wire.get("spans") or ():
+        try:
+            stage, start, end = span
+            trace.add_span(str(stage), float(start), float(end))
+        except (TypeError, ValueError):
+            continue
+    trace.outcome = wire.get("outcome")
+    trace.anomaly = bool(wire.get("anomaly"))
+    duration = wire.get("duration")
+    if duration is not None:
+        trace.end = float(duration)
+    elif trace.spans:
+        trace.end = max(end for _stage, _start, end in trace.spans)
+    return trace
 
 
 class TraceSampler:
@@ -367,12 +428,18 @@ class Tracer:
         ring_size: int = 256,
         metrics=None,  # metrics.MetricsRegistry, optional
         enabled: bool = True,
+        export_buffer=None,  # bounded deque; worker-side procpool export
     ):
         self.enabled = enabled
         self.sample_rate = sample_rate
         self.sampler = TraceSampler(sample_rate)
         self.ring = TraceRing(ring_size)
         self.metrics = metrics
+        # when set (worker processes), every finished trace ALSO lands in
+        # this deque as its export_trace() dict; the worker's stats loop
+        # drains it onto the procpool wire. A deque(maxlen=N) bounds it —
+        # newest wins, same policy as the ring.
+        self.export_buffer = export_buffer
 
     # -- head sampling (ingest hot path) -----------------------------------
 
@@ -445,6 +512,8 @@ class Tracer:
         trace.end = end if end is not None else time.monotonic()
         trace.anomaly = outcome in ANOMALY_OUTCOMES or trace.sampled_by == "anomaly"
         self.ring.record(trace)
+        if self.export_buffer is not None:
+            self.export_buffer.append(export_trace(trace))
         metrics = self.metrics
         if metrics is not None:
             metrics.counter("trace_completed").inc()

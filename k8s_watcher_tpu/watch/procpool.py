@@ -100,6 +100,16 @@ class WorkerPlan:
     checkpoint_dir: Optional[str] = None
     source_factory: Optional[Callable[["WorkerPlan"], Sequence[Any]]] = None
     factory_arg: Any = None
+    #: spawn generation, stamped by the parent at each (re)spawn and
+    #: echoed on every stats frame ("g") so stale frames are discarded
+    generation: int = 0
+    #: ship the worker registry's sample() (+ completed traces) on the
+    #: periodic stats frame (``metrics.process_export``; the bench A/B's
+    #: off switch)
+    export_registry: bool = True
+    #: factory-path head-sampling rate for the worker tracer (production
+    #: plans read ``config.trace`` instead; 0 = off)
+    trace_sample_rate: int = 0
 
 
 def plans_from_config(config) -> List[WorkerPlan]:
@@ -121,6 +131,7 @@ def plans_from_config(config) -> List[WorkerPlan]:
             queue_capacity=ingest.queue_capacity,
             config=config,
             checkpoint_dir=checkpoint_dir,
+            export_registry=config.metrics.process_export,
         )
         for p in range(ingest.processes)
     ]
@@ -261,16 +272,49 @@ def _worker_entry(plan: WorkerPlan, conn) -> None:
     stopping = threading.Event()
     checkpoints: Dict[int, Any] = {}
     rv_views: Dict[int, Any] = {}
-    metrics = None
+    k8s_metrics = None
     if plan.source_factory is not None:
         sources = list(plan.source_factory(plan))
+        # always instrumented, matching the production path (k8s_metrics
+        # below): export_registry gates only the sample/ship/fold — so
+        # the bench A/B measures exactly what metrics.process_export
+        # toggles, not the cost of having counters at all
+        from k8s_watcher_tpu.metrics import MetricsRegistry
+
+        registry = MetricsRegistry()
     else:
-        sources, checkpoints, rv_views, metrics = _build_k8s_sources(plan)
+        sources, checkpoints, rv_views, k8s_metrics = _build_k8s_sources(plan)
+        registry = k8s_metrics
+    # worker-side tracer: head-samples journeys at the shard pumps and
+    # always-captures anomalies; completed traces ride the stats frame
+    # into the parent ring. Gated on export_registry — a trace nobody can
+    # ever read is pure overhead.
+    tracer = None
+    trace_export: Optional[Any] = None
+    trace_cfg = getattr(plan.config, "trace", None) if plan.config is not None else None
+    if plan.export_registry and registry is not None and (
+        (trace_cfg is not None and trace_cfg.enabled) or plan.trace_sample_rate > 0
+    ):
+        import collections
+
+        from k8s_watcher_tpu.trace.trace import Tracer
+
+        trace_export = collections.deque(maxlen=128)
+        tracer = Tracer(
+            sample_rate=(
+                trace_cfg.sample_rate if trace_cfg is not None and trace_cfg.enabled
+                else plan.trace_sample_rate
+            ),
+            ring_size=trace_cfg.ring_size if trace_cfg is not None and trace_cfg.enabled else 256,
+            metrics=registry,
+            export_buffer=trace_export,
+        )
     sharded = ShardedWatchSource(
         sources,
         batch_max=plan.batch_max,
         queue_capacity=plan.queue_capacity,
-        metrics=metrics,
+        metrics=registry,
+        tracer=tracer,
     )
 
     def on_sigterm(signum, frame):  # noqa: ARG001 — signal signature
@@ -301,15 +345,26 @@ def _worker_entry(plan: WorkerPlan, conn) -> None:
             "shard_counts": list(sharded.per_shard_counts),
             "queue_high_water": sharded.queue.high_water,
         }
-        if metrics is not None:
-            out["prefiltered"] = int(metrics.counter("events_prefiltered").value)
-            out["relists"] = int(metrics.counter("relists").value)
+        if k8s_metrics is not None:
+            out["prefiltered"] = int(k8s_metrics.counter("events_prefiltered").value)
+            out["relists"] = int(k8s_metrics.counter("relists").value)
         else:
             # factory sources (bench/tests) count their own skips
             counts = [getattr(s, "prefiltered", None) for s in sources]
             known = [c for c in counts if c is not None]
             if known:
                 out["prefiltered"] = int(sum(known))
+        if plan.export_registry and registry is not None:
+            out["registry"] = registry.sample(include_series=True)
+        if trace_export is not None:
+            drained = []
+            while True:
+                try:
+                    drained.append(trace_export.popleft())
+                except IndexError:
+                    break
+            if drained:
+                out["traces"] = drained
         return out
 
     resumed = [
@@ -386,6 +441,7 @@ def _worker_entry(plan: WorkerPlan, conn) -> None:
 
         sharded.start()
         seq = 0
+        shipped_counter = registry.counter("ingest_events_shipped") if registry is not None else None
         last_stats = time.monotonic()
         while True:
             batch = sharded.queue.get_batch(plan.batch_max, timeout=0.5)
@@ -411,6 +467,20 @@ def _worker_entry(plan: WorkerPlan, conn) -> None:
                     )
                 )
                 seq += len(batch)
+                if shipped_counter is not None:
+                    shipped_counter.inc(len(batch))
+                if tracer is not None:
+                    # a worker journey ends at the pipe: close sampled
+                    # traces here so they ride the next stats frame into
+                    # the parent ring (the parent pump re-samples its own
+                    # journeys on the decoded stream independently)
+                    now_mono = time.monotonic()
+                    for ev in batch:
+                        trace = ev.trace
+                        if trace is not None:
+                            trace.add_span("queue_wait", trace.queue_enter, now_mono)
+                            tracer.finish(trace, "shipped", end=now_mono)
+                            ev.trace = None  # the wire encode drops it anyway
                 commit_sent(batch)
             elif sharded.queue.depth() == 0:
                 # idle with an empty queue: everything the pumps saved rv
@@ -423,14 +493,16 @@ def _worker_entry(plan: WorkerPlan, conn) -> None:
             if now - last_stats >= plan.stats_interval_seconds:
                 last_stats = now
                 commit_quiescent()
-                conn.send_bytes(_pack({"stats": stats_payload()}))
+                conn.send_bytes(
+                    _pack({"stats": stats_payload(), "g": plan.generation})
+                )
                 persist()
         for view in rv_views.values():
             # end of stream: the queue is fully drained onto the pipe
             if view is not None:
                 view.commit()
         persist(force=True)
-        conn.send_bytes(_pack({"stats": stats_payload()}))
+        conn.send_bytes(_pack({"stats": stats_payload(), "g": plan.generation}))
         conn.send_bytes(_pack({"eos": True, "drained": stopping.is_set()}))
     except (BrokenPipeError, OSError):
         # parent died or closed the pipe: durable state first, then exit —
@@ -464,6 +536,7 @@ class _WorkerEndpoint(SupervisedEndpoint):
         *,
         metrics=None,
         heartbeat=None,
+        trace_ring=None,
         respawn_backoff: float = 0.5,
         respawn_backoff_max: float = 15.0,
     ):
@@ -480,6 +553,11 @@ class _WorkerEndpoint(SupervisedEndpoint):
             respawn_counter="ingest_worker_respawns",
             label="Ingest worker",
             respawn_note="resume from per-shard checkpoints",
+            process_label=f"ingest-shard-{plan.proc_index}",
+            trace_ring=trace_ring,
+            # the ad-hoc prefiltered fold below already owns the unlabeled
+            # events_prefiltered total — registry folding must not add it twice
+            rollup_exclude={"events_prefiltered"},
         )
         # cumulative ACROSS incarnations (a respawned worker's counters
         # restart at zero; parent-side totals must not)
@@ -487,10 +565,11 @@ class _WorkerEndpoint(SupervisedEndpoint):
         self._prefiltered_seen = 0
 
     def on_spawn(self) -> None:
+        super().on_spawn()  # reset registry-fold watermarks
         self._prefiltered_seen = 0  # per-incarnation cumulative counters
 
     def on_stats(self, stats: Dict[str, Any]) -> None:
-        self.last_stats = stats
+        super().on_stats(stats)  # fold exported registry sample + traces
         prefiltered = stats.get("prefiltered")
         if prefiltered is not None:
             delta = prefiltered - self._prefiltered_seen
@@ -541,6 +620,7 @@ class ProcessShardedWatchSource(ShardedWatchSource):
                 plan,
                 metrics=metrics,
                 heartbeat=heartbeat,
+                trace_ring=tracer.ring if tracer is not None else None,
                 respawn_backoff=respawn_backoff,
             )
             for plan in plans
@@ -572,6 +652,10 @@ class ProcessShardedWatchSource(ShardedWatchSource):
             "prefiltered": sum(e.prefiltered_total for e in self.endpoints),
             "hellos": [e.last_hello for e in self.endpoints],
         }
+
+    def process_report(self) -> List[Dict[str, Any]]:
+        """Per-worker supervision rows for ``/debug/processes``."""
+        return [e.report() for e in self.endpoints]
 
     def join(self, timeout: float = 5.0) -> None:
         """Bounded shutdown: give workers the drain grace, then hard-kill

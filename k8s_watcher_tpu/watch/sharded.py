@@ -241,6 +241,16 @@ class ShardedWatchSource:
             logger.exception("Shard %d watch stream died", shard)
             if self.metrics is not None:
                 self.metrics.counter("ingest_shard_stream_deaths").inc()
+            if tracer is not None:
+                # always-captured anomaly: in a worker process this rides
+                # the next stats frame into the parent's shared ring
+                trace = tracer.start_anomaly(
+                    uid=f"shard-{shard}", name=f"shard-{shard}",
+                    kind="watch_stream", t0=time.monotonic(),
+                )
+                if trace is not None:
+                    trace.shard = shard
+                    tracer.finish(trace, "failed")
         finally:
             with self._start_lock:
                 self._live_pumps -= 1

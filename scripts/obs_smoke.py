@@ -28,6 +28,17 @@ freshness & SLO contract the tentpole promises:
 6. **recovery** — churn resumes; the watermark re-advances and the
    breach clears once the slow window drains.
 
+Then the **multi-process leg** (``run_multiproc_smoke``): a third app
+with REAL worker processes on both tiers (``ingest.shards: 2`` +
+``ingest.processes: 2`` over a second mock apiserver,
+``federation.processes: 2`` over the upstream plus a never-connecting
+"ghost" upstream) gates the process-observability surfaces — worker-
+labeled series on the parent ``/metrics`` scrape, ``/debug/processes``
+reporting all four workers, a worker-side anomaly trace (the ghost's
+staleness verdict, captured inside a merge worker) queryable at the
+parent's ``/debug/trace?uid=``, and the ``/healthz`` BODY's worker-
+stats freshness fold.
+
 Artifact: ``artifacts/obs_smoke.json``. Exit 0 on PASS.
 
 The LATENCY gate on the same histograms (3-upstream p50/p99 budgets) is
@@ -146,12 +157,12 @@ def _start_app(config):
     return app, thread
 
 
-def _churn(server, stop: threading.Event, beat: float = 0.1) -> None:
+def _churn(server, stop: threading.Event, beat: float = 0.1, prefix: str = "obs-pod") -> None:
     phases = ("Running", "Pending")
     r = 0
     while not stop.is_set():
         for i in range(N_PODS):
-            server.cluster.set_phase("default", f"obs-pod-{i}", phases[r % 2])
+            server.cluster.set_phase("default", f"{prefix}-{i}", phases[r % 2])
         r += 1
         time.sleep(beat)
 
@@ -349,8 +360,191 @@ def run_smoke() -> dict:
     return result
 
 
+def _multiproc_config(tmp: Path, server_url: str, upstreams, status_port: int):
+    """App 3: REAL worker processes on both tiers — 2 ingest shard
+    readers over the mock apiserver and 2 federation merge workers —
+    with the worker registry/trace export on (the default)."""
+    kc_path = tmp / "mp-kubeconfig.json"
+    kc_path.write_text(json.dumps({
+        "apiVersion": "v1", "kind": "Config",
+        "clusters": [{"name": "m", "cluster": {"server": server_url}}],
+        "contexts": [{"name": "m", "context": {"cluster": "m", "user": "m"}}],
+        "current-context": "m",
+        "users": [{"name": "m", "user": {"token": "t"}}],
+    }))
+    config = load_config("development", str(REPO / "config"), env={})
+    return dataclasses.replace(
+        config,
+        kubernetes=dataclasses.replace(
+            config.kubernetes, use_mock=False, config_file=str(kc_path),
+            watch_timeout_seconds=5,
+        ),
+        clusterapi=dataclasses.replace(config.clusterapi, base_url=server_url),
+        watcher=dataclasses.replace(
+            config.watcher, status_port=status_port, status_auth_token=TOKEN,
+        ),
+        ingest=dataclasses.replace(config.ingest, shards=2, processes=2),
+        state=dataclasses.replace(
+            config.state, checkpoint_path=str(tmp / "mp-ckpt.json"),
+        ),
+        trace=dataclasses.replace(config.trace, enabled=True, sample_rate=4),
+        federation=dataclasses.replace(
+            config.federation,
+            enabled=True,
+            processes=2,
+            upstreams=tuple(upstreams),
+            stale_after_seconds=1.0,
+            resync_backoff_seconds=0.2,
+        ),
+    )
+
+
+def run_multiproc_smoke() -> dict:
+    """The multi-process leg: worker-labeled series render on the parent
+    scrape, /debug/processes reports the fleet, a worker-side anomaly
+    trace (never-connected "ghost" upstream going stale inside a merge
+    worker) lands in the parent's shared ring, and the /healthz BODY
+    folds worker-stats freshness."""
+    import tempfile
+
+    from k8s_watcher_tpu.watch.sharded import shard_of
+
+    result: dict = {"checks": {}}
+    checks = result["checks"]
+    # the ghost must hash to the OTHER merge worker so both spawn (an
+    # ownerless fan-in worker is not spawned at all)
+    ghost = next(
+        name for name in ("ghost-a", "ghost-b", "ghost-c", "ghost-d")
+        if shard_of(name, 2) != shard_of("cluster-a", 2)
+    )
+    result["ghost_upstream"] = ghost
+    expected = {
+        "ingest-shard-0", "ingest-shard-1", "merge-worker-0", "merge-worker-1",
+    }
+    with tempfile.TemporaryDirectory(prefix="obs-smoke-mp-") as tmp_str, \
+            MockApiServer() as server_a, MockApiServer() as server_b:
+        tmp = Path(tmp_str)
+        for i in range(N_PODS):
+            server_a.cluster.add_pod(build_pod(
+                f"obs-pod-{i}", "default", uid=f"obs-uid-{i}",
+                phase="Pending", tpu_chips=4,
+            ))
+            server_b.cluster.add_pod(build_pod(
+                f"obsm-pod-{i}", "default", uid=f"obsm-uid-{i}",
+                phase="Pending", tpu_chips=4,
+            ))
+        serve_port = _free_port()
+        status_m = _free_port()
+        upstream_app, upstream_thread = _start_app(
+            _upstream_config(tmp, server_a.url, serve_port)
+        )
+        mp_app = mp_thread = None
+        stop_churn = threading.Event()
+        churners = []
+        try:
+            mp_app, mp_thread = _start_app(_multiproc_config(
+                tmp, server_b.url,
+                [
+                    FederationUpstream(
+                        url=f"http://127.0.0.1:{serve_port}",
+                        name="cluster-a", token=TOKEN,
+                    ),
+                    # never connects: goes stale inside its merge worker
+                    # after the grace window -> worker-side anomaly trace
+                    FederationUpstream(
+                        url=f"http://127.0.0.1:{_free_port()}",
+                        name=ghost, token=TOKEN,
+                    ),
+                ],
+                status_m,
+            ))
+            for server, prefix in ((server_a, "obs-pod"), (server_b, "obsm-pod")):
+                t = threading.Thread(
+                    target=_churn, args=(server, stop_churn, 0.1, prefix),
+                    daemon=True,
+                )
+                t.start()
+                churners.append(t)
+
+            # the fleet spins up: all four workers alive with fresh stats
+            rows = []
+            deadline = time.monotonic() + DEADLINE_S * 2
+            while time.monotonic() < deadline:
+                try:
+                    body = _get(status_m, "/debug/processes").json()["processes"]
+                    rows = body["workers"]
+                    alive = {r["process"] for r in rows if r["alive"]}
+                    if alive >= expected:
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.3)
+            checks["debug_processes_reports_fleet"] = (
+                {r["process"] for r in rows if r["alive"]} >= expected
+                and all(r["generation"] >= 1 for r in rows)
+            )
+            result["process_rows"] = rows
+
+            # worker-labeled series render on the PARENT scrape
+            wanted = [f'process="{label}"' for label in expected]
+            missing = list(wanted)
+            deadline = time.monotonic() + DEADLINE_S
+            while missing and time.monotonic() < deadline:
+                text = _get(status_m, "/metrics", params={"format": "prometheus"}).text
+                missing = [w for w in wanted if w not in text]
+                if missing:
+                    time.sleep(0.5)
+            checks["worker_labeled_series_render"] = not missing
+            if missing:
+                result["missing_worker_series"] = missing
+            checks["ingest_shipped_series_render"] = (
+                'k8s_watcher_ingest_events_shipped_total{process="ingest-shard-' in text
+            )
+
+            # the ghost upstream's staleness verdict, captured INSIDE a
+            # merge worker, queryable at the parent's /debug/trace?uid=
+            traces = []
+            deadline = time.monotonic() + DEADLINE_S
+            while time.monotonic() < deadline:
+                traces = _get(
+                    status_m, "/debug/trace", params={"uid": ghost},
+                ).json().get("traces", [])
+                if traces:
+                    break
+                time.sleep(0.5)
+            checks["worker_anomaly_trace_in_parent_ring"] = bool(traces) and (
+                traces[0].get("anomaly") is True
+                and str(traces[0].get("process", "")).startswith("merge-worker-")
+            )
+            result["ghost_traces"] = traces[:2]
+
+            # /healthz BODY folds worker-stats freshness (alive workers
+            # report in well under the staleness threshold)
+            health = _get(status_m, "/healthz").json()
+            processes_fold = health.get("processes", {})
+            checks["healthz_processes_fold"] = (
+                health.get("alive") is True
+                and processes_fold.get("healthy") is True
+                and processes_fold.get("processes", 0) >= 4
+            )
+            result["healthz_processes"] = processes_fold
+        finally:
+            stop_churn.set()
+            for t in churners:
+                t.join(timeout=5)
+            for app, thread in ((mp_app, mp_thread), (upstream_app, upstream_thread)):
+                if app is not None:
+                    app.stop()
+                    thread.join(timeout=20)
+    return result
+
+
 def main() -> int:
     result = run_smoke()
+    mp = run_multiproc_smoke()
+    result["multiproc"] = {k: v for k, v in mp.items() if k != "checks"}
+    result["checks"].update(mp["checks"])
+    result["ok"] = bool(result["checks"]) and all(result["checks"].values())
     ARTIFACTS.mkdir(exist_ok=True)
     out = ARTIFACTS / "obs_smoke.json"
     out.write_text(json.dumps(result, indent=2) + "\n")

@@ -46,6 +46,10 @@ def test_bench_smoke_headline_within_budget():
     assert headline["max_sustained_events_per_sec"] >= 100_000, headline
     assert headline["ingest_procs_ok"] is True, headline
     assert headline.get("saturating_stage") is None, headline
+    # process observability: the worker registry/trace export costs <3%
+    # on the same sharded ingest path, with the parent's process-labeled
+    # fold summing exactly (details.proc_obs carries the number)
+    assert headline["proc_obs_ok"] is True, headline
     # egress plane: the ramp must produce a number + a verdict field, and
     # sustained notify throughput must stay >= 5x the r06 seed (520/s) —
     # the rebuilt plane measures 15-20k/s, so 2600 only trips on a real
@@ -159,6 +163,12 @@ def test_bench_smoke_headline_within_budget():
     assert procs["prefiltered"] == procs["expected_prefiltered"], procs
     assert procs["terminal_phases_ok"] and procs["respawns"] == 0, procs
     assert procs["saturating_stage"] is None, procs
+    # the export-overhead A/B behind proc_obs_ok: both arms correctness-
+    # gated, labeled fold exact, measured overhead under the 3% budget
+    proc_obs = detail["details"]["proc_obs"]
+    assert proc_obs["labeled_fold_exact"] is True, proc_obs
+    assert proc_obs["correctness_ok"] is True, proc_obs
+    assert proc_obs["overhead_pct"] < proc_obs["max_overhead_pct"], proc_obs
     # prefilter A/B: the correctness contract (identical terminal view,
     # same final checkpoint rv, monotone rv lines, frames actually
     # skipped) gates BEFORE the speedup — and is never retried away

@@ -791,7 +791,7 @@ class TestFreshnessPlane:
             plane.stop()
             s1.stop()
 
-    def test_labeled_gauges_default_legacy_off(self):
+    def test_labeled_gauges(self):
         (v1, s1) = _upstream_stack()
         reg = MetricsRegistry()
         gview = FleetView(metrics=reg)
@@ -802,24 +802,8 @@ class TestFreshnessPlane:
             plane.upstreams[0].update_gauges()
             text = reg.prometheus_text()
             assert 'k8s_watcher_federation_upstream_lag_rv{upstream="c0"} 0' in text
-            # suffix-mangled legacy series NOT emitted without the flag
+            # the pre-PR-10 suffix-mangled series are gone for good
             assert "federation_upstream_lag_rv_c0" not in text
-        finally:
-            s1.stop()
-
-    def test_legacy_suffix_names_flag_mirrors_gauges(self):
-        (v1, s1) = _upstream_stack()
-        reg = MetricsRegistry(legacy_suffix_names=True)
-        gview = FleetView(metrics=reg)
-        plane = FederationPlane(
-            _fed_config([f"http://127.0.0.1:{s1.port}"]), gview, metrics=reg,
-        )
-        try:
-            plane.upstreams[0].update_gauges()
-            text = reg.prometheus_text()
-            # both shapes tick for one release of dashboard continuity
-            assert 'k8s_watcher_federation_upstream_lag_rv{upstream="c0"} 0' in text
-            assert "k8s_watcher_federation_upstream_lag_rv_c0 0" in text
         finally:
             s1.stop()
 

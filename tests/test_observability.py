@@ -610,6 +610,54 @@ k8s_watcher_hop_seconds_sum{upstream="a"} 0.002
 k8s_watcher_hop_seconds_count{upstream="a"} 1
 """
 
+PROCESS_GOLDEN_EXPOSITION = """\
+# TYPE k8s_watcher_deltas_shipped_total counter
+k8s_watcher_deltas_shipped_total{cluster="a",process="ingest-shard-0"} 2
+k8s_watcher_deltas_shipped_total{process="ingest-shard-0"} 0
+# TYPE k8s_watcher_events_decoded_total counter
+k8s_watcher_events_decoded_total 7
+k8s_watcher_events_decoded_total{process="ingest-shard-0"} 7
+# TYPE k8s_watcher_queue_depth gauge
+k8s_watcher_queue_depth{process="ingest-shard-0"} 3
+# TYPE k8s_watcher_decode_seconds histogram
+k8s_watcher_decode_seconds_bucket{le="1e-05"} 0
+k8s_watcher_decode_seconds_bucket{le="3.16e-05"} 0
+k8s_watcher_decode_seconds_bucket{le="0.0001"} 0
+k8s_watcher_decode_seconds_bucket{le="0.000316"} 0
+k8s_watcher_decode_seconds_bucket{le="0.001"} 0
+k8s_watcher_decode_seconds_bucket{le="0.00316"} 1
+k8s_watcher_decode_seconds_bucket{le="0.01"} 1
+k8s_watcher_decode_seconds_bucket{le="0.0316"} 1
+k8s_watcher_decode_seconds_bucket{le="0.1"} 1
+k8s_watcher_decode_seconds_bucket{le="0.316"} 1
+k8s_watcher_decode_seconds_bucket{le="1"} 1
+k8s_watcher_decode_seconds_bucket{le="3.16"} 1
+k8s_watcher_decode_seconds_bucket{le="10"} 1
+k8s_watcher_decode_seconds_bucket{le="31.6"} 1
+k8s_watcher_decode_seconds_bucket{le="100"} 1
+k8s_watcher_decode_seconds_bucket{le="+Inf"} 1
+k8s_watcher_decode_seconds_sum 0.002
+k8s_watcher_decode_seconds_count 1
+k8s_watcher_decode_seconds_bucket{process="ingest-shard-0",le="1e-05"} 0
+k8s_watcher_decode_seconds_bucket{process="ingest-shard-0",le="3.16e-05"} 0
+k8s_watcher_decode_seconds_bucket{process="ingest-shard-0",le="0.0001"} 0
+k8s_watcher_decode_seconds_bucket{process="ingest-shard-0",le="0.000316"} 0
+k8s_watcher_decode_seconds_bucket{process="ingest-shard-0",le="0.001"} 0
+k8s_watcher_decode_seconds_bucket{process="ingest-shard-0",le="0.00316"} 1
+k8s_watcher_decode_seconds_bucket{process="ingest-shard-0",le="0.01"} 1
+k8s_watcher_decode_seconds_bucket{process="ingest-shard-0",le="0.0316"} 1
+k8s_watcher_decode_seconds_bucket{process="ingest-shard-0",le="0.1"} 1
+k8s_watcher_decode_seconds_bucket{process="ingest-shard-0",le="0.316"} 1
+k8s_watcher_decode_seconds_bucket{process="ingest-shard-0",le="1"} 1
+k8s_watcher_decode_seconds_bucket{process="ingest-shard-0",le="3.16"} 1
+k8s_watcher_decode_seconds_bucket{process="ingest-shard-0",le="10"} 1
+k8s_watcher_decode_seconds_bucket{process="ingest-shard-0",le="31.6"} 1
+k8s_watcher_decode_seconds_bucket{process="ingest-shard-0",le="100"} 1
+k8s_watcher_decode_seconds_bucket{process="ingest-shard-0",le="+Inf"} 1
+k8s_watcher_decode_seconds_sum{process="ingest-shard-0"} 0.002
+k8s_watcher_decode_seconds_count{process="ingest-shard-0"} 1
+"""
+
 
 class TestLabeledMetrics:
     """First-class Prometheus labels (PR 10): Counter/Gauge/Histogram
@@ -635,6 +683,25 @@ class TestLabeledMetrics:
         assert reg.prometheus_text() == LABELED_GOLDEN_EXPOSITION
         # ...and byte-stable across scrapes (the sorted-name cache)
         assert reg.prometheus_text() == LABELED_GOLDEN_EXPOSITION
+
+    def test_process_labeled_exposition_is_byte_stable(self):
+        # the fold_sample golden: a worker registry sample folded under
+        # a process label renders process-labeled children next to exact
+        # unlabeled rollups — counters always register the child (idle
+        # workers stay visible at 0), gauges/worker-labeled series stay
+        # child-only, histograms fold cum-bucket deltas into both
+        worker = MetricsRegistry()
+        worker.counter("events_decoded").inc(7)
+        worker.counter("deltas_shipped").labels(cluster="a").inc(2)
+        worker.gauge("queue_depth").set(3)
+        worker.histogram("decode_seconds").record(0.002)
+        parent = MetricsRegistry()
+        parent.fold_sample(
+            worker.sample(include_series=True),
+            process="ingest-shard-0", watermarks={},
+        )
+        assert parent.prometheus_text() == PROCESS_GOLDEN_EXPOSITION
+        assert parent.prometheus_text() == PROCESS_GOLDEN_EXPOSITION
 
     def test_same_label_set_returns_same_child(self):
         reg = MetricsRegistry()

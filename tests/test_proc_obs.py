@@ -1,0 +1,231 @@
+"""Fleet-wide process observability (worker registry export over the
+procpool wire): generation-guarded stats folding, process-labeled
+counter exactness through a SIGKILL->respawn, and cross-process trace
+import into the parent ring.
+
+Scripted worker targets live at module level: multiprocessing's spawn
+start method re-imports this module in the child to resolve them."""
+
+import os
+import random
+import signal
+import threading
+import time
+
+import pytest
+
+from k8s_watcher_tpu.metrics import MetricsRegistry
+from k8s_watcher_tpu.parallel.procpool import SupervisedEndpoint, pack
+from k8s_watcher_tpu.trace.trace import Tracer
+from k8s_watcher_tpu.watch.fake import FakeWatchSource, build_pod, shard_streams
+from k8s_watcher_tpu.watch.procpool import ProcessShardedWatchSource, WorkerPlan
+from k8s_watcher_tpu.watch.source import WatchEvent
+
+
+def _events(n: int, prefix: str = "po"):
+    return [
+        WatchEvent(
+            type="ADDED",
+            pod=build_pod(
+                f"{prefix}-{i}", uid=f"{prefix}-uid-{i}",
+                resource_version=str(i + 1), tpu_chips=4,
+            ),
+            resource_version=str(i + 1),
+        )
+        for i in range(n)
+    ]
+
+
+def holdopen_factory(plan):
+    """Hold-open streams: replay then stay alive (kill targets; a
+    respawned incarnation replays from the start — no checkpoints)."""
+    n, shards = plan.factory_arg
+    streams = shard_streams(_events(n), shards)
+    return [
+        FakeWatchSource(streams[s], delay_seconds=0.005, hold_open=True)
+        for s in plan.owned_shards
+    ]
+
+
+def replay_factory(plan):
+    n, shards = plan.factory_arg
+    streams = shard_streams(_events(n), shards)
+    return [FakeWatchSource(streams[s]) for s in plan.owned_shards]
+
+
+def _plans(procs, shards, factory, arg, **extra):
+    return [
+        WorkerPlan(
+            proc_index=p, processes=procs,
+            owned_shards=tuple(range(shards))[p::procs], shards=shards,
+            source_factory=factory, factory_arg=arg, **extra,
+        )
+        for p in range(procs)
+    ]
+
+
+# -- scripted stale-frame worker ---------------------------------------------
+
+
+def _scripted_stale_entry(plan, conn):
+    """Sends one good-generation stats frame, then the SAME cumulative
+    sample stamped with the PREVIOUS generation — the shape of a stale
+    frame drained off a killed worker's pipe after a respawn. Folding it
+    would double-count (the fresh watermarks have been reset)."""
+    reg = MetricsRegistry()
+    reg.counter("scripted_work").inc(5)
+    tracer = Tracer(sample_rate=0, ring_size=8, metrics=reg, export_buffer=None)
+    trace = tracer.start_anomaly(uid="po-uid-3", name="po-3", kind="pod")
+    from k8s_watcher_tpu.trace.trace import export_trace
+
+    tracer.finish(trace, "failed")
+    sample = reg.sample(include_series=True)
+    conn.send_bytes(pack({"hello": {"proc": plan.proc_index, "pid": os.getpid()}}))
+    conn.send_bytes(pack({
+        "stats": {"registry": sample, "traces": [export_trace(trace)]},
+        "g": plan.generation,
+    }))
+    conn.send_bytes(pack({"stats": {"registry": sample}, "g": plan.generation - 1}))
+    conn.send_bytes(pack({"eos": True, "drained": True}))
+    conn.close()
+
+
+class TestGenerationGuard:
+    def test_stale_generation_frame_is_discarded(self):
+        metrics = MetricsRegistry()
+        parent_ring_tracer = Tracer(sample_rate=0, ring_size=16)
+        ep = SupervisedEndpoint(
+            WorkerPlan(proc_index=0, processes=1, owned_shards=(0,), shards=1),
+            target=_scripted_stale_entry, name="scripted-0", index=0,
+            metrics=metrics, process_label="scripted-0",
+            trace_ring=parent_ring_tracer.ring,
+        )
+        for _ in ep.frames():  # no payload frames; drives the stats fold
+            pass
+        # exactly one frame folded; the stale-generation one discarded, visibly
+        assert ep.stats_frames == 1
+        assert ep.stale_stats_discarded == 1
+        assert metrics.counter("procpool_stale_stats_discarded").value == 1
+        # the counter folded ONCE: labeled child and unlabeled rollup both 5
+        family = metrics.counter("scripted_work")
+        assert family.labels(process="scripted-0").value == 5
+        assert family.value == 5
+        # the worker's anomaly trace crossed the wire into the parent ring
+        found = parent_ring_tracer.ring.snapshot(uid="po-uid-3")
+        assert found and found[0]["process"] == "scripted-0"
+        assert found[0]["anomaly"] is True
+        assert ep.traces_imported == 1
+        assert metrics.counter("process_traces_imported").value == 1
+        # /debug/processes row shape
+        row = ep.report()
+        assert row["process"] == "scripted-0"
+        assert row["generation"] == 1 and row["stats_frames"] == 1
+        assert row["stale_stats_discarded"] == 1
+        assert row["last_stats_age_seconds"] is not None
+
+
+# -- live multi-process export ------------------------------------------------
+
+
+class TestWorkerRegistryExport:
+    def test_shipped_counter_and_traces_reach_parent(self):
+        # finite replay: workers sample 1-in-4 journeys, finish them as
+        # "shipped" at the pipe, and the final pre-EOS stats frame drains
+        # the export buffer — so after a clean EOS everything has landed
+        metrics = MetricsRegistry()
+        tracer = Tracer(sample_rate=0, ring_size=64, metrics=metrics)
+        source = ProcessShardedWatchSource(
+            _plans(2, 2, replay_factory, (40, 2), trace_sample_rate=4),
+            metrics=metrics, tracer=tracer,
+        )
+        got = []
+        for batch in source.batches():
+            got.extend(batch)
+        assert len(got) == 40
+        family = metrics.counter("ingest_events_shipped")
+        streams = shard_streams(_events(40), 2)
+        for p in range(2):
+            assert family.labels(process=f"ingest-shard-{p}").value == len(streams[p])
+        assert family.value == 40  # unlabeled rollup stays exact
+        imported = tracer.ring.snapshot()
+        assert imported, "worker traces should land in the parent ring"
+        assert {t["process"] for t in imported} <= {"ingest-shard-0", "ingest-shard-1"}
+        assert all(t["outcome"] == "shipped" for t in imported)
+        assert all(
+            any(s["stage"] == "queue_wait" for s in t["spans"]) for t in imported
+        )
+        # supervision rows for /debug/processes
+        rows = source.process_report()
+        assert [r["process"] for r in rows] == ["ingest-shard-0", "ingest-shard-1"]
+        # hottest-series decoration ranks the shipped counter
+        hot = metrics.hottest_series("ingest-shard-0", 3)
+        assert any(r["series"] == "ingest_events_shipped" for r in hot)
+
+    def test_export_off_ships_no_registry(self):
+        metrics = MetricsRegistry()
+        source = ProcessShardedWatchSource(
+            _plans(1, 1, replay_factory, (10, 1), export_registry=False),
+            metrics=metrics,
+        )
+        for _ in source.batches():
+            pass
+        assert "ingest_events_shipped" not in metrics.dump()
+        assert source.worker_stats()["events_delivered"] == 10
+
+
+SEEDS = [11, 23, 47]
+
+
+class TestCounterExactnessThroughRespawn:
+    """Property (3 seeds): after a SIGKILL mid-run, the parent-aggregated
+    process-labeled counter total equals EXACTLY the sum of what each
+    worker incarnation itself counted — the generation watermarks never
+    double-count a drained stale frame and never step backwards."""
+
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_labeled_totals_match_worker_samples(self, seed):
+        rng = random.Random(seed)
+        n = rng.randrange(30, 70)
+        metrics = MetricsRegistry()
+        source = ProcessShardedWatchSource(
+            _plans(2, 2, holdopen_factory, (n, 2)),
+            metrics=metrics, respawn_backoff=0.2,
+        )
+        streams = shard_streams(_events(n), 2)
+        k0, k1 = len(streams[0]), len(streams[1])
+        family = metrics.counter("ingest_events_shipped")
+        child0 = family.labels(process="ingest-shard-0")
+        child1 = family.labels(process="ingest-shard-1")
+        consumer = threading.Thread(
+            target=lambda: [None for _ in source.batches()], daemon=True
+        )
+        consumer.start()
+        deadline = time.monotonic() + 30.0
+        try:
+            # wait until the parent has folded incarnation 1's FULL count
+            while time.monotonic() < deadline:
+                if child0.value == k0 and child1.value == k1:
+                    break
+                time.sleep(0.05)
+            assert (child0.value, child1.value) == (k0, k1)
+            victim = source.worker_pids()[0]
+            os.kill(victim, signal.SIGKILL)
+            # incarnation 2 replays from scratch (no checkpoints): the
+            # labeled total must land on exactly 2*k0 — a double-folded
+            # stale frame would overshoot, a backwards fold undershoot
+            while time.monotonic() < deadline:
+                assert child0.value <= 2 * k0, "double-counted a stale frame"
+                if child0.value == 2 * k0:
+                    break
+                time.sleep(0.05)
+            assert child0.value == 2 * k0
+            time.sleep(0.7)  # one more stats period: totals must hold
+            assert child0.value == 2 * k0
+            assert child1.value == k1
+            assert family.value == 2 * k0 + k1  # unlabeled == sum of samples
+            assert source.worker_stats()["respawns"] >= 1
+        finally:
+            source.stop()
+            source.join(10.0)
+            consumer.join(timeout=10.0)
+        assert not consumer.is_alive()

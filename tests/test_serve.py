@@ -1234,26 +1234,10 @@ class TestSnapshotCodecCache:
         assert reg.counter("serve_snapshot_cache_hits").labels(codec="msgpack").value == 1
         assert reg.counter("serve_snapshot_cache_hits").value == 2
         assert reg.counter("serve_snapshot_cache_misses").value == 2
-        # the legacy suffixed names are NOT emitted by default...
-        assert reg.counter("serve_snapshot_cache_hits_json").value == 0
         # a publish invalidates BOTH codec entries by bumping rv
         view.apply("pod", "a", {"kind": "pod", "key": "a", "seq": 1})
         assert view.snapshot_bytes() is not bj
         assert view.snapshot_bytes(codec=CODEC_MSGPACK) is not bm
-
-    def test_legacy_suffix_names_flag_mirrors_old_series(self):
-        # metrics.legacy_suffix_names: one release of dashboard
-        # continuity — the old suffix-mangled series keep ticking
-        # ALONGSIDE the labeled ones
-        reg = MetricsRegistry(legacy_suffix_names=True)
-        view = FleetView(metrics=reg)
-        view.apply("pod", "a", {"kind": "pod", "key": "a", "seq": 0})
-        view.snapshot_bytes()
-        view.snapshot_bytes()
-        assert reg.counter("serve_snapshot_cache_misses_json").value == 1
-        assert reg.counter("serve_snapshot_cache_hits_json").value == 1
-        assert reg.counter("serve_snapshot_cache_misses").labels(codec="json").value == 1
-        assert reg.counter("serve_snapshot_cache_hits").labels(codec="json").value == 1
 
 
 class TestFreshnessStamps:
